@@ -1,0 +1,21 @@
+"""nomad_tpu — a TPU-native scheduling framework with the capabilities of
+HashiCorp Nomad's service/batch scheduler (reference: alexandredantas/nomad).
+
+Layout (mirrors SURVEY.md §2's layer map, re-designed TPU-first):
+  structs/    data model + scoring/capacity oracles   (ref: nomad/structs)
+  state/      in-memory state store w/ MVCC snapshots (ref: nomad/state)
+  mock/       canonical test fixtures                 (ref: nomad/mock)
+  scheduler/  reconciler, generic/system schedulers,
+              harness, preemption                     (ref: scheduler/)
+  pack/       host->device lowering: interning,
+              packed tensors, constraint lowering     (new, TPU-first)
+  ops/        JAX kernels: feasibility masks,
+              bin-pack/spread scoring, top-k select   (new, TPU-first)
+  parallel/   Mesh sharding, psum'd spread counts,
+              two-stage top-k over ICI                (new, TPU-first)
+  core/       eval broker, blocked evals, plan queue,
+              plan applier, eval workers              (ref: nomad/)
+  utils/      misc helpers
+"""
+
+__version__ = "0.1.0"

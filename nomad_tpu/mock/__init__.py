@@ -1,0 +1,235 @@
+"""Canonical test fixtures (reference: nomad/mock/mock.go).
+
+`mock.node()`, `mock.job()`, `mock.batch_job()`, `mock.system_job()`,
+`mock.alloc()`, `mock.eval()` — the shared objects every scheduler test
+starts from, replicated early per SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from nomad_tpu.structs import (
+    Affinity,
+    Allocation,
+    AllocMetric,
+    Constraint,
+    Evaluation,
+    Job,
+    JOB_TYPE_BATCH,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSBATCH,
+    JOB_TYPE_SYSTEM,
+    Node,
+    NodeResources,
+    NodeReservedResources,
+    OP_EQ,
+    ReschedulePolicy,
+    Resources,
+    RestartPolicy,
+    Spread,
+    SpreadTarget,
+    Task,
+    TaskGroup,
+    UpdateStrategy,
+    alloc_name,
+    compute_class,
+    new_id,
+)
+
+_counter = itertools.count()
+
+
+def node(**overrides) -> Node:
+    """reference: mock.Node — 4000MHz cpu / 8192MB mem / 100GB disk,
+    linux/amd64, docker+exec drivers."""
+    i = next(_counter)
+    n = Node(
+        name=f"node-{i}",
+        datacenter="dc1",
+        node_pool="default",
+        node_class="",
+        attributes={
+            "kernel.name": "linux",
+            "arch": "amd64",
+            "cpu.arch": "amd64",
+            "os.name": "ubuntu",
+            "os.version": "22.04",
+            "driver.docker": "1",
+            "driver.exec": "1",
+            "nomad.version": "1.6.0",
+            "unique.hostname": f"node-{i}",
+        },
+        resources=NodeResources(cpu=4000, memory_mb=8192, disk_mb=100 * 1024),
+        reserved=NodeReservedResources(cpu=100, memory_mb=256),
+        drivers={"docker": True, "exec": True, "raw_exec": True, "mock": True},
+    )
+    for k, v in overrides.items():
+        setattr(n, k, v)
+    n.computed_class = compute_class(n)
+    return n
+
+
+def job(**overrides) -> Job:
+    """reference: mock.Job — service job, 1 task group, count=10,
+    500MHz/256MB web task, kernel.name=linux constraint."""
+    j = Job(
+        id=f"mock-service-{new_id()[:8]}",
+        name="my-job",
+        type=JOB_TYPE_SERVICE,
+        priority=50,
+        datacenters=["dc1"],
+        constraints=[Constraint("${attr.kernel.name}", OP_EQ, "linux")],
+        task_groups=[
+            TaskGroup(
+                name="web",
+                count=10,
+                restart_policy=RestartPolicy(attempts=3, interval_s=600,
+                                             delay_s=60, mode="delay"),
+                reschedule_policy=ReschedulePolicy(
+                    attempts=2, interval_s=600, delay_s=30,
+                    delay_function="exponential", max_delay_s=3600,
+                    unlimited=False),
+                tasks=[
+                    Task(
+                        name="web",
+                        driver="exec",
+                        config={"command": "/bin/date"},
+                        resources=Resources(cpu=500, memory_mb=256),
+                    )
+                ],
+            )
+        ],
+        update=UpdateStrategy(max_parallel=1),
+        status="pending",
+        version=0,
+    )
+    for k, v in overrides.items():
+        setattr(j, k, v)
+    return j
+
+
+def batch_job(**overrides) -> Job:
+    """reference: mock.BatchJob"""
+    j = Job(
+        id=f"mock-batch-{new_id()[:8]}",
+        name="batch-job",
+        type=JOB_TYPE_BATCH,
+        priority=50,
+        datacenters=["dc1"],
+        task_groups=[
+            TaskGroup(
+                name="worker",
+                count=10,
+                restart_policy=RestartPolicy(attempts=3, interval_s=600,
+                                             delay_s=15, mode="delay"),
+                reschedule_policy=ReschedulePolicy(
+                    attempts=2, interval_s=600, delay_s=5,
+                    delay_function="constant", unlimited=False),
+                tasks=[
+                    Task(
+                        name="worker",
+                        driver="mock",
+                        config={"run_for": "500ms"},
+                        resources=Resources(cpu=100, memory_mb=100),
+                    )
+                ],
+            )
+        ],
+        status="pending",
+    )
+    for k, v in overrides.items():
+        setattr(j, k, v)
+    return j
+
+
+def system_job(**overrides) -> Job:
+    """reference: mock.SystemJob"""
+    j = Job(
+        id=f"mock-system-{new_id()[:8]}",
+        name="my-sysjob",
+        type=JOB_TYPE_SYSTEM,
+        priority=100,
+        datacenters=["dc1"],
+        constraints=[Constraint("${attr.kernel.name}", OP_EQ, "linux")],
+        task_groups=[
+            TaskGroup(
+                name="web",
+                count=1,
+                restart_policy=RestartPolicy(attempts=3, interval_s=600,
+                                             delay_s=60, mode="delay"),
+                reschedule_policy=None,
+                tasks=[
+                    Task(
+                        name="web",
+                        driver="exec",
+                        config={"command": "/bin/date"},
+                        resources=Resources(cpu=500, memory_mb=256),
+                    )
+                ],
+            )
+        ],
+        status="pending",
+    )
+    for k, v in overrides.items():
+        setattr(j, k, v)
+    return j
+
+
+def sysbatch_job(**overrides) -> Job:
+    j = system_job(**overrides)
+    if "id" not in overrides:
+        j.id = f"mock-sysbatch-{new_id()[:8]}"
+    j.type = JOB_TYPE_SYSBATCH
+    j.priority = overrides.get("priority", 50)
+    return j
+
+
+def spread_job(**overrides) -> Job:
+    """Service job with spread + affinity stanzas (BASELINE config #3)."""
+    j = job(**overrides)
+    j.datacenters = ["dc1", "dc2", "dc3"]
+    j.spreads = [Spread(attribute="${node.datacenter}", weight=100,
+                        targets=(SpreadTarget("dc1", 50),
+                                 SpreadTarget("dc2", 30),
+                                 SpreadTarget("dc3", 20)))]
+    j.affinities = [Affinity("${attr.os.name}", OP_EQ, "ubuntu", weight=50)]
+    return j
+
+
+def alloc(**overrides) -> Allocation:
+    """reference: mock.Alloc — running service alloc on a mock job."""
+    j = overrides.pop("job", None) or job()
+    tg = j.task_groups[0]
+    a = Allocation(
+        namespace=j.namespace,
+        eval_id=new_id(),
+        name=alloc_name(j.id, tg.name, 0),
+        node_id="",
+        job_id=j.id,
+        job=j,
+        task_group=tg.name,
+        resources=tg.combined_resources(),
+        desired_status="run",
+        client_status="pending",
+        job_version=j.version,
+        metrics=AllocMetric(),
+    )
+    for k, v in overrides.items():
+        setattr(a, k, v)
+    return a
+
+
+def eval(**overrides) -> Evaluation:  # noqa: A001 - matches reference name
+    """reference: mock.Eval"""
+    e = Evaluation(
+        namespace="default",
+        priority=50,
+        type=JOB_TYPE_SERVICE,
+        job_id=new_id(),
+        status="pending",
+    )
+    for k, v in overrides.items():
+        setattr(e, k, v)
+    return e

@@ -1,0 +1,13 @@
+"""Scheduler package (reference: scheduler/)."""
+
+from .base import (  # noqa: F401
+    BUILTIN_SCHEDULERS,
+    Planner,
+    Scheduler,
+    new_scheduler,
+    register_scheduler,
+)
+from .testing import Harness  # noqa: F401
+
+# Register built-in schedulers on import (factories defined in P4).
+from . import _register  # noqa: F401,E402

@@ -1,0 +1,23 @@
+"""Built-in scheduler registration (reference: scheduler.BuiltinSchedulers).
+
+Populated as scheduler implementations land; importing this module wires the
+factory map.
+"""
+
+from .base import register_scheduler
+
+try:
+    from .generic import new_batch_scheduler, new_service_scheduler
+    register_scheduler("service", new_service_scheduler)
+    register_scheduler("service-tpu", new_service_scheduler)
+    register_scheduler("batch", new_batch_scheduler)
+    register_scheduler("batch-tpu", new_batch_scheduler)
+except ImportError:  # pragma: no cover - during early bootstrap
+    pass
+
+try:
+    from .system import new_sysbatch_scheduler, new_system_scheduler
+    register_scheduler("system", new_system_scheduler)
+    register_scheduler("sysbatch", new_sysbatch_scheduler)
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,67 @@
+"""Scheduler interfaces and factory (reference: scheduler/scheduler.go).
+
+The two narrow seams the scheduler touches the rest of the system through
+(SURVEY.md §2):
+
+  - `State`  — read-only snapshot access (``nomad_tpu.state.StateSnapshot``
+    satisfies it structurally; any object with the same methods works).
+  - `Planner` — submit plans / update evals.  In production the eval worker
+    (nomad_tpu.core.worker); in tests the Harness (scheduler/testing.py).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from nomad_tpu.structs import Evaluation, Plan, PlanResult
+
+
+class Planner(Protocol):
+    """reference: scheduler.Planner"""
+
+    def submit_plan(self, plan: Plan) -> Tuple[Optional[PlanResult], object, Optional[Exception]]:
+        """Returns (result, new_state_or_None, err).  new_state is a refreshed
+        State snapshot when the plan was only partially committed and the
+        scheduler should retry against newer state."""
+        ...
+
+    def update_eval(self, evaluation: Evaluation) -> None: ...
+
+    def create_eval(self, evaluation: Evaluation) -> None: ...
+
+    def reblock_eval(self, evaluation: Evaluation) -> None: ...
+
+    def serves_plan(self) -> bool:
+        """ServersMeetMinimumVersion analog — always true here."""
+        return True
+
+
+class Scheduler(abc.ABC):
+    """reference: scheduler.Scheduler interface"""
+
+    @abc.abstractmethod
+    def process(self, evaluation: Evaluation) -> Optional[Exception]:
+        ...
+
+
+SchedulerFactory = Callable[..., Scheduler]
+
+# reference: scheduler.BuiltinSchedulers + NewScheduler factory map.  The
+# TPU-backed schedulers register under both the stock names (they ARE the
+# implementation in this framework) and the explicit -tpu aliases the
+# north-star prescribes.
+BUILTIN_SCHEDULERS: Dict[str, SchedulerFactory] = {}
+
+
+def register_scheduler(name: str, factory: SchedulerFactory) -> None:
+    BUILTIN_SCHEDULERS[name] = factory
+
+
+def new_scheduler(name: str, state, planner: Planner, **kwargs) -> Scheduler:
+    """reference: scheduler.NewScheduler"""
+    try:
+        factory = BUILTIN_SCHEDULERS[name]
+    except KeyError:
+        raise ValueError(f"unknown scheduler '{name}'") from None
+    return factory(state, planner, **kwargs)

@@ -1,0 +1,90 @@
+"""Scheduler test harness (reference: scheduler/testing.go).
+
+`Harness` = a real in-memory StateStore + a fake Planner whose submit_plan
+applies results through `state.upsert_plan_results` — the full scheduler runs
+in-process with no broker, no RPC, no cluster.  This is THE testing pattern
+per SURVEY.md §5 and is also what bench.py drives.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Evaluation,
+    Plan,
+    PlanResult,
+)
+
+from .base import Planner, Scheduler, new_scheduler
+
+
+class Harness:
+    """reference: scheduler.Harness / NewHarness"""
+
+    def __init__(self, state: Optional[StateStore] = None) -> None:
+        self.state = state or StateStore()
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []          # update_eval calls
+        self.create_evals: List[Evaluation] = []
+        self.reblock_evals: List[Evaluation] = []
+        self._lock = threading.Lock()
+        # When set, submit_plan only records the plan without applying it
+        # (the `nomad job plan` dry-run / annotation path).
+        self.no_submit = False
+
+    # ------------------------------------------------------------ Planner
+
+    def submit_plan(self, plan: Plan
+                    ) -> Tuple[Optional[PlanResult], object, Optional[Exception]]:
+        with self._lock:
+            self.plans.append(plan)
+        if self.no_submit:
+            return PlanResult(), None, None
+        result = PlanResult(
+            node_update=plan.node_update,
+            node_allocation=plan.node_allocation,
+            node_preemptions=plan.node_preemptions,
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+        )
+        index = self.state.upsert_plan_results(plan, result)
+        result.alloc_index = index
+        return result, None, None
+
+    def update_eval(self, evaluation: Evaluation) -> None:
+        with self._lock:
+            self.evals.append(evaluation)
+
+    def create_eval(self, evaluation: Evaluation) -> None:
+        with self._lock:
+            self.create_evals.append(evaluation)
+
+    def reblock_eval(self, evaluation: Evaluation) -> None:
+        with self._lock:
+            self.reblock_evals.append(evaluation)
+
+    def serves_plan(self) -> bool:
+        return True
+
+    # ------------------------------------------------------------ driving
+
+    def snapshot(self):
+        return self.state.snapshot()
+
+    def process(self, scheduler_name: str, evaluation: Evaluation,
+                **kwargs) -> Optional[Exception]:
+        """reference: Harness.Process — snapshot state, build the scheduler,
+        run one eval through it."""
+        sched: Scheduler = new_scheduler(scheduler_name, self.snapshot(),
+                                         self, **kwargs)
+        return sched.process(evaluation)
+
+    # ------------------------------------------------------------- asserts
+
+    def assert_eval_status(self, want: str) -> None:
+        assert len(self.evals) > 0, "no eval updates"
+        got = self.evals[-1].status
+        assert got == want, f"eval status {got!r} != {want!r}"

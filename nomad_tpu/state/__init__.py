@@ -1,0 +1,3 @@
+"""State store (reference: nomad/state)."""
+
+from .state_store import StateSnapshot, StateStore  # noqa: F401

@@ -1,0 +1,502 @@
+"""In-memory cluster state store with MVCC snapshots.
+
+Reference semantics: `nomad/state/state_store.go` (go-memdb immutable radix
+trees).  Re-designed for this framework: plain dict tables with strict
+copy-on-write discipline — write paths copy incoming objects on insert (the
+embedded `Allocation.job` pointer is shared; jobs are immutable by
+discipline once stored), objects already in tables are never mutated, and
+every write bumps a monotonically increasing index (the Raft-log-index
+analog).  `snapshot()` is O(#tables + touched buckets), returning a
+`StateSnapshot` that is immutable by construction and is exactly what
+schedulers read (the `scheduler.State` seam, SURVEY.md §2).
+
+Device tensors (nomad_tpu.pack) are a cache of a snapshot at some index and
+are rebuildable from here at any time (checkpoint/resume, SURVEY.md §6.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from nomad_tpu.structs import (
+    Allocation,
+    CSIVolume,
+    Deployment,
+    Evaluation,
+    Job,
+    JOB_STATUS_DEAD,
+    JOB_STATUS_PENDING,
+    Namespace,
+    Node,
+    NodePool,
+    Plan,
+    PlanResult,
+    SchedulerConfiguration,
+    compute_class,
+)
+
+
+class StateStore:
+    """All cluster state.  Thread-safe; single writer at a time."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._index_cv = threading.Condition(self._lock)
+        self._index = 0
+        # primary tables: id -> object
+        self._nodes: Dict[str, Node] = {}
+        self._jobs: Dict[Tuple[str, str], Job] = {}          # (ns, id)
+        self._job_versions: Dict[Tuple[str, str], Dict[int, Job]] = {}
+        self._evals: Dict[str, Evaluation] = {}
+        self._allocs: Dict[str, Allocation] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        self._namespaces: Dict[str, Namespace] = {"default": Namespace()}
+        self._node_pools: Dict[str, NodePool] = {
+            "default": NodePool("default"), "all": NodePool("all")}
+        self._csi_volumes: Dict[Tuple[str, str], CSIVolume] = {}
+        self._scheduler_config = SchedulerConfiguration()
+        # secondary indexes (bucket dicts are copy-on-write)
+        self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], Dict[str, Allocation]] = {}
+        self._evals_by_job: Dict[Tuple[str, str], Dict[str, Evaluation]] = {}
+        # listeners for state-change events (event broker seam, SURVEY §6.5)
+        self._listeners: List[Callable[[str, int, object], None]] = []
+
+    # ------------------------------------------------------------- indexes
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def _bump(self) -> int:
+        self._index += 1
+        self._index_cv.notify_all()
+        return self._index
+
+    def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
+        """Block until the store has applied at least `index` (the eval
+        worker's waitForIndex, reference: nomad/worker.go)."""
+        with self._index_cv:
+            return self._index_cv.wait_for(lambda: self._index >= index,
+                                           timeout=timeout)
+
+    def subscribe(self, fn: Callable[[str, int, object], None]) -> None:
+        """fn(topic, index, payload) on every commit (event stream seam).
+        Listeners fire after tables are assigned, so re-entrant reads see the
+        committed data; a raising listener cannot abort a commit."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _emit(self, topic: str, index: int, payload: object) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(topic, index, payload)
+            except Exception:  # noqa: BLE001 - listener isolation
+                pass
+
+    # --------------------------------------------------------------- nodes
+
+    def upsert_node(self, node: Node) -> int:
+        with self._lock:
+            idx = self._bump()
+            prev = self._nodes.get(node.id)
+            node = node.copy()
+            node.create_index = prev.create_index if prev else idx
+            node.modify_index = idx
+            # Always recompute: a stale class hash would poison per-class
+            # feasibility caching after attribute changes.
+            node.computed_class = compute_class(node)
+            self._nodes = {**self._nodes, node.id: node}
+            self._emit("Node", idx, node)
+            return idx
+
+    def delete_node(self, node_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            nodes = dict(self._nodes)
+            nodes.pop(node_id, None)
+            self._nodes = nodes
+            self._emit("Node", idx, node_id)
+            return idx
+
+    def update_node_status(self, node_id: str, status: str) -> int:
+        """No-op (returning the current index) when the node is unknown —
+        a status update racing node GC must not crash the caller."""
+        with self._lock:
+            cur = self._nodes.get(node_id)
+            if cur is None:
+                return self._index
+            n = cur.copy()
+            n.status = status
+            return self.upsert_node(n)
+
+    def update_node_eligibility(self, node_id: str, elig: str) -> int:
+        with self._lock:
+            cur = self._nodes.get(node_id)
+            if cur is None:
+                return self._index
+            n = cur.copy()
+            n.scheduling_eligibility = elig
+            return self.upsert_node(n)
+
+    def update_node_drain(self, node_id: str, drain) -> int:
+        with self._lock:
+            cur = self._nodes.get(node_id)
+            if cur is None:
+                return self._index
+            n = cur.copy()
+            n.drain = drain
+            if drain is not None:
+                n.scheduling_eligibility = "ineligible"
+            return self.upsert_node(n)
+
+    # ---------------------------------------------------------------- jobs
+
+    def upsert_job(self, job: Job) -> int:
+        with self._lock:
+            idx = self._bump()
+            key = job.ns_id()
+            prev = self._jobs.get(key)
+            job = job.copy()
+            job.create_index = prev.create_index if prev else idx
+            job.modify_index = idx
+            job.job_modify_index = idx
+            if prev is not None and prev.version >= job.version:
+                job.version = prev.version + 1
+            job.status = _job_initial_status(job)
+            self._jobs = {**self._jobs, key: job}
+            versions = dict(self._job_versions.get(key, {}))
+            versions[job.version] = job
+            self._job_versions = {**self._job_versions, key: versions}
+            self._emit("Job", idx, job)
+            return idx
+
+    def delete_job(self, namespace: str, job_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            jobs = dict(self._jobs)
+            jobs.pop((namespace, job_id), None)
+            self._jobs = jobs
+            self._emit("Job", idx, (namespace, job_id))
+            return idx
+
+    # --------------------------------------------------------------- evals
+
+    def upsert_evals(self, evals: Iterable[Evaluation]) -> int:
+        with self._lock:
+            idx = self._bump()
+            table = dict(self._evals)
+            by_job = dict(self._evals_by_job)
+            inserted = []
+            for e in evals:
+                prev = table.get(e.id)
+                e = e.copy()
+                e.create_index = prev.create_index if prev else idx
+                e.modify_index = idx
+                table[e.id] = e
+                key = (e.namespace, e.job_id)
+                bucket = dict(by_job.get(key, {}))
+                bucket[e.id] = e
+                by_job[key] = bucket
+                inserted.append(e)
+            self._evals = table
+            self._evals_by_job = by_job
+            for e in inserted:
+                self._emit("Evaluation", idx, e)
+            return idx
+
+    def delete_evals(self, eval_ids: Iterable[str]) -> int:
+        with self._lock:
+            idx = self._bump()
+            table = dict(self._evals)
+            by_job = dict(self._evals_by_job)
+            for eid in eval_ids:
+                e = table.pop(eid, None)
+                if e is not None:
+                    key = (e.namespace, e.job_id)
+                    bucket = dict(by_job.get(key, {}))
+                    bucket.pop(eid, None)
+                    by_job[key] = bucket
+            self._evals = table
+            self._evals_by_job = by_job
+            return idx
+
+    # -------------------------------------------------------------- allocs
+
+    def upsert_allocs(self, allocs: Iterable[Allocation]) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._insert_allocs(allocs, idx)
+            return idx
+
+    def _insert_allocs(self, allocs: Iterable[Allocation], idx: int) -> None:
+        table = dict(self._allocs)
+        by_node = dict(self._allocs_by_node)
+        by_job = dict(self._allocs_by_job)
+        inserted = []
+        for a in allocs:
+            prev = table.get(a.id)
+            a = a.copy_skip_job()   # embedded job pointer shared by design
+            a.create_index = prev.create_index if prev else idx
+            a.modify_index = idx
+            if prev is not None and a.job is None:
+                a.job = prev.job
+            table[a.id] = a
+            if prev is not None and prev.node_id and prev.node_id != a.node_id:
+                bucket = dict(by_node.get(prev.node_id, {}))
+                bucket.pop(a.id, None)
+                by_node[prev.node_id] = bucket
+            if a.node_id:
+                bucket = dict(by_node.get(a.node_id, {}))
+                bucket[a.id] = a
+                by_node[a.node_id] = bucket
+            key = (a.namespace, a.job_id)
+            bucket = dict(by_job.get(key, {}))
+            bucket[a.id] = a
+            by_job[key] = bucket
+            inserted.append(a)
+        self._allocs = table
+        self._allocs_by_node = by_node
+        self._allocs_by_job = by_job
+        for a in inserted:
+            self._emit("Allocation", idx, a)
+
+    def update_allocs_from_client(self, updates: Iterable[Allocation]) -> int:
+        """Client-side status updates (reference: FSM AllocClientUpdate):
+        merges client_status into the stored alloc."""
+        with self._lock:
+            idx = self._bump()
+            merged = []
+            for u in updates:
+                cur = self._allocs.get(u.id)
+                if cur is None:
+                    continue
+                a = cur.copy_skip_job()
+                a.client_status = u.client_status
+                a.client_description = u.client_description
+                a.deployment_status = u.deployment_status
+                a.modify_time = u.modify_time
+                merged.append(a)
+            self._insert_allocs(merged, idx)
+            return idx
+
+    # --------------------------------------------------------- deployments
+
+    def upsert_deployment(self, dep: Deployment) -> int:
+        with self._lock:
+            idx = self._bump()
+            prev = self._deployments.get(dep.id)
+            dep = dep.copy()
+            dep.create_index = prev.create_index if prev else idx
+            dep.modify_index = idx
+            self._deployments = {**self._deployments, dep.id: dep}
+            self._emit("Deployment", idx, dep)
+            return idx
+
+    # ------------------------------------------------------- plan results
+
+    def upsert_plan_results(self, plan: Plan, result: PlanResult) -> int:
+        """Apply a committed plan (reference: FSM ApplyPlanResults →
+        state.UpsertPlanResults): stops, preemption evictions, placements,
+        deployment upserts — one atomic index bump."""
+        with self._lock:
+            idx = self._bump()
+            allocs: List[Allocation] = []
+            for node_allocs in result.node_update.values():
+                allocs.extend(node_allocs)
+            for node_allocs in result.node_preemptions.values():
+                allocs.extend(node_allocs)
+            for node_allocs in result.node_allocation.values():
+                allocs.extend(node_allocs)
+            self._insert_allocs(allocs, idx)
+            if result.deployment is not None:
+                dep = result.deployment
+                prev = self._deployments.get(dep.id)
+                dep.create_index = prev.create_index if prev else idx
+                dep.modify_index = idx
+                self._deployments = {**self._deployments, dep.id: dep}
+            for du in result.deployment_updates:
+                cur = self._deployments.get(du.deployment_id)
+                if cur is not None:
+                    d = cur.copy()
+                    d.status = du.status
+                    d.status_description = du.status_description
+                    d.modify_index = idx
+                    self._deployments = {**self._deployments, d.id: d}
+            self._emit("PlanResult", idx, result)
+            return idx
+
+    # ----------------------------------------------------------- csi / cfg
+
+    def upsert_csi_volume(self, vol: CSIVolume) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._csi_volumes = {**self._csi_volumes,
+                                 (vol.namespace, vol.id): vol}
+            return idx
+
+    def set_scheduler_config(self, cfg: SchedulerConfiguration) -> int:
+        with self._lock:
+            idx = self._bump()
+            cfg.modify_index = idx
+            self._scheduler_config = cfg
+            return idx
+
+    def upsert_namespace(self, ns: Namespace) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._namespaces = {**self._namespaces, ns.name: ns}
+            return idx
+
+    def upsert_node_pool(self, pool: NodePool) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._node_pools = {**self._node_pools, pool.name: pool}
+            return idx
+
+    # ------------------------------------------------------------ snapshot
+
+    def snapshot(self) -> "StateSnapshot":
+        with self._lock:
+            return StateSnapshot(
+                index=self._index,
+                nodes=self._nodes,
+                jobs=self._jobs,
+                job_versions=self._job_versions,
+                evals=self._evals,
+                allocs=self._allocs,
+                deployments=self._deployments,
+                namespaces=self._namespaces,
+                node_pools=self._node_pools,
+                csi_volumes=self._csi_volumes,
+                scheduler_config=self._scheduler_config,
+                allocs_by_node=self._allocs_by_node,
+                allocs_by_job=self._allocs_by_job,
+                evals_by_job=self._evals_by_job,
+            )
+
+    # convenience pass-throughs (read the live head; schedulers must use
+    # snapshot() for consistency)
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._nodes.get(node_id)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._jobs.get((namespace, job_id))
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._evals.get(eval_id)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._allocs.get(alloc_id)
+
+    def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
+        return list(self._allocs_by_job.get((namespace, job_id), {}).values())
+
+
+class StateSnapshot:
+    """Immutable point-in-time view — the `scheduler.State` seam.
+
+    reference: nomad/state StateSnapshot + scheduler/scheduler.go State
+    interface (Nodes, AllocsByNode, AllocsByJob, JobByID, SchedulerConfig...).
+    """
+
+    def __init__(self, index, nodes, jobs, job_versions, evals, allocs,
+                 deployments, namespaces, node_pools, csi_volumes,
+                 scheduler_config, allocs_by_node, allocs_by_job,
+                 evals_by_job):
+        self.index = index
+        self._nodes = nodes
+        self._jobs = jobs
+        self._job_versions = job_versions
+        self._evals = evals
+        self._allocs = allocs
+        self._deployments = deployments
+        self._namespaces = namespaces
+        self._node_pools = node_pools
+        self._csi_volumes = csi_volumes
+        self._scheduler_config = scheduler_config
+        self._allocs_by_node = allocs_by_node
+        self._allocs_by_job = allocs_by_job
+        self._evals_by_job = evals_by_job
+
+    # --- scheduler.State interface ---
+
+    def nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._nodes.get(node_id)
+
+    def ready_nodes_in_pool(self, datacenters: List[str],
+                            pool: str = "default") -> List[Node]:
+        """reference: scheduler/util.go readyNodesInDCs (+ node-pool filter)"""
+        dcs = set(datacenters)
+        out = []
+        for n in self._nodes.values():
+            if not n.ready():
+                continue
+            if n.datacenter not in dcs:
+                continue
+            if pool != "all" and n.node_pool != pool:
+                continue
+            out.append(n)
+        return out
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._jobs.get((namespace, job_id))
+
+    def job_by_id_and_version(self, namespace: str, job_id: str,
+                              version: int) -> Optional[Job]:
+        return self._job_versions.get((namespace, job_id), {}).get(version)
+
+    def jobs(self) -> List[Job]:
+        return list(self._jobs.values())
+
+    def allocs_by_job(self, namespace: str, job_id: str,
+                      anystate: bool = True) -> List[Allocation]:
+        return list(self._allocs_by_job.get((namespace, job_id), {}).values())
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return list(self._allocs_by_node.get(node_id, {}).values())
+
+    def allocs_by_node_terminal(self, node_id: str,
+                                terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._allocs.get(alloc_id)
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._evals.get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return list(self._evals_by_job.get((namespace, job_id), {}).values())
+
+    def latest_deployment_by_job(self, namespace: str,
+                                 job_id: str) -> Optional[Deployment]:
+        best = None
+        for d in self._deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
+        return self._deployments.get(dep_id)
+
+    def csi_volume_by_id(self, namespace: str, vol_id: str) -> Optional[CSIVolume]:
+        return self._csi_volumes.get((namespace, vol_id))
+
+    def node_pool_by_name(self, name: str) -> Optional[NodePool]:
+        return self._node_pools.get(name)
+
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self._scheduler_config
+
+
+def _job_initial_status(job: Job) -> str:
+    if job.stop:
+        return JOB_STATUS_DEAD
+    return JOB_STATUS_PENDING

@@ -1,0 +1,190 @@
+"""Scoring and capacity-check oracles.
+
+Reference semantics: `nomad/structs/funcs.go` (`ScoreFit`, `AllocsFit`) and
+`nomad/structs/network.go` (`NetworkIndex`).  These pure-Python versions are
+the *golden oracles* the vectorized JAX kernels in `nomad_tpu.ops` are
+property-tested against (SURVEY.md §7 P0).
+
+ScoreFit is the Google-Borg-style "best fit v3" exponential bin-packing score:
+    free_frac_d = 1 - used_d / capacity_d          (per dimension d in {cpu, mem})
+    total      = sum_d 10 ** free_frac_d           (2 at full util .. 20 at empty)
+    binpack    = clamp(20 - total, 0, 18)          (18 = perfectly full node)
+    spread     = clamp(total - 2,  0, 18)          (18 = empty node; the
+                                                    SchedulerAlgorithm="spread"
+                                                    inversion)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .structs import (
+    Allocation,
+    MAX_DYNAMIC_PORT,
+    MIN_DYNAMIC_PORT,
+    NetworkResource,
+    Node,
+    Resources,
+    SCHED_ALGO_SPREAD,
+)
+
+# Maximum per-node score magnitude from the fit function.
+MAX_FIT_SCORE = 18.0
+
+
+def score_fit_binpack(node_cpu: float, node_mem: float,
+                      used_cpu: float, used_mem: float) -> float:
+    """reference: structs.ScoreFitBinPack"""
+    if node_cpu <= 0 or node_mem <= 0:
+        return 0.0
+    free_cpu = 1.0 - min(used_cpu / node_cpu, 1.0)
+    free_mem = 1.0 - min(used_mem / node_mem, 1.0)
+    total = 10.0 ** free_cpu + 10.0 ** free_mem
+    return max(0.0, min(MAX_FIT_SCORE, 20.0 - total))
+
+
+def score_fit_spread(node_cpu: float, node_mem: float,
+                     used_cpu: float, used_mem: float) -> float:
+    """reference: structs.ScoreFitSpread — inverted bin-pack used when
+    SchedulerConfiguration.scheduler_algorithm == "spread"."""
+    if node_cpu <= 0 or node_mem <= 0:
+        return 0.0
+    free_cpu = 1.0 - min(used_cpu / node_cpu, 1.0)
+    free_mem = 1.0 - min(used_mem / node_mem, 1.0)
+    total = 10.0 ** free_cpu + 10.0 ** free_mem
+    return max(0.0, min(MAX_FIT_SCORE, total - 2.0))
+
+
+def score_fit(node: Node, used: Resources, algorithm: str) -> float:
+    f = score_fit_spread if algorithm == SCHED_ALGO_SPREAD else score_fit_binpack
+    return f(node.resources.cpu - node.reserved.cpu,
+             node.resources.memory_mb - node.reserved.memory_mb,
+             used.cpu, used.memory_mb)
+
+
+# ---------------------------------------------------------------------------
+# NetworkIndex — per-node port bookkeeping (reference: structs/network.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NetworkIndex:
+    """Tracks port usage on one node.  Simplified to a single host network
+    (the packed-tensor plane models ports as one bitmap per node, which is
+    also what the kernels consume)."""
+
+    used_ports: Set[int] = field(default_factory=set)
+
+    def set_node(self, node: Node) -> None:
+        for p in node.reserved.reserved_ports:
+            self.used_ports.add(p)
+        for net in node.resources.networks:
+            for p in net.reserved_ports:
+                self.used_ports.add(p.value)
+
+    def add_allocs(self, allocs: Iterable[Allocation]) -> None:
+        for a in allocs:
+            if a.terminal_status():
+                continue
+            for port in a.allocated_ports.values():
+                self.used_ports.add(port)
+            for net in a.resources.networks:
+                for p in net.reserved_ports:
+                    self.used_ports.add(p.value)
+
+    def add_reserved(self, net: NetworkResource) -> None:
+        for p in net.reserved_ports:
+            self.used_ports.add(p.value)
+        for p in net.dynamic_ports:
+            if p.value:
+                self.used_ports.add(p.value)
+
+    def assign_ports(self, ask: List[NetworkResource],
+                     ) -> Tuple[Optional[Dict[str, int]], str]:
+        """Try to satisfy the reserved+dynamic port ask.  Returns
+        (label->port, "") on success or (None, dimension) on exhaustion."""
+        assigned: Dict[str, int] = {}
+        newly: Set[int] = set()
+        for net in ask:
+            for p in net.reserved_ports:
+                if p.value in self.used_ports or p.value in newly:
+                    return None, f"network: reserved port collision {p.value}"
+                newly.add(p.value)
+                assigned[p.label or str(p.value)] = p.value
+            for p in net.dynamic_ports:
+                got = self._pick_dynamic(newly)
+                if got is None:
+                    return None, "network: dynamic port exhaustion"
+                newly.add(got)
+                assigned[p.label or f"dyn{got}"] = got
+        return assigned, ""
+
+    def _pick_dynamic(self, newly: Set[int]) -> Optional[int]:
+        # Deterministic first-fit scan; the device plane uses a bitmap scan.
+        for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1):
+            if port not in self.used_ports and port not in newly:
+                return port
+        return None
+
+    def commit(self, ports: Dict[str, int]) -> None:
+        self.used_ports.update(ports.values())
+
+
+# ---------------------------------------------------------------------------
+# AllocsFit — capacity check (reference: structs.AllocsFit)
+# ---------------------------------------------------------------------------
+
+
+def allocs_fit(node: Node, allocs: List[Allocation],
+               net_index: Optional[NetworkIndex] = None,
+               check_devices: bool = False,
+               ) -> Tuple[bool, str, Resources]:
+    """Check that `allocs` all fit on `node` simultaneously.
+
+    Returns (fits, failed_dimension, used_totals).  Mirrors the reference's
+    behavior: terminal allocs are skipped; reserved node resources reduce
+    capacity; ports are checked via NetworkIndex.
+    """
+    used = Resources(cpu=0, memory_mb=0, disk_mb=0)
+    ni = net_index or NetworkIndex()
+    if net_index is None:
+        ni.set_node(node)
+
+    seen_ports: Set[int] = set(ni.used_ports)
+    for a in allocs:
+        if a.terminal_status():
+            continue
+        used.cpu += a.resources.cpu
+        used.memory_mb += a.resources.memory_mb
+        used.disk_mb += a.resources.disk_mb
+        ports = list(a.allocated_ports.values())
+        for net in a.resources.networks:
+            ports.extend(p.value for p in net.reserved_ports)
+        for port in ports:
+            if port in seen_ports:
+                return False, "network: port collision", used
+            seen_ports.add(port)
+
+    cap_cpu = node.resources.cpu - node.reserved.cpu
+    cap_mem = node.resources.memory_mb - node.reserved.memory_mb
+    cap_disk = node.resources.disk_mb - node.reserved.disk_mb
+    if used.cpu > cap_cpu:
+        return False, "cpu", used
+    if used.memory_mb > cap_mem:
+        return False, "memory", used
+    if used.disk_mb > cap_disk:
+        return False, "disk", used
+    return True, "", used
+
+
+def comparable_used(allocs: Iterable[Allocation]) -> Resources:
+    """Sum non-terminal alloc resources (reference: AllocsFit's accumulation)."""
+    used = Resources(cpu=0, memory_mb=0, disk_mb=0)
+    for a in allocs:
+        if a.terminal_status():
+            continue
+        used.cpu += a.resources.cpu
+        used.memory_mb += a.resources.memory_mb
+        used.disk_mb += a.resources.disk_mb
+    return used

@@ -1,0 +1,53 @@
+"""Node computed class (reference: nomad/structs/node_class.go).
+
+Hashes the scheduling-relevant subset of a node so feasibility can be cached
+per *class* rather than per node.  In the TPU framework this also drives
+packed-tensor dedup: nodes in the same computed class share attribute rows.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List
+
+from .structs import Constraint, Node
+
+# Attribute keys that are unique per node and must NOT contribute to the class
+# hash (reference: node UniqueNamespace "unique." prefix convention).
+UNIQUE_PREFIX = "unique."
+
+
+def is_unique_attr(key: str) -> bool:
+    return key.startswith(UNIQUE_PREFIX) or ".unique." in key
+
+
+def compute_class(node: Node) -> str:
+    """reference: Node.ComputeClass / ComputedClass"""
+    h = hashlib.blake2b(digest_size=16)
+    payload = {
+        "datacenter": node.datacenter,
+        "node_pool": node.node_pool,
+        "node_class": node.node_class,
+        "attributes": {k: v for k, v in sorted(node.attributes.items())
+                       if not is_unique_attr(k)},
+        "meta": {k: v for k, v in sorted(node.meta.items())
+                 if not is_unique_attr(k)},
+        "drivers": sorted(k for k, healthy in node.drivers.items() if healthy),
+        "host_volumes": sorted(node.host_volumes),
+        "csi": sorted(k for k, ok in node.csi_node_plugins.items() if ok),
+    }
+    h.update(json.dumps(payload, sort_keys=True).encode())
+    return "v1:" + h.hexdigest()
+
+
+def constraint_targets_unique(c: Constraint) -> bool:
+    """True when a constraint references per-node-unique state, escaping the
+    computed-class cache (reference: EscapedConstraints)."""
+    t = c.ltarget + " " + c.rtarget
+    return ("unique." in t or "${node.unique." in t
+            or c.operand in ("distinct_hosts", "distinct_property"))
+
+
+def escaped_constraints(constraints: List[Constraint]) -> List[Constraint]:
+    return [c for c in constraints if constraint_targets_unique(c)]

@@ -1,0 +1,970 @@
+"""Core data model for the TPU-native Nomad scheduler framework.
+
+Semantics re-derived from upstream hashicorp/nomad `nomad/structs/structs.go`
+(the reference fork `alexandredantas/nomad` was unavailable at survey time —
+see SURVEY.md §0).  These are *host-side* control-plane objects: plain Python
+dataclasses, never traced by JAX.  The device-side representation is a packed
+tensor cache produced by `nomad_tpu.pack` and rebuilt from any state snapshot.
+
+Design departures from the reference (deliberate, TPU-first):
+  - No msgpack/wire tags; objects are in-process only (the Go/RPC plane stays
+    in the host orchestrator per the north-star scoping).
+  - Resources are flat scalars (cpu MHz shares, memory MB, disk MB) plus a
+    port set, matching what the scoring kernels consume.
+  - `Job` embeds no HCL; `nomad_tpu.core.jobspec` parses a dict/JSON jobspec.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Enumerations (string-valued to match reference wire values)
+# ---------------------------------------------------------------------------
+
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+JOB_TYPE_SYSBATCH = "sysbatch"
+JOB_TYPE_CORE = "_core"
+
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_DEAD = "dead"
+
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+NODE_STATUS_DISCONNECTED = "disconnected"
+
+NODE_SCHED_ELIGIBLE = "eligible"
+NODE_SCHED_INELIGIBLE = "ineligible"
+
+ALLOC_DESIRED_RUN = "run"
+ALLOC_DESIRED_STOP = "stop"
+ALLOC_DESIRED_EVICT = "evict"
+
+ALLOC_CLIENT_PENDING = "pending"
+ALLOC_CLIENT_RUNNING = "running"
+ALLOC_CLIENT_COMPLETE = "complete"
+ALLOC_CLIENT_FAILED = "failed"
+ALLOC_CLIENT_LOST = "lost"
+ALLOC_CLIENT_UNKNOWN = "unknown"
+
+EVAL_STATUS_PENDING = "pending"
+EVAL_STATUS_COMPLETE = "complete"
+EVAL_STATUS_FAILED = "failed"
+EVAL_STATUS_BLOCKED = "blocked"
+EVAL_STATUS_CANCELLED = "canceled"
+
+# Evaluation trigger reasons (reference: structs.go EvalTriggerX consts).
+TRIGGER_JOB_REGISTER = "job-register"
+TRIGGER_JOB_DEREGISTER = "job-deregister"
+TRIGGER_PERIODIC_JOB = "periodic-job"
+TRIGGER_NODE_UPDATE = "node-update"
+TRIGGER_NODE_DRAIN = "node-drain"
+TRIGGER_ALLOC_FAILURE = "alloc-failure"
+TRIGGER_ALLOC_STOP = "alloc-stop"
+TRIGGER_ROLLING_UPDATE = "rolling-update"
+TRIGGER_DEPLOYMENT_WATCHER = "deployment-watcher"
+TRIGGER_FAILED_FOLLOW_UP = "failed-follow-up"
+TRIGGER_MAX_DISCONNECT_TIMEOUT = "max-disconnect-timeout"
+TRIGGER_RECONNECT = "reconnect"
+TRIGGER_QUEUED_ALLOCS = "queued-allocs"
+TRIGGER_RETRY_FAILED_ALLOC = "retry-failed-alloc"
+TRIGGER_SCHEDULED = "scheduled"
+
+# Constraint operands (reference: structs.go ConstraintX consts).
+OP_EQ = "="
+OP_NEQ = "!="
+OP_LT = "<"
+OP_LTE = "<="
+OP_GT = ">"
+OP_GTE = ">="
+OP_REGEX = "regexp"
+OP_VERSION = "version"
+OP_SEMVER = "semver"
+OP_SET_CONTAINS = "set_contains"
+OP_SET_CONTAINS_ALL = "set_contains_all"
+OP_SET_CONTAINS_ANY = "set_contains_any"
+OP_DISTINCT_HOSTS = "distinct_hosts"
+OP_DISTINCT_PROPERTY = "distinct_property"
+OP_IS_SET = "is_set"
+OP_IS_NOT_SET = "is_not_set"
+
+SCHED_ALGO_BINPACK = "binpack"
+SCHED_ALGO_SPREAD = "spread"
+
+DEPLOYMENT_STATUS_RUNNING = "running"
+DEPLOYMENT_STATUS_PAUSED = "paused"
+DEPLOYMENT_STATUS_FAILED = "failed"
+DEPLOYMENT_STATUS_SUCCESSFUL = "successful"
+DEPLOYMENT_STATUS_CANCELLED = "cancelled"
+
+# Dynamic port allocation range (reference: structs.go DefaultMinDynamicPort/
+# DefaultMaxDynamicPort).
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 32000
+
+
+def new_id() -> str:
+    return str(uuid.uuid4())
+
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Port:
+    label: str = ""
+    value: int = 0          # static port number; 0 => dynamic
+    to: int = 0
+    host_network: str = "default"
+
+
+@dataclass
+class NetworkResource:
+    mode: str = "host"
+    device: str = ""
+    ip: str = ""
+    mbits: int = 0
+    reserved_ports: List[Port] = field(default_factory=list)
+    dynamic_ports: List[Port] = field(default_factory=list)
+
+    def port_labels(self) -> Dict[str, int]:
+        out = {}
+        for p in self.reserved_ports:
+            out[p.label] = p.value
+        for p in self.dynamic_ports:
+            out[p.label] = p.value
+        return out
+
+
+@dataclass
+class Resources:
+    """Task-level resource ask (reference: structs.Resources)."""
+
+    cpu: int = 100            # MHz shares
+    memory_mb: int = 300
+    memory_max_mb: int = 0    # oversubscription ceiling; 0 = disabled
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List["RequestedDevice"] = field(default_factory=list)
+
+    def add(self, other: "Resources") -> None:
+        self.cpu += other.cpu
+        self.memory_mb += other.memory_mb
+        self.disk_mb += other.disk_mb
+        self.networks.extend(other.networks)
+
+    def copy(self) -> "Resources":
+        return Resources(
+            cpu=self.cpu,
+            memory_mb=self.memory_mb,
+            memory_max_mb=self.memory_max_mb,
+            disk_mb=self.disk_mb,
+            networks=[replace(n,
+                             reserved_ports=[replace(p) for p in n.reserved_ports],
+                             dynamic_ports=[replace(p) for p in n.dynamic_ports])
+                      for n in self.networks],
+            devices=[replace(d) for d in self.devices],
+        )
+
+
+@dataclass
+class RequestedDevice:
+    name: str = ""            # e.g. "gpu", "nvidia/gpu", "nvidia/gpu/1080ti"
+    count: int = 1
+    constraints: List["Constraint"] = field(default_factory=list)
+    affinities: List["Affinity"] = field(default_factory=list)
+
+
+@dataclass
+class NodeDeviceResource:
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    instance_ids: List[str] = field(default_factory=list)
+    attributes: Dict[str, str] = field(default_factory=dict)
+
+    def id(self) -> str:
+        return f"{self.vendor}/{self.type}/{self.name}"
+
+
+@dataclass
+class NodeResources:
+    """Node capacity (reference: structs.NodeResources + legacy Resources)."""
+
+    cpu: int = 4000
+    memory_mb: int = 8192
+    disk_mb: int = 100 * 1024
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[NodeDeviceResource] = field(default_factory=list)
+
+
+@dataclass
+class NodeReservedResources:
+    cpu: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    reserved_ports: List[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Constraints / affinities / spread
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Constraint:
+    ltarget: str = ""         # e.g. "${attr.kernel.name}"
+    operand: str = OP_EQ
+    rtarget: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.ltarget} {self.operand} {self.rtarget}"
+
+
+@dataclass(frozen=True)
+class Affinity:
+    ltarget: str = ""
+    operand: str = OP_EQ
+    rtarget: str = ""
+    weight: int = 50          # [-100, 100]; negative = anti-affinity
+
+
+@dataclass(frozen=True)
+class SpreadTarget:
+    value: str = ""
+    percent: int = 0
+
+
+@dataclass(frozen=True)
+class Spread:
+    attribute: str = ""       # e.g. "${node.datacenter}"
+    weight: int = 50          # (0, 100]
+    targets: Tuple[SpreadTarget, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DrainStrategy:
+    deadline_s: float = 0.0       # <=0: no deadline ("-1" force semantics host-side)
+    ignore_system_jobs: bool = False
+    force_deadline: float = 0.0
+
+
+@dataclass
+class Node:
+    id: str = field(default_factory=new_id)
+    name: str = ""
+    datacenter: str = "dc1"
+    node_pool: str = "default"
+    node_class: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    resources: NodeResources = field(default_factory=NodeResources)
+    reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
+    status: str = NODE_STATUS_READY
+    scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
+    drain: Optional[DrainStrategy] = None
+    drivers: Dict[str, bool] = field(default_factory=dict)   # driver -> healthy
+    host_volumes: Dict[str, str] = field(default_factory=dict)  # name -> path
+    csi_node_plugins: Dict[str, bool] = field(default_factory=dict)  # plugin id -> healthy
+    create_index: int = 0
+    modify_index: int = 0
+    # cached computed class (see node_class.py)
+    computed_class: str = ""
+
+    def ready(self) -> bool:
+        """reference: Node.Ready()"""
+        return (self.status == NODE_STATUS_READY
+                and self.drain is None
+                and self.scheduling_eligibility == NODE_SCHED_ELIGIBLE)
+
+    def copy(self) -> "Node":
+        import copy as _copy
+        return _copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Job
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RestartPolicy:
+    attempts: int = 2
+    interval_s: float = 1800.0
+    delay_s: float = 15.0
+    mode: str = "fail"        # "fail" | "delay"
+
+
+@dataclass
+class ReschedulePolicy:
+    """reference: structs.ReschedulePolicy."""
+    attempts: int = 0
+    interval_s: float = 0.0
+    delay_s: float = 30.0
+    delay_function: str = "exponential"   # constant | exponential | fibonacci
+    max_delay_s: float = 3600.0
+    unlimited: bool = True
+
+
+@dataclass
+class MigrateStrategy:
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+
+
+@dataclass
+class UpdateStrategy:
+    """Rolling-update config (reference: structs.UpdateStrategy)."""
+    stagger_s: float = 30.0
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+    progress_deadline_s: float = 600.0
+    auto_revert: bool = False
+    auto_promote: bool = False
+    canary: int = 0
+
+    def rolling(self) -> bool:
+        return self.max_parallel > 0
+
+
+@dataclass
+class EphemeralDisk:
+    size_mb: int = 300
+    sticky: bool = False
+    migrate: bool = False
+
+
+@dataclass
+class VolumeRequest:
+    name: str = ""
+    type: str = "host"        # "host" | "csi"
+    source: str = ""
+    read_only: bool = False
+    access_mode: str = ""
+    attachment_mode: str = ""
+    per_alloc: bool = False
+
+
+@dataclass
+class Service:
+    name: str = ""
+    port_label: str = ""
+    provider: str = "consul"
+    tags: List[str] = field(default_factory=list)
+    checks: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class Task:
+    name: str = "task"
+    driver: str = "exec"
+    config: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    services: List[Service] = field(default_factory=list)
+    leader: bool = False
+    kill_timeout_s: float = 5.0
+    artifacts: List[Dict[str, Any]] = field(default_factory=list)
+    templates: List[Dict[str, Any]] = field(default_factory=list)
+    vault: Optional[Dict[str, Any]] = None
+    lifecycle: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class TaskGroup:
+    name: str = "group"
+    count: int = 1
+    tasks: List[Task] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    migrate: MigrateStrategy = field(default_factory=MigrateStrategy)
+    update: Optional[UpdateStrategy] = None
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    networks: List[NetworkResource] = field(default_factory=list)
+    volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
+    services: List[Service] = field(default_factory=list)
+    max_client_disconnect_s: Optional[float] = None
+
+    def combined_resources(self) -> Resources:
+        """Sum of task resources + ephemeral disk, the unit the scheduler
+        places (reference: structs.AllocatedResources flattening)."""
+        total = Resources(cpu=0, memory_mb=0, disk_mb=self.ephemeral_disk.size_mb)
+        for t in self.tasks:
+            total.cpu += t.resources.cpu
+            total.memory_mb += t.resources.memory_mb
+            total.networks.extend([n for n in t.resources.networks])
+        total.networks.extend(self.networks)
+        return total
+
+
+@dataclass
+class PeriodicConfig:
+    enabled: bool = True
+    spec: str = ""            # cron spec
+    spec_type: str = "cron"
+    prohibit_overlap: bool = False
+    timezone: str = "UTC"
+
+
+@dataclass
+class ParameterizedJobConfig:
+    payload: str = "optional"
+    meta_required: List[str] = field(default_factory=list)
+    meta_optional: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Multiregion:
+    strategy: Dict[str, Any] = field(default_factory=dict)
+    regions: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    id: str = ""
+    name: str = ""
+    namespace: str = "default"
+    region: str = "global"
+    type: str = JOB_TYPE_SERVICE
+    priority: int = 50
+    all_at_once: bool = False
+    datacenters: List[str] = field(default_factory=lambda: ["dc1"])
+    node_pool: str = "default"
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    update: Optional[UpdateStrategy] = None
+    periodic: Optional[PeriodicConfig] = None
+    parameterized: Optional[ParameterizedJobConfig] = None
+    multiregion: Optional[Multiregion] = None
+    meta: Dict[str, str] = field(default_factory=dict)
+    status: str = JOB_STATUS_PENDING
+    stop: bool = False
+    version: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+    parent_id: str = ""
+    payload: bytes = b""
+    dispatched: bool = False
+
+    def __post_init__(self):
+        if not self.id:
+            self.id = new_id()
+        if not self.name:
+            self.name = self.id
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def stopped(self) -> bool:
+        """reference: Job.Stopped (nil-job case handled by callers)."""
+        return self.stop
+
+    def copy(self) -> "Job":
+        import copy as _copy
+        return _copy.deepcopy(self)
+
+    def ns_id(self) -> Tuple[str, str]:
+        return (self.namespace, self.id)
+
+
+# ---------------------------------------------------------------------------
+# Allocation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeScoreMeta:
+    """Per-candidate score breakdown (reference: structs.NodeScoreMeta)."""
+    node_id: str = ""
+    scores: Dict[str, float] = field(default_factory=dict)
+    norm_score: float = 0.0
+
+
+@dataclass
+class AllocMetric:
+    """Scheduler decision introspection attached to every allocation
+    (reference: structs.AllocMetric) — the de-facto scheduler output
+    contract per SURVEY.md §4.5."""
+
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    nodes_in_pool: int = 0
+    nodes_available: Dict[str, int] = field(default_factory=dict)   # per-dc
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    quota_exhausted: List[str] = field(default_factory=list)
+    score_meta_data: List[NodeScoreMeta] = field(default_factory=list)
+    allocation_time_ns: int = 0
+    coalesced_failures: int = 0
+
+    def exhausted_node(self, dimension: str) -> None:
+        self.nodes_exhausted += 1
+        if dimension:
+            self.dimension_exhausted[dimension] = (
+                self.dimension_exhausted.get(dimension, 0) + 1)
+
+    def filter_node(self, reason: str) -> None:
+        self.nodes_filtered += 1
+        if reason:
+            self.constraint_filtered[reason] = (
+                self.constraint_filtered.get(reason, 0) + 1)
+
+
+@dataclass
+class RescheduleEvent:
+    reschedule_time: float = 0.0
+    prev_alloc_id: str = ""
+    prev_node_id: str = ""
+    delay_s: float = 0.0
+
+
+@dataclass
+class RescheduleTracker:
+    events: List[RescheduleEvent] = field(default_factory=list)
+
+
+@dataclass
+class DesiredTransition:
+    migrate: bool = False
+    reschedule: bool = False
+    force_reschedule: bool = False
+    no_shutdown_delay: bool = False
+
+
+@dataclass
+class NetworkAllocation:
+    ip: str = ""
+    ports: Dict[str, int] = field(default_factory=dict)   # label -> host port
+
+
+@dataclass
+class Allocation:
+    id: str = field(default_factory=new_id)
+    namespace: str = "default"
+    eval_id: str = ""
+    name: str = ""            # job.name[index]
+    node_id: str = ""
+    node_name: str = ""
+    job_id: str = ""
+    job: Optional[Job] = None
+    task_group: str = ""
+    resources: Resources = field(default_factory=Resources)
+    allocated_ports: Dict[str, int] = field(default_factory=dict)
+    desired_status: str = ALLOC_DESIRED_RUN
+    desired_description: str = ""
+    desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
+    client_status: str = ALLOC_CLIENT_PENDING
+    client_description: str = ""
+    previous_allocation: str = ""
+    next_allocation: str = ""
+    deployment_id: str = ""
+    deployment_status: Optional[Dict[str, Any]] = None   # {healthy: bool, ts: float}
+    reschedule_tracker: Optional[RescheduleTracker] = None
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    followup_eval_id: str = ""
+    preempted_by_allocation: str = ""
+    preempted_allocations: List[str] = field(default_factory=list)
+    metrics: AllocMetric = field(default_factory=AllocMetric)
+    job_version: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    alloc_modify_index: int = 0
+    create_time: float = 0.0
+    modify_time: float = 0.0
+
+    # -- status helpers (reference: structs.Allocation.TerminalStatus etc.) --
+
+    def terminal_status(self) -> bool:
+        """True when the *desired* or *client* status is terminal."""
+        if self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            return True
+        return self.client_terminal_status()
+
+    def client_terminal_status(self) -> bool:
+        return self.client_status in (
+            ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED, ALLOC_CLIENT_LOST)
+
+    def migrate_disk(self) -> bool:
+        if self.job is None:
+            return False
+        tg = self.job.lookup_task_group(self.task_group)
+        return bool(tg and tg.ephemeral_disk.migrate)
+
+    def index(self) -> int:
+        """Alloc name index: `job.name[idx]` (reference: AllocIndexFromName)."""
+        l, r = self.name.rfind("["), self.name.rfind("]")
+        if l == -1 or r == -1:
+            return -1
+        try:
+            return int(self.name[l + 1:r])
+        except ValueError:
+            return -1
+
+    def ran_successfully(self) -> bool:
+        return self.client_status == ALLOC_CLIENT_COMPLETE
+
+    def copy(self) -> "Allocation":
+        import copy as _copy
+        return _copy.deepcopy(self)
+
+    def copy_skip_job(self) -> "Allocation":
+        import copy as _copy
+        job, self.job = self.job, None
+        try:
+            out = _copy.deepcopy(self)
+        finally:
+            self.job = job
+        out.job = job
+        return out
+
+
+def alloc_name(job_id: str, group: str, idx: int) -> str:
+    """reference: structs.AllocName"""
+    return f"{job_id}.{group}[{idx}]"
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Evaluation:
+    id: str = field(default_factory=new_id)
+    namespace: str = "default"
+    priority: int = 50
+    type: str = JOB_TYPE_SERVICE        # scheduler type
+    triggered_by: str = TRIGGER_JOB_REGISTER
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    deployment_id: str = ""
+    status: str = EVAL_STATUS_PENDING
+    status_description: str = ""
+    wait_until: float = 0.0
+    next_eval: str = ""
+    previous_eval: str = ""
+    blocked_eval: str = ""
+    related_evals: List[str] = field(default_factory=list)
+    class_eligibility: Dict[str, bool] = field(default_factory=dict)
+    escaped_computed_class: bool = False
+    quota_limit_reached: str = ""
+    queued_allocations: Dict[str, int] = field(default_factory=dict)  # tg -> queued
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    annotate_plan: bool = False
+    snapshot_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: float = 0.0
+    modify_time: float = 0.0
+
+    def terminal_status(self) -> bool:
+        return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED,
+                               EVAL_STATUS_CANCELLED)
+
+    def should_enqueue(self) -> bool:
+        return self.status == EVAL_STATUS_PENDING
+
+    def should_block(self) -> bool:
+        return self.status == EVAL_STATUS_BLOCKED
+
+    def copy(self) -> "Evaluation":
+        import copy as _copy
+        return _copy.deepcopy(self)
+
+    def create_blocked_eval(self, class_eligibility: Dict[str, bool],
+                            escaped: bool, quota: str = "",
+                            failed_tg_allocs: Optional[Dict[str, AllocMetric]] = None,
+                            ) -> "Evaluation":
+        """reference: Evaluation.CreateBlockedEval"""
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_QUEUED_ALLOCS,
+            job_id=self.job_id,
+            status=EVAL_STATUS_BLOCKED,
+            previous_eval=self.id,
+            class_eligibility=dict(class_eligibility),
+            escaped_computed_class=escaped,
+            quota_limit_reached=quota,
+            failed_tg_allocs=dict(failed_tg_allocs or {}),
+        )
+
+    def create_failed_follow_up_eval(self, wait_until: float) -> "Evaluation":
+        return Evaluation(
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_FAILED_FOLLOW_UP,
+            job_id=self.job_id,
+            status=EVAL_STATUS_PENDING,
+            wait_until=wait_until,
+            previous_eval=self.id,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Deployment
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeploymentState:
+    auto_revert: bool = False
+    auto_promote: bool = False
+    promoted: bool = False
+    placed_canaries: List[str] = field(default_factory=list)
+    desired_canaries: int = 0
+    desired_total: int = 0
+    placed_allocs: int = 0
+    healthy_allocs: int = 0
+    unhealthy_allocs: int = 0
+    progress_deadline_s: float = 0.0
+    require_progress_by: float = 0.0
+
+
+@dataclass
+class Deployment:
+    id: str = field(default_factory=new_id)
+    namespace: str = "default"
+    job_id: str = ""
+    job_version: int = 0
+    job_modify_index: int = 0
+    job_create_index: int = 0
+    task_groups: Dict[str, DeploymentState] = field(default_factory=dict)
+    status: str = DEPLOYMENT_STATUS_RUNNING
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def active(self) -> bool:
+        return self.status in (DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_PAUSED)
+
+    def requires_promotion(self) -> bool:
+        return any(s.desired_canaries > 0 and not s.promoted
+                   for s in self.task_groups.values())
+
+    def copy(self) -> "Deployment":
+        import copy as _copy
+        return _copy.deepcopy(self)
+
+
+@dataclass
+class DeploymentStatusUpdate:
+    deployment_id: str = ""
+    status: str = ""
+    status_description: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Plan:
+    """Scheduler output submitted to the plan applier
+    (reference: structs.Plan)."""
+
+    eval_id: str = ""
+    eval_token: str = ""
+    priority: int = 50
+    all_at_once: bool = False
+    job: Optional[Job] = None
+    # node_id -> allocs to stop/evict (desired_status already set)
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # node_id -> new/updated allocs to place
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # node_id -> allocs preempted to make room
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    annotations: Optional["PlanAnnotations"] = None
+    snapshot_index: int = 0
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def append_stopped_alloc(self, alloc: Allocation, desired_desc: str,
+                             client_status: str = "",
+                             followup_eval_id: str = "") -> None:
+        """reference: Plan.AppendStoppedAlloc"""
+        a = alloc.copy_skip_job()
+        a.desired_status = ALLOC_DESIRED_STOP
+        a.desired_description = desired_desc
+        if client_status:
+            a.client_status = client_status
+        if followup_eval_id:
+            a.followup_eval_id = followup_eval_id
+        self.node_update.setdefault(a.node_id, []).append(a)
+
+    def append_preempted_alloc(self, alloc: Allocation, preempting_id: str) -> None:
+        a = alloc.copy_skip_job()
+        a.desired_status = ALLOC_DESIRED_EVICT
+        a.desired_description = f"Preempted by alloc ID {preempting_id}"
+        a.preempted_by_allocation = preempting_id
+        self.node_preemptions.setdefault(a.node_id, []).append(a)
+
+    def is_no_op(self) -> bool:
+        return (not self.node_update and not self.node_allocation
+                and not self.node_preemptions and self.deployment is None
+                and not self.deployment_updates)
+
+
+@dataclass
+class PlanResult:
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    refuted_nodes: List[str] = field(default_factory=list)
+    alloc_index: int = 0
+
+    def full_commit(self, plan: Plan) -> Tuple[bool, int, int]:
+        expected = sum(len(v) for v in plan.node_allocation.values())
+        actual = sum(len(v) for v in self.node_allocation.values())
+        return actual == expected, expected, actual
+
+
+@dataclass
+class DesiredUpdates:
+    """Per-taskgroup annotation counts (reference: structs.DesiredUpdates)."""
+    ignore: int = 0
+    place: int = 0
+    migrate: int = 0
+    stop: int = 0
+    in_place_update: int = 0
+    destructive_update: int = 0
+    canary: int = 0
+    preemptions: int = 0
+    reschedule_now: int = 0
+    reschedule_later: int = 0
+    disconnect_updates: int = 0
+    reconnect_updates: int = 0
+
+
+@dataclass
+class PlanAnnotations:
+    desired_tg_updates: Dict[str, DesiredUpdates] = field(default_factory=dict)
+    preempted_allocs: List[str] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler configuration (runtime cluster config plane — SURVEY §6.6)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PreemptionConfig:
+    system_scheduler_enabled: bool = True
+    sysbatch_scheduler_enabled: bool = False
+    batch_scheduler_enabled: bool = False
+    service_scheduler_enabled: bool = False
+
+
+@dataclass
+class SchedulerConfiguration:
+    scheduler_algorithm: str = SCHED_ALGO_BINPACK
+    preemption_config: PreemptionConfig = field(default_factory=PreemptionConfig)
+    memory_oversubscription_enabled: bool = False
+    reject_job_registration: bool = False
+    pause_eval_broker: bool = False
+    # TPU-backend enablement (new-framework plane-(c) flag, mirrors how
+    # preemption was rolled out in the reference):
+    tpu_backend_enabled: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Namespaces / node pools / misc cluster objects
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Namespace:
+    name: str = "default"
+    description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class NodePool:
+    name: str = "default"
+    description: str = ""
+    scheduler_algorithm: str = ""    # "" = inherit global
+    create_index: int = 0
+    modify_index: int = 0
+
+NODE_POOL_ALL = "all"
+NODE_POOL_DEFAULT = "default"
+
+
+@dataclass
+class CSIVolume:
+    id: str = ""
+    namespace: str = "default"
+    plugin_id: str = ""
+    access_mode: str = "multi-node-multi-writer"
+    attachment_mode: str = "file-system"
+    # node ids in the volume's accessible topology; empty = all
+    topology_node_ids: Tuple[str, ...] = ()
+    # simple claim model: alloc ids holding read/write claims
+    read_allocs: Dict[str, bool] = field(default_factory=dict)
+    write_allocs: Dict[str, bool] = field(default_factory=dict)
+    schedulable: bool = True
+
+    def claim_ok(self, read_only: bool) -> bool:
+        if not self.schedulable:
+            return False
+        if read_only:
+            return True
+        if self.access_mode.startswith("single-node-writer"):
+            return not self.write_allocs
+        return True
+
+
+# Explicit public surface: every class/function defined in this module plus
+# the upper-case constants (keeps `from .structs import *` from leaking
+# stdlib/typing names).
+__all__ = [
+    _n for _n, _v in list(globals().items())
+    if not _n.startswith("_")
+    and (getattr(_v, "__module__", None) == __name__
+         or (_n.isupper() and isinstance(_v, (str, int, float))))
+]

@@ -1,0 +1,176 @@
+"""State store tests (reference semantics: nomad/state/state_store.go)."""
+
+import threading
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Plan,
+    PlanResult,
+)
+
+
+def test_upsert_node_and_snapshot_isolation():
+    s = StateStore()
+    n = mock.node()
+    idx = s.upsert_node(n)
+    snap = s.snapshot()
+    assert snap.index == idx
+    assert snap.node_by_id(n.id).id == n.id
+    # later writes must not show in existing snapshot
+    n2 = mock.node()
+    s.upsert_node(n2)
+    assert snap.node_by_id(n2.id) is None
+    assert s.snapshot().node_by_id(n2.id) is not None
+
+
+def test_index_monotonic_and_modify_index():
+    s = StateStore()
+    n = mock.node()
+    i1 = s.upsert_node(n)
+    j = mock.job()
+    i2 = s.upsert_job(j)
+    assert i2 == i1 + 1
+    stored = s.snapshot().job_by_id(j.namespace, j.id)
+    assert stored.modify_index == i2 and stored.create_index == i2
+    i3 = s.upsert_job(j.copy())
+    stored2 = s.snapshot().job_by_id(j.namespace, j.id)
+    assert stored2.create_index == i2 and stored2.modify_index == i3
+    assert stored2.version == stored.version + 1
+
+
+def test_snapshot_immune_to_caller_mutation():
+    # The store copies on insert: mutating the caller's object after upsert
+    # must not alter what snapshots see.
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    snap = s.snapshot()
+    n.status = "down"
+    assert snap.node_by_id(n.id).status == "ready"
+
+
+def test_computed_class_recomputed_on_upsert():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    before = s.snapshot().node_by_id(n.id).computed_class
+    n2 = s.snapshot().node_by_id(n.id).copy()
+    n2.attributes = {**n2.attributes, "os.name": "debian"}
+    s.upsert_node(n2)
+    after = s.snapshot().node_by_id(n.id).computed_class
+    assert before != after
+
+
+def test_unknown_node_update_is_noop():
+    s = StateStore()
+    idx = s.latest_index()
+    assert s.update_node_status("nope", "down") == idx
+
+
+def test_listener_sees_committed_state_and_cannot_abort():
+    s = StateStore()
+    seen = []
+
+    def listener(topic, index, payload):
+        if topic == "Evaluation":
+            # re-entrant read must see the committed eval
+            seen.append(s.eval_by_id(payload.id) is not None)
+        raise RuntimeError("listener bug must not abort the commit")
+
+    s.subscribe(listener)
+    e = mock.eval()
+    s.upsert_evals([e])
+    assert seen == [True]
+    assert s.eval_by_id(e.id) is not None
+
+
+def test_allocs_by_node_and_job_indexes():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    j = mock.job()
+    s.upsert_job(j)
+    a = mock.alloc(job=j, node_id=n.id)
+    s.upsert_allocs([a])
+    snap = s.snapshot()
+    assert [x.id for x in snap.allocs_by_node(n.id)] == [a.id]
+    assert [x.id for x in snap.allocs_by_job(j.namespace, j.id)] == [a.id]
+    assert snap.allocs_by_node_terminal(n.id, terminal=False)[0].id == a.id
+    assert snap.allocs_by_node_terminal(n.id, terminal=True) == []
+
+
+def test_ready_nodes_filters():
+    s = StateStore()
+    ready = mock.node()
+    down = mock.node(status="down")
+    inel = mock.node(scheduling_eligibility="ineligible")
+    other_dc = mock.node(datacenter="dc9")
+    for n in (ready, down, inel, other_dc):
+        s.upsert_node(n)
+    snap = s.snapshot()
+    got = {n.id for n in snap.ready_nodes_in_pool(["dc1"])}
+    assert got == {ready.id}
+
+
+def test_upsert_plan_results_applies_stops_and_places():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(n)
+    j = mock.job()
+    s.upsert_job(j)
+    old = mock.alloc(job=j, node_id=n.id)
+    s.upsert_allocs([old])
+
+    stopped = old.copy_skip_job()
+    stopped.desired_status = "stop"
+    new = mock.alloc(job=j, node_id=n.id)
+    plan = Plan(eval_id="e1", job=j)
+    result = PlanResult(node_update={n.id: [stopped]},
+                        node_allocation={n.id: [new]})
+    s.upsert_plan_results(plan, result)
+    snap = s.snapshot()
+    assert snap.alloc_by_id(old.id).desired_status == "stop"
+    assert snap.alloc_by_id(new.id) is not None
+    live = snap.allocs_by_node_terminal(n.id, terminal=False)
+    assert {a.id for a in live} == {new.id}
+
+
+def test_client_status_merge():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(j)
+    a = mock.alloc(job=j, node_id="n1")
+    s.upsert_allocs([a])
+    upd = a.copy_skip_job()
+    upd.client_status = "running"
+    s.update_allocs_from_client([upd])
+    assert s.snapshot().alloc_by_id(a.id).client_status == "running"
+
+
+def test_wait_for_index():
+    s = StateStore()
+    target = s.latest_index() + 1
+
+    def later():
+        s.upsert_node(mock.node())
+
+    t = threading.Timer(0.05, later)
+    t.start()
+    assert s.wait_for_index(target, timeout=2.0)
+    t.join()
+
+
+def test_job_versions():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(j)
+    j2 = j.copy()
+    j2.priority = 70
+    s.upsert_job(j2)
+    snap = s.snapshot()
+    cur = snap.job_by_id(j.namespace, j.id)
+    assert cur.version == 1 and cur.priority == 70
+    # version history must be immutable: v0 keeps the old priority
+    assert snap.job_by_id_and_version(j.namespace, j.id, 0).priority == 50
+    assert snap.job_by_id_and_version(j.namespace, j.id, 1).priority == 70

@@ -1,0 +1,178 @@
+"""Data-model and oracle tests (reference semantics: nomad/structs)."""
+
+import math
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    Allocation,
+    MAX_FIT_SCORE,
+    NetworkIndex,
+    NetworkResource,
+    Node,
+    NodeResources,
+    Port,
+    Resources,
+    allocs_fit,
+    alloc_name,
+    compute_class,
+    score_fit_binpack,
+    score_fit_spread,
+)
+
+
+class TestScoreFit:
+    def test_empty_node_binpack_is_zero(self):
+        # free=1.0 per dim -> total=20 -> score 0 (worst bin-pack fit)
+        assert score_fit_binpack(4000, 8192, 0, 0) == pytest.approx(0.0)
+
+    def test_full_node_binpack_is_max(self):
+        # used == capacity -> total=2 -> score 18 (perfect fit)
+        assert score_fit_binpack(4000, 8192, 4000, 8192) == pytest.approx(MAX_FIT_SCORE)
+
+    def test_half_utilized(self):
+        got = score_fit_binpack(100, 100, 50, 50)
+        want = 20.0 - 2 * math.pow(10, 0.5)
+        assert got == pytest.approx(want)
+
+    def test_monotone_in_utilization(self):
+        prev = -1.0
+        for used in range(0, 4001, 250):
+            s = score_fit_binpack(4000, 8192, used, used * 2)
+            assert s >= prev
+            prev = s
+
+    def test_spread_is_inverse(self):
+        # spread algorithm rewards empty nodes
+        assert score_fit_spread(4000, 8192, 0, 0) == pytest.approx(MAX_FIT_SCORE)
+        assert score_fit_spread(4000, 8192, 4000, 8192) == pytest.approx(0.0)
+
+    def test_overcommit_clamped(self):
+        assert 0.0 <= score_fit_binpack(100, 100, 500, 500) <= MAX_FIT_SCORE
+
+    def test_zero_capacity(self):
+        assert score_fit_binpack(0, 0, 0, 0) == 0.0
+
+
+class TestAllocsFit:
+    def _alloc(self, cpu, mem, ports=()):
+        a = mock.alloc()
+        a.resources = Resources(cpu=cpu, memory_mb=mem)
+        a.allocated_ports = {f"p{p}": p for p in ports}
+        return a
+
+    def test_fits_empty(self):
+        n = mock.node()
+        ok, dim, used = allocs_fit(n, [])
+        assert ok and dim == ""
+        assert used.cpu == 0
+
+    def test_fits_exact_capacity(self):
+        n = mock.node()
+        cap_cpu = n.resources.cpu - n.reserved.cpu
+        cap_mem = n.resources.memory_mb - n.reserved.memory_mb
+        ok, dim, _ = allocs_fit(n, [self._alloc(cap_cpu, cap_mem)])
+        assert ok, dim
+
+    def test_cpu_exhausted(self):
+        n = mock.node()
+        ok, dim, _ = allocs_fit(n, [self._alloc(n.resources.cpu + 1, 10)])
+        assert not ok and dim == "cpu"
+
+    def test_memory_exhausted(self):
+        n = mock.node()
+        ok, dim, _ = allocs_fit(n, [self._alloc(1, n.resources.memory_mb + 1)])
+        assert not ok and dim == "memory"
+
+    def test_terminal_allocs_ignored(self):
+        n = mock.node()
+        a = self._alloc(n.resources.cpu * 2, 10)
+        a.desired_status = "stop"
+        ok, _, used = allocs_fit(n, [a])
+        assert ok and used.cpu == 0
+
+    def test_port_collision(self):
+        n = mock.node()
+        ok, dim, _ = allocs_fit(
+            n, [self._alloc(10, 10, ports=[8080]),
+                self._alloc(10, 10, ports=[8080])])
+        assert not ok and "port" in dim
+
+    def test_reserved_node_port_collision(self):
+        n = mock.node()
+        n.reserved.reserved_ports = [22]
+        ni = NetworkIndex()
+        ni.set_node(n)
+        got, err = ni.assign_ports(
+            [NetworkResource(reserved_ports=[Port("ssh", 22)])])
+        assert got is None and "collision" in err
+
+    def test_dynamic_port_assignment(self):
+        ni = NetworkIndex()
+        got, err = ni.assign_ports(
+            [NetworkResource(dynamic_ports=[Port("http"), Port("rpc")])])
+        assert err == "" and len(set(got.values())) == 2
+
+
+class TestComputedClass:
+    def test_same_attrs_same_class(self):
+        n1, n2 = mock.node(), mock.node()
+        # unique.hostname differs but must not affect class
+        assert n1.attributes["unique.hostname"] != n2.attributes["unique.hostname"]
+        assert compute_class(n1) == compute_class(n2)
+
+    def test_different_dc_different_class(self):
+        n1 = mock.node()
+        n2 = mock.node(datacenter="dc2")
+        assert compute_class(n1) != compute_class(n2)
+
+    def test_different_attr_different_class(self):
+        n1 = mock.node()
+        n2 = mock.node()
+        n2.attributes = {**n2.attributes, "os.name": "debian"}
+        assert compute_class(n1) != compute_class(n2)
+
+
+class TestAllocHelpers:
+    def test_alloc_name_index(self):
+        a = mock.alloc()
+        a.name = alloc_name("job", "web", 7)
+        assert a.index() == 7
+
+    def test_terminal_status(self):
+        a = mock.alloc()
+        assert not a.terminal_status()
+        a.client_status = "failed"
+        assert a.terminal_status()
+        b = mock.alloc()
+        b.desired_status = "evict"
+        assert b.terminal_status()
+
+    def test_copy_skip_job_keeps_job_ref(self):
+        a = mock.alloc()
+        c = a.copy_skip_job()
+        assert c.job is a.job
+        assert c is not a
+
+
+class TestMockFixtures:
+    def test_job_shape(self):
+        j = mock.job()
+        assert j.type == "service"
+        assert j.task_groups[0].count == 10
+        assert j.task_groups[0].tasks[0].resources.cpu == 500
+
+    def test_combined_resources(self):
+        tg = mock.job().task_groups[0]
+        r = tg.combined_resources()
+        assert r.cpu == 500 and r.memory_mb == 256
+        assert r.disk_mb == tg.ephemeral_disk.size_mb
+
+    def test_system_job(self):
+        j = mock.system_job()
+        assert j.type == "system" and j.priority == 100
+
+    def test_eval(self):
+        e = mock.eval()
+        assert e.should_enqueue()
