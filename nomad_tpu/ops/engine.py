@@ -1,0 +1,187 @@
+"""Host↔device placement engine.
+
+Bridges the control plane (snapshots, Job/TaskGroup objects, reconciler
+output) and the device kernels: packs state, pads to shape buckets to bound
+recompilation, runs the `place` kernel, and maps node rows back to ids +
+AllocMetric.  This is the seam the Go worker would call through the PJRT
+bridge (SURVEY.md §7 P6); in-process it is plain Python.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.pack.interner import UNSET
+from nomad_tpu.pack.packer import ClusterPacker, JobContext, NodeTensors, TGTensors
+from nomad_tpu.pack.spread import SpreadTensors, lower_spreads
+from nomad_tpu.structs import (
+    AllocMetric,
+    Job,
+    NodeScoreMeta,
+    SCHED_ALGO_SPREAD,
+    TaskGroup,
+)
+
+from .select import PlacementInputs, place_jit
+
+
+@dataclass
+class PlacementRequest:
+    """One placement the reconciler asked for."""
+    tg_name: str
+    prev_node_id: str = ""       # reschedule penalty target
+
+
+@dataclass
+class PlacementDecision:
+    tg_name: str
+    node_id: Optional[str]       # None = no feasible node
+    score: float
+    metric: AllocMetric
+
+
+def _pad_pow2(x: int, lo: int = 8) -> int:
+    p = lo
+    while p < x:
+        p *= 2
+    return p
+
+
+class PlacementEngine:
+    """Owns a ClusterPacker + device caches for one scheduling session."""
+
+    def __init__(self, packer: Optional[ClusterPacker] = None) -> None:
+        self.packer = packer or ClusterPacker()
+        self._dev_cache: Dict[str, object] = {}
+        self._cache_version: Tuple[int, int] = (-1, -1)
+
+    # ------------------------------------------------------------ devices
+
+    def _node_arrays(self, t: NodeTensors):
+        """Upload node tensors once per (version, vocab, width) — the
+        incremental HBM sync point.  Width matters: ensure_column can widen
+        attrs after a build without bumping the row version."""
+        key = (t.version, len(self.packer.interner), t.attrs.shape[1])
+        if self._cache_version != key:
+            self._dev_cache = {
+                "attrs": jnp.asarray(t.attrs),
+                "cap": jnp.asarray(t.cap),
+                "used": jnp.asarray(t.used),
+                "elig": jnp.asarray(t.elig),
+            }
+            self._cache_version = key
+        return self._dev_cache
+
+    # -------------------------------------------------------------- solve
+
+    def place(self, snapshot, job: Job, tgs: Sequence[TaskGroup],
+              requests: Sequence[PlacementRequest],
+              tensors: Optional[NodeTensors] = None,
+              ) -> List[PlacementDecision]:
+        """Score + select nodes for `requests` (placements of `tgs`).
+        Returns one decision per request, in order."""
+        if not requests:
+            return []
+        t0 = time.perf_counter_ns()
+        t = tensors if tensors is not None else self.packer.update(snapshot)
+        n = t.n
+        if n == 0:
+            return [self._no_nodes_decision(r, snapshot, job) for r in requests]
+
+        tg_tensors: TGTensors = self.packer.lower_task_groups(job, tgs)
+        ctx: JobContext = self.packer.job_context(job, snapshot, t)
+        sp: SpreadTensors = lower_spreads(self.packer, job, t, snapshot)
+
+        name_to_g = {name: i for i, name in enumerate(tg_tensors.names)}
+        p_real = len(requests)
+        p_pad = _pad_pow2(p_real)
+        tg_idx = np.zeros(p_pad, np.int32)
+        prev_row = np.full(p_pad, -1, np.int32)
+        active = np.zeros(p_pad, bool)
+        for i, r in enumerate(requests):
+            tg_idx[i] = name_to_g[r.tg_name]
+            if r.prev_node_id:
+                prev_row[i] = t.id_to_row.get(r.prev_node_id, -1)
+            active[i] = True
+
+        desired = np.array([tg.count for tg in tgs], np.int32)
+        pd = self.packer.lower_distinct(job, tgs, tg_tensors, t, snapshot)
+        algo = snapshot.scheduler_config().scheduler_algorithm
+        dev = self._node_arrays(t)
+        inp = PlacementInputs(
+            attrs=dev["attrs"], cap=dev["cap"], used0=dev["used"],
+            elig=dev["elig"],
+            dc_mask=jnp.asarray(ctx.dc_mask),
+            pool_mask=jnp.asarray(ctx.pool_mask),
+            luts=jnp.asarray(tg_tensors.luts),
+            con=jnp.asarray(tg_tensors.con),
+            aff=jnp.asarray(tg_tensors.aff),
+            req=jnp.asarray(tg_tensors.req),
+            desired=jnp.asarray(desired),
+            dh_limit=jnp.asarray(tg_tensors.dh_limit),
+            sp_nodeval=jnp.asarray(sp.sp_nodeval),
+            sp_weight=jnp.asarray(sp.sp_weight),
+            sp_expected=jnp.asarray(sp.sp_expected),
+            sp_counts0=jnp.asarray(sp.sp_counts0),
+            pd_nodeval=jnp.asarray(pd.pd_nodeval),
+            pd_limit=jnp.asarray(pd.pd_limit),
+            pd_apply=jnp.asarray(pd.pd_apply),
+            pd_counts0=jnp.asarray(pd.pd_counts0),
+            tg_idx=jnp.asarray(tg_idx),
+            prev_row=jnp.asarray(prev_row),
+            active=jnp.asarray(active),
+            job_count0=jnp.asarray(ctx.job_count),
+            spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
+        )
+        out = place_jit(inp)
+        picks = np.asarray(out.picks)[:p_real]
+        scores = np.asarray(out.scores)[:p_real]
+        topk_rows = np.asarray(out.topk_rows)[:p_real]
+        topk_scores = np.asarray(out.topk_scores)[:p_real]
+        n_feas = np.asarray(out.n_feasible)[:p_real]
+        n_filt = np.asarray(out.n_filtered)[:p_real]
+        n_exh = np.asarray(out.n_exhausted)[:p_real]
+        dim_exh = np.asarray(out.dim_exhausted)[:p_real]
+        elapsed = (time.perf_counter_ns() - t0) // max(p_real, 1)
+
+        dc_counts: Dict[str, int] = {}
+        for nd in snapshot.nodes():
+            if nd.ready():
+                dc_counts[nd.datacenter] = dc_counts.get(nd.datacenter, 0) + 1
+
+        decisions: List[PlacementDecision] = []
+        dims = ("cpu", "memory", "disk")
+        for i, r in enumerate(requests):
+            metric = AllocMetric(
+                nodes_evaluated=n,
+                nodes_filtered=int(n_filt[i]),
+                nodes_in_pool=int(ctx.pool_mask.sum()),
+                nodes_available=dict(dc_counts),
+                nodes_exhausted=int(n_exh[i]),
+                allocation_time_ns=int(elapsed),
+            )
+            for d in range(3):
+                if dim_exh[i][d]:
+                    metric.dimension_exhausted[dims[d]] = int(dim_exh[i][d])
+            for kr, ks in zip(topk_rows[i], topk_scores[i]):
+                if kr >= 0:
+                    metric.score_meta_data.append(NodeScoreMeta(
+                        node_id=t.node_ids[int(kr)],
+                        scores={"final": float(ks)},
+                        norm_score=float(ks)))
+            node_id = t.node_ids[int(picks[i])] if picks[i] >= 0 else None
+            decisions.append(PlacementDecision(
+                tg_name=r.tg_name, node_id=node_id,
+                score=float(scores[i]), metric=metric))
+        return decisions
+
+    def _no_nodes_decision(self, r: PlacementRequest, snapshot, job: Job
+                           ) -> PlacementDecision:
+        return PlacementDecision(
+            tg_name=r.tg_name, node_id=None, score=0.0,
+            metric=AllocMetric(nodes_evaluated=0))

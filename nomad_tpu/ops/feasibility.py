@@ -1,0 +1,76 @@
+"""Feasibility mask kernel.
+
+Replaces the reference's pull-based FeasibleIterator chain
+(scheduler/feasible.go: DriverChecker, ConstraintChecker, HostVolumeChecker,
+CSIVolumeChecker, NodePoolChecker, per-ComputedClass EvalCache) with one
+vectorized evaluation: a `[G, N]` boolean mask over all task groups × all
+nodes in a single fused XLA computation.  The reference's per-class caching
+trick is unnecessary — we don't cache per class, we just score every node.
+
+All string work happened host-side in nomad_tpu.pack: the device sees interned
+ids, opcodes, and pre-evaluated LUT rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nomad_tpu.pack.interner import UNSET
+from nomad_tpu.pack.packer import (
+    DOP_EQ,
+    DOP_IS_NOT_SET,
+    DOP_IS_SET,
+    DOP_LUT,
+    DOP_NEQ,
+    DOP_TRUE,
+)
+
+
+def constraint_mask(attrs: jnp.ndarray,      # [N, A] int32
+                    con: jnp.ndarray,        # [G, C, 3] int32 (col, op, arg)
+                    luts: jnp.ndarray,       # [L, V] bool
+                    ) -> jnp.ndarray:        # [G, N] bool
+    """Evaluate every packed constraint row against every node."""
+    cols = con[..., 0]                       # [G, C]
+    ops = con[..., 1][..., None]             # [G, C, 1]
+    args = con[..., 2]                       # [G, C]
+
+    av = attrs[:, cols]                      # [N, G, C]
+    av = jnp.moveaxis(av, 0, -1)             # [G, C, N]
+    is_set = av != UNSET
+
+    arg_b = args[..., None]                  # [G, C, 1]
+    lut_rows = jnp.clip(args, 0, luts.shape[0] - 1)
+    av_clip = jnp.clip(av, 0, luts.shape[1] - 1)
+    lut_val = luts[lut_rows[..., None], av_clip]   # [G, C, N]
+
+    res = jnp.where(
+        ops == DOP_EQ, is_set & (av == arg_b),
+        jnp.where(
+            ops == DOP_NEQ, (~is_set) | (av != arg_b),
+            jnp.where(
+                ops == DOP_IS_SET, is_set,
+                jnp.where(
+                    ops == DOP_IS_NOT_SET, ~is_set,
+                    jnp.where(ops == DOP_LUT, is_set & lut_val,
+                              jnp.ones_like(is_set))))))
+    return jnp.all(res, axis=1)              # [G, N]
+
+
+def feasible_mask(attrs: jnp.ndarray,        # [N, A]
+                  elig: jnp.ndarray,         # [N] bool
+                  dc_mask: jnp.ndarray,      # [N] bool
+                  pool_mask: jnp.ndarray,    # [N] bool
+                  con: jnp.ndarray,          # [G, C, 3]
+                  luts: jnp.ndarray,         # [L, V]
+                  ) -> jnp.ndarray:          # [G, N] bool
+    """Full static feasibility: node eligibility (status/drain/eligibility
+    collapsed host-side), datacenter and node-pool membership, and the
+    constraint rows.  Capacity fit is dynamic (depends on in-plan usage) and
+    lives in the selection kernel."""
+    base = elig & dc_mask & pool_mask        # [N]
+    return constraint_mask(attrs, con, luts) & base[None, :]
+
+
+feasible_mask_jit = jax.jit(feasible_mask)

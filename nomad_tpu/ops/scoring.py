@@ -1,0 +1,133 @@
+"""Vectorized ranking kernels.
+
+Replaces the reference RankIterator chain (scheduler/rank.go, spread.go):
+BinPackIterator → JobAntiAffinityIterator → NodeReschedulingPenaltyIterator →
+NodeAffinityIterator → SpreadIterator → ScoreNormalizationIterator — as dense
+[G, N] (or [N]) score tensors combined by mean-normalization, matching the
+reference's FinalScore = mean(component scores) contract so AllocMetric
+score_meta_data stays comparable.
+
+Score components (all bounded like the reference's):
+  binpack     [0, 18]   structs.ScoreFit exponential (or inverted for spread
+                        scheduler algorithm)
+  job-anti-affinity  [-1, 0]   -(collisions / desired_count)
+  node-reschedule-penalty  {-1, 0}  previous node of a rescheduled alloc
+  node-affinity  [-1, 1]  sum(matched weights)/sum(|weights|)
+  allocation-spread  [-1, 1]  per-property boost toward target percentages
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nomad_tpu.pack.interner import UNSET
+from .feasibility import constraint_mask
+
+MAX_FIT_SCORE = 18.0
+
+
+def binpack_score(cap: jnp.ndarray,          # [N, 3] float32
+                  used: jnp.ndarray,         # [N, 3] float32 (incl. proposed)
+                  req: jnp.ndarray,          # [..., 3] float32 broadcastable
+                  spread_algo: bool = False,
+                  ) -> jnp.ndarray:
+    """structs.ScoreFit vectorized.  `used + req` is the post-placement
+    utilization; only cpu (0) and memory (1) dims contribute to the score,
+    matching the reference."""
+    total_used = used + req
+    safe_cap = jnp.maximum(cap, 1.0)
+    free = 1.0 - jnp.minimum(total_used / safe_cap, 1.0)
+    total = jnp.power(10.0, free[..., 0]) + jnp.power(10.0, free[..., 1])
+    score = jnp.where(spread_algo, total - 2.0, 20.0 - total)
+    score = jnp.clip(score, 0.0, MAX_FIT_SCORE)
+    # zero-capacity nodes score 0
+    ok = (cap[..., 0] > 0) & (cap[..., 1] > 0)
+    return jnp.where(ok, score, 0.0)
+
+
+def capacity_fit(cap: jnp.ndarray,           # [N, 3] int32
+                 used: jnp.ndarray,          # [N, 3] int32
+                 req: jnp.ndarray,           # [..., 3] int32
+                 ) -> jnp.ndarray:           # [...] bool (last dim reduced)
+    """AllocsFit's dimension check (ports handled host-side at plan build)."""
+    return jnp.all(used + req <= cap, axis=-1)
+
+
+def job_anti_affinity(job_count: jnp.ndarray,   # [N] int32
+                      desired_count: jnp.ndarray | float,
+                      ) -> jnp.ndarray:          # [N] float32
+    """reference: JobAntiAffinityIterator — penalize nodes already running
+    allocs of the same job: -(collisions / desired_total)."""
+    d = jnp.maximum(desired_count, 1.0)
+    return -(job_count.astype(jnp.float32) / d)
+
+
+def affinity_score(attrs: jnp.ndarray,       # [N, A]
+                   aff: jnp.ndarray,         # [G, Af, 4] (col, op, arg, w)
+                   luts: jnp.ndarray,        # [L, V]
+                   ) -> jnp.ndarray:         # [G, N] float32
+    """reference: NodeAffinityIterator — normalized sum of matched affinity
+    weights.  Padding rows have weight 0 and contribute nothing."""
+    matched = constraint_mask_rows(attrs, aff[..., :3], luts)   # [G, Af, N]
+    w = aff[..., 3].astype(jnp.float32)                          # [G, Af]
+    total = jnp.sum(jnp.abs(w), axis=1, keepdims=True)           # [G, 1]
+    got = jnp.einsum("gan,ga->gn", matched.astype(jnp.float32), w)
+    return jnp.where(total > 0, got / jnp.maximum(total, 1.0), 0.0)
+
+
+def constraint_mask_rows(attrs: jnp.ndarray, con: jnp.ndarray,
+                         luts: jnp.ndarray) -> jnp.ndarray:
+    """Per-row (no all-reduce) predicate evaluation: [G, C, N] bool."""
+    from nomad_tpu.pack.packer import (
+        DOP_EQ, DOP_IS_NOT_SET, DOP_IS_SET, DOP_LUT, DOP_NEQ)
+    cols = con[..., 0]
+    ops = con[..., 1][..., None]
+    args = con[..., 2]
+    av = jnp.moveaxis(attrs[:, cols], 0, -1)          # [G, C, N]
+    is_set = av != UNSET
+    arg_b = args[..., None]
+    lut_rows = jnp.clip(args, 0, luts.shape[0] - 1)
+    av_clip = jnp.clip(av, 0, luts.shape[1] - 1)
+    lut_val = luts[lut_rows[..., None], av_clip]
+    return jnp.where(
+        ops == DOP_EQ, is_set & (av == arg_b),
+        jnp.where(
+            ops == DOP_NEQ, (~is_set) | (av != arg_b),
+            jnp.where(
+                ops == DOP_IS_SET, is_set,
+                jnp.where(
+                    ops == DOP_IS_NOT_SET, ~is_set,
+                    jnp.where(ops == DOP_LUT, is_set & lut_val,
+                              jnp.zeros_like(is_set))))))
+
+
+def spread_boost(sp_nodeval: jnp.ndarray,    # [S, N] int32 local value idx, -1 none
+                 sp_weight: jnp.ndarray,     # [S] float32 (0 = padding row)
+                 sp_expected: jnp.ndarray,   # [S, K] float32 expected counts
+                 sp_counts: jnp.ndarray,     # [S, K] float32 current counts
+                 ) -> jnp.ndarray:           # [N] float32
+    """reference: SpreadIterator/propertySet — boost toward target
+    percentages.  For node n with value v on spread s:
+        boost = (expected_v - count_v) / max(expected_v, 1)   clipped to <=1
+    weighted by sp_weight/100 and averaged over non-padding spreads."""
+    k = sp_counts.shape[1]
+    val = jnp.clip(sp_nodeval, 0, k - 1)
+    exp_n = jnp.take_along_axis(sp_expected, val, axis=1)     # [S, N]
+    cnt_n = jnp.take_along_axis(sp_counts, val, axis=1)       # [S, N]
+    boost = (exp_n - cnt_n) / jnp.maximum(exp_n, 1.0)
+    boost = jnp.clip(boost, -1.0, 1.0)
+    # nodes whose value is not a spread target get no boost
+    boost = jnp.where(sp_nodeval >= 0, boost, 0.0)
+    w = sp_weight / 100.0
+    n_active = jnp.maximum(jnp.sum(sp_weight > 0), 1.0)
+    return jnp.sum(boost * w[:, None], axis=0) / n_active
+
+
+def normalize_scores(components: jnp.ndarray,   # [Ncomp, ...] stacked
+                     active: jnp.ndarray,       # [Ncomp, ...] bool
+                     ) -> jnp.ndarray:
+    """reference: ScoreNormalizationIterator — FinalScore is the mean of the
+    component scores that actually apply."""
+    n = jnp.maximum(jnp.sum(active, axis=0), 1.0)
+    return jnp.sum(jnp.where(active, components, 0.0), axis=0) / n

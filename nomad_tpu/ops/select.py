@@ -1,0 +1,196 @@
+"""Placement selection kernel.
+
+Replaces the reference's per-placement iterator walk + LimitIterator(2) +
+MaxScoreIterator (scheduler/select.go) with full-cluster scoring and an
+exact argmax — stock Nomad scores a 2-node random subset per placement
+(power-of-two-choices); we score *every* feasible node, so placement quality
+strictly dominates stock while still being faster.
+
+The subtle part (SURVEY.md §4.3): placements within one plan see each other —
+capacity, job anti-affinity counts, spread counts, distinct_hosts all update
+as the plan grows.  That sequential dependence is preserved exactly with a
+`lax.scan` over the placement axis; everything inside one step is vectorized
+over all N nodes (and the static feasibility/affinity tensors are computed
+once for all G task groups before the scan).
+
+Outputs per placement: chosen node row (-1 = no node), final score, top-k
+candidate rows/scores (feeds AllocMetric.score_meta_data), and filter/exhaust
+counts (feeds nodes_filtered / nodes_exhausted / dimension_exhausted).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .feasibility import feasible_mask
+from .scoring import (
+    affinity_score,
+    binpack_score,
+    capacity_fit,
+    job_anti_affinity,
+    normalize_scores,
+    spread_boost,
+)
+
+NEG_INF = -1e30
+TOP_K = 3
+
+
+class PlacementInputs(NamedTuple):
+    """Device inputs for one eval's placement batch."""
+    # node state
+    attrs: jnp.ndarray       # [N, A] int32
+    cap: jnp.ndarray         # [N, 3] int32
+    used0: jnp.ndarray       # [N, 3] int32
+    elig: jnp.ndarray        # [N] bool
+    dc_mask: jnp.ndarray     # [N] bool
+    pool_mask: jnp.ndarray   # [N] bool
+    luts: jnp.ndarray        # [L, V] bool
+    # per-task-group statics
+    con: jnp.ndarray         # [G, C, 3] int32
+    aff: jnp.ndarray         # [G, Af, 4] int32
+    req: jnp.ndarray         # [G, 3] int32
+    desired: jnp.ndarray     # [G] int32 (tg count, anti-affinity denominator)
+    dh_limit: jnp.ndarray    # [G] int32 distinct_hosts limit (0 = none)
+    # job-level spread state
+    sp_nodeval: jnp.ndarray  # [S, N] int32 local value idx (-1 = not a target)
+    sp_weight: jnp.ndarray   # [S] float32 (0 = padding)
+    sp_expected: jnp.ndarray  # [S, K] float32
+    sp_counts0: jnp.ndarray  # [S, K] float32 (existing alloc counts)
+    # distinct_property count state (reference: propertyset.go)
+    pd_nodeval: jnp.ndarray  # [D, N] int32 local value idx (-1 = unset)
+    pd_limit: jnp.ndarray    # [D] int32 (0 = inert padding row)
+    pd_apply: jnp.ndarray    # [G, D] bool
+    pd_counts0: jnp.ndarray  # [D, Kd] int32
+    # per-placement
+    tg_idx: jnp.ndarray      # [P] int32
+    prev_row: jnp.ndarray    # [P] int32 (-1 = not a reschedule)
+    active: jnp.ndarray      # [P] bool (padding rows False)
+    # dynamic per-node
+    job_count0: jnp.ndarray  # [N] int32 (existing allocs of this job)
+    # config
+    spread_algo: jnp.ndarray  # [] bool (SchedulerAlgorithm == "spread")
+
+
+class PlacementOutputs(NamedTuple):
+    picks: jnp.ndarray        # [P] int32 node row or -1
+    scores: jnp.ndarray       # [P] float32 final (normalized) score of pick
+    topk_rows: jnp.ndarray    # [P, K] int32
+    topk_scores: jnp.ndarray  # [P, K] float32
+    n_feasible: jnp.ndarray   # [P] int32 feasible candidates at this step
+    n_filtered: jnp.ndarray   # [P] int32 statically filtered nodes
+    n_exhausted: jnp.ndarray  # [P] int32 feasible-but-full nodes
+    dim_exhausted: jnp.ndarray  # [P, 3] int32 per-dimension exhaustion
+    used: jnp.ndarray         # [N, 3] final proposed usage
+    job_count: jnp.ndarray    # [N] final job counts
+
+
+def place(inp: PlacementInputs) -> PlacementOutputs:
+    n = inp.attrs.shape[0]
+    top_k = min(TOP_K, n)
+    static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
+                           inp.con, inp.luts)              # [G, N]
+    aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)  # [G, N]
+    aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)        # [G]
+    sp_any = jnp.any(inp.sp_weight > 0)
+    capf = inp.cap.astype(jnp.float32)
+
+    def step(carry, xs):
+        used, job_count, sp_counts, pd_counts = carry
+        g, prev, act = xs
+        req_g = inp.req[g]
+        stat_g = static[g]
+        fit = capacity_fit(inp.cap, used, req_g)
+        dh_ok = jnp.where(inp.dh_limit[g] > 0,
+                          job_count < inp.dh_limit[g], True)
+        # distinct_property: node's per-value count must stay under the limit
+        kd = pd_counts.shape[1]
+        pd_val = jnp.clip(inp.pd_nodeval, 0, kd - 1)             # [D, N]
+        pd_cnt = jnp.take_along_axis(pd_counts, pd_val, axis=1)  # [D, N]
+        pd_row_ok = (pd_cnt < inp.pd_limit[:, None]) & (inp.pd_nodeval >= 0)
+        pd_applies = inp.pd_apply[g] & (inp.pd_limit > 0)        # [D]
+        pd_ok = jnp.all(jnp.where(pd_applies[:, None], pd_row_ok, True),
+                        axis=0)                                  # [N]
+        feas = stat_g & fit & dh_ok & pd_ok
+
+        # ---- rank chain ----
+        # normalized to [0,1] like the reference (rank.go: fit/maxFitScore)
+        # so binpack is comparable with the ±1-bounded affinity/spread boosts
+        bp = binpack_score(capf, used.astype(jnp.float32),
+                           req_g.astype(jnp.float32),
+                           inp.spread_algo) / 18.0
+        aa = job_anti_affinity(job_count, inp.desired[g])
+        rows = jnp.arange(n)
+        rp = jnp.where(rows == prev, -1.0, 0.0)
+        af = aff_sc[g]
+        sp = spread_boost(inp.sp_nodeval, inp.sp_weight,
+                          inp.sp_expected, sp_counts)
+        comps = jnp.stack([bp, aa, rp, af, sp])            # [5, N]
+        act_mask = jnp.stack([
+            jnp.ones(n, bool),
+            job_count > 0,
+            rows == prev,
+            jnp.broadcast_to(aff_any[g], (n,)),
+            jnp.broadcast_to(sp_any, (n,)),
+        ])
+        final = normalize_scores(comps, act_mask)
+
+        masked = jnp.where(feas, final, NEG_INF)
+        top_sc, top_rows = jax.lax.top_k(masked, top_k)
+        pick = top_rows[0]
+        ok = act & (top_sc[0] > NEG_INF / 2)
+        pick = jnp.where(ok, pick, -1)
+
+        # ---- state update (no-op when not placed) ----
+        onehot = (rows == pick) & ok
+        used = used + onehot[:, None].astype(jnp.int32) * req_g[None, :]
+        job_count = job_count + onehot.astype(jnp.int32)
+        # spread counts: bump (s, value[s, pick]) for real values
+        val_p = jnp.where(pick >= 0,
+                          inp.sp_nodeval[:, jnp.maximum(pick, 0)],
+                          -1)                               # [S]
+        k = sp_counts.shape[1]
+        sp_hot = (jax.nn.one_hot(jnp.clip(val_p, 0, k - 1), k)
+                  * ((val_p >= 0) & ok)[..., None])
+        sp_counts = sp_counts + sp_hot
+        # distinct_property counts bump only for rows applying to this TG
+        pd_val_p = jnp.where(pick >= 0,
+                             inp.pd_nodeval[:, jnp.maximum(pick, 0)],
+                             -1)                            # [D]
+        pd_hot = (jax.nn.one_hot(jnp.clip(pd_val_p, 0, kd - 1), kd,
+                                 dtype=pd_counts.dtype)
+                  * ((pd_val_p >= 0) & inp.pd_apply[g] & ok)[..., None])
+        pd_counts = pd_counts + pd_hot
+
+        # ---- metrics ----
+        n_filtered = jnp.sum(~stat_g)
+        exhausted = stat_g & (~fit | ~dh_ok)
+        n_exhausted = jnp.sum(exhausted)
+        over = (used - onehot[:, None].astype(jnp.int32) * req_g[None, :]
+                + req_g[None, :]) > inp.cap                # pre-update usage
+        dim_ex = jnp.sum((stat_g & ~fit)[:, None] & over, axis=0)
+
+        out = (pick,
+               jnp.where(ok, top_sc[0], 0.0),
+               jnp.where(ok, top_rows, -1),
+               jnp.where(ok, top_sc, 0.0),
+               jnp.sum(feas).astype(jnp.int32),
+               n_filtered.astype(jnp.int32),
+               n_exhausted.astype(jnp.int32),
+               dim_ex.astype(jnp.int32))
+        return (used, job_count, sp_counts, pd_counts), out
+
+    carry0 = (inp.used0, inp.job_count0, inp.sp_counts0, inp.pd_counts0)
+    (used, job_count, _, _), outs = jax.lax.scan(
+        step, carry0, (inp.tg_idx, inp.prev_row, inp.active))
+    return PlacementOutputs(
+        picks=outs[0], scores=outs[1], topk_rows=outs[2], topk_scores=outs[3],
+        n_feasible=outs[4], n_filtered=outs[5], n_exhausted=outs[6],
+        dim_exhausted=outs[7], used=used, job_count=job_count)
+
+
+place_jit = jax.jit(place)

@@ -1,0 +1,13 @@
+"""Host→device lowering: interning, packed tensors, constraint LUTs."""
+
+from .interner import Interner, UNSET  # noqa: F401
+from .packer import (  # noqa: F401
+    ClusterPacker,
+    DistinctTensors,
+    JobContext,
+    NodeTensors,
+    TGTensors,
+    node_property_map,
+    resolve_target_key,
+)
+from .spread import SpreadTensors, lower_spreads  # noqa: F401

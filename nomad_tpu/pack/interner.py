@@ -1,0 +1,60 @@
+"""String interning for the host→device lowering plane.
+
+Strings (attribute values, datacenters, node classes, pools) never reach the
+device: they are interned to dense int32 ids here, and every string-valued
+predicate (regex, version, lexical order, set_contains) is pre-evaluated
+host-side over the vocabulary into boolean lookup tables (LUTs) the device
+gathers through.  UNSET (-1) marks a missing attribute.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+UNSET = -1
+
+
+class Interner:
+    """Monotone string→int32 vocabulary with reverse lookup."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[str, int] = {}
+        self._strs: List[str] = []
+
+    def intern(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._strs)
+            self._ids[s] = i
+            self._strs.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Like intern but returns UNSET for unknown strings (used for
+        constraint rtargets that match no existing value)."""
+        return self._ids.get(s, UNSET)
+
+    def string(self, i: int) -> str:
+        return self._strs[i]
+
+    def __len__(self) -> int:
+        return len(self._strs)
+
+    @property
+    def version(self) -> int:
+        """Grows monotonically with the vocab; LUT cache key component."""
+        return len(self._strs)
+
+    def strings(self) -> List[str]:
+        return self._strs
+
+    def build_lut(self, predicate) -> np.ndarray:
+        """Evaluate `predicate(value_string) -> bool` over the whole vocab.
+        Returns a [V] bool array; callers index it with value ids (UNSET
+        handled by the caller's is-set mask)."""
+        out = np.zeros(len(self._strs), dtype=bool)
+        for i, s in enumerate(self._strs):
+            out[i] = bool(predicate(s))
+        return out

@@ -1,0 +1,548 @@
+"""Snapshot packer: host-side lowering of cluster state to device tensors.
+
+SURVEY.md §7 P1.  Nodes become packed int32/float32 rows; every string
+predicate is pre-lowered so the device kernels (nomad_tpu.ops) see only:
+
+  - `cap`   [N, 3] int32   usable capacity (cpu MHz, memory MB, disk MB),
+                           node reservations already subtracted
+  - `used`  [N, 3] int32   sum of non-terminal alloc resources per node
+  - `attrs` [N, A] int32   interned value id per attribute column (-1 unset)
+  - `elig`  [N]    bool    node.ready() (status+drain+eligibility collapsed)
+  - `dc`, `pool`, `klass` [N] int32   interned ids for the hot synthetics
+
+plus per-eval tensors from `lower_task_groups` (constraint rows, LUTs,
+affinity rows, spread specs, resource asks).
+
+Incremental sync: `attach(store)` subscribes to state-store events and marks
+dirty node rows; `update(snapshot)` rebuilds only those rows.  Device upload
+and caching live in nomad_tpu.ops — these are host (numpy) buffers, the
+rebuildable cache of a state snapshot (never the source of truth).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    Affinity,
+    Constraint,
+    Job,
+    Node,
+    OP_DISTINCT_HOSTS,
+    OP_DISTINCT_PROPERTY,
+    OP_EQ,
+    OP_IS_NOT_SET,
+    OP_IS_SET,
+    OP_NEQ,
+    OP_REGEX,
+    OP_SEMVER,
+    OP_SET_CONTAINS,
+    OP_SET_CONTAINS_ALL,
+    OP_SET_CONTAINS_ANY,
+    OP_VERSION,
+    TaskGroup,
+)
+from nomad_tpu.utils.version import check_constraint as check_version
+
+from .interner import Interner, UNSET
+
+# Device-side constraint opcodes (see ops/feasibility.py):
+DOP_TRUE = 0        # padding row, always satisfied
+DOP_EQ = 1          # set(col) and attrs[col] == arg
+DOP_NEQ = 2         # unset(col) or attrs[col] != arg
+DOP_IS_SET = 3
+DOP_IS_NOT_SET = 4
+DOP_LUT = 5         # set(col) and luts[arg, attrs[col]]
+
+_TARGET_RE = re.compile(r"^\$\{(.+)\}$")
+
+
+def resolve_target_key(target: str) -> str:
+    """Normalize a constraint l-target to a column key
+    (reference: scheduler/feasible.go resolveTarget interpolation)."""
+    m = _TARGET_RE.match(target.strip())
+    t = m.group(1) if m else target.strip()
+    if t.startswith(("attr.", "meta.", "node.", "driver.", "hostvol.", "csi.")):
+        return t
+    # bare names historically resolve as attributes
+    return "attr." + t
+
+
+def node_property_map(node: Node) -> Dict[str, str]:
+    """All scheduling-relevant string properties of a node, keyed by column
+    key.  This is the single place node state is flattened for the device."""
+    out: Dict[str, str] = {
+        "node.datacenter": node.datacenter,
+        "node.class": node.node_class,
+        "node.pool": node.node_pool,
+        "node.region": "global",
+        "node.unique.name": node.name,
+        "node.unique.id": node.id,
+    }
+    for k, v in node.attributes.items():
+        out["attr." + k] = v
+    for k, v in node.meta.items():
+        out["meta." + k] = v
+    for drv, healthy in node.drivers.items():
+        if healthy:
+            out["driver." + drv] = "1"
+    for vol in node.host_volumes:
+        out["hostvol." + vol] = "1"
+    for plug, ok in node.csi_node_plugins.items():
+        if ok:
+            out["csi." + plug] = "1"
+    return out
+
+
+@dataclass
+class NodeTensors:
+    """Host-side packed node state (numpy; ops layer handles device upload)."""
+
+    node_ids: List[str]
+    id_to_row: Dict[str, int]
+    cap: np.ndarray          # [N,3] int32
+    used: np.ndarray         # [N,3] int32
+    attrs: np.ndarray        # [N,A] int32
+    elig: np.ndarray         # [N] bool
+    dc: np.ndarray           # [N] int32
+    pool: np.ndarray         # [N] int32
+    klass: np.ndarray        # [N] int32  (computed-class id)
+    version: int = 0         # bumped on every row change (device cache key)
+
+    @property
+    def n(self) -> int:
+        return len(self.node_ids)
+
+
+class ClusterPacker:
+    """Maintains NodeTensors for a state store / snapshots.
+
+    Column registry and value vocabulary grow monotonically; rows are
+    rebuilt incrementally from dirty-node tracking.
+    """
+
+    def __init__(self, interner: Optional[Interner] = None) -> None:
+        self.interner = interner or Interner()
+        self.columns: Dict[str, int] = {}
+        self._tensors: Optional[NodeTensors] = None
+        self._dirty: Set[str] = set()
+        self._all_dirty = True
+        self._attached = False
+        self._seq = 0                 # monotone tensor version source
+        self._last_index = -1         # state index the tensors reflect
+        # LUT cache: (operand, rtarget) -> [lut_id, vocab_size_built_to].
+        # Rows are extended in place as the vocab grows, so the device LUT
+        # matrix stays O(#distinct predicates), not O(#evals).
+        self._lut_cache: Dict[Tuple[str, str], List[int]] = {}
+        self._luts: List[np.ndarray] = []
+
+    # ------------------------------------------------------------ columns
+
+    def ensure_column(self, key: str) -> int:
+        col = self.columns.get(key)
+        if col is None:
+            col = len(self.columns)
+            self.columns[key] = col
+            t = self._tensors
+            if t is not None and t.attrs.shape[1] < len(self.columns):
+                t.attrs = np.concatenate(
+                    [t.attrs, np.full((t.attrs.shape[0], 1), UNSET, np.int32)],
+                    axis=1)
+        return col
+
+    # ------------------------------------------------------- store attach
+
+    def attach(self, store) -> None:
+        """Subscribe to a StateStore for dirty-row tracking."""
+
+        self._attached = True
+
+        def on_event(topic: str, index: int, payload) -> None:
+            if topic == "Node":
+                nid = payload if isinstance(payload, str) else payload.id
+                self._dirty.add(nid)
+            elif topic == "Allocation":
+                if payload.node_id:
+                    self._dirty.add(payload.node_id)
+            elif topic == "PlanResult":
+                for table in (payload.node_update, payload.node_allocation,
+                              payload.node_preemptions):
+                    self._dirty.update(table.keys())
+
+        store.subscribe(on_event)
+
+    # ------------------------------------------------------------- build
+
+    def build(self, snapshot) -> NodeTensors:
+        """Full rebuild from a snapshot."""
+        nodes = snapshot.nodes()
+        n = len(nodes)
+        # discover all columns first so attrs has stable width this build
+        prop_maps = [node_property_map(nd) for nd in nodes]
+        for pm in prop_maps:
+            for k in pm:
+                self.ensure_column(k)
+        a = len(self.columns)
+        t = NodeTensors(
+            node_ids=[nd.id for nd in nodes],
+            id_to_row={nd.id: i for i, nd in enumerate(nodes)},
+            cap=np.zeros((n, 3), np.int32),
+            used=np.zeros((n, 3), np.int32),
+            attrs=np.full((n, a), UNSET, np.int32),
+            elig=np.zeros(n, bool),
+            dc=np.zeros(n, np.int32),
+            pool=np.zeros(n, np.int32),
+            klass=np.zeros(n, np.int32),
+        )
+        for i, nd in enumerate(nodes):
+            self._fill_row(t, i, nd, snapshot, prop_maps[i])
+        self._seq += 1
+        t.version = self._seq
+        self._tensors = t
+        self._dirty.clear()
+        self._all_dirty = False
+        self._last_index = getattr(snapshot, "index", -1)
+        return t
+
+    def update(self, snapshot) -> NodeTensors:
+        """Incremental: rebuild only dirty rows; add/remove nodes as needed.
+
+        Without `attach()` there is no dirty tracking, so any state-index
+        change forces a full rebuild (correct, just slower); an unchanged
+        index returns the cached tensors as-is."""
+        t = self._tensors
+        if t is None or self._all_dirty:
+            return self.build(snapshot)
+        if not self._attached:
+            if getattr(snapshot, "index", -1) == self._last_index:
+                return t
+            return self.build(snapshot)
+        live_ids = {nd.id for nd in snapshot.nodes()}
+        removed = [nid for nid in t.node_ids if nid not in live_ids]
+        added = [nid for nid in live_ids if nid not in t.id_to_row]
+        if removed or added:
+            # membership change: full rebuild keeps row mapping simple
+            return self.build(snapshot)
+        if not self._dirty:
+            self._last_index = getattr(snapshot, "index", self._last_index)
+            return t
+        for nid in self._dirty:
+            row = t.id_to_row.get(nid)
+            if row is None:
+                continue
+            nd = snapshot.node_by_id(nid)
+            if nd is None:
+                continue
+            pm = node_property_map(nd)
+            for k in pm:
+                self.ensure_column(k)
+            t.attrs[row, :] = UNSET
+            self._fill_row(t, row, nd, snapshot, pm)
+        self._seq += 1
+        t.version = self._seq
+        self._dirty.clear()
+        self._last_index = getattr(snapshot, "index", self._last_index)
+        return t
+
+    def _fill_row(self, t: NodeTensors, i: int, nd: Node, snapshot, pm) -> None:
+        t.cap[i] = (nd.resources.cpu - nd.reserved.cpu,
+                    nd.resources.memory_mb - nd.reserved.memory_mb,
+                    nd.resources.disk_mb - nd.reserved.disk_mb)
+        used = [0, 0, 0]
+        for alc in snapshot.allocs_by_node(nd.id):
+            if alc.terminal_status():
+                continue
+            used[0] += alc.resources.cpu
+            used[1] += alc.resources.memory_mb
+            used[2] += alc.resources.disk_mb
+        t.used[i] = used
+        t.elig[i] = nd.ready()
+        t.dc[i] = self.interner.intern(nd.datacenter)
+        t.pool[i] = self.interner.intern(nd.node_pool)
+        t.klass[i] = self.interner.intern(nd.computed_class or nd.id)
+        for k, v in pm.items():
+            t.attrs[i, self.columns[k]] = self.interner.intern(v)
+
+    # ------------------------------------------------- constraint lowering
+
+    def lower_predicate(self, operand: str, rtarget: str) -> Tuple[int, int]:
+        """Lower (operand, rtarget) to a device (op, arg) pair.  LUT-class
+        predicates are evaluated over the vocab host-side and cached."""
+        if operand in ("=", "==", "is"):
+            return DOP_EQ, self.interner.lookup(rtarget)
+        if operand in ("!=", "not"):
+            return DOP_NEQ, self.interner.lookup(rtarget)
+        if operand == OP_IS_SET:
+            return DOP_IS_SET, 0
+        if operand == OP_IS_NOT_SET:
+            return DOP_IS_NOT_SET, 0
+        return DOP_LUT, self._lut_id(operand, rtarget)
+
+    def _lut_id(self, operand: str, rtarget: str) -> int:
+        key = (operand, rtarget)
+        v = len(self.interner)
+        hit = self._lut_cache.get(key)
+        if hit is not None:
+            lid, built = hit
+            if built < v:
+                # vocab grew: evaluate only the new values, extend in place
+                pred = _string_predicate(operand, rtarget)
+                ext = np.fromiter(
+                    (pred(self.interner.string(i)) for i in range(built, v)),
+                    dtype=bool, count=v - built)
+                self._luts[lid] = np.concatenate([self._luts[lid], ext])
+                hit[1] = v
+            return lid
+        pred = _string_predicate(operand, rtarget)
+        lut = self.interner.build_lut(pred)
+        lid = len(self._luts)
+        self._luts.append(lut)
+        self._lut_cache[key] = [lid, v]
+        return lid
+
+    def lut_matrix(self) -> np.ndarray:
+        """[L, V] bool, padded to the current vocab size."""
+        v = len(self.interner)
+        if not self._luts:
+            return np.zeros((1, max(v, 1)), bool)
+        out = np.zeros((len(self._luts), max(v, 1)), bool)
+        for i, lut in enumerate(self._luts):
+            out[i, :len(lut)] = lut
+        return out
+
+    # --------------------------------------------------------- TG lowering
+
+    def lower_task_groups(self, job: Job, tgs: Sequence[TaskGroup],
+                          ) -> "TGTensors":
+        """Pack the placeable unit: per-TG resource asks + constraint rows +
+        affinity rows.  Job-level constraints/affinities apply to every TG;
+        task-level ones are merged up (the TG is the placement unit).
+        distinct_hosts / distinct_property become dynamic specs handled by
+        the selection kernel, not static rows."""
+        g = len(tgs)
+        req = np.zeros((g, 3), np.int32)
+        dh_limit = np.zeros(g, np.int32)
+        rows: List[List[Tuple[int, int, int]]] = []
+        aff_rows: List[List[Tuple[int, int, int, int]]] = []
+        # distinct_property specs: (col, limit, scope) where scope is None
+        # for job-level (counts all job allocs) or the TG name (counts only
+        # that TG's allocs) — consumed by lower_distinct.
+        distinct: List[List[Tuple[int, int, Optional[str]]]] = []
+        for gi, tg in enumerate(tgs):
+            ask = tg.combined_resources()
+            req[gi] = (ask.cpu, ask.memory_mb, ask.disk_mb)
+            crows: List[Tuple[int, int, int]] = []
+            dist: List[Tuple[int, int, Optional[str]]] = []
+            for task in tg.tasks:
+                if task.driver:
+                    crows.append((self.ensure_column("driver." + task.driver),
+                                  DOP_EQ, self.interner.intern("1")))
+            for scope, constraints in (
+                    (None, job.constraints),
+                    (tg.name, list(tg.constraints)
+                     + [c for task in tg.tasks for c in task.constraints])):
+                for c in constraints:
+                    lowered = self._lower_constraint(c)
+                    if lowered is not None:
+                        crows.append(lowered)
+                    elif c.operand == OP_DISTINCT_HOSTS:
+                        dh_limit[gi] = max(_int_or(c.rtarget, 1), 1)
+                    elif c.operand == OP_DISTINCT_PROPERTY:
+                        dist.append((
+                            self.ensure_column(resolve_target_key(c.ltarget)),
+                            max(_int_or(c.rtarget, 1), 1), scope))
+            arows: List[Tuple[int, int, int, int]] = []
+            affinities = (list(job.affinities) + list(tg.affinities)
+                          + [a for task in tg.tasks for a in task.affinities])
+            for af in affinities:
+                op, arg = self.lower_predicate(af.operand, af.rtarget)
+                col = self.ensure_column(resolve_target_key(af.ltarget))
+                arows.append((col, op, arg, int(af.weight)))
+            rows.append(crows)
+            aff_rows.append(arows)
+            distinct.append(dist)
+
+        c_max = max([len(r) for r in rows] + [1])
+        a_max = max([len(r) for r in aff_rows] + [1])
+        con = np.zeros((g, c_max, 3), np.int32)   # (col, op, arg); op 0 pad
+        aff = np.zeros((g, a_max, 4), np.int32)
+        for gi in range(g):
+            for ci, row in enumerate(rows[gi]):
+                con[gi, ci] = row
+            for ai, row in enumerate(aff_rows[gi]):
+                aff[gi, ai] = row
+        return TGTensors(
+            names=[tg.name for tg in tgs], req=req, con=con, aff=aff,
+            dh_limit=dh_limit, distinct=distinct, luts=self.lut_matrix(),
+        )
+
+    def lower_distinct(self, job: Job, tgs: Sequence[TaskGroup],
+                       tg_tensors: "TGTensors", tensors: NodeTensors,
+                       snapshot) -> "DistinctTensors":
+        """Pack distinct_property constraints into per-value count state the
+        selection kernel enforces and updates as the plan grows
+        (reference: scheduler/propertyset.go).  Nodes lacking the property
+        are infeasible for the constraint, matching the reference."""
+        n = tensors.n
+        # dedupe (col, limit, scope) rows; remember which TGs they apply to
+        specs: Dict[Tuple[int, int, Optional[str]], List[int]] = {}
+        for gi, dist in enumerate(tg_tensors.distinct):
+            for spec in dist:
+                specs.setdefault(spec, []).append(gi)
+        if not specs or n == 0:
+            return DistinctTensors.empty(len(tgs), n)
+        d = len(specs)
+        nodeval = np.full((d, n), -1, np.int32)
+        limit = np.zeros(d, np.int32)
+        apply = np.zeros((len(tgs), d), bool)
+        counts_rows: List[np.ndarray] = []
+        k_max = 1
+        for di, ((col, lim, scope), gis) in enumerate(specs.items()):
+            col_vals = (tensors.attrs[:, col] if col < tensors.attrs.shape[1]
+                        else np.full(n, UNSET, np.int32))
+            uniq = [int(v) for v in np.unique(col_vals) if v != UNSET]
+            local = {v: i for i, v in enumerate(uniq)}
+            k = max(len(uniq), 1)
+            k_max = max(k_max, k)
+            remap = np.full(len(self.interner) + 1, -1, np.int32)
+            for v, li in local.items():
+                remap[v] = li
+            nodeval[di] = np.where(col_vals == UNSET, -1, remap[col_vals])
+            limit[di] = lim
+            for gi in gis:
+                apply[gi, di] = True
+            counts = np.zeros(k, np.int32)
+            for alc in snapshot.allocs_by_job(job.namespace, job.id):
+                if alc.terminal_status():
+                    continue
+                if scope is not None and alc.task_group != scope:
+                    continue
+                row = tensors.id_to_row.get(alc.node_id)
+                if row is not None and nodeval[di, row] >= 0:
+                    counts[nodeval[di, row]] += 1
+            counts_rows.append(counts)
+        cnt = np.zeros((d, k_max), np.int32)
+        for di, c in enumerate(counts_rows):
+            cnt[di, :len(c)] = c
+        return DistinctTensors(pd_nodeval=nodeval, pd_limit=limit,
+                               pd_apply=apply, pd_counts0=cnt)
+
+    def _lower_constraint(self, c: Constraint
+                          ) -> Optional[Tuple[int, int, int]]:
+        if c.operand in (OP_DISTINCT_HOSTS, OP_DISTINCT_PROPERTY):
+            return None
+        op, arg = self.lower_predicate(c.operand, c.rtarget)
+        col = self.ensure_column(resolve_target_key(c.ltarget))
+        return (col, op, arg)
+
+    def job_context(self, job: Job, snapshot, tensors: NodeTensors,
+                    ) -> "JobContext":
+        """Per-eval dynamic vectors the kernels need beyond static state:
+        dc/pool masks and the job's current per-node alloc counts (for
+        anti-affinity and distinct_hosts)."""
+        dc_ids = np.array([self.interner.intern(d) for d in job.datacenters],
+                          np.int32)
+        dc_mask = np.isin(tensors.dc, dc_ids)
+        if job.node_pool in ("", "all"):
+            pool_mask = np.ones(tensors.n, bool)
+        else:
+            pool_mask = tensors.pool == self.interner.intern(job.node_pool)
+        job_count = np.zeros(tensors.n, np.int32)
+        for alc in snapshot.allocs_by_job(job.namespace, job.id):
+            if alc.terminal_status():
+                continue
+            row = tensors.id_to_row.get(alc.node_id)
+            if row is not None:
+                job_count[row] += 1
+        return JobContext(dc_mask=dc_mask, pool_mask=pool_mask,
+                          job_count=job_count)
+
+
+@dataclass
+class TGTensors:
+    names: List[str]
+    req: np.ndarray                      # [G,3] int32
+    con: np.ndarray                      # [G,C,3] int32 (col, op, arg)
+    aff: np.ndarray                      # [G,Af,4] int32 (col, op, arg, w)
+    dh_limit: np.ndarray                 # [G] int32 distinct_hosts (0=none)
+    distinct: List[List[Tuple[int, int, Optional[str]]]]
+    luts: np.ndarray                     # [L,V] bool
+
+
+@dataclass
+class DistinctTensors:
+    """distinct_property count state (reference: propertyset.go)."""
+    pd_nodeval: np.ndarray               # [D,N] int32 local value idx (-1)
+    pd_limit: np.ndarray                 # [D] int32 (0 = inert padding)
+    pd_apply: np.ndarray                 # [G,D] bool
+    pd_counts0: np.ndarray               # [D,K] int32
+
+    @staticmethod
+    def empty(g: int, n: int) -> "DistinctTensors":
+        return DistinctTensors(
+            pd_nodeval=np.full((1, max(n, 1)), -1, np.int32),
+            pd_limit=np.zeros(1, np.int32),
+            pd_apply=np.zeros((max(g, 1), 1), bool),
+            pd_counts0=np.zeros((1, 1), np.int32),
+        )
+
+
+@dataclass
+class JobContext:
+    dc_mask: np.ndarray                  # [N] bool
+    pool_mask: np.ndarray                # [N] bool
+    job_count: np.ndarray                # [N] int32
+
+
+def _int_or(s: str, default: int) -> int:
+    try:
+        return int(s)
+    except (TypeError, ValueError):
+        return default
+
+
+def _split_set(s: str) -> List[str]:
+    return [p.strip() for p in s.split(",") if p.strip()]
+
+
+def _string_predicate(operand: str, rtarget: str):
+    """Host-side evaluation of LUT-class predicates over vocab strings
+    (reference: scheduler/feasible.go checkConstraint/checkLexicalOrder/
+    checkVersionMatch/checkRegexpMatch/checkSetContainsAll)."""
+    if operand == OP_REGEX:
+        try:
+            rx = re.compile(rtarget)
+        except re.error:
+            return lambda v: False
+        return lambda v: rx.search(v) is not None
+    if operand == OP_VERSION:
+        return lambda v: check_version(v, rtarget, strict=False)
+    if operand == OP_SEMVER:
+        return lambda v: check_version(v, rtarget, strict=True)
+    if operand in (OP_SET_CONTAINS, OP_SET_CONTAINS_ALL):
+        want = _split_set(rtarget)
+        return lambda v: set(want) <= {p.strip() for p in v.split(",")}
+    if operand == OP_SET_CONTAINS_ANY:
+        want = set(_split_set(rtarget))
+        return lambda v: bool(want & {p.strip() for p in v.split(",")})
+    if operand in ("<", "<=", ">", ">="):
+        def order(v: str) -> bool:
+            # numeric if both parse, else lexical (reference checkLexicalOrder)
+            try:
+                lv, rv = float(v), float(rtarget)
+            except ValueError:
+                lv, rv = v, rtarget  # type: ignore[assignment]
+            if operand == "<":
+                return lv < rv
+            if operand == "<=":
+                return lv <= rv
+            if operand == ">":
+                return lv > rv
+            return lv >= rv
+        return order
+    # unknown operand: never feasible (loud is better than silently true)
+    return lambda v: False

@@ -1,0 +1,113 @@
+"""Spread lowering (reference: scheduler/spread.go, propertyset.go).
+
+A Spread stanza targets an attribute column; the device needs, per spread:
+  sp_nodeval  [S, N]  each node's *local* value index for the spread
+                      attribute (-1 when the node's value isn't tracked)
+  sp_weight   [S]     stanza weight (0 marks padding rows)
+  sp_expected [S, K]  expected alloc count per tracked value
+  sp_counts0  [S, K]  current (existing, non-terminal) counts per value
+
+Expected counts follow the reference's propertySet math: explicit targets get
+`percent/100 * desired_total`; with no explicit targets the desired total is
+split evenly across the values observed on feasible-eligible nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+import numpy as np
+
+from nomad_tpu.structs import Job
+from .interner import UNSET
+from .packer import ClusterPacker, NodeTensors, resolve_target_key
+
+
+@dataclass
+class SpreadTensors:
+    sp_nodeval: np.ndarray   # [S, N] int32
+    sp_weight: np.ndarray    # [S] float32
+    sp_expected: np.ndarray  # [S, K] float32
+    sp_counts0: np.ndarray   # [S, K] float32
+
+    @staticmethod
+    def empty(n: int) -> "SpreadTensors":
+        return SpreadTensors(
+            sp_nodeval=np.full((1, n), -1, np.int32),
+            sp_weight=np.zeros(1, np.float32),
+            sp_expected=np.ones((1, 1), np.float32),
+            sp_counts0=np.zeros((1, 1), np.float32),
+        )
+
+
+def lower_spreads(packer: ClusterPacker, job: Job, tensors: NodeTensors,
+                  snapshot) -> SpreadTensors:
+    spreads = list(job.spreads)
+    for tg in job.task_groups:
+        spreads.extend(tg.spreads)
+    n = tensors.n
+    if not spreads:
+        return SpreadTensors.empty(n)
+
+    desired_total = sum(tg.count for tg in job.task_groups)
+    sp_nodeval = []
+    sp_weight = []
+    expected_rows: List[np.ndarray] = []
+    counts_rows: List[np.ndarray] = []
+    k_max = 1
+
+    for sp in spreads:
+        col = packer.ensure_column(resolve_target_key(sp.attribute))
+        col_vals = (tensors.attrs[:, col] if col < tensors.attrs.shape[1]
+                    else np.full(n, UNSET, np.int32))
+        # tracked values: explicit targets first, then observed values
+        local: Dict[int, int] = {}
+        pcts: List[float] = []
+        for t in sp.targets:
+            vid = packer.interner.intern(t.value)
+            if vid not in local:
+                local[vid] = len(local)
+                pcts.append(float(t.percent))
+        if not sp.targets:
+            for vid in np.unique(col_vals[tensors.elig]):
+                if vid != UNSET and vid not in local:
+                    local[vid] = len(local)
+            k = max(len(local), 1)
+            pcts = [100.0 / k] * len(local)
+        k = max(len(local), 1)
+        k_max = max(k_max, k)
+
+        remap = np.full(len(packer.interner) + 1, -1, np.int32)
+        for vid, li in local.items():
+            remap[vid] = li
+        nodeval = np.where(col_vals == UNSET, -1, remap[col_vals])
+
+        expected = np.zeros(k, np.float32)
+        for li, pct in enumerate(pcts):
+            expected[li] = pct / 100.0 * desired_total
+        counts = np.zeros(k, np.float32)
+        for alc in snapshot.allocs_by_job(job.namespace, job.id):
+            if alc.terminal_status():
+                continue
+            row = tensors.id_to_row.get(alc.node_id)
+            if row is not None and nodeval[row] >= 0:
+                counts[nodeval[row]] += 1
+
+        sp_nodeval.append(nodeval.astype(np.int32))
+        sp_weight.append(float(sp.weight))
+        expected_rows.append(expected)
+        counts_rows.append(counts)
+
+    s = len(sp_nodeval)
+    exp = np.zeros((s, k_max), np.float32)
+    cnt = np.zeros((s, k_max), np.float32)
+    for i in range(s):
+        exp[i, :len(expected_rows[i])] = expected_rows[i]
+        cnt[i, :len(counts_rows[i])] = counts_rows[i]
+    return SpreadTensors(
+        sp_nodeval=np.stack(sp_nodeval),
+        sp_weight=np.array(sp_weight, np.float32),
+        sp_expected=exp,
+        sp_counts0=cnt,
+    )

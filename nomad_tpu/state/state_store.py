@@ -311,7 +311,7 @@ class StateStore:
                 allocs.extend(node_allocs)
             self._insert_allocs(allocs, idx)
             if result.deployment is not None:
-                dep = result.deployment
+                dep = result.deployment.copy()
                 prev = self._deployments.get(dep.id)
                 dep.create_index = prev.create_index if prev else idx
                 dep.modify_index = idx

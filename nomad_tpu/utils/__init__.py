@@ -1,0 +1,4 @@
+"""Cross-cutting helpers."""
+
+from .version import check_constraint as check_version_constraint  # noqa: F401
+from .version import parse_version  # noqa: F401

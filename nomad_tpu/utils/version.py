@@ -1,0 +1,98 @@
+"""Version constraint checking (reference: go-version / go-semver usage in
+scheduler/feasible.go checkVersionMatch / checkSemverMatch).
+
+Implements the go-version constraint grammar subset Nomad uses:
+    ">= 1.2, < 2.0"   comma-separated list, all must hold
+    operators: =, !=, >, <, >=, <=, ~> (pessimistic)
+Pre-release handling: "1.2.3-beta" — numeric segments compare numerically,
+pre-release tags compare lexically and sort before the release (semver mode);
+in lenient (version) mode a malformed version never matches.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+_VER_RE = re.compile(
+    r"^v?(\d+(?:\.\d+)*)(?:-([0-9A-Za-z.-]+))?(?:\+[0-9A-Za-z.-]+)?$")
+
+_OP_RE = re.compile(r"^\s*(>=|<=|!=|~>|=|>|<)?\s*(.+?)\s*$")
+
+
+def parse_version(s: str) -> Optional[Tuple[Tuple[int, ...], Tuple]]:
+    """Returns ((nums...), prerelease_key) or None if unparseable."""
+    m = _VER_RE.match(s.strip())
+    if not m:
+        return None
+    nums = tuple(int(x) for x in m.group(1).split("."))
+    pre = m.group(2)
+    if pre is None:
+        # release sorts after any pre-release: use a sentinel that compares
+        # greater than any tuple of parts
+        pre_key: Tuple = (1,)
+    else:
+        parts = []
+        for part in pre.split("."):
+            parts.append((0, int(part)) if part.isdigit() else (1, part))
+        pre_key = (0, tuple(parts))
+    return nums, pre_key
+
+
+def _cmp(a, b) -> int:
+    (an, ap), (bn, bp) = a, b
+    # pad numeric segments to equal length
+    ln = max(len(an), len(bn))
+    an = an + (0,) * (ln - len(an))
+    bn = bn + (0,) * (ln - len(bn))
+    if an != bn:
+        return -1 if an < bn else 1
+    if ap == bp:
+        return 0
+    return -1 if ap < bp else 1
+
+
+def check_constraint(version: str, constraints: str, strict: bool = False) -> bool:
+    """True when `version` satisfies the comma-separated `constraints`.
+    strict=True is the `semver` operand (requires 3 numeric segments)."""
+    v = parse_version(version)
+    if v is None:
+        return False
+    if strict and len(v[0]) != 3:
+        return False
+    for clause in constraints.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        m = _OP_RE.match(clause)
+        if not m:
+            return False
+        op = m.group(1) or "="
+        target = parse_version(m.group(2))
+        if target is None:
+            return False
+        c = _cmp(v, target)
+        if op == "=" and c != 0:
+            return False
+        if op == "!=" and c == 0:
+            return False
+        if op == ">" and c <= 0:
+            return False
+        if op == ">=" and c < 0:
+            return False
+        if op == "<" and c >= 0:
+            return False
+        if op == "<=" and c > 0:
+            return False
+        if op == "~>":
+            # pessimistic: >= target and < next significant release
+            if c < 0:
+                return False
+            tn = target[0]
+            if len(tn) <= 1:
+                upper = (tn[0] + 1,)
+            else:
+                upper = tn[:-2] + (tn[-2] + 1,)
+            if _cmp(v, (upper, (0, ()))) >= 0:
+                return False
+    return True
