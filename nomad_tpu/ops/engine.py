@@ -82,9 +82,16 @@ class PlacementEngine:
     def place(self, snapshot, job: Job, tgs: Sequence[TaskGroup],
               requests: Sequence[PlacementRequest],
               tensors: Optional[NodeTensors] = None,
+              stopped_allocs: Sequence = (),
               ) -> List[PlacementDecision]:
         """Score + select nodes for `requests` (placements of `tgs`).
-        Returns one decision per request, in order."""
+        Returns one decision per request, in order.
+
+        `stopped_allocs`: allocs the in-flight plan is stopping/evicting —
+        their usage (and job-count, for this job) is subtracted before
+        scoring, mirroring the reference's proposed-allocation view that
+        folds plan.NodeUpdate into capacity (plan_apply.go evaluateNodePlan).
+        """
         if not requests:
             return []
         t0 = time.perf_counter_ns()
@@ -113,8 +120,23 @@ class PlacementEngine:
         pd = self.packer.lower_distinct(job, tgs, tg_tensors, t, snapshot)
         algo = snapshot.scheduler_config().scheduler_algorithm
         dev = self._node_arrays(t)
+        used0 = dev["used"]
+        job_count = ctx.job_count
+        if stopped_allocs:
+            delta = np.zeros((n, 3), np.int32)
+            job_count = job_count.copy()
+            for a in stopped_allocs:
+                row = t.id_to_row.get(a.node_id)
+                if row is None:
+                    continue
+                delta[row, 0] -= a.resources.cpu
+                delta[row, 1] -= a.resources.memory_mb
+                delta[row, 2] -= a.resources.disk_mb
+                if a.job_id == job.id and job_count[row] > 0:
+                    job_count[row] -= 1
+            used0 = used0 + jnp.asarray(delta)
         inp = PlacementInputs(
-            attrs=dev["attrs"], cap=dev["cap"], used0=dev["used"],
+            attrs=dev["attrs"], cap=dev["cap"], used0=used0,
             elig=dev["elig"],
             dc_mask=jnp.asarray(ctx.dc_mask),
             pool_mask=jnp.asarray(ctx.pool_mask),
@@ -135,7 +157,7 @@ class PlacementEngine:
             tg_idx=jnp.asarray(tg_idx),
             prev_row=jnp.asarray(prev_row),
             active=jnp.asarray(active),
-            job_count0=jnp.asarray(ctx.job_count),
+            job_count0=jnp.asarray(job_count),
             spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
         )
         out = place_jit(inp)

@@ -134,6 +134,7 @@ class ClusterPacker:
         self._attached = False
         self._seq = 0                 # monotone tensor version source
         self._last_index = -1         # state index the tensors reflect
+        self._last_store = None       # store identity the tensors reflect
         # LUT cache: (operand, rtarget) -> [lut_id, vocab_size_built_to].
         # Rows are extended in place as the vocab grows, so the device LUT
         # matrix stays O(#distinct predicates), not O(#evals).
@@ -206,16 +207,20 @@ class ClusterPacker:
         self._dirty.clear()
         self._all_dirty = False
         self._last_index = getattr(snapshot, "index", -1)
+        self._last_store = getattr(snapshot, "store_id", None)
         return t
 
     def update(self, snapshot) -> NodeTensors:
         """Incremental: rebuild only dirty rows; add/remove nodes as needed.
 
-        Without `attach()` there is no dirty tracking, so any state-index
-        change forces a full rebuild (correct, just slower); an unchanged
-        index returns the cached tensors as-is."""
+        Without `attach()` there is no dirty tracking, so any change of
+        state index (or of the backing store identity) forces a full rebuild
+        (correct, just slower); an unchanged (store, index) returns the
+        cached tensors as-is."""
         t = self._tensors
         if t is None or self._all_dirty:
+            return self.build(snapshot)
+        if getattr(snapshot, "store_id", None) != self._last_store:
             return self.build(snapshot)
         if not self._attached:
             if getattr(snapshot, "index", -1) == self._last_index:

@@ -1,0 +1,276 @@
+"""Generic (service/batch) scheduler (reference: scheduler/generic_sched.go).
+
+`process(eval)` = snapshot → reconcile → batched device placement → plan →
+submit, with the reference's retry-on-partial-commit loop, blocked-eval
+creation for failed placements, and follow-up evals for delayed reschedules.
+
+The hot-loop difference vs the reference: computePlacements there walks
+candidates one placement at a time through the iterator stack; here ALL
+placements of the eval go to the TPU kernel as one batch
+(nomad_tpu.ops.PlacementEngine) and come back as node picks + AllocMetrics
+in a single device round-trip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from nomad_tpu.ops import PlacementEngine, PlacementRequest
+from nomad_tpu.structs import (
+    Allocation,
+    AllocMetric,
+    EVAL_STATUS_COMPLETE,
+    Evaluation,
+    Job,
+    NetworkIndex,
+    Plan,
+    PlanAnnotations,
+    TRIGGER_QUEUED_ALLOCS,
+)
+
+from .base import Planner, Scheduler
+from .reconcile import PlaceRequest as RPlace
+from .reconcile import ReconcileResults, reconcile
+from .util import ALLOC_RESCHEDULED, tainted_nodes
+
+# reference: maxServiceScheduleAttempts / maxBatchScheduleAttempts
+MAX_SERVICE_ATTEMPTS = 5
+MAX_BATCH_ATTEMPTS = 2
+
+# Shared engine so the packed node tensors + jit caches persist across evals
+# of one in-process scheduler session (the worker wires its own).
+_default_engine: Optional[PlacementEngine] = None
+
+
+def _engine(explicit: Optional[PlacementEngine]) -> PlacementEngine:
+    global _default_engine
+    if explicit is not None:
+        return explicit
+    if _default_engine is None:
+        _default_engine = PlacementEngine()
+    return _default_engine
+
+
+class GenericScheduler(Scheduler):
+    """reference: scheduler.GenericScheduler"""
+
+    def __init__(self, state, planner: Planner, is_batch: bool = False,
+                 engine: Optional[PlacementEngine] = None,
+                 now: Optional[float] = None) -> None:
+        self.state = state
+        self.planner = planner
+        self.is_batch = is_batch
+        self.engine = _engine(engine)
+        self.now = now if now is not None else time.time()
+        self.max_attempts = (MAX_BATCH_ATTEMPTS if is_batch
+                             else MAX_SERVICE_ATTEMPTS)
+        self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        self.queued_allocs: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- process
+
+    def process(self, evaluation: Evaluation) -> Optional[Exception]:
+        for attempt in range(self.max_attempts):
+            done, err = self._process_once(evaluation)
+            if err is not None:
+                self._update_eval_status(evaluation, "failed", str(err))
+                return err
+            if done:
+                break
+        else:
+            self._update_eval_status(
+                evaluation, "failed",
+                f"maximum attempts reached ({self.max_attempts})")
+            return None
+        self._finalize(evaluation)
+        return None
+
+    def _finalize(self, evaluation: Evaluation) -> None:
+        # blocked eval for unplaced allocs (reference: ensureBlockedEval)
+        if self.failed_tg_allocs and evaluation.triggered_by != TRIGGER_QUEUED_ALLOCS:
+            blocked = evaluation.create_blocked_eval(
+                class_eligibility={}, escaped=True,
+                failed_tg_allocs=self.failed_tg_allocs)
+            self.planner.create_eval(blocked)
+            evaluation.blocked_eval = blocked.id
+        self._update_eval_status(evaluation, EVAL_STATUS_COMPLETE, "")
+
+    def _update_eval_status(self, evaluation: Evaluation, status: str,
+                            desc: str) -> None:
+        e = evaluation.copy()
+        e.status = status
+        e.status_description = desc
+        e.queued_allocations = dict(self.queued_allocs)
+        e.failed_tg_allocs = dict(self.failed_tg_allocs)
+        self.planner.update_eval(e)
+
+    # -------------------------------------------------------- single pass
+
+    def _process_once(self, evaluation: Evaluation):
+        state = self.state
+        job = state.job_by_id(evaluation.namespace, evaluation.job_id)
+        allocs = state.allocs_by_job(evaluation.namespace, evaluation.job_id)
+        tainted = tainted_nodes(state, allocs)
+        stopped = job is None or job.stopped()
+        deployment = (state.latest_deployment_by_job(
+            evaluation.namespace, evaluation.job_id) if job else None)
+
+        results = reconcile(job, stopped, allocs, tainted, self.now,
+                            existing_deployment=deployment)
+
+        plan = Plan(eval_id=evaluation.id, priority=evaluation.priority,
+                    job=job)
+        if evaluation.annotate_plan:
+            plan.annotations = PlanAnnotations(
+                desired_tg_updates=results.desired_tg_updates)
+
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {tg.name: 0 for tg in job.task_groups} if job else {}
+
+        # ---- stops ----
+        for s in results.stop:
+            plan.append_stopped_alloc(s.alloc, s.status_description,
+                                      client_status=s.client_status)
+
+        # ---- in-place updates ----
+        for a in results.inplace_update:
+            upd = a.copy_skip_job()
+            upd.job = job
+            upd.job_version = job.version
+            plan.append_alloc(upd)
+
+        # ---- destructive updates: stop old + place new ----
+        destructive_places: List[RPlace] = []
+        for a in results.destructive_update:
+            plan.append_stopped_alloc(
+                a, "alloc is being updated due to job update")
+            tg = job.lookup_task_group(a.task_group)
+            destructive_places.append(RPlace(
+                tg=tg, name=a.name, index=a.index(), previous_alloc=a))
+
+        # ---- reschedule-later: follow-up evals + alloc annotations ----
+        if results.reschedule_later:
+            by_time: Dict[float, List[Allocation]] = {}
+            for a, ready_at in results.reschedule_later:
+                by_time.setdefault(ready_at, []).append(a)
+            for ready_at, late_allocs in sorted(by_time.items()):
+                follow = evaluation.create_failed_follow_up_eval(ready_at)
+                self.planner.create_eval(follow)
+                for a in late_allocs:
+                    upd = a.copy_skip_job()
+                    upd.job = job
+                    upd.followup_eval_id = follow.id
+                    plan.append_alloc(upd)
+
+        # ---- placements: one batched device call for the whole eval ----
+        all_places = results.place + destructive_places
+        if all_places and job is not None:
+            self._compute_placements(plan, job, all_places, evaluation,
+                                     results)
+
+        plan.deployment = results.deployment
+        plan.deployment_updates = results.deployment_updates
+
+        if plan.is_no_op():
+            return True, None
+
+        result, refreshed_state, err = self.planner.submit_plan(plan)
+        if err is not None:
+            return False, err
+        if result is not None:
+            full, expected, actual = result.full_commit(plan)
+            if not full:
+                if refreshed_state is not None:
+                    self.state = refreshed_state
+                return False, None
+        return True, None
+
+    # ---------------------------------------------------------- placement
+
+    def _compute_placements(self, plan: Plan, job: Job,
+                            places: List[RPlace],
+                            evaluation: Evaluation,
+                            results: ReconcileResults) -> None:
+        tgs = job.task_groups
+        reqs = []
+        for p in places:
+            prev_node = ""
+            if p.previous_alloc is not None and p.reschedule:
+                prev_node = p.previous_alloc.node_id
+            reqs.append(PlacementRequest(tg_name=p.tg.name,
+                                         prev_node_id=prev_node))
+        # allocs this plan is stopping free their capacity for placement
+        stopped = [a for allocs in plan.node_update.values() for a in allocs]
+        decisions = self.engine.place(self.state, job, tgs, reqs,
+                                      stopped_allocs=stopped)
+
+        # host-side port assignment per chosen node (reference: AllocsFit's
+        # NetworkIndex, kept off-device per SURVEY §7 P1)
+        net_idx: Dict[str, NetworkIndex] = {}
+
+        for p, d in zip(places, decisions):
+            tg = p.tg
+            if d.node_id is None:
+                self._record_failure(tg.name, d.metric)
+                continue
+            ports = None
+            ask = tg.combined_resources()
+            if ask.networks:
+                ni = net_idx.get(d.node_id)
+                if ni is None:
+                    ni = NetworkIndex()
+                    node = self.state.node_by_id(d.node_id)
+                    if node is not None:
+                        ni.set_node(node)
+                    ni.add_allocs(self.state.allocs_by_node(d.node_id))
+                    net_idx[d.node_id] = ni
+                ports, fail = ni.assign_ports(ask.networks)
+                if ports is None:
+                    d.metric.exhausted_node(fail)
+                    self._record_failure(tg.name, d.metric)
+                    continue
+                ni.commit(ports)
+
+            alloc = Allocation(
+                namespace=job.namespace,
+                eval_id=evaluation.id,
+                name=p.name,
+                node_id=d.node_id,
+                job_id=job.id,
+                job=job,
+                task_group=tg.name,
+                resources=ask,
+                allocated_ports=ports or {},
+                desired_status="run",
+                client_status="pending",
+                job_version=job.version,
+                metrics=d.metric,
+                create_time=self.now,
+                modify_time=self.now,
+            )
+            if results.deployment is not None:
+                alloc.deployment_id = results.deployment.id
+            if p.previous_alloc is not None:
+                alloc.previous_allocation = p.previous_alloc.id
+                if p.reschedule:
+                    from .util import append_reschedule_tracker
+                    append_reschedule_tracker(alloc, p.previous_alloc, self.now)
+                    alloc.desired_description = ALLOC_RESCHEDULED
+            plan.append_alloc(alloc)
+
+    def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
+        prev = self.failed_tg_allocs.get(tg_name)
+        if prev is not None:
+            prev.coalesced_failures += 1
+        else:
+            self.failed_tg_allocs[tg_name] = metric
+        self.queued_allocs[tg_name] = self.queued_allocs.get(tg_name, 0) + 1
+
+
+def new_service_scheduler(state, planner, **kwargs) -> GenericScheduler:
+    return GenericScheduler(state, planner, is_batch=False, **kwargs)
+
+
+def new_batch_scheduler(state, planner, **kwargs) -> GenericScheduler:
+    return GenericScheduler(state, planner, is_batch=True, **kwargs)

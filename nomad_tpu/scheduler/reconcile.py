@@ -1,0 +1,304 @@
+"""Allocation reconciler (reference: scheduler/reconcile.go, reconcile_util.go).
+
+Diffs desired state (the job) against actual state (existing allocations +
+node health) and emits the action sets the scheduler turns into a plan:
+place / stop / ignore / in-place update / destructive update / migrate /
+reschedule-now / reschedule-later, plus deployment bookkeeping and the
+per-task-group DesiredUpdates annotation counts.
+
+This is deliberately host-side Python (SURVEY.md §7 P4): it is control-flow
+heavy, data-light, and feeds the batched device placement kernel with one
+flat list of placement requests per eval.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Deployment,
+    DeploymentState,
+    DeploymentStatusUpdate,
+    DesiredUpdates,
+    JOB_TYPE_BATCH,
+    Job,
+    Node,
+    TaskGroup,
+)
+
+from .util import (
+    ALLOC_LOST,
+    ALLOC_MIGRATING,
+    ALLOC_NOT_NEEDED,
+    RESCHEDULE_LATER,
+    RESCHEDULE_NOW,
+    free_indexes,
+    should_reschedule,
+    tasks_updated,
+)
+
+
+@dataclass
+class PlaceRequest:
+    """One placement the scheduler must make."""
+    tg: TaskGroup
+    name: str
+    index: int
+    previous_alloc: Optional[Allocation] = None   # reschedule/migrate source
+    reschedule: bool = False
+    migrate: bool = False
+    canary: bool = False
+
+
+@dataclass
+class StopRequest:
+    alloc: Allocation
+    status_description: str
+    client_status: str = ""          # e.g. "lost" for down nodes
+
+
+@dataclass
+class ReconcileResults:
+    place: List[PlaceRequest] = field(default_factory=list)
+    stop: List[StopRequest] = field(default_factory=list)
+    inplace_update: List[Allocation] = field(default_factory=list)
+    destructive_update: List[Allocation] = field(default_factory=list)
+    ignore: List[Allocation] = field(default_factory=list)
+    # (alloc, ready_time): follow-up eval needed at ready_time
+    reschedule_later: List[tuple] = field(default_factory=list)
+    desired_tg_updates: Dict[str, DesiredUpdates] = field(default_factory=dict)
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return not (self.place or self.stop or self.inplace_update
+                    or self.destructive_update or self.reschedule_later)
+
+
+def reconcile(job: Optional[Job],
+              job_stopped: bool,
+              allocs: List[Allocation],
+              tainted: Dict[str, Optional[Node]],
+              now: float,
+              existing_deployment: Optional[Deployment] = None,
+              ) -> ReconcileResults:
+    """Compute the action sets for one eval.
+
+    reference: allocReconciler.Compute.  Semantics preserved:
+      - stopped/deregistered job ⇒ stop everything non-terminal
+      - batch jobs don't replace successfully-completed allocs
+      - allocs on down nodes are lost (stop w/ client_status=lost) and
+        replaced; draining nodes migrate (stop + place with migrate flag)
+      - failed allocs follow the task group ReschedulePolicy (now / later
+        with follow-up eval / never)
+      - job-version changes split into in-place vs destructive updates via
+        tasks_updated; destructive updates are throttled by
+        update.max_parallel when an update stanza is present
+      - excess allocs (count shrink) stop highest name-indexes first
+    """
+    r = ReconcileResults()
+
+    live = [a for a in allocs if not a.terminal_status()]
+    if job is None or job_stopped:
+        for a in live:
+            r.stop.append(StopRequest(a, ALLOC_NOT_NEEDED))
+        return r
+
+    is_batch = job.type == JOB_TYPE_BATCH
+    by_tg: Dict[str, List[Allocation]] = {}
+    for a in allocs:
+        by_tg.setdefault(a.task_group, []).append(a)
+
+    # allocs for task groups that no longer exist
+    known = {tg.name for tg in job.task_groups}
+    for tg_name, tg_allocs in by_tg.items():
+        if tg_name not in known:
+            for a in tg_allocs:
+                if not a.terminal_status():
+                    r.stop.append(StopRequest(a, ALLOC_NOT_NEEDED))
+
+    for tg in job.task_groups:
+        _reconcile_group(r, job, tg, by_tg.get(tg.name, []), tainted, now,
+                         is_batch, existing_deployment)
+    return r
+
+
+def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
+                     allocs: List[Allocation],
+                     tainted: Dict[str, Optional[Node]], now: float,
+                     is_batch: bool,
+                     deployment: Optional[Deployment]) -> None:
+    du = DesiredUpdates()
+    r.desired_tg_updates[tg.name] = du
+
+    untainted: List[Allocation] = []
+    migrate: List[Allocation] = []
+    lost: List[Allocation] = []
+    failed: List[Allocation] = []
+    done_batch: List[Allocation] = []   # batch allocs that ran successfully
+
+    for a in allocs:
+        if a.desired_status != "run":
+            continue  # already stopping/evicting
+        if a.node_id in tainted:
+            node = tainted[a.node_id]
+            if node is None or node.status in ("down", "disconnected"):
+                if a.client_terminal_status():
+                    continue
+                lost.append(a)
+            else:  # draining
+                if a.client_terminal_status():
+                    continue
+                migrate.append(a)
+            continue
+        if a.client_status == ALLOC_CLIENT_FAILED:
+            failed.append(a)
+            continue
+        if a.client_terminal_status():
+            # complete: batch jobs treat success as done — the slot is
+            # filled forever, never replaced
+            if is_batch and a.ran_successfully():
+                du.ignore += 1
+                r.ignore.append(a)
+                done_batch.append(a)
+            continue
+        untainted.append(a)
+
+    # ---- lost: stop w/ lost status + replace ----
+    for a in lost:
+        du.stop += 1
+        r.stop.append(StopRequest(a, ALLOC_LOST, client_status=ALLOC_CLIENT_LOST))
+
+    # ---- migrate (drain): stop + replacement placement ----
+    for a in migrate:
+        du.migrate += 1
+        r.stop.append(StopRequest(a, ALLOC_MIGRATING))
+
+    # ---- failed: reschedule policy ----
+    # Failed allocs NOT rescheduled right now still hold their slot (the
+    # reference keeps them in the untainted set): a reschedule-later alloc
+    # is replaced only when its follow-up eval fires; a
+    # reschedule-exhausted alloc is never replaced.
+    reschedule_now: List[Allocation] = []
+    failed_holding_slot: List[Allocation] = []
+    for a in failed:
+        policy = tg.reschedule_policy
+        verdict, ready_at = should_reschedule(a, policy, now)
+        if verdict == RESCHEDULE_NOW:
+            reschedule_now.append(a)
+            du.reschedule_now += 1
+        elif verdict == RESCHEDULE_LATER:
+            r.reschedule_later.append((a, ready_at))
+            failed_holding_slot.append(a)
+            du.reschedule_later += 1
+        else:
+            r.ignore.append(a)
+            failed_holding_slot.append(a)
+
+    # ---- count management: stop excess (highest indexes) BEFORE the
+    # update split, so a count decrease can shed old-version allocs too ----
+    n_replacements = len(lost) + len(migrate) + len(reschedule_now)
+    needed = (tg.count - len(untainted) - len(done_batch)
+              - len(failed_holding_slot) - n_replacements)
+    if needed < 0:
+        excess = sorted(untainted, key=lambda a: a.index(), reverse=True)
+        to_stop = excess[:-needed]
+        for a in to_stop:
+            du.stop += 1
+            r.stop.append(StopRequest(a, ALLOC_NOT_NEEDED))
+        stop_ids = {a.id for a in to_stop}
+        untainted = [a for a in untainted if a.id not in stop_ids]
+        needed = 0
+
+    # ---- updates: in-place vs destructive for old-version allocs ----
+    inplace: List[Allocation] = []
+    destructive: List[Allocation] = []
+    current: List[Allocation] = []
+    for a in untainted:
+        if a.job is not None and a.job_version != job.version:
+            if tasks_updated(a.job, job, tg.name):
+                destructive.append(a)
+            else:
+                inplace.append(a)
+        else:
+            current.append(a)
+
+    limit = len(destructive)
+    update = tg.update or job.update
+    if update is not None and update.max_parallel > 0 and not is_batch:
+        limit = min(limit, update.max_parallel)
+    for a in destructive[:limit]:
+        du.destructive_update += 1
+        r.destructive_update.append(a)
+    for a in destructive[limit:]:
+        du.ignore += 1
+        r.ignore.append(a)
+    for a in inplace:
+        du.in_place_update += 1
+        r.inplace_update.append(a)
+
+    # allocs that keep their slot (current, updated in place, or updated
+    # destructively — the destructive replacement reuses the name/index)
+    keep = current + inplace + destructive
+
+    # ---- place: replacements first (carry prev alloc), then new slots ----
+    indexes = free_indexes(keep + done_batch + failed_holding_slot, tg.count,
+                           extra=n_replacements + max(needed, 0))
+    ptr = 0
+
+    for a in lost + migrate:
+        r.place.append(PlaceRequest(
+            tg=tg, name=_name(job, tg, indexes[ptr]), index=indexes[ptr],
+            previous_alloc=a, migrate=a in migrate))
+        ptr += 1
+        du.place += 1
+    for a in reschedule_now:
+        r.place.append(PlaceRequest(
+            tg=tg, name=_name(job, tg, indexes[ptr]), index=indexes[ptr],
+            previous_alloc=a, reschedule=True))
+        ptr += 1
+        du.place += 1
+    for _ in range(max(needed, 0)):
+        r.place.append(PlaceRequest(
+            tg=tg, name=_name(job, tg, indexes[ptr]), index=indexes[ptr]))
+        ptr += 1
+        du.place += 1
+
+    # kept-current allocs are untouched
+    du.ignore += len(current)
+    r.ignore.extend(current)
+
+    # ---- deployment bookkeeping (service jobs with update stanza) ----
+    # Accumulate onto the deployment the previous task group created this
+    # reconcile, so multi-group jobs share one deployment object.
+    if (not is_batch and update is not None
+            and (r.place or r.destructive_update)
+            and job.type == "service"):
+        dep = r.deployment
+        if dep is None:
+            dep = deployment
+            if (dep is None or dep.job_version != job.version
+                    or not dep.active()):
+                dep = Deployment(
+                    namespace=job.namespace, job_id=job.id,
+                    job_version=job.version,
+                    job_modify_index=job.job_modify_index)
+            else:
+                dep = dep.copy()
+        state = dep.task_groups.get(tg.name) or DeploymentState(
+            auto_revert=update.auto_revert,
+            auto_promote=update.auto_promote,
+            progress_deadline_s=update.progress_deadline_s)
+        state.desired_total = tg.count
+        state.desired_canaries = update.canary
+        dep.task_groups[tg.name] = state
+        r.deployment = dep
+
+
+def _name(job: Job, tg: TaskGroup, idx: int) -> str:
+    return f"{job.id}.{tg.name}[{idx}]"

@@ -1,0 +1,171 @@
+"""Scheduler utilities (reference: scheduler/util.go).
+
+taintedNodes, tasksUpdated, reschedule timing, alloc-name index management —
+the pure control-flow helpers shared by the generic and system schedulers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_FAILED,
+    ALLOC_DESIRED_RUN,
+    Allocation,
+    Job,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_DISCONNECTED,
+    Node,
+    ReschedulePolicy,
+    RescheduleEvent,
+    RescheduleTracker,
+    TaskGroup,
+)
+
+
+def tainted_nodes(state, allocs: List[Allocation]) -> Dict[str, Optional[Node]]:
+    """Nodes referenced by `allocs` that are not ready (down, draining,
+    ineligible-by-drain, disconnected, or deregistered).
+    reference: scheduler/util.go taintedNodes.  A None value means the node
+    no longer exists (treated as down)."""
+    out: Dict[str, Optional[Node]] = {}
+    for a in allocs:
+        if not a.node_id or a.node_id in out:
+            continue
+        node = state.node_by_id(a.node_id)
+        if node is None:
+            out[a.node_id] = None
+        elif node.status in (NODE_STATUS_DOWN, NODE_STATUS_DISCONNECTED):
+            out[a.node_id] = node
+        elif node.drain is not None:
+            out[a.node_id] = node
+    return out
+
+
+def tasks_updated(job_a: Job, job_b: Job, tg_name: str) -> bool:
+    """True when the task group differs in a way that requires a destructive
+    (stop + re-place) update; False means in-place update is allowed.
+    reference: scheduler/util.go tasksUpdated."""
+    a = job_a.lookup_task_group(tg_name)
+    b = job_b.lookup_task_group(tg_name)
+    if a is None or b is None:
+        return True
+    if len(a.tasks) != len(b.tasks):
+        return True
+    if a.ephemeral_disk != b.ephemeral_disk:
+        return True
+    if a.networks != b.networks:
+        return True
+    if a.volumes != b.volumes:
+        return True
+    bt = {t.name: t for t in b.tasks}
+    for t in a.tasks:
+        o = bt.get(t.name)
+        if o is None:
+            return True
+        if (t.driver != o.driver or t.config != o.config or t.env != o.env
+                or t.resources != o.resources or t.artifacts != o.artifacts
+                or t.templates != o.templates or t.vault != o.vault
+                or t.services != o.services
+                or t.constraints != o.constraints):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Reschedule timing (reference: structs ReschedulePolicy + NextRescheduleTime)
+# ---------------------------------------------------------------------------
+
+RESCHEDULE_NO = "no"
+RESCHEDULE_NOW = "now"
+RESCHEDULE_LATER = "later"
+
+_FIB = [1, 1, 2, 3, 5, 8, 13, 21, 34, 55]
+
+
+def reschedule_delay(policy: ReschedulePolicy, n_prior: int) -> float:
+    """Delay before the (n_prior+1)-th reschedule attempt."""
+    base = policy.delay_s
+    if policy.delay_function == "constant":
+        d = base
+    elif policy.delay_function == "fibonacci":
+        d = base * _FIB[min(n_prior, len(_FIB) - 1)]
+    else:  # exponential (default)
+        d = base * (2 ** n_prior)
+    if policy.max_delay_s > 0:
+        d = min(d, policy.max_delay_s)
+    return d
+
+
+def should_reschedule(alloc: Allocation, policy: Optional[ReschedulePolicy],
+                      now: float, fail_time: Optional[float] = None,
+                      ) -> Tuple[str, float]:
+    """Decide whether a failed alloc is rescheduled now, later (returns the
+    eval wait_until time), or never."""
+    if policy is None:
+        return RESCHEDULE_NO, 0.0
+    if alloc.client_status != ALLOC_CLIENT_FAILED:
+        return RESCHEDULE_NO, 0.0
+    if alloc.desired_status != ALLOC_DESIRED_RUN:
+        return RESCHEDULE_NO, 0.0
+    events = (alloc.reschedule_tracker.events
+              if alloc.reschedule_tracker else [])
+    if not policy.unlimited:
+        if policy.attempts <= 0:
+            return RESCHEDULE_NO, 0.0
+        window_start = now - policy.interval_s
+        recent = [e for e in events if e.reschedule_time >= window_start]
+        if len(recent) >= policy.attempts:
+            return RESCHEDULE_NO, 0.0
+    ft = fail_time if fail_time is not None else (alloc.modify_time or now)
+    delay = reschedule_delay(policy, len(events))
+    ready_at = ft + delay
+    if ready_at <= now:
+        return RESCHEDULE_NOW, 0.0
+    return RESCHEDULE_LATER, ready_at
+
+
+def next_reschedule_event(alloc: Allocation, now: float) -> RescheduleEvent:
+    return RescheduleEvent(reschedule_time=now, prev_alloc_id=alloc.id,
+                           prev_node_id=alloc.node_id)
+
+
+def append_reschedule_tracker(new_alloc: Allocation, prev: Allocation,
+                              now: float) -> None:
+    events = list(prev.reschedule_tracker.events) if prev.reschedule_tracker else []
+    events.append(next_reschedule_event(prev, now))
+    new_alloc.reschedule_tracker = RescheduleTracker(events=events)
+
+
+# ---------------------------------------------------------------------------
+# Alloc name / index management (reference: structs.AllocName + bitmap)
+# ---------------------------------------------------------------------------
+
+
+def free_indexes(existing: List[Allocation], count: int, extra: int = 0,
+                 ) -> List[int]:
+    """Lowest free name-indexes given existing (non-stopping) allocs."""
+    taken: Set[int] = set()
+    for a in existing:
+        i = a.index()
+        if i >= 0:
+            taken.add(i)
+    out = []
+    i = 0
+    need = extra if extra > 0 else count
+    while len(out) < need:
+        if i not in taken:
+            out.append(i)
+        i += 1
+    return out
+
+
+# Stop/status description strings (reference: scheduler/generic_sched.go)
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_RESCHEDULED = "alloc was rescheduled because it failed"
+ALLOC_LOST = "alloc is lost since its node is down"
+ALLOC_UNKNOWN = "alloc is unknown since its node is disconnected"
+ALLOC_NOT_PLACED = "failed to place all allocations"
+BLOCKED_EVAL_MAX_PLAN = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"

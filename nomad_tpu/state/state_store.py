@@ -41,6 +41,8 @@ class StateStore:
     """All cluster state.  Thread-safe; single writer at a time."""
 
     def __init__(self) -> None:
+        import uuid as _uuid
+        self.store_id = str(_uuid.uuid4())   # distinguishes stores for caches
         self._lock = threading.RLock()
         self._index_cv = threading.Condition(self._lock)
         self._index = 0
@@ -360,6 +362,7 @@ class StateStore:
     def snapshot(self) -> "StateSnapshot":
         with self._lock:
             return StateSnapshot(
+                store_id=self.store_id,
                 index=self._index,
                 nodes=self._nodes,
                 jobs=self._jobs,
@@ -404,7 +407,8 @@ class StateSnapshot:
     def __init__(self, index, nodes, jobs, job_versions, evals, allocs,
                  deployments, namespaces, node_pools, csi_volumes,
                  scheduler_config, allocs_by_node, allocs_by_job,
-                 evals_by_job):
+                 evals_by_job, store_id=""):
+        self.store_id = store_id
         self.index = index
         self._nodes = nodes
         self._jobs = jobs

