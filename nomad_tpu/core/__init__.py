@@ -1,0 +1,8 @@
+"""Server core (reference: nomad/)."""
+
+from .blocked_evals import BlockedEvals  # noqa: F401
+from .eval_broker import EvalBroker  # noqa: F401
+from .heartbeat import HeartbeatTimers, invalidate_heartbeat  # noqa: F401
+from .plan_apply import PendingPlan, PlanApplier, PlanQueue  # noqa: F401
+from .server import Server  # noqa: F401
+from .worker import Worker  # noqa: F401

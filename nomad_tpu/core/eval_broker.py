@@ -1,0 +1,227 @@
+"""Evaluation broker (reference: nomad/eval_broker.go).
+
+Priority + FIFO queue of evaluations by scheduler type with:
+  - per-job serialization: only one eval per (namespace, job) outstanding;
+    later evals for the same job wait until the current one is acked
+  - dequeue with a token; ack/nack protocol; nack re-enqueues with a
+    requeue penalty until the delivery limit is reached, then the eval is
+    routed to the failed queue
+  - wait_until (delayed) evals held until their time arrives
+
+Timebase is injected (`now` arguments) so tests are deterministic; the
+server's tick loop supplies wall-clock time.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import Evaluation, new_id
+
+DEFAULT_NACK_TIMEOUT = 60.0
+DEFAULT_DELIVERY_LIMIT = 3
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._enabled = False
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._seq = itertools.count()
+        # ready heaps per scheduler type: (-priority, seq, eval)
+        self._ready: Dict[str, List[Tuple[int, int, Evaluation]]] = {}
+        # evals waiting on an earlier eval of the same job
+        self._pending_by_job: Dict[Tuple[str, str], List[Evaluation]] = {}
+        self._in_flight_jobs: set = set()
+        # delayed evals: (wait_until, seq, eval)
+        self._delayed: List[Tuple[float, int, Evaluation]] = []
+        # outstanding: eval_id -> (token, deadline, eval)
+        self._outstanding: Dict[str, Tuple[str, float, Evaluation]] = {}
+        self._dequeues: Dict[str, int] = {}       # delivery attempts
+        self._failed: List[Evaluation] = []
+        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0,
+                      "nacked": 0, "failed": 0}
+
+    # ------------------------------------------------------------ control
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                self._ready.clear()
+                self._pending_by_job.clear()
+                self._in_flight_jobs.clear()
+                self._delayed.clear()
+                self._outstanding.clear()
+                self._dequeues.clear()
+            self._cv.notify_all()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # ------------------------------------------------------------ enqueue
+
+    def enqueue(self, evaluation: Evaluation, now: float = 0.0) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            self.stats["enqueued"] += 1
+            if evaluation.wait_until and evaluation.wait_until > now:
+                heapq.heappush(self._delayed,
+                               (evaluation.wait_until, next(self._seq),
+                                evaluation))
+                return
+            self._enqueue_locked(evaluation)
+            self._cv.notify()
+
+    def _enqueue_locked(self, evaluation: Evaluation) -> None:
+        key = (evaluation.namespace, evaluation.job_id)
+        if key in self._in_flight_jobs:
+            self._pending_by_job.setdefault(key, []).append(evaluation)
+            return
+        heap = self._ready.setdefault(evaluation.type, [])
+        heapq.heappush(heap, (-evaluation.priority, next(self._seq),
+                              evaluation))
+
+    # ------------------------------------------------------------ dequeue
+
+    def dequeue(self, schedulers: List[str], now: float,
+                timeout: Optional[float] = None,
+                ) -> Tuple[Optional[Evaluation], str]:
+        """Pop the highest-priority ready eval for any of `schedulers`.
+        Returns (eval, token) or (None, "") on timeout/disabled."""
+        deadline = None if timeout is None else now + timeout
+        with self._cv:
+            while True:
+                if not self._enabled:
+                    return None, ""
+                self._tick_locked(now)
+                ev = self._pop_ready_locked(schedulers)
+                if ev is not None:
+                    token = new_id()
+                    self._outstanding[ev.id] = (
+                        token, now + self.nack_timeout, ev)
+                    self._dequeues[ev.id] = self._dequeues.get(ev.id, 0) + 1
+                    self._in_flight_jobs.add((ev.namespace, ev.job_id))
+                    self.stats["dequeued"] += 1
+                    return ev, token
+                if timeout == 0.0 or (deadline is not None and now >= deadline):
+                    return None, ""
+                if not self._cv.wait(timeout=0.05):
+                    now += 0.05
+                else:
+                    now += 0.001
+
+    def _pop_ready_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
+        """Pop the best ready eval whose job has no eval in flight; evals
+        for busy jobs are stashed in the per-job waiting list."""
+        while True:
+            best_type, best = None, None
+            for st in schedulers:
+                heap = self._ready.get(st)
+                while heap and heap[0][2].id in self._outstanding:
+                    heapq.heappop(heap)    # stale entry
+                if heap and (best is None or heap[0] < best):
+                    best_type, best = st, heap[0]
+            if best is None:
+                return None
+            heapq.heappop(self._ready[best_type])
+            ev = best[2]
+            key = (ev.namespace, ev.job_id)
+            if key in self._in_flight_jobs:
+                self._pending_by_job.setdefault(key, []).append(ev)
+                continue
+            return ev
+
+    # ----------------------------------------------------------- ack/nack
+
+    def ack(self, eval_id: str, token: str) -> Optional[str]:
+        with self._lock:
+            rec = self._outstanding.get(eval_id)
+            if rec is None or rec[0] != token:
+                return "token mismatch"
+            ev = rec[2]
+            del self._outstanding[eval_id]
+            self._dequeues.pop(eval_id, None)
+            self.stats["acked"] += 1
+            self._release_job_locked((ev.namespace, ev.job_id))
+            return None
+
+    def _release_job_locked(self, key: Tuple[str, str]) -> None:
+        """Job no longer has an eval in flight (acked, failed, or expired):
+        promote the next waiting eval for it, if any."""
+        self._in_flight_jobs.discard(key)
+        waiting = self._pending_by_job.get(key)
+        if waiting:
+            nxt = waiting.pop(0)
+            if not waiting:
+                del self._pending_by_job[key]
+            self._enqueue_locked(nxt)
+            self._cv.notify()
+
+    def nack(self, eval_id: str, token: str, now: float = 0.0) -> Optional[str]:
+        with self._lock:
+            rec = self._outstanding.get(eval_id)
+            if rec is None or rec[0] != token:
+                return "token mismatch"
+            ev = rec[2]
+            del self._outstanding[eval_id]
+            self.stats["nacked"] += 1
+            key = (ev.namespace, ev.job_id)
+            if self._dequeues.get(eval_id, 0) >= self.delivery_limit:
+                self._failed.append(ev)
+                self.stats["failed"] += 1
+                self._dequeues.pop(eval_id, None)
+                # waiters for this job must not strand behind a failed eval
+                self._release_job_locked(key)
+            else:
+                self._in_flight_jobs.discard(key)
+                self._enqueue_locked(ev)
+            self._cv.notify()
+            return None
+
+    # --------------------------------------------------------------- tick
+
+    def tick(self, now: float) -> None:
+        """Promote delayed evals whose time arrived and requeue expired
+        (nack-timeout) outstanding evals."""
+        with self._lock:
+            self._tick_locked(now)
+            self._cv.notify_all()
+
+    def _tick_locked(self, now: float) -> None:
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, ev = heapq.heappop(self._delayed)
+            self._enqueue_locked(ev)
+        expired = [eid for eid, (tok, deadline, ev) in self._outstanding.items()
+                   if deadline <= now]
+        for eid in expired:
+            tok, _, ev = self._outstanding.pop(eid)
+            key = (ev.namespace, ev.job_id)
+            if self._dequeues.get(eid, 0) >= self.delivery_limit:
+                self._failed.append(ev)
+                self.stats["failed"] += 1
+                self._release_job_locked(key)
+            else:
+                self._in_flight_jobs.discard(key)
+                self._enqueue_locked(ev)
+
+    # -------------------------------------------------------------- stats
+
+    def pending_evals(self) -> int:
+        with self._lock:
+            n = sum(len(h) for h in self._ready.values())
+            n += sum(len(v) for v in self._pending_by_job.values())
+            n += len(self._delayed)
+            return n
+
+    def failed_evals(self) -> List[Evaluation]:
+        with self._lock:
+            return list(self._failed)

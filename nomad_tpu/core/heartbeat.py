@@ -1,0 +1,73 @@
+"""Node heartbeats (reference: nomad/heartbeat.go).
+
+Per-node TTL timers; a missed heartbeat marks the node down and creates
+evals for every job with allocs on it (the failure-detection path of
+SURVEY.md §6.3).  Deadlines are checked by the server tick loop with an
+injected timebase for deterministic tests."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from nomad_tpu.structs import (
+    Evaluation,
+    NODE_STATUS_DOWN,
+    TRIGGER_NODE_UPDATE,
+)
+
+DEFAULT_HEARTBEAT_TTL = 30.0
+
+
+class HeartbeatTimers:
+    def __init__(self, ttl: float = DEFAULT_HEARTBEAT_TTL) -> None:
+        self._lock = threading.Lock()
+        self.ttl = ttl
+        self._deadlines: Dict[str, float] = {}
+
+    def reset(self, node_id: str, now: float) -> None:
+        """Node registered or heartbeated."""
+        with self._lock:
+            self._deadlines[node_id] = now + self.ttl
+
+    def remove(self, node_id: str) -> None:
+        with self._lock:
+            self._deadlines.pop(node_id, None)
+
+    def expired(self, now: float) -> List[str]:
+        with self._lock:
+            out = [nid for nid, dl in self._deadlines.items() if dl <= now]
+            for nid in out:
+                del self._deadlines[nid]
+            return out
+
+
+def build_node_evals(snap, node_id: str) -> List[Evaluation]:
+    """One TRIGGER_NODE_UPDATE eval per job with live allocs on the node
+    (shared by heartbeat expiry and explicit status updates)."""
+    evals = []
+    seen = set()
+    for a in snap.allocs_by_node(node_id):
+        if a.terminal_status():
+            continue
+        key = (a.namespace, a.job_id)
+        if key in seen:
+            continue
+        seen.add(key)
+        job = snap.job_by_id(a.namespace, a.job_id)
+        evals.append(Evaluation(
+            namespace=a.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else "service",
+            triggered_by=TRIGGER_NODE_UPDATE,
+            job_id=a.job_id,
+            node_id=node_id,
+        ))
+    return evals
+
+
+def invalidate_heartbeat(state, node_id: str, now: float) -> List[Evaluation]:
+    """Mark the node down and build evals for affected jobs
+    (reference: invalidateHeartbeat → Node.UpdateStatus(down))."""
+    state.update_node_status(node_id, NODE_STATUS_DOWN)
+    return build_node_evals(state.snapshot(), node_id)

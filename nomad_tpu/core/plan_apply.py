@@ -1,0 +1,177 @@
+"""Plan queue + serialized plan applier
+(reference: nomad/plan_queue.go, nomad/plan_apply.go).
+
+THE serialization point of the whole system: workers submit plans built
+against possibly-stale snapshots; the applier pops them in priority order,
+re-checks every touched node against the *latest* state (AllocsFit with the
+plan's own stops folded in), drops refuted nodes (partial commit), and
+commits the remainder atomically.  Optimistic concurrency between parallel
+eval workers becomes refuted plans, never corrupted state — the reference's
+"races are tested, not prevented" posture (SURVEY.md §6.2).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Allocation,
+    NetworkIndex,
+    Plan,
+    PlanResult,
+    allocs_fit,
+)
+
+
+@dataclass
+class PendingPlan:
+    plan: Plan
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[PlanResult] = None
+    error: Optional[Exception] = None
+
+    def respond(self, result: Optional[PlanResult],
+                error: Optional[Exception]) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+    def wait(self, timeout: float = 30.0
+             ) -> Tuple[Optional[PlanResult], Optional[Exception]]:
+        if not self.done.wait(timeout):
+            return None, TimeoutError("plan apply timed out")
+        return self.result, self.error
+
+
+class PlanQueue:
+    """Leader-side priority heap of submitted plans."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._enabled = False
+        self._seq = itertools.count()
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self.stats = {"depth_peak": 0}
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for _, _, p in self._heap:
+                    p.respond(None, RuntimeError("plan queue disabled"))
+                self._heap.clear()
+            self._cv.notify_all()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        with self._lock:
+            if not self._enabled:
+                p = PendingPlan(plan)
+                p.respond(None, RuntimeError("plan queue disabled"))
+                return p
+            pending = PendingPlan(plan)
+            heapq.heappush(self._heap,
+                           (-plan.priority, next(self._seq), pending))
+            self.stats["depth_peak"] = max(self.stats["depth_peak"],
+                                           len(self._heap))
+            self._cv.notify()
+            return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        with self._cv:
+            if not self._heap:
+                self._cv.wait(timeout=timeout)
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
+class PlanApplier:
+    """Serialized plan evaluation + commit (reference: planApply loop)."""
+
+    def __init__(self, state: StateStore, queue: PlanQueue) -> None:
+        self.state = state
+        self.queue = queue
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ running
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="plan-applier",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.set_enabled(False)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.dequeue(timeout=0.1)
+            if pending is None:
+                continue
+            self.apply_one(pending)
+
+    # ------------------------------------------------------------- apply
+
+    def apply_one(self, pending: PendingPlan) -> None:
+        try:
+            result = self.evaluate_plan(pending.plan)
+            self.state.upsert_plan_results(pending.plan, result)
+            result.alloc_index = self.state.latest_index()
+            pending.respond(result, None)
+        except Exception as e:  # noqa: BLE001
+            pending.respond(None, e)
+
+    def evaluate_plan(self, plan: Plan) -> PlanResult:
+        """Re-check each touched node against the latest snapshot; refuted
+        nodes are dropped from the result (partial commit).
+        reference: evaluatePlan / evaluateNodePlan."""
+        snap = self.state.snapshot()
+        result = PlanResult(
+            node_update=dict(plan.node_update),
+            node_preemptions=dict(plan.node_preemptions),
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+        )
+        for node_id, new_allocs in plan.node_allocation.items():
+            if self._node_plan_ok(snap, plan, node_id, new_allocs):
+                result.node_allocation[node_id] = new_allocs
+            else:
+                result.refuted_nodes.append(node_id)
+                # stops/preemptions for a refuted node are also withheld
+                result.node_update.pop(node_id, None)
+                result.node_preemptions.pop(node_id, None)
+        return result
+
+    def _node_plan_ok(self, snap, plan: Plan, node_id: str,
+                      new_allocs: List[Allocation]) -> bool:
+        node = snap.node_by_id(node_id)
+        if node is None:
+            return False
+        if node.status == "down":
+            # only stops are allowed on down nodes
+            return False
+        existing = {a.id: a for a in snap.allocs_by_node(node_id)
+                    if not a.terminal_status()}
+        for a in plan.node_update.get(node_id, []):
+            existing.pop(a.id, None)
+        for a in plan.node_preemptions.get(node_id, []):
+            existing.pop(a.id, None)
+        for a in new_allocs:
+            existing[a.id] = a   # same-id update replaces
+        ok, _, _ = allocs_fit(node, list(existing.values()))
+        return ok

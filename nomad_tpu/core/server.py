@@ -1,0 +1,217 @@
+"""In-process server core (reference: nomad/server.go + nomad/leader.go +
+job/node endpoint semantics).
+
+Owns the state store, eval broker, blocked-evals tracker, plan queue +
+serialized applier, heartbeat timers, and N eval workers sharing one
+PlacementEngine — the single-process equivalent of `nomad agent -dev`'s
+server half (SURVEY.md §4.1), minus Raft/RPC (explicitly out of scope per
+the north-star; this object IS the seam where the Go/Raft plane would sit).
+
+Two run modes:
+  dev_mode=True  (default): no threads; `process_all()` drains the broker
+      deterministically — what tests and bench.py use.
+  dev_mode=False: applier + worker threads, wall-clock ticks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from nomad_tpu.ops import PlacementEngine
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    Evaluation,
+    Job,
+    Node,
+    TRIGGER_JOB_DEREGISTER,
+    TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_UPDATE,
+    new_id,
+)
+
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .heartbeat import HeartbeatTimers, build_node_evals, invalidate_heartbeat
+from .plan_apply import PlanApplier, PlanQueue
+from .worker import Worker
+
+
+class Server:
+    def __init__(self, num_workers: int = 1, dev_mode: bool = True,
+                 heartbeat_ttl: float = 30.0) -> None:
+        self.state = StateStore()
+        self.eval_broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(self.state, self.plan_queue)
+        self.heartbeats = HeartbeatTimers(ttl=heartbeat_ttl)
+        self.engine = PlacementEngine()
+        self.engine.packer.attach(self.state)
+        self.dev_mode = dev_mode
+        self.workers = [Worker(self, i) for i in range(num_workers)]
+        self._applier_running = False
+        self._leader = False
+        # capacity-change events release blocked evals
+        self.state.subscribe(self._on_state_event)
+
+    # --------------------------------------------------------- leadership
+
+    def establish_leadership(self) -> None:
+        """reference: leaderLoop/establishLeadership — enable broker, plan
+        queue, blocked evals; restore pending evals from state."""
+        self._leader = True
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        snap = self.state.snapshot()
+        now = time.time()
+        for ev in snap.evals():
+            if ev.status == EVAL_STATUS_PENDING:
+                self.eval_broker.enqueue(ev, now=now)
+            elif ev.status == EVAL_STATUS_BLOCKED:
+                self.blocked_evals.block(ev)
+
+    def start(self) -> None:
+        """Threaded mode: start applier + workers."""
+        if not self._leader:
+            self.establish_leadership()
+        self.dev_mode = False
+        self.plan_applier.start()
+        self._applier_running = True
+        for w in self.workers:
+            w.start()
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            w.stop()
+        if self._applier_running:
+            self.plan_applier.stop()
+            self._applier_running = False
+        self.eval_broker.set_enabled(False)
+
+    def maybe_apply_inline(self, pending) -> None:
+        """dev_mode: the worker's submit_plan applies plans synchronously
+        (there is no applier thread)."""
+        if not self._applier_running:
+            self.plan_applier.apply_one(pending)
+
+    # ------------------------------------------------------- job endpoint
+
+    def register_job(self, job: Job, now: Optional[float] = None) -> Evaluation:
+        """reference: Job.Register RPC — upsert + eval create + enqueue."""
+        t = now if now is not None else time.time()
+        self.state.upsert_job(job)
+        stored = self.state.job_by_id(job.namespace, job.id)
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=stored.priority,
+            type=stored.type,
+            triggered_by=TRIGGER_JOB_REGISTER,
+            job_id=stored.id,
+            job_modify_index=stored.modify_index,
+        )
+        self.apply_eval_update([ev], now=t)
+        return ev
+
+    def deregister_job(self, namespace: str, job_id: str,
+                       purge: bool = False,
+                       now: Optional[float] = None) -> Optional[Evaluation]:
+        t = now if now is not None else time.time()
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        stopped = job.copy()
+        stopped.stop = True
+        self.state.upsert_job(stopped)
+        if purge:
+            self.state.delete_job(namespace, job_id)
+        self.blocked_evals.untrack(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+        )
+        self.apply_eval_update([ev], now=t)
+        return ev
+
+    # ------------------------------------------------------ node endpoint
+
+    def register_node(self, node: Node, now: Optional[float] = None) -> None:
+        t = now if now is not None else time.time()
+        self.state.upsert_node(node)
+        self.heartbeats.reset(node.id, t)
+
+    def heartbeat_node(self, node_id: str, now: Optional[float] = None) -> None:
+        t = now if now is not None else time.time()
+        self.heartbeats.reset(node_id, t)
+
+    def update_node_status(self, node_id: str, status: str,
+                           now: Optional[float] = None) -> List[Evaluation]:
+        t = now if now is not None else time.time()
+        node = self.state.node_by_id(node_id)
+        self.state.update_node_status(node_id, status)
+        evals: List[Evaluation] = []
+        if status == "down" and node is not None:
+            evals = build_node_evals(self.state.snapshot(), node_id)
+        self.apply_eval_update(evals, now=t)
+        return evals
+
+    # ------------------------------------------------------ eval plumbing
+
+    def apply_eval_update(self, evals: Iterable[Evaluation],
+                          now: Optional[float] = None) -> None:
+        """The FSM ApplyEval analog: persist evals, then route pending ones
+        to the broker and blocked ones to the tracker."""
+        evals = list(evals)
+        if not evals:
+            return
+        t = now if now is not None else time.time()
+        self.state.upsert_evals(evals)
+        for ev in evals:
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev, now=t)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    # ------------------------------------------------------------- events
+
+    def _on_state_event(self, topic: str, index: int, payload) -> None:
+        """Capacity-change signals release blocked evals
+        (reference: BlockedEvals.Unblock wiring in nomad/fsm.go)."""
+        if topic == "Node" and not isinstance(payload, str):
+            if payload.ready():
+                self.blocked_evals.unblock(payload.computed_class)
+        elif topic == "Allocation":
+            if payload.terminal_status() and payload.node_id:
+                node = self.state.node_by_id(payload.node_id)
+                if node is not None:
+                    self.blocked_evals.unblock(node.computed_class)
+
+    # --------------------------------------------------------------- tick
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Periodic leader duties: broker delayed-eval promotion + nack
+        timeouts, heartbeat expiry."""
+        t = now if now is not None else time.time()
+        self.eval_broker.tick(t)
+        for node_id in self.heartbeats.expired(t):
+            evals = invalidate_heartbeat(self.state, node_id, t)
+            self.apply_eval_update(evals, now=t)
+
+    # ---------------------------------------------------------- dev drive
+
+    def process_all(self, now: Optional[float] = None, limit: int = 1000,
+                    ) -> int:
+        """dev_mode: drain the broker with worker 0 until empty.  Returns
+        the number of evals processed."""
+        t = now if now is not None else time.time()
+        n = 0
+        while n < limit and self.workers[0].run_once(timeout=0.0, now=t):
+            n += 1
+        return n

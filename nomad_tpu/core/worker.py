@@ -1,0 +1,125 @@
+"""Eval worker (reference: nomad/worker.go).
+
+Dequeue an eval → wait for the state store to reach the eval's index →
+snapshot → instantiate the scheduler from the factory map → process → submit
+plans through the plan queue → ack/nack.  Implements the scheduler.Planner
+seam for production (the Harness is the test implementation).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from nomad_tpu.ops import PlacementEngine
+from nomad_tpu.scheduler import new_scheduler
+from nomad_tpu.structs import Evaluation, Plan, PlanResult
+
+SCHEDULERS_SERVED = ["service", "batch", "system", "sysbatch",
+                     "service-tpu", "batch-tpu", "_core"]
+
+
+class Worker:
+    """One eval worker.  The server runs `count` of these; each holds its
+    own reference to the shared PlacementEngine so packed tensors and jit
+    caches are shared across workers (device work is serialized by JAX)."""
+
+    def __init__(self, server, worker_id: int = 0) -> None:
+        self.server = server
+        self.id = worker_id
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"invoked": 0, "acked": 0, "nacked": 0}
+        # set per-eval by process():
+        self._snapshot = None
+        self._eval_token = ""
+
+    # ------------------------------------------------------------ running
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"worker-{self.id}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.run_once(timeout=0.1)
+
+    # ------------------------------------------------------------- steps
+
+    def run_once(self, timeout: float = 0.0, now: Optional[float] = None
+                 ) -> bool:
+        """Dequeue + process one eval.  Returns True when an eval was
+        handled (used by tests and by the drain loop)."""
+        broker = self.server.eval_broker
+        t = now if now is not None else time.time()
+        evaluation, token = broker.dequeue(SCHEDULERS_SERVED, now=t,
+                                           timeout=timeout)
+        if evaluation is None:
+            return False
+        self._eval_token = token
+        err = self._invoke(evaluation, t)
+        if err is None:
+            broker.ack(evaluation.id, token)
+            self.stats["acked"] += 1
+        else:
+            broker.nack(evaluation.id, token, now=t)
+            self.stats["nacked"] += 1
+        return True
+
+    def _invoke(self, evaluation: Evaluation, now: float) -> Optional[Exception]:
+        state = self.server.state
+        # wait for the state to catch up to the eval (waitForIndex)
+        if evaluation.modify_index:
+            state.wait_for_index(evaluation.modify_index, timeout=5.0)
+        self._snapshot = state.snapshot()
+        self.stats["invoked"] += 1
+        if evaluation.type == "_core":
+            kwargs = {"now": now, "store": state}
+        else:
+            kwargs = {"now": now, "engine": self.server.engine}
+        try:
+            sched = new_scheduler(evaluation.type, self._snapshot, self,
+                                  **kwargs)
+        except ValueError as e:
+            return e
+        return sched.process(evaluation)
+
+    # ----------------------------------------------------------- Planner
+
+    def submit_plan(self, plan: Plan
+                    ) -> Tuple[Optional[PlanResult], object, Optional[Exception]]:
+        plan.snapshot_index = self._snapshot.index if self._snapshot else 0
+        pending = self.server.plan_queue.enqueue(plan)
+        # the applier thread evaluates + commits; in single-threaded test
+        # mode the server applies inline
+        self.server.maybe_apply_inline(pending)
+        result, err = pending.wait()
+        if err is not None:
+            return None, None, err
+        refreshed = None
+        if result is not None and result.refuted_nodes:
+            refreshed = self.server.state.snapshot()
+        return result, refreshed, None
+
+    def update_eval(self, evaluation: Evaluation) -> None:
+        self.server.apply_eval_update([evaluation])
+        if evaluation.status == "complete" and evaluation.failed_tg_allocs:
+            pass  # blocked eval creation handled by the scheduler
+
+    def create_eval(self, evaluation: Evaluation) -> None:
+        self.server.apply_eval_update([evaluation])
+
+    def reblock_eval(self, evaluation: Evaluation) -> None:
+        self.server.apply_eval_update([evaluation])
+        self.server.blocked_evals.block(evaluation)
+
+    def serves_plan(self) -> bool:
+        return True

@@ -10,7 +10,7 @@ bridge (SURVEY.md §7 P6); in-process it is plain Python.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -27,6 +27,8 @@ from nomad_tpu.structs import (
     TaskGroup,
 )
 
+from .feasibility import feasible_mask_jit
+from .preempt import Preemptor, preemption_enabled
 from .select import PlacementInputs, place_jit
 
 
@@ -43,6 +45,8 @@ class PlacementDecision:
     node_id: Optional[str]       # None = no feasible node
     score: float
     metric: AllocMetric
+    # allocs to evict to make this placement possible (preemption)
+    evictions: List = field(default_factory=list)
 
 
 def _pad_pow2(x: int, lo: int = 8) -> int:
@@ -161,7 +165,7 @@ class PlacementEngine:
             spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
         )
         out = place_jit(inp)
-        picks = np.asarray(out.picks)[:p_real]
+        picks = np.asarray(out.picks)[:p_real].copy()
         scores = np.asarray(out.scores)[:p_real]
         topk_rows = np.asarray(out.topk_rows)[:p_real]
         topk_scores = np.asarray(out.topk_scores)[:p_real]
@@ -170,6 +174,28 @@ class PlacementEngine:
         n_exh = np.asarray(out.n_exhausted)[:p_real]
         dim_exh = np.asarray(out.dim_exhausted)[:p_real]
         elapsed = (time.perf_counter_ns() - t0) // max(p_real, 1)
+
+        # ---- preemption fallback for failed placements ----
+        # (reference: BinPackIterator drives Preemptor when Fit fails and
+        # preemption is enabled for the scheduler type)
+        evictions_by_req: Dict[int, List] = {}
+        if (np.any(picks < 0)
+                and preemption_enabled(snapshot.scheduler_config(), job.type)):
+            static = np.asarray(feasible_mask_jit(
+                inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
+                inp.con, inp.luts))
+            preemptor = Preemptor(job, snapshot, t, static,
+                                  np.asarray(out.used),
+                                  job_count=np.asarray(out.job_count),
+                                  dh_limit=tg_tensors.dh_limit)
+            for i in range(p_real):
+                if picks[i] >= 0:
+                    continue
+                g = int(tg_idx[i])
+                res = preemptor.preempt_for(g, tg_tensors.req[g].astype(np.int64))
+                if res is not None:
+                    picks[i] = res.node_row
+                    evictions_by_req[i] = res.evictions
 
         dc_counts: Dict[str, int] = {}
         for nd in snapshot.nodes():
@@ -199,7 +225,8 @@ class PlacementEngine:
             node_id = t.node_ids[int(picks[i])] if picks[i] >= 0 else None
             decisions.append(PlacementDecision(
                 tg_name=r.tg_name, node_id=node_id,
-                score=float(scores[i]), metric=metric))
+                score=float(scores[i]), metric=metric,
+                evictions=evictions_by_req.get(i, [])))
         return decisions
 
     def _no_nodes_decision(self, r: PlacementRequest, snapshot, job: Job

@@ -1,0 +1,174 @@
+"""Preemption (reference: scheduler/preemption.go).
+
+When normal placement fails and preemption is enabled for the job's
+scheduler type, lower-priority allocs are evicted to make room.  Matches the
+reference's semantics:
+
+  - only allocs whose job priority is strictly lower than the preempting
+    job's priority are candidates;
+  - node choice minimizes the aggregate priority/resources disturbed;
+  - per node, eviction is greedy: lowest priority first, and within a
+    priority band the alloc whose resources best match the remaining
+    shortfall (basicResourceDistance).
+
+This pass runs host-side (numpy) over the packed node tensors for the few
+placements that failed the device batch — the common case (everything
+places) never pays for it.  A fully device-resident priority-bucket design
+is sketched in the docstring of `usage_by_priority` for a later round.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nomad_tpu.structs import (
+    Allocation,
+    Job,
+    JOB_TYPE_BATCH,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSBATCH,
+    JOB_TYPE_SYSTEM,
+    PreemptionConfig,
+    SchedulerConfiguration,
+)
+
+
+def preemption_enabled(cfg: SchedulerConfiguration, job_type: str) -> bool:
+    """reference: SchedulerConfiguration.PreemptionConfig gates by type."""
+    pc: PreemptionConfig = cfg.preemption_config
+    return {
+        JOB_TYPE_SYSTEM: pc.system_scheduler_enabled,
+        JOB_TYPE_SYSBATCH: pc.sysbatch_scheduler_enabled,
+        JOB_TYPE_BATCH: pc.batch_scheduler_enabled,
+        JOB_TYPE_SERVICE: pc.service_scheduler_enabled,
+    }.get(job_type, False)
+
+
+def resource_distance(delta: np.ndarray, ask: np.ndarray) -> float:
+    """reference: basicResourceDistance — euclidean distance between the
+    remaining shortfall and a candidate alloc's resources, normalized per
+    dimension."""
+    num = ask.astype(np.float64)
+    den = np.maximum(delta.astype(np.float64), 1.0)
+    return float(np.sqrt(np.sum(((num - den) / den) ** 2)))
+
+
+@dataclass
+class PreemptionResult:
+    node_row: int
+    evictions: List[Allocation] = field(default_factory=list)
+
+
+class Preemptor:
+    """Per-eval preemption state over packed node tensors.
+
+    Built lazily on the first failed placement; tracks capacity freed by
+    earlier preemptions within the same plan so successive failed
+    placements see each other's evictions.
+    """
+
+    def __init__(self, job: Job, snapshot, tensors, static_mask: np.ndarray,
+                 used: np.ndarray, job_count: Optional[np.ndarray] = None,
+                 dh_limit: Optional[np.ndarray] = None) -> None:
+        self.job = job
+        self.tensors = tensors
+        self.static = static_mask            # [G, N] bool
+        self.used = used.copy()              # [N, 3] int32 (proposed usage)
+        # dynamic constraints the kernel enforces must hold here too:
+        self.job_count = (job_count.copy() if job_count is not None
+                          else np.zeros(tensors.n, np.int32))
+        self.dh_limit = (dh_limit if dh_limit is not None
+                         else np.zeros(1, np.int32))
+        self.evicted_ids: set = set()
+        # candidate allocs per node row: (priority, resources array, alloc)
+        self.cands: Dict[int, List[Tuple[int, np.ndarray, Allocation]]] = {}
+        self._build(snapshot)
+
+    def _build(self, snapshot) -> None:
+        t = self.tensors
+        my_prio = self.job.priority
+        for row, node_id in enumerate(t.node_ids):
+            lst = []
+            for a in snapshot.allocs_by_node(node_id):
+                if a.terminal_status():
+                    continue
+                prio = a.job.priority if a.job is not None else 50
+                if prio >= my_prio:
+                    continue
+                if a.job_id == self.job.id:
+                    continue
+                res = np.array([a.resources.cpu, a.resources.memory_mb,
+                                a.resources.disk_mb], np.int64)
+                lst.append((prio, res, a))
+            if lst:
+                self.cands[row] = lst
+
+    # ------------------------------------------------------------- solve
+
+    def preempt_for(self, g: int, req: np.ndarray
+                    ) -> Optional[PreemptionResult]:
+        """Find a node where evicting lower-priority allocs fits `req`.
+        Returns None when impossible."""
+        t = self.tensors
+        cap = t.cap.astype(np.int64)
+        used = self.used.astype(np.int64)
+        # preemptible resources per node (remaining candidates only)
+        preemptible = np.zeros_like(used)
+        for row, lst in self.cands.items():
+            live = [c for c in lst if c[2].id not in self.evicted_ids]
+            if live:
+                preemptible[row] = np.sum([c[1] for c in live], axis=0)
+        fits = np.all(used - preemptible + req <= cap, axis=1)
+        fits &= self.static[g]
+        if g < len(self.dh_limit) and self.dh_limit[g] > 0:
+            fits &= self.job_count < self.dh_limit[g]
+        rows = np.nonzero(fits)[0]
+        if rows.size == 0:
+            return None
+        # node choice: minimize total preempted priority-weighted resources
+        best_row, best_cost, best_evict = -1, None, None
+        for row in rows:
+            evict, cost = self._greedy_evict(int(row), req)
+            if evict is None:
+                continue
+            if best_cost is None or cost < best_cost:
+                best_row, best_cost, best_evict = int(row), cost, evict
+        if best_evict is None:
+            return None
+        for a in best_evict:
+            self.evicted_ids.add(a.id)
+            self.used[best_row] -= np.array(
+                [a.resources.cpu, a.resources.memory_mb, a.resources.disk_mb],
+                np.int32)
+        self.used[best_row] += req.astype(np.int32)
+        self.job_count[best_row] += 1
+        return PreemptionResult(node_row=best_row, evictions=best_evict)
+
+    def _greedy_evict(self, row: int, req: np.ndarray):
+        """Greedy eviction on one node: lowest priority first; within a
+        band, best resource-distance match to the remaining shortfall."""
+        t = self.tensors
+        cap = t.cap[row].astype(np.int64)
+        used = self.used[row].astype(np.int64)
+        shortfall = used + req - cap           # per-dim overrun
+        cands = [c for c in self.cands.get(row, [])
+                 if c[2].id not in self.evicted_ids]
+        cands.sort(key=lambda c: c[0])         # priority ascending
+        evictions: List[Allocation] = []
+        cost = 0.0
+        while np.any(shortfall > 0):
+            if not cands:
+                return None, None
+            lowest = cands[0][0]
+            band = [c for c in cands if c[0] == lowest]
+            delta = np.maximum(shortfall, 0)
+            band.sort(key=lambda c: resource_distance(delta, c[1]))
+            prio, res, alloc = band[0]
+            cands.remove(band[0])
+            evictions.append(alloc)
+            shortfall -= res
+            cost += (prio + 1) * 1000 + float(res.sum())
+        return evictions, cost

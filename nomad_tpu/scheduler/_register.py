@@ -21,3 +21,9 @@ try:
     register_scheduler("sysbatch", new_sysbatch_scheduler)
 except ImportError:  # pragma: no cover
     pass
+
+try:
+    from .core_sched import new_core_scheduler
+    register_scheduler("_core", new_core_scheduler)
+except ImportError:  # pragma: no cover
+    pass

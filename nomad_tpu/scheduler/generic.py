@@ -206,8 +206,10 @@ class GenericScheduler(Scheduler):
                                       stopped_allocs=stopped)
 
         # host-side port assignment per chosen node (reference: AllocsFit's
-        # NetworkIndex, kept off-device per SURVEY §7 P1)
+        # NetworkIndex, kept off-device per SURVEY §7 P1).  Preemption
+        # victims' ports are freed: exclude them from the index.
         net_idx: Dict[str, NetworkIndex] = {}
+        victim_ids = {v.id for d in decisions for v in d.evictions}
 
         for p, d in zip(places, decisions):
             tg = p.tg
@@ -223,7 +225,9 @@ class GenericScheduler(Scheduler):
                     node = self.state.node_by_id(d.node_id)
                     if node is not None:
                         ni.set_node(node)
-                    ni.add_allocs(self.state.allocs_by_node(d.node_id))
+                    ni.add_allocs(
+                        a for a in self.state.allocs_by_node(d.node_id)
+                        if a.id not in victim_ids)
                     net_idx[d.node_id] = ni
                 ports, fail = ni.assign_ports(ask.networks)
                 if ports is None:
@@ -249,6 +253,10 @@ class GenericScheduler(Scheduler):
                 create_time=self.now,
                 modify_time=self.now,
             )
+            if d.evictions:
+                for victim in d.evictions:
+                    plan.append_preempted_alloc(victim, alloc.id)
+                alloc.preempted_allocations = [v.id for v in d.evictions]
             if results.deployment is not None:
                 alloc.deployment_id = results.deployment.id
             if p.previous_alloc is not None:

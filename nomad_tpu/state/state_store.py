@@ -331,6 +331,14 @@ class StateStore:
 
     # ----------------------------------------------------------- csi / cfg
 
+    def delete_deployment(self, dep_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            deps = dict(self._deployments)
+            deps.pop(dep_id, None)
+            self._deployments = deps
+            return idx
+
     def upsert_csi_volume(self, vol: CSIVolume) -> int:
         with self._lock:
             idx = self._bump()
@@ -475,8 +483,14 @@ class StateSnapshot:
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
         return self._evals.get(eval_id)
 
+    def evals(self) -> List[Evaluation]:
+        return list(self._evals.values())
+
     def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
         return list(self._evals_by_job.get((namespace, job_id), {}).values())
+
+    def deployments(self) -> List[Deployment]:
+        return list(self._deployments.values())
 
     def latest_deployment_by_job(self, namespace: str,
                                  job_id: str) -> Optional[Deployment]:

@@ -1,0 +1,316 @@
+"""Server-core tests: broker, blocked evals, plan applier, worker, server
+(reference scenarios: nomad/eval_broker_test.go, blocked_evals_test.go,
+plan_apply_test.go, worker_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core import EvalBroker, PlanQueue, PlanApplier, Server
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Plan, Resources
+
+NOW = 1_700_000_000.0
+
+
+class TestEvalBroker:
+    def test_priority_order(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        lo = mock.eval(priority=10)
+        hi = mock.eval(priority=90)
+        b.enqueue(lo, now=NOW)
+        b.enqueue(hi, now=NOW)
+        ev, tok = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert ev.id == hi.id
+        b.ack(ev.id, tok)
+        ev2, tok2 = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert ev2.id == lo.id
+
+    def test_per_job_serialization(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        e1 = mock.eval(job_id="j1")
+        e2 = mock.eval(job_id="j1")
+        b.enqueue(e1, now=NOW)
+        b.enqueue(e2, now=NOW)
+        ev, tok = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert ev.id == e1.id
+        # second eval for the same job is held
+        none, _ = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert none is None
+        b.ack(e1.id, tok)
+        ev2, _ = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert ev2.id == e2.id
+
+    def test_nack_requeues_then_fails(self):
+        b = EvalBroker(delivery_limit=2)
+        b.set_enabled(True)
+        e = mock.eval()
+        b.enqueue(e, now=NOW)
+        for i in range(2):
+            ev, tok = b.dequeue(["service"], now=NOW, timeout=0.0)
+            assert ev is not None
+            b.nack(ev.id, tok, now=NOW)
+        none, _ = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert none is None
+        assert len(b.failed_evals()) == 1
+
+    def test_nack_timeout_requeues(self):
+        b = EvalBroker(nack_timeout=10)
+        b.set_enabled(True)
+        e = mock.eval()
+        b.enqueue(e, now=NOW)
+        ev, tok = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert ev is not None
+        # worker dies; timeout passes
+        b.tick(NOW + 11)
+        ev2, tok2 = b.dequeue(["service"], now=NOW + 11, timeout=0.0)
+        assert ev2.id == e.id
+        # stale token no longer acks
+        assert b.ack(e.id, tok) is not None
+        assert b.ack(e.id, tok2) is None
+
+    def test_delayed_eval_held_until_wait_until(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        e = mock.eval()
+        e.wait_until = NOW + 100
+        b.enqueue(e, now=NOW)
+        none, _ = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert none is None
+        b.tick(NOW + 101)
+        ev, _ = b.dequeue(["service"], now=NOW + 101, timeout=0.0)
+        assert ev.id == e.id
+
+    def test_disabled_drops(self):
+        b = EvalBroker()
+        b.enqueue(mock.eval(), now=NOW)
+        assert b.pending_evals() == 0
+
+
+class TestPlanApplier:
+    def _setup(self):
+        state = StateStore()
+        q = PlanQueue()
+        q.set_enabled(True)
+        applier = PlanApplier(state, q)
+        return state, q, applier
+
+    def test_refutes_overcommitted_node(self):
+        state, q, applier = self._setup()
+        n = mock.node()
+        state.upsert_node(n)
+        job = mock.job()
+        state.upsert_job(job)
+        # two workers racing: plan A commits 3000MHz, plan B (stale) wants
+        # 3000MHz more -> B must be refuted
+        a1 = mock.alloc(job=job, node_id=n.id)
+        a1.resources = Resources(cpu=3000, memory_mb=100)
+        plan_a = Plan(eval_id="ea", job=job)
+        plan_a.append_alloc(a1)
+        pa = q.enqueue(plan_a)
+        applier.apply_one(pa)
+        res_a, err_a = pa.wait(0.1)
+        assert err_a is None and not res_a.refuted_nodes
+
+        a2 = mock.alloc(job=job, node_id=n.id)
+        a2.resources = Resources(cpu=3000, memory_mb=100)
+        plan_b = Plan(eval_id="eb", job=job)
+        plan_b.append_alloc(a2)
+        pb = q.enqueue(plan_b)
+        applier.apply_one(pb)
+        res_b, err_b = pb.wait(0.1)
+        assert err_b is None
+        assert res_b.refuted_nodes == [n.id]
+        full, expected, actual = res_b.full_commit(plan_b)
+        assert not full and expected == 1 and actual == 0
+        # state must NOT contain the refuted alloc
+        assert state.snapshot().alloc_by_id(a2.id) is None
+
+    def test_plan_with_stop_frees_capacity(self):
+        state, q, applier = self._setup()
+        n = mock.node()
+        state.upsert_node(n)
+        job = mock.job()
+        state.upsert_job(job)
+        old = mock.alloc(job=job, node_id=n.id)
+        old.resources = Resources(cpu=3500, memory_mb=100)
+        state.upsert_allocs([old])
+        stopped = old.copy_skip_job()
+        new = mock.alloc(job=job, node_id=n.id)
+        new.resources = Resources(cpu=3500, memory_mb=100)
+        plan = Plan(eval_id="e", job=job)
+        plan.append_stopped_alloc(stopped, "update")
+        plan.append_alloc(new)
+        p = q.enqueue(plan)
+        applier.apply_one(p)
+        res, err = p.wait(0.1)
+        assert err is None and not res.refuted_nodes
+
+    def test_down_node_refused(self):
+        state, q, applier = self._setup()
+        n = mock.node(status="down")
+        state.upsert_node(n)
+        job = mock.job()
+        plan = Plan(eval_id="e", job=job)
+        plan.append_alloc(mock.alloc(job=job, node_id=n.id))
+        p = q.enqueue(plan)
+        applier.apply_one(p)
+        res, _ = p.wait(0.1)
+        assert res.refuted_nodes == [n.id]
+
+
+class TestServer:
+    def test_register_to_running_end_to_end(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        for _ in range(3):
+            s.register_node(mock.node(), now=NOW)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        s.register_job(job, now=NOW)
+        n = s.process_all(now=NOW)
+        assert n == 1
+        live = [a for a in s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 3
+        ev = s.state.snapshot().evals_by_job(job.namespace, job.id)
+        assert any(e.status == "complete" for e in ev)
+
+    def test_blocked_eval_released_on_new_node(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        # no nodes: everything blocks
+        job = mock.job()
+        job.task_groups[0].count = 2
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        assert s.blocked_evals.num_blocked() == 1
+        # capacity arrives
+        s.register_node(mock.node(), now=NOW + 1)
+        processed = s.process_all(now=NOW + 1)
+        assert processed >= 1
+        live = [a for a in s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 2
+
+    def test_heartbeat_expiry_reschedules(self):
+        s = Server(dev_mode=True, heartbeat_ttl=30)
+        s.establish_leadership()
+        n1, n2 = mock.node(), mock.node()
+        s.register_node(n1, now=NOW)
+        s.register_node(n2, now=NOW)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        victim = next(a.node_id for a in
+                      s.state.snapshot().allocs_by_job(job.namespace, job.id))
+        other = n2.id if victim == n1.id else n1.id
+        # victim stops heartbeating; the other keeps beating
+        s.heartbeat_node(other, now=NOW + 25)
+        s.tick(now=NOW + 31)
+        assert s.state.node_by_id(victim).status == "down"
+        s.process_all(now=NOW + 31)
+        live = [a for a in s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 1 and live[0].node_id == other
+
+    def test_deregister_stops_allocs(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.register_node(mock.node(), now=NOW)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        s.deregister_job(job.namespace, job.id, now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        live = [a for a in s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert live == []
+
+    def test_threaded_mode_smoke(self):
+        import time as _t
+        s = Server(num_workers=2, dev_mode=False)
+        s.start()
+        try:
+            for _ in range(3):
+                s.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 5
+            s.register_job(job)
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                live = [a for a in
+                        s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                        if not a.terminal_status()]
+                if len(live) == 5:
+                    break
+                _t.sleep(0.1)
+            assert len(live) == 5
+        finally:
+            s.shutdown()
+
+
+class TestReviewRegressions:
+    def test_waiters_released_when_eval_fails(self):
+        # An eval hitting the delivery limit must not strand same-job waiters.
+        b = EvalBroker(delivery_limit=1)
+        b.set_enabled(True)
+        e1 = mock.eval(job_id="j1")
+        e2 = mock.eval(job_id="j1")
+        b.enqueue(e1, now=NOW)
+        ev, tok = b.dequeue(["service"], now=NOW, timeout=0.0)
+        b.enqueue(e2, now=NOW)   # stashed behind in-flight e1
+        b.nack(ev.id, tok, now=NOW)       # limit 1 -> e1 fails
+        assert len(b.failed_evals()) == 1
+        ev2, _ = b.dequeue(["service"], now=NOW, timeout=0.0)
+        assert ev2 is not None and ev2.id == e2.id
+
+    def test_core_gc_eval(self):
+        from nomad_tpu.structs import Evaluation
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.register_node(mock.node(), now=NOW)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        s.deregister_job(job.namespace, job.id, now=NOW)
+        s.process_all(now=NOW)
+        # force-GC via a _core eval (the `nomad system gc` path)
+        gc = Evaluation(type="_core", job_id="force-gc", priority=100)
+        s.apply_eval_update([gc], now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        assert snap.job_by_id(job.namespace, job.id) is None
+        assert all(e.id == gc.id or e.status != "complete"
+                   or e.job_id != job.id for e in snap.evals())
+
+    def test_preemption_respects_distinct_hosts(self):
+        from nomad_tpu.structs import (Constraint, PreemptionConfig, Resources,
+                                       SchedulerConfiguration)
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.state.set_scheduler_config(SchedulerConfiguration(
+            preemption_config=PreemptionConfig(service_scheduler_enabled=True)))
+        n = mock.node()
+        s.register_node(n, now=NOW)
+        low = mock.batch_job(priority=10)
+        low.task_groups[0].count = 4
+        low.task_groups[0].tasks[0].resources = Resources(cpu=900, memory_mb=256)
+        s.register_job(low, now=NOW)
+        s.process_all(now=NOW)
+        hi = mock.job(priority=90)
+        hi.constraints.append(Constraint("", "distinct_hosts", ""))
+        hi.task_groups[0].count = 2
+        hi.task_groups[0].tasks[0].resources = Resources(cpu=1000, memory_mb=128)
+        s.register_job(hi, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(hi.namespace, hi.id)
+                if not a.terminal_status()]
+        # only one node exists: distinct_hosts allows exactly ONE placement
+        # even though preemption could free room for both
+        assert len(live) == 1

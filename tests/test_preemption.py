@@ -1,0 +1,109 @@
+"""Preemption tests (reference scenarios: scheduler/preemption_test.go)."""
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    PreemptionConfig,
+    Resources,
+    SchedulerConfiguration,
+)
+
+NOW = 1_700_000_000.0
+
+
+def full_node_harness(service_preemption=False):
+    """One 4000MHz node filled by a low-priority batch job."""
+    h = Harness()
+    cfg = SchedulerConfiguration(
+        preemption_config=PreemptionConfig(
+            system_scheduler_enabled=True,
+            batch_scheduler_enabled=False,
+            service_scheduler_enabled=service_preemption))
+    h.state.set_scheduler_config(cfg)
+    n = mock.node()
+    n.resources = type(n.resources)(cpu=4000, memory_mb=8192, disk_mb=100000)
+    n.reserved = type(n.reserved)()
+    h.state.upsert_node(n)
+    low = mock.batch_job(priority=20)
+    low.task_groups[0].count = 4
+    low.task_groups[0].tasks[0].resources = Resources(cpu=900, memory_mb=512)
+    h.state.upsert_job(low)
+    e = mock.eval(job_id=low.id, type="batch")
+    assert h.process("batch", e, now=NOW) is None
+    live = [a for a in h.snapshot().allocs_by_job(low.namespace, low.id)
+            if not a.terminal_status()]
+    assert len(live) == 4     # node now has 3600/4000 used
+    return h, n, low
+
+
+class TestPreemption:
+    def test_system_job_preempts_lower_priority(self):
+        h, node, low = full_node_harness()
+        sysjob = mock.system_job(priority=100)   # needs 500MHz; 400 free
+        sysjob.task_groups[0].tasks[0].resources = Resources(
+            cpu=800, memory_mb=256)
+        h.state.upsert_job(sysjob)
+        # system scheduler path goes through host allocs_fit; preemption is
+        # driven via the generic engine only — use a service-type eval of
+        # equivalent shape to exercise the engine path:
+        svc = mock.job(priority=100)
+        svc.task_groups[0].count = 1
+        svc.task_groups[0].tasks[0].resources = Resources(cpu=800, memory_mb=256)
+        cfg = h.state.snapshot().scheduler_config()
+        cfg2 = SchedulerConfiguration(
+            preemption_config=PreemptionConfig(service_scheduler_enabled=True))
+        h.state.set_scheduler_config(cfg2)
+        h.state.upsert_job(svc)
+        e = mock.eval(job_id=svc.id, priority=100)
+        assert h.process("service", e, now=NOW) is None
+        plan = h.plans[-1]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 1
+        preempted = [a for allocs in plan.node_preemptions.values()
+                     for a in allocs]
+        assert len(preempted) == 1    # one 900MHz eviction frees enough
+        assert preempted[0].desired_status == "evict"
+        assert preempted[0].preempted_by_allocation == placed[0].id
+        assert placed[0].preempted_allocations == [preempted[0].id]
+        # state reflects the eviction
+        snap = h.snapshot()
+        assert snap.alloc_by_id(preempted[0].id).desired_status == "evict"
+
+    def test_no_preemption_when_disabled(self):
+        h, node, low = full_node_harness(service_preemption=False)
+        svc = mock.job(priority=100)
+        svc.task_groups[0].count = 1
+        svc.task_groups[0].tasks[0].resources = Resources(cpu=800, memory_mb=256)
+        h.state.upsert_job(svc)
+        e = mock.eval(job_id=svc.id, priority=100)
+        h.process("service", e, now=NOW)
+        preempted = [a for p in h.plans for allocs in p.node_preemptions.values()
+                     for a in allocs]
+        assert preempted == []
+        # blocked eval instead
+        assert any(ev.status == "blocked" for ev in h.create_evals)
+
+    def test_equal_priority_not_preempted(self):
+        h, node, low = full_node_harness(service_preemption=True)
+        svc = mock.job(priority=20)   # same as the batch job
+        svc.task_groups[0].count = 1
+        svc.task_groups[0].tasks[0].resources = Resources(cpu=800, memory_mb=256)
+        h.state.upsert_job(svc)
+        e = mock.eval(job_id=svc.id, priority=20)
+        h.process("service", e, now=NOW)
+        preempted = [a for p in h.plans for allocs in p.node_preemptions.values()
+                     for a in allocs]
+        assert preempted == []
+
+    def test_minimal_eviction_set(self):
+        # needs 1700 free; has 400 -> must evict exactly 2 x 900 allocs
+        h, node, low = full_node_harness(service_preemption=True)
+        svc = mock.job(priority=70)
+        svc.task_groups[0].count = 1
+        svc.task_groups[0].tasks[0].resources = Resources(cpu=1700, memory_mb=256)
+        h.state.upsert_job(svc)
+        e = mock.eval(job_id=svc.id, priority=70)
+        h.process("service", e, now=NOW)
+        preempted = [a for p in h.plans for allocs in p.node_preemptions.values()
+                     for a in allocs]
+        assert len(preempted) == 2
