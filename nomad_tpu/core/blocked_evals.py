@@ -35,14 +35,20 @@ class BlockedEvals:
                 self._by_class.clear()
                 self._escaped.clear()
 
-    def block(self, evaluation: Evaluation) -> None:
+    def block(self, evaluation: Evaluation) -> bool:
+        """Track a blocked eval.  Returns False when an eval for the same
+        job is already blocked (the caller should cancel the duplicate in
+        state, matching the reference's duplicate-blocked-eval
+        cancellation)."""
         with self._lock:
             if not self._enabled:
-                return
+                return True
             key = (evaluation.namespace, evaluation.job_id)
             if key in self._blocked:
+                if self._blocked[key].id == evaluation.id:
+                    return True      # same eval re-tracked (leader flap)
                 self.stats["deduped"] += 1
-                return
+                return False
             self._blocked[key] = evaluation
             self.stats["blocked"] += 1
             if evaluation.escaped_computed_class or not evaluation.class_eligibility:
@@ -51,6 +57,7 @@ class BlockedEvals:
                 for klass, eligible in evaluation.class_eligibility.items():
                     if eligible:
                         self._by_class.setdefault(klass, set()).add(key)
+            return True
 
     def unblock(self, computed_class: str, now: float = 0.0) -> int:
         """Capacity changed on a node of `computed_class`: release matching

@@ -73,10 +73,12 @@ class Server:
             if ev.status == EVAL_STATUS_PENDING:
                 self.eval_broker.enqueue(ev, now=now)
             elif ev.status == EVAL_STATUS_BLOCKED:
-                self.blocked_evals.block(ev)
+                if not self.blocked_evals.block(ev):
+                    self._cancel_eval(ev)
 
-    def start(self) -> None:
-        """Threaded mode: start applier + workers."""
+    def start(self, tick_interval: float = 1.0) -> None:
+        """Threaded mode: start applier + workers + the tick loop that
+        drives heartbeat expiry and broker timeouts."""
         if not self._leader:
             self.establish_leadership()
         self.dev_mode = False
@@ -84,8 +86,21 @@ class Server:
         self._applier_running = True
         for w in self.workers:
             w.start()
+        self._tick_stop = threading.Event()
+
+        def tick_loop():
+            while not self._tick_stop.wait(tick_interval):
+                self.tick()
+
+        self._tick_thread = threading.Thread(target=tick_loop,
+                                             name="server-tick", daemon=True)
+        self._tick_thread.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "_tick_thread", None) is not None:
+            self._tick_stop.set()
+            self._tick_thread.join(timeout=5)
+            self._tick_thread = None
         for w in self.workers:
             w.stop()
         if self._applier_running:
@@ -177,7 +192,16 @@ class Server:
             if ev.should_enqueue():
                 self.eval_broker.enqueue(ev, now=t)
             elif ev.should_block():
-                self.blocked_evals.block(ev)
+                if not self.blocked_evals.block(ev):
+                    self._cancel_eval(ev)
+
+    def _cancel_eval(self, ev: Evaluation) -> None:
+        """Duplicate blocked eval: cancel it in state so it neither lingers
+        as 'blocked' forever nor re-feeds the tracker on leader flaps."""
+        c = ev.copy()
+        c.status = "canceled"
+        c.status_description = "canceled: duplicate blocked evaluation"
+        self.state.upsert_evals([c])
 
     # ------------------------------------------------------------- events
 

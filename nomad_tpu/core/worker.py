@@ -65,7 +65,10 @@ class Worker:
         if evaluation is None:
             return False
         self._eval_token = token
-        err = self._invoke(evaluation, t)
+        try:
+            err = self._invoke(evaluation, t)
+        except Exception as e:  # noqa: BLE001 - a scheduler bug must nack,
+            err = e             # not kill the worker thread
         if err is None:
             broker.ack(evaluation.id, token)
             self.stats["acked"] += 1
@@ -118,8 +121,9 @@ class Worker:
         self.server.apply_eval_update([evaluation])
 
     def reblock_eval(self, evaluation: Evaluation) -> None:
+        # apply_eval_update routes blocked evals to the tracker (and
+        # cancels duplicates)
         self.server.apply_eval_update([evaluation])
-        self.server.blocked_evals.block(evaluation)
 
     def serves_plan(self) -> bool:
         return True

@@ -163,11 +163,14 @@ class Preemptor:
             if not cands:
                 return None, None
             lowest = cands[0][0]
-            band = [c for c in cands if c[0] == lowest]
             delta = np.maximum(shortfall, 0)
-            band.sort(key=lambda c: resource_distance(delta, c[1]))
-            prio, res, alloc = band[0]
-            cands.remove(band[0])
+            # best same-priority candidate by resource distance; remove by
+            # index (tuples contain numpy arrays, so list.remove would
+            # attempt an ambiguous array comparison)
+            best_i = min(
+                (i for i, c in enumerate(cands) if c[0] == lowest),
+                key=lambda i: resource_distance(delta, cands[i][1]))
+            prio, res, alloc = cands.pop(best_i)
             evictions.append(alloc)
             shortfall -= res
             cost += (prio + 1) * 1000 + float(res.sum())

@@ -1,0 +1,3 @@
+"""Device-mesh sharding for the node axis."""
+
+from .mesh import AXIS, make_mesh, pad_nodes, place_sharded_fn  # noqa: F401

@@ -1,0 +1,203 @@
+"""Multi-device sharded placement (SURVEY.md §6.7/§7 P7).
+
+The node axis — the framework's "long context" — is sharded across the
+device mesh.  Per placement step, each device scores its node shard
+locally; the winner is found with a two-stage top-k (local `lax.top_k`,
+then a global top-k over the all-gathered shard winners riding ICI);
+spread / distinct-property counts are replicated and updated identically on
+every shard by psum-broadcasting the picked node's property values from the
+owning shard.  This is the DP/CP mapping from SURVEY.md §3.6: eval batch ↔
+data parallel, node axis ↔ context parallel; there are no weights, so
+TP/PP have no analog.
+
+Works identically on a real multi-chip TPU mesh and on the virtual
+8-device CPU mesh used in tests and the driver's multichip dry-run.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nomad_tpu.ops.feasibility import feasible_mask
+from nomad_tpu.ops.scoring import (
+    affinity_score,
+    binpack_score,
+    capacity_fit,
+    job_anti_affinity,
+    normalize_scores,
+    spread_boost,
+)
+from nomad_tpu.ops.select import (
+    NEG_INF,
+    TOP_K,
+    PlacementInputs,
+    PlacementOutputs,
+)
+
+AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def pad_nodes(n: int, ndev: int) -> int:
+    """Global node count padded to a multiple of the mesh size."""
+    return ((n + ndev - 1) // ndev) * ndev
+
+
+def _place_local(inp: PlacementInputs) -> PlacementOutputs:
+    """Per-shard body (runs under shard_map).  Mirrors ops.select.place but
+    with global winner selection and replicated count-state updates."""
+    n_loc = inp.attrs.shape[0]
+    ndev = jax.lax.axis_size(AXIS)
+    offset = jax.lax.axis_index(AXIS) * n_loc
+    global_rows = offset + jnp.arange(n_loc)
+    k_loc = min(TOP_K, n_loc)
+
+    static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
+                           inp.con, inp.luts)              # [G, N_loc]
+    aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)  # [G, N_loc]
+    aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)
+    sp_any = jnp.any(inp.sp_weight > 0)
+    capf = inp.cap.astype(jnp.float32)
+
+    def step(carry, xs):
+        used, job_count, sp_counts, pd_counts = carry
+        g, prev, act = xs
+        req_g = inp.req[g]
+        stat_g = static[g]
+        fit = capacity_fit(inp.cap, used, req_g)
+        dh_ok = jnp.where(inp.dh_limit[g] > 0,
+                          job_count < inp.dh_limit[g], True)
+        kd = pd_counts.shape[1]
+        pd_val = jnp.clip(inp.pd_nodeval, 0, kd - 1)
+        pd_cnt = jnp.take_along_axis(pd_counts, pd_val, axis=1)
+        pd_row_ok = (pd_cnt < inp.pd_limit[:, None]) & (inp.pd_nodeval >= 0)
+        pd_applies = inp.pd_apply[g] & (inp.pd_limit > 0)
+        pd_ok = jnp.all(jnp.where(pd_applies[:, None], pd_row_ok, True),
+                        axis=0)
+        feas = stat_g & fit & dh_ok & pd_ok
+
+        bp = binpack_score(capf, used.astype(jnp.float32),
+                           req_g.astype(jnp.float32),
+                           inp.spread_algo) / 18.0
+        aa = job_anti_affinity(job_count, inp.desired[g])
+        rp = jnp.where(global_rows == prev, -1.0, 0.0)
+        af = aff_sc[g]
+        sp = spread_boost(inp.sp_nodeval, inp.sp_weight,
+                          inp.sp_expected, sp_counts)
+        comps = jnp.stack([bp, aa, rp, af, sp])
+        act_mask = jnp.stack([
+            jnp.ones(n_loc, bool),
+            job_count > 0,
+            global_rows == prev,
+            jnp.broadcast_to(aff_any[g], (n_loc,)),
+            jnp.broadcast_to(sp_any, (n_loc,)),
+        ])
+        final = normalize_scores(comps, act_mask)
+        masked = jnp.where(feas, final, NEG_INF)
+
+        # ---- two-stage top-k: local, then global over shard winners ----
+        loc_sc, loc_rows = jax.lax.top_k(masked, k_loc)
+        loc_grows = jnp.where(loc_sc > NEG_INF / 2,
+                              global_rows[loc_rows], -1)
+        all_sc = jax.lax.all_gather(loc_sc, AXIS).reshape(-1)
+        all_rows = jax.lax.all_gather(loc_grows, AXIS).reshape(-1)
+        k_glob = min(TOP_K, all_sc.shape[0])
+        top_sc, top_idx = jax.lax.top_k(all_sc, k_glob)
+        top_rows = all_rows[top_idx]
+        pick = top_rows[0]
+        ok = act & (top_sc[0] > NEG_INF / 2)
+        pick = jnp.where(ok, pick, -1)
+
+        # ---- state update ----
+        onehot = (global_rows == pick) & ok
+        used = used + onehot[:, None].astype(jnp.int32) * req_g[None, :]
+        job_count = job_count + onehot.astype(jnp.int32)
+
+        # owner shard broadcasts the picked node's spread / property values
+        owns = ok & (pick >= offset) & (pick < offset + n_loc)
+        loc_pick = jnp.clip(pick - offset, 0, n_loc - 1)
+        sval = jnp.where(owns, inp.sp_nodeval[:, loc_pick] + 1, 0)
+        sval = jax.lax.psum(sval, AXIS) - 1                 # [S], -1 = none
+        k_sp = sp_counts.shape[1]
+        sp_hot = (jax.nn.one_hot(jnp.clip(sval, 0, k_sp - 1), k_sp)
+                  * ((sval >= 0) & ok)[..., None])
+        sp_counts = sp_counts + sp_hot
+        pval = jnp.where(owns, inp.pd_nodeval[:, loc_pick] + 1, 0)
+        pval = jax.lax.psum(pval, AXIS) - 1                 # [D]
+        pd_hot = (jax.nn.one_hot(jnp.clip(pval, 0, kd - 1), kd,
+                                 dtype=pd_counts.dtype)
+                  * ((pval >= 0) & inp.pd_apply[g] & ok)[..., None])
+        pd_counts = pd_counts + pd_hot
+
+        # ---- metrics (global) ----
+        n_filtered = jax.lax.psum(jnp.sum(~stat_g), AXIS)
+        exhausted = stat_g & (~fit | ~dh_ok | ~pd_ok)
+        n_exhausted = jax.lax.psum(jnp.sum(exhausted), AXIS)
+        n_feas = jax.lax.psum(jnp.sum(feas), AXIS)
+        pre_used = used - onehot[:, None].astype(jnp.int32) * req_g[None, :]
+        over = (pre_used + req_g[None, :]) > inp.cap
+        dim_ex = jax.lax.psum(jnp.sum((stat_g & ~fit)[:, None] & over,
+                                      axis=0), AXIS)
+
+        out = (pick,
+               jnp.where(ok, top_sc[0], 0.0),
+               jnp.where(ok, top_rows, -1),
+               jnp.where(ok, top_sc, 0.0),
+               n_feas.astype(jnp.int32),
+               n_filtered.astype(jnp.int32),
+               n_exhausted.astype(jnp.int32),
+               dim_ex.astype(jnp.int32))
+        return (used, job_count, sp_counts, pd_counts), out
+
+    # replicated carries become device-varying once updated with values
+    # derived from collectives; pcast the initial values to match
+    carry0 = (inp.used0, inp.job_count0,
+              jax.lax.pcast(inp.sp_counts0, (AXIS,), to="varying"),
+              jax.lax.pcast(inp.pd_counts0, (AXIS,), to="varying"))
+    (used, job_count, _, _), outs = jax.lax.scan(
+        step, carry0, (inp.tg_idx, inp.prev_row, inp.active))
+    return PlacementOutputs(
+        picks=outs[0], scores=outs[1], topk_rows=outs[2], topk_scores=outs[3],
+        n_feasible=outs[4], n_filtered=outs[5], n_exhausted=outs[6],
+        dim_exhausted=outs[7], used=used, job_count=job_count)
+
+
+def place_sharded_fn(mesh: Mesh):
+    """Build the jitted sharded placement step for `mesh`.  Node-axis
+    arrays are sharded over the mesh; everything else is replicated; the
+    per-placement outputs are replicated, final usage stays sharded."""
+    spec_n = P(AXIS)
+    in_specs = PlacementInputs(
+        attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n,
+        dc_mask=spec_n, pool_mask=spec_n, luts=P(),
+        con=P(), aff=P(), req=P(), desired=P(), dh_limit=P(),
+        sp_nodeval=P(None, AXIS), sp_weight=P(), sp_expected=P(),
+        sp_counts0=P(),
+        pd_nodeval=P(None, AXIS), pd_limit=P(), pd_apply=P(), pd_counts0=P(),
+        tg_idx=P(), prev_row=P(), active=P(), job_count0=spec_n,
+        spread_algo=P(),
+    )
+    out_specs = PlacementOutputs(
+        picks=P(), scores=P(), topk_rows=P(), topk_scores=P(),
+        n_feasible=P(), n_filtered=P(), n_exhausted=P(), dim_exhausted=P(),
+        used=spec_n, job_count=spec_n,
+    )
+    # check_vma=False: the per-placement outputs are identical on every
+    # shard by construction (derived from all_gather + psum), but the
+    # varying-axes checker cannot infer that through the scan.
+    f = jax.shard_map(_place_local, mesh=mesh,
+                      in_specs=(in_specs,), out_specs=out_specs,
+                      check_vma=False)
+    return jax.jit(f)

@@ -17,6 +17,7 @@ are rebuildable from here at any time (checkpoint/resume, SURVEY.md §6.4).
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from nomad_tpu.structs import (
@@ -191,11 +192,15 @@ class StateStore:
             table = dict(self._evals)
             by_job = dict(self._evals_by_job)
             inserted = []
+            now = _time.time()
             for e in evals:
                 prev = table.get(e.id)
                 e = e.copy()
                 e.create_index = prev.create_index if prev else idx
                 e.modify_index = idx
+                if e.create_time == 0.0:
+                    e.create_time = prev.create_time if prev else now
+                e.modify_time = now
                 table[e.id] = e
                 key = (e.namespace, e.job_id)
                 bucket = dict(by_job.get(key, {}))

@@ -1,15 +1,22 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual XLA devices so the multi-chip sharding path
-(nomad_tpu.parallel) is exercised without TPU hardware — must be set before
-jax is imported anywhere.
+(nomad_tpu.parallel) is exercised without TPU hardware.  The machine's
+sitecustomize imports jax and registers the axon TPU plugin before this
+conftest runs, so plain env vars are too late — force the platform through
+jax.config (no backend is initialized yet at conftest time).  Real-TPU
+behavior is covered by bench.py and the verify flows.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

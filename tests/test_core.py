@@ -314,3 +314,120 @@ class TestReviewRegressions:
         # only one node exists: distinct_hosts allows exactly ONE placement
         # even though preemption could free room for both
         assert len(live) == 1
+
+    def test_heterogeneous_preemption_candidates(self):
+        # Same-priority victims with different resource vectors: eviction
+        # selection must not crash and must pick the best distance match.
+        from nomad_tpu.structs import (PreemptionConfig, Resources,
+                                       SchedulerConfiguration)
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.state.set_scheduler_config(SchedulerConfiguration(
+            preemption_config=PreemptionConfig(service_scheduler_enabled=True)))
+        n = mock.node()
+        s.register_node(n, now=NOW)
+        low = mock.batch_job(priority=10)
+        from nomad_tpu.structs import Task, TaskGroup
+        low.task_groups = [
+            TaskGroup(name="small", count=2,
+                      tasks=[Task(name="t", driver="exec",
+                                  resources=Resources(cpu=400, memory_mb=3000))]),
+            TaskGroup(name="big", count=2,
+                      tasks=[Task(name="t", driver="exec",
+                                  resources=Resources(cpu=1500, memory_mb=500))]),
+        ]
+        s.register_job(low, now=NOW)
+        s.process_all(now=NOW)
+        hi = mock.job(priority=90)
+        hi.task_groups[0].count = 1
+        hi.task_groups[0].tasks[0].resources = Resources(cpu=1400, memory_mb=200)
+        s.register_job(hi, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(hi.namespace, hi.id)
+                if not a.terminal_status()]
+        assert len(live) == 1
+        evicted = [a for a in snap.allocs_by_job(low.namespace, low.id)
+                   if a.desired_status == "evict"]
+        # one 1500MHz victim suffices and matches the shortfall best
+        assert len(evicted) == 1 and evicted[0].task_group == "big"
+
+    def test_worker_survives_scheduler_crash(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.register_node(mock.node(), now=NOW)
+        job = mock.job()
+        s.register_job(job, now=NOW)
+        # sabotage: make the engine raise for this eval
+        orig = s.engine.place
+        calls = {"n": 0}
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("injected engine failure")
+        s.engine.place = boom
+        s.process_all(now=NOW)
+        # worker nacked rather than dying; eval retried to delivery limit
+        assert calls["n"] >= 1
+        assert s.eval_broker.stats["nacked"] >= 1
+        s.engine.place = orig
+
+    def test_duplicate_blocked_eval_cancelled(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        job = mock.job()   # no nodes -> blocks
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        assert s.blocked_evals.num_blocked() == 1
+        # trigger a second failing eval for the same job
+        from nomad_tpu.structs import Evaluation
+        e2 = Evaluation(namespace=job.namespace, job_id=job.id,
+                        type="service", triggered_by="node-update")
+        s.apply_eval_update([e2], now=NOW)
+        s.process_all(now=NOW)
+        assert s.blocked_evals.num_blocked() == 1
+        snap = s.state.snapshot()
+        blocked = [e for e in snap.evals_by_job(job.namespace, job.id)
+                   if e.status == "blocked"]
+        cancelled = [e for e in snap.evals_by_job(job.namespace, job.id)
+                     if e.status == "canceled"]
+        assert len(blocked) == 1
+        assert len(cancelled) >= 1
+
+    def test_threaded_heartbeat_expiry(self):
+        import time as _t
+        s = Server(num_workers=1, dev_mode=False, heartbeat_ttl=0.5)
+        s.start(tick_interval=0.1)
+        try:
+            n1, n2 = mock.node(), mock.node()
+            s.register_node(n1)
+            s.register_node(n2)
+            job = mock.job()
+            job.task_groups[0].count = 1
+            s.register_job(job)
+            deadline = _t.time() + 15
+            victim = None
+            while _t.time() < deadline:
+                allocs = [a for a in
+                          s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                          if not a.terminal_status()]
+                if allocs:
+                    victim = allocs[0].node_id
+                    break
+                _t.sleep(0.05)
+            assert victim is not None
+            other = n2.id if victim == n1.id else n1.id
+            # only the other node keeps heartbeating
+            deadline = _t.time() + 15
+            moved = False
+            while _t.time() < deadline:
+                s.heartbeat_node(other)
+                live = [a for a in
+                        s.state.snapshot().allocs_by_job(job.namespace, job.id)
+                        if not a.terminal_status()]
+                if live and live[0].node_id == other:
+                    moved = True
+                    break
+                _t.sleep(0.1)
+            assert moved, "alloc never moved off the dead node in threaded mode"
+        finally:
+            s.shutdown()
