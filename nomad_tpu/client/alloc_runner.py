@@ -1,0 +1,184 @@
+"""Alloc runner (reference: client/allocrunner/alloc_runner.go).
+
+Per-allocation lifecycle: builds the alloc dir, runs one TaskRunner per
+task (leader-kill semantics: leader death kills the rest), aggregates task
+states into the alloc client status, and watches health for deployments
+(health_hook.go semantics: all tasks running for min_healthy_time ⇒
+healthy).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING,
+    Allocation,
+    TASK_LEADER_DEAD,
+    TASK_SIBLING_FAILED,
+    TASK_STATE_DEAD,
+    TASK_STATE_RUNNING,
+)
+
+from .task_runner import TaskRunner
+
+
+class AllocRunner:
+    def __init__(self, alloc: Allocation, drivers: Dict, node,
+                 alloc_dir: str = "",
+                 on_update: Optional[Callable] = None) -> None:
+        self.alloc = alloc
+        self.node = node
+        self.drivers = drivers
+        self.alloc_dir = alloc_dir
+        self.on_update = on_update
+        self.task_runners: List[TaskRunner] = []
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._destroyed = False
+        self.health: Optional[bool] = None
+        self._build_runners()
+
+    # ------------------------------------------------------------- build
+
+    def _tg(self):
+        return self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+
+    def _build_runners(self) -> None:
+        tg = self._tg()
+        if tg is None:
+            return
+        is_batch = bool(self.alloc.job and
+                        self.alloc.job.type in ("batch", "sysbatch"))
+        for task in tg.tasks:
+            driver = self.drivers.get(task.driver)
+            if driver is None:
+                continue
+            tdir = os.path.join(self.alloc_dir, self.alloc.id, task.name) \
+                if self.alloc_dir else ""
+            self.task_runners.append(TaskRunner(
+                self.alloc, task, driver, self.node, task_dir=tdir,
+                is_batch=is_batch, on_state_change=self._on_task_change))
+
+    # ------------------------------------------------------------ status
+
+    def _on_task_change(self, runner: TaskRunner) -> None:
+        with self._lock:
+            self.alloc.task_states[runner.task.name] = runner.state
+            self._recompute_status()
+        if self.on_update:
+            self.on_update(self)
+
+    def _recompute_status(self) -> None:
+        """reference: alloc_runner.go clientStatus derivation."""
+        states = [tr.state for tr in self.task_runners]
+        if not states:
+            return
+        if any(s.state == TASK_STATE_DEAD and s.failed for s in states):
+            self.alloc.client_status = ALLOC_CLIENT_FAILED
+        elif all(s.state == TASK_STATE_DEAD for s in states):
+            self.alloc.client_status = ALLOC_CLIENT_COMPLETE
+        elif any(s.state == TASK_STATE_RUNNING for s in states):
+            self.alloc.client_status = ALLOC_CLIENT_RUNNING
+        else:
+            self.alloc.client_status = ALLOC_CLIENT_PENDING
+        if self.alloc.client_status in (ALLOC_CLIENT_FAILED,
+                                        ALLOC_CLIENT_COMPLETE):
+            self._done.set()
+
+    def client_update(self):
+        """Consistent copy of (client_status, deployment_status,
+        task_states) for shipping to the server — deep-copied under the
+        runner lock so task threads can keep mutating their TaskStates."""
+        import copy
+        with self._lock:
+            return (self.alloc.client_status,
+                    copy.deepcopy(self.alloc.deployment_status),
+                    copy.deepcopy(self.alloc.task_states))
+
+    # ------------------------------------------------------------- run
+
+    def run(self) -> None:
+        for tr in self.task_runners:
+            tr.start()
+        threading.Thread(target=self._supervise, daemon=True,
+                         name=f"alloc-{self.alloc.id[:8]}").start()
+
+    def _supervise(self) -> None:
+        """Leader-kill + sibling-failure semantics + health watching."""
+        tg = self._tg()
+        min_healthy = 10.0
+        if tg is not None and tg.update is not None:
+            min_healthy = tg.update.min_healthy_time_s
+        healthy_since: Optional[float] = None
+        while not self._done.is_set() and not self._destroyed:
+            time.sleep(0.05)
+            with self._lock:
+                leaders_dead = any(
+                    tr.task.leader and tr.state.state == TASK_STATE_DEAD
+                    for tr in self.task_runners)
+                any_failed = any(
+                    tr.state.state == TASK_STATE_DEAD and tr.state.failed
+                    for tr in self.task_runners)
+                all_running = all(
+                    tr.state.state == TASK_STATE_RUNNING
+                    for tr in self.task_runners) and self.task_runners
+            if leaders_dead or any_failed:
+                reason = TASK_SIBLING_FAILED if any_failed else \
+                    TASK_LEADER_DEAD
+                for tr in self.task_runners:
+                    if tr.state.state != TASK_STATE_DEAD:
+                        tr.kill(wait=False, reason=reason)
+                if leaders_dead and not any_failed:
+                    # leader completing is a normal completion
+                    for tr in self.task_runners:
+                        tr.dead.wait(5)
+            # deployment health
+            if self.alloc.deployment_id and self.health is None:
+                if all_running:
+                    if healthy_since is None:
+                        healthy_since = time.time()
+                    elif time.time() - healthy_since >= min_healthy:
+                        self._set_health(True)
+                elif any_failed:
+                    self._set_health(False)
+                else:
+                    healthy_since = None
+        if self.alloc.deployment_id and self.health is None:
+            # terminal before becoming healthy
+            self._set_health(
+                self.alloc.client_status == ALLOC_CLIENT_COMPLETE)
+
+    def _set_health(self, healthy: bool) -> None:
+        self.health = healthy
+        self.alloc.deployment_status = {"healthy": healthy,
+                                        "ts": time.time()}
+        if self.on_update:
+            self.on_update(self)
+
+    # ----------------------------------------------------------- control
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def update(self, alloc: Allocation) -> None:
+        """Server pushed a new version of this alloc (e.g. desired=stop)."""
+        self.alloc.desired_status = alloc.desired_status
+        self.alloc.desired_description = alloc.desired_description
+        if alloc.desired_status != "run":
+            self.destroy()
+
+    def destroy(self) -> None:
+        self._destroyed = True
+        for tr in self.task_runners:
+            tr.kill(wait=False)
+        with self._lock:
+            self._recompute_status()
+        self._done.set()

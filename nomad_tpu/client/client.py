@@ -1,0 +1,196 @@
+"""Client core (reference: client/client.go).
+
+Registers the node, heartbeats, long-polls its allocations (the blocking
+query `Node.GetClientAllocs` analog), runs alloc runners through the driver
+registry, and batches client status updates back to the server
+(`Node.UpdateAlloc` / allocSync).
+
+The server is reached through an `rpc` object exposing the node/alloc
+endpoint surface; `InProcessRPC` wraps a core.Server directly and
+nomad_tpu.rpc provides the TCP implementation of the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from nomad_tpu.structs import (
+    ALLOC_DESIRED_RUN,
+    Allocation,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+    Node,
+)
+
+from .alloc_runner import AllocRunner
+from .drivers import new_driver_registry
+from .fingerprint import FingerprintManager
+from .state import StateDB
+
+
+class InProcessRPC:
+    """Direct in-process server access (the `-dev` wiring)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def register_node(self, node: Node) -> None:
+        self.server.register_node(node)
+
+    def heartbeat_node(self, node_id: str) -> None:
+        self.server.heartbeat_node(node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.server.update_node_status(node_id, status)
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          timeout: float = 5.0):
+        return self.server.get_client_allocs(node_id, min_index, timeout)
+
+    def update_allocs(self, allocs: List[Allocation]) -> None:
+        self.server.update_allocs_from_client(allocs)
+
+
+class Client:
+    def __init__(self, rpc, node: Optional[Node] = None,
+                 data_dir: str = "", drivers: Optional[Dict] = None,
+                 heartbeat_interval: float = 10.0,
+                 sync_interval: float = 0.2) -> None:
+        self.rpc = rpc
+        self.data_dir = data_dir
+        self.drivers = drivers if drivers is not None \
+            else new_driver_registry()
+        self.node = node or Node()
+        self.heartbeat_interval = heartbeat_interval
+        self.sync_interval = sync_interval
+        self.state_db = StateDB(data_dir)
+        self.alloc_runners: Dict[str, AllocRunner] = {}
+        self._known_index = 0
+        self._dirty_allocs: Dict[str, Allocation] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        fp = FingerprintManager(self.drivers, data_dir)
+        fp.run(self.node)
+        self.node.status = NODE_STATUS_READY
+        from nomad_tpu.structs import compute_class
+        self.node.computed_class = compute_class(self.node)
+
+    # ----------------------------------------------------------- control
+
+    def start(self) -> None:
+        """register + heartbeat + watch_allocations + alloc_sync loops."""
+        self.rpc.register_node(self.node)
+        for name, fn in (("heartbeat", self._heartbeat_loop),
+                         ("watch-allocs", self._watch_loop),
+                         ("alloc-sync", self._sync_loop)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"client-{name}")
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for ar in list(self.alloc_runners.values()):
+            ar.destroy()
+        for t in self._threads:
+            t.join(timeout=2)
+        # task threads can still be in their kill path (kill_timeout_s);
+        # wait them out before closing the state db they write to
+        self.wait_until_idle(timeout=10.0)
+        self.state_db.close()
+
+    # ------------------------------------------------------------- loops
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self.rpc.heartbeat_node(self.node.id)
+            except Exception:
+                pass
+
+    def _watch_loop(self) -> None:
+        """reference: client.watchAllocations — blocking query on the
+        node's alloc set, then reconcile runners."""
+        while not self._stop.is_set():
+            try:
+                allocs, index = self.rpc.get_client_allocs(
+                    self.node.id, self._known_index, timeout=1.0)
+            except Exception:
+                if self._stop.wait(0.5):
+                    return
+                continue
+            if index <= self._known_index:
+                continue
+            self._known_index = index
+            self.run_allocs(allocs)
+
+    def run_allocs(self, allocs: List[Allocation]) -> None:
+        """reference: client.runAllocs — diff against current runners."""
+        seen = set()
+        for alloc in allocs:
+            seen.add(alloc.id)
+            ar = self.alloc_runners.get(alloc.id)
+            if ar is None:
+                if alloc.desired_status != ALLOC_DESIRED_RUN or \
+                        alloc.client_terminal_status():
+                    continue
+                ar = AllocRunner(alloc.copy(), self.drivers, self.node,
+                                 alloc_dir=self.data_dir,
+                                 on_update=self._on_alloc_update)
+                self.alloc_runners[alloc.id] = ar
+                self.state_db.put_allocation(alloc)
+                ar.run()
+            else:
+                ar.update(alloc)
+        # allocs no longer assigned to this node: destroy
+        for alloc_id in list(self.alloc_runners):
+            if alloc_id not in seen:
+                self.alloc_runners[alloc_id].destroy()
+                del self.alloc_runners[alloc_id]
+                self.state_db.delete_allocation(alloc_id)
+
+    def _on_alloc_update(self, ar: AllocRunner) -> None:
+        client_status, dep_status, task_states = ar.client_update()
+        with self._lock:
+            upd = Allocation(
+                id=ar.alloc.id, namespace=ar.alloc.namespace,
+                job_id=ar.alloc.job_id, node_id=self.node.id,
+                task_group=ar.alloc.task_group,
+                client_status=client_status,
+                deployment_status=dep_status,
+                task_states=task_states)
+            upd.modify_time = time.time()
+            self._dirty_allocs[upd.id] = upd
+        self.state_db.put_allocation(ar.alloc)
+
+    def _sync_loop(self) -> None:
+        """reference: client.allocSync — batch client status updates."""
+        while not self._stop.wait(self.sync_interval):
+            self.sync_once()
+        self.sync_once()
+
+    def sync_once(self) -> None:
+        with self._lock:
+            dirty = list(self._dirty_allocs.values())
+            self._dirty_allocs.clear()
+        if dirty:
+            try:
+                self.rpc.update_allocs(dirty)
+            except Exception:
+                with self._lock:
+                    for a in dirty:
+                        self._dirty_allocs.setdefault(a.id, a)
+
+    # ------------------------------------------------------------ helpers
+
+    def wait_until_idle(self, timeout: float = 10.0) -> bool:
+        """Test helper: wait for every runner to reach a terminal state."""
+        deadline = time.time() + timeout
+        for ar in list(self.alloc_runners.values()):
+            if not ar.wait(max(0.0, deadline - time.time())):
+                return False
+        return True

@@ -1,0 +1,93 @@
+"""raw_exec driver (reference: drivers/rawexec) — fork/exec with no
+isolation. Task config: {"command": str, "args": [str, ...]}."""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from .base import Driver, DriverCapabilities, DriverError, TaskHandle, TaskResult
+
+
+class RawExecDriver(Driver):
+    name = "raw_exec"
+
+    def __init__(self):
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def capabilities(self) -> DriverCapabilities:
+        return DriverCapabilities(send_signals=True, exec_=True)
+
+    def _spawn(self, task_id, task, env, task_dir,
+               inherit_env: bool = True) -> subprocess.Popen:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError(f"{self.name}: config.command required")
+        args = [command] + list(cfg.get("args", []))
+        final_env = {**os.environ, **env} if inherit_env else env
+        stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab") \
+            if task_dir else subprocess.DEVNULL
+        stderr = open(os.path.join(task_dir, f"{task.name}.stderr"), "ab") \
+            if task_dir else subprocess.DEVNULL
+        try:
+            return subprocess.Popen(
+                args, env=final_env, cwd=task_dir or None,
+                stdout=stdout, stderr=stderr,
+                start_new_session=True)
+        except OSError as e:
+            raise DriverError(f"{self.name}: {e}") from e
+        finally:
+            for fh in (stdout, stderr):
+                if hasattr(fh, "close"):
+                    fh.close()
+
+    def start_task(self, task_id, task, env, task_dir) -> TaskHandle:
+        proc = self._spawn(task_id, task, env, task_dir)
+        with self._lock:
+            self._procs[task_id] = proc
+        return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid)
+
+    def wait_task(self, handle, timeout=None) -> Optional[TaskResult]:
+        proc = self._procs.get(handle.task_id)
+        if proc is None:
+            return TaskResult(err="unknown task")
+        try:
+            rc = proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        if rc < 0:
+            return TaskResult(exit_code=128 - rc, signal=-rc)
+        return TaskResult(exit_code=rc)
+
+    def stop_task(self, handle, kill_timeout: float = 5.0) -> None:
+        proc = self._procs.get(handle.task_id)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(proc.pid), _signal.SIGTERM)
+            proc.wait(kill_timeout)
+        except (subprocess.TimeoutExpired, ProcessLookupError):
+            try:
+                os.killpg(os.getpgid(proc.pid), _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    def signal_task(self, handle, signal_num: int) -> None:
+        proc = self._procs.get(handle.task_id)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal_num)
+
+    def recover_task(self, handle) -> bool:
+        """Re-adopt a live pid after agent restart (reference: executor
+        reattach). We can signal/poll it but not wait() a non-child; treat
+        liveness via kill(pid, 0)."""
+        try:
+            os.kill(handle.pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return False
+        return True

@@ -1,0 +1,239 @@
+"""Task runner (reference: client/allocrunner/taskrunner/task_runner.go).
+
+Per-task lifecycle state machine: prestart hooks → driver StartTask → wait →
+restart decision loop → dead. Emits TaskEvents into a TaskState that the
+alloc runner aggregates and ships to the server.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.structs import (
+    Task,
+    TaskEvent,
+    TaskState,
+    TASK_DRIVER_FAILURE,
+    TASK_KILLED,
+    TASK_KILLING,
+    TASK_NOT_RESTARTING,
+    TASK_RECEIVED,
+    TASK_RESTARTING,
+    TASK_SETUP,
+    TASK_STARTED,
+    TASK_STATE_DEAD,
+    TASK_STATE_PENDING,
+    TASK_STATE_RUNNING,
+    TASK_TERMINATED,
+)
+
+from .drivers.base import Driver, DriverError, TaskHandle
+from .restarts import KILL, RESTART, RestartTracker, WAIT
+from .taskenv import build_task_env
+
+
+class TaskHook:
+    """reference: taskrunner hooks (artifact, template, logmon, …)."""
+    name = "hook"
+
+    def prestart(self, runner: "TaskRunner") -> None:  # may raise
+        pass
+
+    def poststart(self, runner: "TaskRunner") -> None:
+        pass
+
+    def stop(self, runner: "TaskRunner") -> None:
+        pass
+
+
+class ArtifactHook(TaskHook):
+    """reference: taskrunner/artifact_hook.go — fetches task.artifacts.
+    Only file:// sources are supported offline; anything else errors the
+    same way a failed download would."""
+    name = "artifact"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        import shutil
+        for art in runner.task.artifacts:
+            src = art.get("source", "") if isinstance(art, dict) else art
+            if src.startswith("file://"):
+                path = src[len("file://"):]
+                dest = os.path.join(runner.task_dir,
+                                    os.path.basename(path))
+                shutil.copyfile(path, dest)
+            elif src:
+                raise DriverError(f"artifact fetch unsupported: {src}")
+
+
+class TemplateHook(TaskHook):
+    """reference: taskrunner/template_hook.go — renders task.templates
+    with ${...} interpolation against the task env."""
+    name = "template"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        from .taskenv import interpolate
+        for tpl in runner.task.templates:
+            data = tpl.get("data", "")
+            dest = tpl.get("destination", "")
+            if not dest:
+                continue
+            path = os.path.join(runner.task_dir, dest)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                f.write(interpolate(data, runner.env, runner.node))
+
+
+class DispatchPayloadHook(TaskHook):
+    """reference: taskrunner/dispatch_hook.go — writes the dispatch
+    payload of parameterized jobs into the task dir."""
+    name = "dispatch_payload"
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        job = runner.alloc.job
+        payload = getattr(job, "payload", None) if job else None
+        dest_file = getattr(runner.task, "dispatch_payload_file", "")
+        if payload and dest_file:
+            path = os.path.join(runner.task_dir, dest_file)
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            mode = "wb" if isinstance(payload, bytes) else "w"
+            with open(path, mode) as f:
+                f.write(payload)
+
+
+DEFAULT_HOOKS = (ArtifactHook, TemplateHook, DispatchPayloadHook)
+
+
+class TaskRunner:
+    def __init__(self, alloc, task: Task, driver: Driver, node,
+                 task_dir: str = "", is_batch: bool = False,
+                 on_state_change: Optional[Callable] = None,
+                 update_interval: float = 0.0) -> None:
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.node = node
+        self.task_dir = task_dir
+        self.state = TaskState()
+        self.restart_tracker = RestartTracker(
+            self._policy(), is_batch=is_batch)
+        self.on_state_change = on_state_change
+        self.handle: Optional[TaskHandle] = None
+        self.env: Dict[str, str] = {}
+        self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
+        self._kill = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.dead = threading.Event()
+
+    def _policy(self):
+        from nomad_tpu.structs import RestartPolicy
+        tg = None
+        if self.alloc.job is not None:
+            tg = self.alloc.job.lookup_task_group(self.alloc.task_group)
+        if tg is not None and tg.restart_policy is not None:
+            return tg.restart_policy
+        return RestartPolicy()
+
+    # ------------------------------------------------------------- events
+
+    def _event(self, type_: str, **kw) -> None:
+        self.state.events.append(TaskEvent(type=type_, time=time.time(), **kw))
+        if self.on_state_change:
+            self.on_state_change(self)
+
+    def _set_state(self, state: str, failed: Optional[bool] = None) -> None:
+        self.state.state = state
+        if failed is not None:
+            self.state.failed = failed
+        if state == TASK_STATE_RUNNING and self.state.started_at == 0:
+            self.state.started_at = time.time()
+        if state == TASK_STATE_DEAD:
+            self.state.finished_at = time.time()
+            self.dead.set()
+        if self.on_state_change:
+            self.on_state_change(self)
+
+    # -------------------------------------------------------------- run
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"task-{self.task.name}")
+        self._thread.start()
+
+    def run(self) -> None:
+        self._event(TASK_RECEIVED)
+        try:
+            if self.task_dir:
+                os.makedirs(self.task_dir, exist_ok=True)
+            self.env = build_task_env(self.alloc, self.task, self.node,
+                                      self.task_dir)
+            self._event(TASK_SETUP)
+            for hook in self.hooks:
+                hook.prestart(self)
+        except Exception as e:
+            self._event(TASK_DRIVER_FAILURE, message=str(e))
+            self._set_state(TASK_STATE_DEAD, failed=True)
+            return
+
+        while not self._kill.is_set():
+            try:
+                task_id = f"{self.alloc.id[:8]}-{self.task.name}"
+                self.handle = self.driver.start_task(
+                    task_id, self.task, self.env, self.task_dir)
+            except DriverError as e:
+                self._event(TASK_DRIVER_FAILURE, message=str(e))
+                decision, delay = self.restart_tracker.next(-1, True)
+                if decision == KILL or self._kill.wait(delay):
+                    self._set_state(TASK_STATE_DEAD, failed=True)
+                    return
+                self._event(TASK_RESTARTING, restart_reason=str(e))
+                continue
+
+            self._event(TASK_STARTED)
+            self._set_state(TASK_STATE_RUNNING)
+            for hook in self.hooks:
+                hook.poststart(self)
+
+            result = None
+            while result is None and not self._kill.is_set():
+                result = self.driver.wait_task(self.handle, timeout=0.25)
+            if self._kill.is_set():
+                break
+            failed = not result.successful()
+            self._event(TASK_TERMINATED, exit_code=result.exit_code,
+                        signal=result.signal, message=result.err or "")
+            decision, delay = self.restart_tracker.next(result.exit_code,
+                                                        failed)
+            if decision == KILL:
+                self._set_state(TASK_STATE_DEAD, failed=failed)
+                if failed:
+                    self._event(TASK_NOT_RESTARTING,
+                                message="Exceeded allowed attempts")
+                return
+            self.state.restarts += 1
+            self.state.last_restart = time.time()
+            self._event(TASK_RESTARTING,
+                        restart_reason="Restart within policy")
+            if decision in (RESTART, WAIT) and self._kill.wait(delay):
+                break
+
+        # killed
+        if self.handle is not None:
+            self._event(TASK_KILLING)
+            self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+            self._event(TASK_KILLED)
+        for hook in self.hooks:
+            hook.stop(self)
+        self._set_state(TASK_STATE_DEAD)
+
+    def kill(self, wait: bool = True, timeout: float = 10.0,
+             reason: str = "") -> None:
+        if reason and not self._kill.is_set():
+            self._event(reason)
+        self._kill.set()
+        if self.handle is not None:
+            self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+        if wait and self._thread is not None:
+            self._thread.join(timeout)

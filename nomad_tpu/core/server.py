@@ -27,6 +27,8 @@ from nomad_tpu.structs import (
     Evaluation,
     Job,
     Node,
+    TRIGGER_ALLOC_FAILURE,
+    TRIGGER_ALLOC_STOP,
     TRIGGER_JOB_DEREGISTER,
     TRIGGER_JOB_REGISTER,
     TRIGGER_NODE_UPDATE,
@@ -176,6 +178,50 @@ class Server:
             evals = build_node_evals(self.state.snapshot(), node_id)
         self.apply_eval_update(evals, now=t)
         return evals
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          timeout: float = 5.0):
+        """reference: Node.GetClientAllocs — blocking query: waits until
+        the state index advances past min_index, then returns the node's
+        allocations (with job attached) and the current index."""
+        self.state.wait_for_index(min_index + 1, timeout=timeout)
+        snap = self.state.snapshot()
+        allocs = snap.allocs_by_node(node_id)
+        return allocs, snap.index
+
+    def update_allocs_from_client(self, updates,
+                                  now: Optional[float] = None) -> None:
+        """reference: Node.UpdateAlloc — merge client statuses, then create
+        evals for terminal allocs so the scheduler reacts (reschedule on
+        failure, next periodic/batch bookkeeping on completion)."""
+        t = now if now is not None else time.time()
+        updates = list(updates)
+        self.state.update_allocs_from_client(updates)
+        evals: List[Evaluation] = []
+        seen = set()
+        for u in updates:
+            if not u.client_terminal_status():
+                continue
+            stored = self.state.alloc_by_id(u.id)
+            if stored is None:
+                continue
+            job = self.state.job_by_id(stored.namespace, stored.job_id)
+            if job is None or job.stopped():
+                continue
+            failed = u.client_status == "failed"
+            key = (stored.namespace, stored.job_id, failed)
+            if key in seen:
+                continue
+            seen.add(key)
+            evals.append(Evaluation(
+                namespace=stored.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=(TRIGGER_ALLOC_FAILURE if failed
+                              else TRIGGER_ALLOC_STOP),
+                job_id=stored.job_id,
+            ))
+        self.apply_eval_update(evals, now=t)
 
     # ------------------------------------------------------ eval plumbing
 

@@ -283,6 +283,10 @@ class StateStore:
                 a.client_status = u.client_status
                 a.client_description = u.client_description
                 a.deployment_status = u.deployment_status
+                # deep copy: the caller (in-process client) keeps mutating
+                # its TaskState objects; committed state must not alias them
+                import copy as _copy
+                a.task_states = _copy.deepcopy(u.task_states)
                 a.modify_time = u.modify_time
                 merged.append(a)
             self._insert_allocs(merged, idx)

@@ -387,6 +387,7 @@ class Task:
     templates: List[Dict[str, Any]] = field(default_factory=list)
     vault: Optional[Dict[str, Any]] = None
     lifecycle: Optional[Dict[str, Any]] = None
+    dispatch_payload_file: str = ""
 
 
 @dataclass
@@ -568,6 +569,51 @@ class NetworkAllocation:
     ports: Dict[str, int] = field(default_factory=dict)   # label -> host port
 
 
+TASK_STATE_PENDING = "pending"
+TASK_STATE_RUNNING = "running"
+TASK_STATE_DEAD = "dead"
+
+# Task event types (reference: structs.go TaskEvent consts).
+TASK_RECEIVED = "Received"
+TASK_SETUP = "Task Setup"
+TASK_STARTED = "Started"
+TASK_TERMINATED = "Terminated"
+TASK_RESTARTING = "Restarting"
+TASK_NOT_RESTARTING = "Not Restarting"
+TASK_KILLING = "Killing"
+TASK_KILLED = "Killed"
+TASK_DRIVER_FAILURE = "Driver Failure"
+TASK_FAILED_ARTIFACT = "Failed Artifact Download"
+TASK_SIBLING_FAILED = "Sibling Task Failed"
+TASK_LEADER_DEAD = "Leader Task Dead"
+
+
+@dataclass
+class TaskEvent:
+    """reference: structs.TaskEvent"""
+    type: str = ""
+    time: float = 0.0
+    message: str = ""
+    exit_code: Optional[int] = None
+    signal: Optional[int] = None
+    restart_reason: str = ""
+
+
+@dataclass
+class TaskState:
+    """reference: structs.TaskState"""
+    state: str = TASK_STATE_PENDING
+    failed: bool = False
+    restarts: int = 0
+    last_restart: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    events: List[TaskEvent] = field(default_factory=list)
+
+    def successful(self) -> bool:
+        return self.state == TASK_STATE_DEAD and not self.failed
+
+
 @dataclass
 class Allocation:
     id: str = field(default_factory=new_id)
@@ -586,6 +632,7 @@ class Allocation:
     desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
     client_status: str = ALLOC_CLIENT_PENDING
     client_description: str = ""
+    task_states: Dict[str, TaskState] = field(default_factory=dict)
     previous_allocation: str = ""
     next_allocation: str = ""
     deployment_id: str = ""

@@ -14,6 +14,9 @@ from __future__ import annotations
 import re
 from typing import List, Optional, Tuple
 
+# Framework version (reference: version/version.go).
+VERSION = "0.1.0"
+
 _VER_RE = re.compile(
     r"^v?(\d+(?:\.\d+)*)(?:-([0-9A-Za-z.-]+))?(?:\+[0-9A-Za-z.-]+)?$")
 

@@ -1,0 +1,250 @@
+"""Client layer tests (reference: client/*_test.go patterns — in-process
+client + server, mock driver lifecycles, no containers)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, InProcessRPC, new_driver_registry
+from nomad_tpu.client.drivers import MockDriver, RawExecDriver
+from nomad_tpu.client.restarts import KILL, RESTART, RestartTracker
+from nomad_tpu.client.state import StateDB
+from nomad_tpu.client.task_runner import TaskRunner
+from nomad_tpu.client.taskenv import build_task_env, interpolate
+from nomad_tpu.core import Server
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_RUNNING,
+    Allocation,
+    RestartPolicy,
+    Task,
+    TASK_STATE_DEAD,
+)
+
+
+def make_alloc(job, node, tg_name=None):
+    tg = job.task_groups[0]
+    a = mock.alloc(job=job, node_id=node.id,
+                   task_group=tg_name or tg.name)
+    a.job = job
+    return a
+
+
+# ---------------------------------------------------------------- drivers
+
+def test_mock_driver_lifecycle():
+    d = MockDriver()
+    task = Task(name="t", driver="mock", config={"run_for_s": 0.05})
+    h = d.start_task("t1", task, {}, "")
+    res = d.wait_task(h, timeout=2)
+    assert res is not None and res.successful()
+
+
+def test_mock_driver_failure_and_kill():
+    d = MockDriver()
+    task = Task(name="t", driver="mock",
+                config={"run_for_s": 0.05, "exit_code": 3})
+    h = d.start_task("t1", task, {}, "")
+    res = d.wait_task(h, timeout=2)
+    assert res.exit_code == 3
+    task2 = Task(name="t2", driver="mock", config={"run_for_s": 30})
+    h2 = d.start_task("t2", task2, {}, "")
+    d.stop_task(h2)
+    res2 = d.wait_task(h2, timeout=2)
+    assert res2.exit_code == 137
+
+
+def test_raw_exec_driver(tmp_path):
+    d = RawExecDriver()
+    task = Task(name="echo", driver="raw_exec",
+                config={"command": "sh", "args": ["-c", "echo hi; exit 0"]})
+    h = d.start_task("t1", task, {}, str(tmp_path))
+    res = d.wait_task(h, timeout=5)
+    assert res.successful()
+    out = (tmp_path / "echo.stdout").read_bytes()
+    assert b"hi" in out
+
+
+def test_raw_exec_nonzero_exit(tmp_path):
+    d = RawExecDriver()
+    task = Task(name="f", driver="raw_exec",
+                config={"command": "sh", "args": ["-c", "exit 7"]})
+    h = d.start_task("t1", task, {}, str(tmp_path))
+    res = d.wait_task(h, timeout=5)
+    assert res.exit_code == 7 and not res.successful()
+
+
+# ---------------------------------------------------------------- restarts
+
+def test_restart_tracker_batch_success_no_restart():
+    rt = RestartTracker(RestartPolicy(attempts=3), is_batch=True)
+    decision, _ = rt.next(0, False, now=100.0)
+    assert decision == KILL
+
+
+def test_restart_tracker_fail_mode_exhaustion():
+    rt = RestartTracker(RestartPolicy(attempts=2, interval_s=300,
+                                      delay_s=0.01, mode="fail"))
+    assert rt.next(1, True, now=10.0)[0] == RESTART
+    assert rt.next(1, True, now=11.0)[0] == RESTART
+    assert rt.next(1, True, now=12.0)[0] == KILL
+
+
+def test_restart_tracker_interval_reset():
+    rt = RestartTracker(RestartPolicy(attempts=1, interval_s=10,
+                                      delay_s=0.01, mode="fail"))
+    assert rt.next(1, True, now=0.0)[0] == RESTART
+    # new interval after 10s: counter resets
+    assert rt.next(1, True, now=20.0)[0] == RESTART
+
+
+# ---------------------------------------------------------------- task env
+
+def test_task_env_and_interpolation():
+    job = mock.job()
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    task = job.task_groups[0].tasks[0]
+    task.env = {"DC": "${node.datacenter}", "K": "${attr.kernel.name}"}
+    env = build_task_env(alloc, task, node)
+    assert env["NOMAD_ALLOC_ID"] == alloc.id
+    assert env["DC"] == "dc1"
+    assert env["K"] == "linux"
+    assert interpolate("${meta.missing}", {}, node) == ""
+
+
+# -------------------------------------------------------------- task runner
+
+def test_task_runner_batch_completes():
+    job = mock.batch_job()
+    job.task_groups[0].tasks[0].config = {"run_for_s": 0.05}
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    tr = TaskRunner(alloc, job.task_groups[0].tasks[0], MockDriver(), node,
+                    is_batch=True)
+    tr.run()
+    assert tr.state.state == TASK_STATE_DEAD
+    assert not tr.state.failed
+    types = [e.type for e in tr.state.events]
+    assert "Started" in types and "Terminated" in types
+
+
+def test_task_runner_restarts_then_fails():
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.restart_policy = RestartPolicy(attempts=1, interval_s=300,
+                                      delay_s=0.01, mode="fail")
+    tg.tasks[0].config = {"run_for_s": 0.02, "exit_code": 1}
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    tr = TaskRunner(alloc, tg.tasks[0], MockDriver(), node, is_batch=True)
+    tr.run()
+    assert tr.state.state == TASK_STATE_DEAD
+    assert tr.state.failed
+    assert tr.state.restarts == 1
+
+
+# ------------------------------------------------------------ client state
+
+def test_state_db_roundtrip(tmp_path):
+    db = StateDB(str(tmp_path))
+    job = mock.batch_job()
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    db.put_allocation(alloc)
+    from nomad_tpu.client.drivers.base import TaskHandle
+    db.put_task_handle(alloc.id, "worker",
+                       TaskHandle(task_id="x", driver="mock", pid=42))
+    db.close()
+    db2 = StateDB(str(tmp_path))
+    assert db2.get_allocations()[0]["id"] == alloc.id
+    assert db2.get_task_handles(alloc.id)["worker"].pid == 42
+    db2.close()
+
+
+# ------------------------------------------------- end-to-end with server
+
+@pytest.fixture
+def dev_cluster():
+    server = Server(dev_mode=True)
+    server.establish_leadership()
+    client = Client(InProcessRPC(server), heartbeat_interval=0.2,
+                    sync_interval=0.05)
+    yield server, client
+    client.shutdown()
+
+
+def test_client_runs_batch_job_to_completion(dev_cluster):
+    server, client = dev_cluster
+    client.rpc.register_node(client.node)
+
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].driver = "mock"
+    job.task_groups[0].tasks[0].config = {"run_for_s": 0.05}
+    server.register_job(job)
+    assert server.process_all() >= 1
+
+    allocs, idx = server.get_client_allocs(client.node.id, 0, timeout=1.0)
+    assert len(allocs) == 2
+    client.run_allocs(allocs)
+    assert client.wait_until_idle(timeout=5)
+    client.sync_once()
+
+    stored = server.state.allocs_by_job(job.namespace, job.id)
+    assert all(a.client_status == ALLOC_CLIENT_COMPLETE for a in stored)
+    assert all(a.task_states["worker"].state == TASK_STATE_DEAD
+               for a in stored)
+
+
+def test_failed_alloc_triggers_reschedule_eval(dev_cluster):
+    server, client = dev_cluster
+    client.rpc.register_node(client.node)
+
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.restart_policy = RestartPolicy(attempts=0, mode="fail")
+    tg.tasks[0].config = {"run_for_s": 0.02, "exit_code": 1}
+    server.register_job(job)
+    server.process_all()
+
+    allocs, _ = server.get_client_allocs(client.node.id, 0, timeout=1.0)
+    assert len(allocs) == 1
+    client.run_allocs(allocs)
+    assert client.wait_until_idle(timeout=5)
+    client.sync_once()
+
+    stored = server.state.alloc_by_id(allocs[0].id)
+    assert stored.client_status == ALLOC_CLIENT_FAILED
+    evs = [e for e in server.state.snapshot().evals()
+           if e.triggered_by == "alloc-failure"]
+    assert evs, "terminal failed alloc must create an eval"
+
+
+def test_client_threaded_end_to_end():
+    server = Server(dev_mode=False, num_workers=1)
+    server.start(tick_interval=0.1)
+    client = Client(InProcessRPC(server), heartbeat_interval=0.2,
+                    sync_interval=0.05)
+    try:
+        client.start()
+        job = mock.batch_job()
+        job.task_groups[0].tasks[0].config = {"run_for_s": 0.05}
+        server.register_job(job)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            stored = server.state.allocs_by_job(job.namespace, job.id)
+            if stored and all(a.client_status == ALLOC_CLIENT_COMPLETE
+                              for a in stored):
+                break
+            time.sleep(0.1)
+        stored = server.state.allocs_by_job(job.namespace, job.id)
+        assert stored
+        assert all(a.client_status == ALLOC_CLIENT_COMPLETE
+                   for a in stored)
+    finally:
+        client.shutdown()
+        server.shutdown()
