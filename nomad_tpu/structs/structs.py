@@ -171,7 +171,9 @@ class Resources:
                              reserved_ports=[replace(p) for p in n.reserved_ports],
                              dynamic_ports=[replace(p) for p in n.dynamic_ports])
                       for n in self.networks],
-            devices=[replace(d) for d in self.devices],
+            devices=[replace(d, constraints=list(d.constraints),
+                             affinities=list(d.affinities))
+                     for d in self.devices],
         )
 
 
@@ -682,17 +684,41 @@ class Allocation:
         return self.client_status == ALLOC_CLIENT_COMPLETE
 
     def copy(self) -> "Allocation":
-        import copy as _copy
-        return _copy.deepcopy(self)
+        out = self.copy_skip_job()
+        if self.job is not None:
+            out.job = self.job.copy()
+        return out
 
     def copy_skip_job(self) -> "Allocation":
+        """Structured copy sharing the embedded job pointer (reference:
+        Allocation.CopySkipJob).  Hand-rolled rather than deepcopy: alloc
+        inserts are the state store's hot path and deepcopy dominates plan
+        apply at bench scale.  NodeScoreMeta/TaskEvent/RescheduleEvent
+        entries are treated as immutable records and shared."""
         import copy as _copy
-        job, self.job = self.job, None
-        try:
-            out = _copy.deepcopy(self)
-        finally:
-            self.job = job
-        out.job = job
+        out = _copy.copy(self)
+        out.resources = self.resources.copy()
+        out.allocated_ports = dict(self.allocated_ports)
+        out.desired_transition = _copy.copy(self.desired_transition)
+        out.task_states = {
+            k: _copy.copy(v) for k, v in self.task_states.items()}
+        for ts in out.task_states.values():
+            ts.events = list(ts.events)
+        if self.deployment_status is not None:
+            out.deployment_status = dict(self.deployment_status)
+        if self.reschedule_tracker is not None:
+            out.reschedule_tracker = RescheduleTracker(
+                events=list(self.reschedule_tracker.events))
+        out.preempted_allocations = list(self.preempted_allocations)
+        m = self.metrics
+        out.metrics = _copy.copy(m)
+        out.metrics.nodes_available = dict(m.nodes_available)
+        out.metrics.class_filtered = dict(m.class_filtered)
+        out.metrics.constraint_filtered = dict(m.constraint_filtered)
+        out.metrics.class_exhausted = dict(m.class_exhausted)
+        out.metrics.dimension_exhausted = dict(m.dimension_exhausted)
+        out.metrics.quota_exhausted = list(m.quota_exhausted)
+        out.metrics.score_meta_data = list(m.score_meta_data)
         return out
 
 
