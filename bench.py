@@ -282,8 +282,12 @@ def run_config_4(args):
     # Each run mutates cluster state (placements + evictions commit), so
     # rate is taken per-run from that run's own (dt, placed); best run wins.
     runs = [one() for _ in range(args.iters + 1)]
-    dt, placed, n_preempt = max(
-        (r for r in runs if r[1] > 0), key=lambda r: r[1] / r[0])
+    productive = [r for r in runs if r[1] > 0]
+    if not productive:
+        return {"metric": "config4_preemption_placements_per_sec",
+                "value": 0.0, "unit": "placements/sec",
+                "preemptions": 0, "error": "no run placed anything"}
+    dt, placed, n_preempt = max(productive, key=lambda r: r[1] / r[0])
     return {"metric": "config4_preemption_placements_per_sec",
             "value": round(placed / dt, 1), "unit": "placements/sec",
             "preemptions": n_preempt, "eval_latency_s": round(dt, 3)}

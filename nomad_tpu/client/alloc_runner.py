@@ -60,6 +60,17 @@ class AllocRunner:
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
+                # fail the alloc up front (reference: driver not found is a
+                # terminal setup error, not a silent skip)
+                from nomad_tpu.structs import (
+                    TASK_DRIVER_FAILURE, TaskEvent, TaskState)
+                self.alloc.task_states[task.name] = TaskState(
+                    state=TASK_STATE_DEAD, failed=True,
+                    events=[TaskEvent(
+                        type=TASK_DRIVER_FAILURE, time=time.time(),
+                        message=f"driver {task.driver!r} not found")])
+                self.alloc.client_status = ALLOC_CLIENT_FAILED
+                self._done.set()
                 continue
             tdir = os.path.join(self.alloc_dir, self.alloc.id, task.name) \
                 if self.alloc_dir else ""
@@ -106,6 +117,12 @@ class AllocRunner:
     # ------------------------------------------------------------- run
 
     def run(self) -> None:
+        if self._done.is_set():
+            # failed during build (e.g. missing driver): ship the terminal
+            # status instead of starting anything
+            if self.on_update:
+                self.on_update(self)
+            return
         for tr in self.task_runners:
             tr.start()
         threading.Thread(target=self._supervise, daemon=True,

@@ -19,7 +19,6 @@ from typing import Dict, List, Optional
 from nomad_tpu.structs import (
     ALLOC_DESIRED_RUN,
     Allocation,
-    NODE_STATUS_DOWN,
     NODE_STATUS_READY,
     Node,
 )
@@ -141,21 +140,31 @@ class Client:
                 ar = AllocRunner(alloc.copy(), self.drivers, self.node,
                                  alloc_dir=self.data_dir,
                                  on_update=self._on_alloc_update)
-                self.alloc_runners[alloc.id] = ar
-                self.state_db.put_allocation(alloc)
+                with self._lock:
+                    self.alloc_runners[alloc.id] = ar
+                    self.state_db.put_allocation(alloc)
                 ar.run()
             else:
                 ar.update(alloc)
-        # allocs no longer assigned to this node: destroy
+        # allocs no longer assigned to this node: destroy.  Removal and
+        # row deletion happen under the lock shared with _on_alloc_update
+        # so a late task-thread update cannot resurrect the row.
         for alloc_id in list(self.alloc_runners):
             if alloc_id not in seen:
-                self.alloc_runners[alloc_id].destroy()
-                del self.alloc_runners[alloc_id]
-                self.state_db.delete_allocation(alloc_id)
+                ar = self.alloc_runners[alloc_id]
+                with self._lock:
+                    del self.alloc_runners[alloc_id]
+                    self.state_db.delete_allocation(alloc_id)
+                ar.destroy()
 
     def _on_alloc_update(self, ar: AllocRunner) -> None:
         client_status, dep_status, task_states = ar.client_update()
         with self._lock:
+            if ar.alloc.id not in self.alloc_runners:
+                # server already dropped this alloc and run_allocs removed
+                # it; a late task-thread update must not resurrect the
+                # state-db row or re-dirty an untracked alloc
+                return
             upd = Allocation(
                 id=ar.alloc.id, namespace=ar.alloc.namespace,
                 job_id=ar.alloc.job_id, node_id=self.node.id,
@@ -165,7 +174,10 @@ class Client:
                 task_states=task_states)
             upd.modify_time = time.time()
             self._dirty_allocs[upd.id] = upd
-        self.state_db.put_allocation(ar.alloc)
+            # inside the critical section: run_allocs removes runners and
+            # deletes their rows under the same lock, so put cannot race a
+            # concurrent removal and resurrect the row
+            self.state_db.put_allocation(ar.alloc)
 
     def _sync_loop(self) -> None:
         """reference: client.allocSync — batch client status updates."""

@@ -69,11 +69,15 @@ class StateDB:
 
     def get_allocations(self) -> List[Dict]:
         with self._lock:
+            if self._closed:
+                return []
             rows = self._db.execute("SELECT body FROM allocs").fetchall()
         return [json.loads(r[0]) for r in rows]
 
     def get_task_handles(self, alloc_id: str) -> Dict[str, TaskHandle]:
         with self._lock:
+            if self._closed:
+                return {}
             rows = self._db.execute(
                 "SELECT task, body FROM task_handles WHERE alloc_id=?",
                 (alloc_id,)).fetchall()

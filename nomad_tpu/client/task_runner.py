@@ -177,6 +177,28 @@ class TaskRunner:
             self._set_state(TASK_STATE_DEAD, failed=True)
             return
 
+        try:
+            self._run_loop()
+        except Exception as e:
+            # anything a driver leaks past DriverError (bad config types,
+            # fs errors opening log files, …) must still land the task in
+            # a terminal state or the alloc hangs non-terminal forever —
+            # and a live workload must not be orphaned on the way down
+            if self.handle is not None:
+                try:
+                    self.driver.stop_task(self.handle,
+                                          self.task.kill_timeout_s)
+                except Exception:
+                    pass
+            for hook in self.hooks:
+                try:
+                    hook.stop(self)
+                except Exception:
+                    pass
+            self._event(TASK_DRIVER_FAILURE, message=str(e))
+            self._set_state(TASK_STATE_DEAD, failed=True)
+
+    def _run_loop(self) -> None:
         while not self._kill.is_set():
             try:
                 task_id = f"{self.alloc.id[:8]}-{self.task.name}"
@@ -214,6 +236,10 @@ class TaskRunner:
                 return
             self.state.restarts += 1
             self.state.last_restart = time.time()
+            # drop out of `running` between exit and restart so deployment
+            # health watchers see crash loops (reference: health_hook.go
+            # resets the healthy timer on task state changes)
+            self._set_state(TASK_STATE_PENDING)
             self._event(TASK_RESTARTING,
                         restart_reason="Restart within policy")
             if decision in (RESTART, WAIT) and self._kill.wait(delay):

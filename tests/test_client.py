@@ -248,3 +248,89 @@ def test_client_threaded_end_to_end():
     finally:
         client.shutdown()
         server.shutdown()
+
+
+# --------------------------------------------- failure-path regressions
+
+def test_missing_driver_fails_alloc():
+    """An alloc whose task driver is absent must fail, not hang pending."""
+    from nomad_tpu.client.alloc_runner import AllocRunner
+    job = mock.batch_job()
+    job.task_groups[0].tasks[0].driver = "docker"   # not in registry
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    updates = []
+    ar = AllocRunner(alloc, {}, node, on_update=updates.append)
+    ar.run()
+    assert ar.wait(1.0)
+    assert alloc.client_status == ALLOC_CLIENT_FAILED
+    ts = alloc.task_states[job.task_groups[0].tasks[0].name]
+    assert ts.state == TASK_STATE_DEAD and ts.failed
+    assert "driver" in ts.events[0].message
+    assert updates, "terminal status must be shipped to the client"
+
+
+def test_driver_leaking_exception_fails_task():
+    """Non-DriverError exceptions from start_task must still land the
+    task in a terminal failed state."""
+    class ExplodingDriver(MockDriver):
+        def start_task(self, *a, **kw):
+            raise ValueError("bad config")
+
+    job = mock.batch_job()
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    tr = TaskRunner(alloc, job.task_groups[0].tasks[0], ExplodingDriver(),
+                    node, is_batch=True)
+    tr.run()
+    assert tr.state.state == TASK_STATE_DEAD
+    assert tr.state.failed
+    assert any("bad config" in (e.message or "") for e in tr.state.events)
+
+
+def test_restart_drops_running_state():
+    """Between exit and restart the task leaves `running` so health
+    watchers can see crash loops."""
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.restart_policy = RestartPolicy(attempts=2, interval_s=300,
+                                      delay_s=0.05, mode="fail")
+    tg.tasks[0].config = {"run_for_s": 0.02, "exit_code": 1}
+    node = mock.node()
+    alloc = make_alloc(job, node)
+    seen = set()
+    tr = TaskRunner(alloc, tg.tasks[0], MockDriver(), node, is_batch=True,
+                    on_state_change=lambda r: seen.add(r.state.state))
+    tr.run()
+    assert "pending" in seen     # dropped out of running during restart
+    assert tr.state.state == TASK_STATE_DEAD
+
+
+def test_removed_alloc_not_resurrected_in_state_db():
+    """A server-dropped alloc must not be re-put into the state DB by a
+    late task-thread update."""
+    server = Server(num_workers=0)
+    server.start()
+    try:
+        client = Client(InProcessRPC(server), node=mock.node(),
+                        sync_interval=0.05)
+        job = mock.batch_job()
+        job.task_groups[0].tasks[0].config = {"run_for_s": 10}
+        alloc = make_alloc(job, client.node)
+        client.run_allocs([alloc])
+        deadline = time.time() + 2
+        while time.time() < deadline and \
+                client.alloc_runners[alloc.id].alloc.client_status \
+                != ALLOC_CLIENT_RUNNING:
+            time.sleep(0.01)
+        # server drops the alloc from the node's set
+        client.run_allocs([])
+        assert alloc.id not in client.alloc_runners
+        # let the killed task threads fire their late updates
+        time.sleep(0.3)
+        ids = [a["id"] for a in client.state_db.get_allocations()]
+        assert alloc.id not in ids
+        client.shutdown()
+        assert client.state_db.get_allocations() == []   # closed: empty
+    finally:
+        server.shutdown()
