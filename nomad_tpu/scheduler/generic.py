@@ -211,13 +211,19 @@ class GenericScheduler(Scheduler):
         net_idx: Dict[str, NetworkIndex] = {}
         victim_ids = {v.id for d in decisions for v in d.evictions}
 
+        # one combined-resources template per task group; copied per alloc
+        ask_templates: Dict[str, object] = {}
+
         for p, d in zip(places, decisions):
             tg = p.tg
             if d.node_id is None:
                 self._record_failure(tg.name, d.metric)
                 continue
             ports = None
-            ask = tg.combined_resources()
+            ask = ask_templates.get(tg.name)
+            if ask is None:
+                ask_templates[tg.name] = ask = tg.combined_resources()
+            ask = ask.copy()
             if ask.networks:
                 ni = net_idx.get(d.node_id)
                 if ni is None:

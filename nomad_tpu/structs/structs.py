@@ -16,6 +16,7 @@ Design departures from the reference (deliberate, TPU-first):
 
 from __future__ import annotations
 
+import os
 import uuid
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Tuple
@@ -110,7 +111,10 @@ MAX_DYNAMIC_PORT = 32000
 
 
 def new_id() -> str:
-    return str(uuid.uuid4())
+    """UUIDv4-shaped random id; os.urandom + slicing is ~3x faster than
+    uuid.uuid4() and ids are minted per alloc on the placement hot path."""
+    h = os.urandom(16).hex()
+    return f"{h[:8]}-{h[8:12]}-4{h[13:16]}-{h[16:20]}-{h[20:]}"
 
 
 # ---------------------------------------------------------------------------
@@ -695,30 +699,41 @@ class Allocation:
         inserts are the state store's hot path and deepcopy dominates plan
         apply at bench scale.  NodeScoreMeta/TaskEvent/RescheduleEvent
         entries are treated as immutable records and shared."""
-        import copy as _copy
-        out = _copy.copy(self)
-        out.resources = self.resources.copy()
-        out.allocated_ports = dict(self.allocated_ports)
-        out.desired_transition = _copy.copy(self.desired_transition)
-        out.task_states = {
-            k: _copy.copy(v) for k, v in self.task_states.items()}
-        for ts in out.task_states.values():
-            ts.events = list(ts.events)
+        cls = type(self)
+        out = cls.__new__(cls)
+        d = dict(self.__dict__)
+        out.__dict__ = d
+        d["resources"] = self.resources.copy()
+        d["allocated_ports"] = dict(self.allocated_ports)
+        dt = self.desired_transition
+        d["desired_transition"] = DesiredTransition(
+            migrate=dt.migrate, reschedule=dt.reschedule,
+            force_reschedule=dt.force_reschedule,
+            no_shutdown_delay=dt.no_shutdown_delay)
+        states = {}
+        for k, v in self.task_states.items():
+            ts = TaskState.__new__(TaskState)
+            ts.__dict__ = dict(v.__dict__)
+            ts.events = list(v.events)
+            states[k] = ts
+        d["task_states"] = states
         if self.deployment_status is not None:
-            out.deployment_status = dict(self.deployment_status)
+            d["deployment_status"] = dict(self.deployment_status)
         if self.reschedule_tracker is not None:
-            out.reschedule_tracker = RescheduleTracker(
+            d["reschedule_tracker"] = RescheduleTracker(
                 events=list(self.reschedule_tracker.events))
-        out.preempted_allocations = list(self.preempted_allocations)
+        d["preempted_allocations"] = list(self.preempted_allocations)
         m = self.metrics
-        out.metrics = _copy.copy(m)
-        out.metrics.nodes_available = dict(m.nodes_available)
-        out.metrics.class_filtered = dict(m.class_filtered)
-        out.metrics.constraint_filtered = dict(m.constraint_filtered)
-        out.metrics.class_exhausted = dict(m.class_exhausted)
-        out.metrics.dimension_exhausted = dict(m.dimension_exhausted)
-        out.metrics.quota_exhausted = list(m.quota_exhausted)
-        out.metrics.score_meta_data = list(m.score_meta_data)
+        nm = AllocMetric.__new__(AllocMetric)
+        nm.__dict__ = dict(m.__dict__)
+        nm.nodes_available = dict(m.nodes_available)
+        nm.class_filtered = dict(m.class_filtered)
+        nm.constraint_filtered = dict(m.constraint_filtered)
+        nm.class_exhausted = dict(m.class_exhausted)
+        nm.dimension_exhausted = dict(m.dimension_exhausted)
+        nm.quota_exhausted = list(m.quota_exhausted)
+        nm.score_meta_data = list(m.score_meta_data)
+        d["metrics"] = nm
         return out
 
 
