@@ -56,8 +56,8 @@ def build_harness(n_nodes: int, n_dcs: int = 1, seed: int = 0):
         n.attributes["platform.rack"] = f"r{i % 20}"
         n.resources.cpu = rng.choice([4000, 8000, 16000])
         n.resources.memory_mb = rng.choice([8192, 16384, 32768])
-        h.state.upsert_node(n)
         nodes.append(n)
+    h.state.upsert_nodes(nodes)
     return h, nodes
 
 
@@ -247,7 +247,7 @@ def run_config_4(args):
     for n in nodes:                       # uniform small nodes: the low-pri
         n.resources.cpu = 4000            # fill leaves no free capacity, so
         n.resources.memory_mb = 8192      # high-pri placements must preempt
-        h.state.upsert_node(n)
+    h.state.upsert_nodes(nodes)
     from nomad_tpu.structs import PreemptionConfig, SchedulerConfiguration
     h.state.set_scheduler_config(SchedulerConfiguration(
         preemption_config=PreemptionConfig(
@@ -302,7 +302,7 @@ def run_config_5(args):
     h, nodes = build_harness(n_nodes, n_dcs=3)
     for i, n in enumerate(nodes):
         n.attributes["storage.topology"] = f"zone{i % 5}"
-        h.state.upsert_node(n)
+    h.state.upsert_nodes(nodes)
 
     def one():
         job = mock.batch_job()

