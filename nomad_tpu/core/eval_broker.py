@@ -225,3 +225,12 @@ class EvalBroker:
     def failed_evals(self) -> List[Evaluation]:
         with self._lock:
             return list(self._failed)
+
+    def drain_failed(self) -> List[Evaluation]:
+        """Pop all delivery-limit-failed evals (the leader's reap loop marks
+        them failed in state and creates follow-up evals;
+        reference: leader.go reapFailedEvaluations)."""
+        with self._lock:
+            out = self._failed
+            self._failed = []
+            return out

@@ -15,6 +15,7 @@ Two run modes:
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Dict, Iterable, List, Optional
@@ -23,6 +24,7 @@ from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import (
     EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_FAILED,
     EVAL_STATUS_PENDING,
     Evaluation,
     Job,
@@ -44,7 +46,8 @@ from .worker import Worker
 
 class Server:
     def __init__(self, num_workers: int = 1, dev_mode: bool = True,
-                 heartbeat_ttl: float = 30.0) -> None:
+                 heartbeat_ttl: float = 30.0,
+                 failed_follow_up_delay: tuple = (60.0, 240.0)) -> None:
         self.state = StateStore()
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.eval_broker)
@@ -54,6 +57,10 @@ class Server:
         self.engine = PlacementEngine()
         self.engine.packer.attach(self.state)
         self.dev_mode = dev_mode
+        # (baseline, max) delay before a failed eval's follow-up re-enters
+        # the queue (reference: evalFailedFollowupBaselineDelay 1min +
+        # up to 4min jitter in nomad/leader.go)
+        self.failed_follow_up_delay = failed_follow_up_delay
         self.workers = [Worker(self, i) for i in range(num_workers)]
         self._applier_running = False
         self._leader = False
@@ -233,6 +240,22 @@ class Server:
         if not evals:
             return
         t = now if now is not None else time.time()
+        # an eval TRANSITIONING to failed (scheduler retry exhaustion,
+        # delivery limit) gets a delayed follow-up so its job is not
+        # stranded until the next state change (reference: leader.go
+        # reapFailedEvaluations / eval.CreateFailedFollowUpEval).  Only on
+        # transition: a persistently-failing eval re-upserted as failed on
+        # every redelivery must mint ONE follow-up, not one per delivery
+        # (geometric eval storm otherwise).
+        follow_ups = []
+        for ev in evals:
+            if ev.status == EVAL_STATUS_FAILED:
+                prev = self.state.eval_by_id(ev.id)
+                if prev is None or prev.status != EVAL_STATUS_FAILED:
+                    lo, hi = self.failed_follow_up_delay
+                    follow_ups.append(ev.create_failed_follow_up_eval(
+                        t + random.uniform(lo, hi)))
+        evals.extend(follow_ups)
         self.state.upsert_evals(evals)
         for ev in evals:
             if ev.should_enqueue():
@@ -257,11 +280,12 @@ class Server:
         if topic == "Node" and not isinstance(payload, str):
             if payload.ready():
                 self.blocked_evals.unblock(payload.computed_class)
-        elif topic == "Allocation":
-            if payload.terminal_status() and payload.node_id:
-                node = self.state.node_by_id(payload.node_id)
-                if node is not None:
-                    self.blocked_evals.unblock(node.computed_class)
+        elif topic == "Allocations":
+            for a in payload:
+                if a.terminal_status() and a.node_id:
+                    node = self.state.node_by_id(a.node_id)
+                    if node is not None:
+                        self.blocked_evals.unblock(node.computed_class)
 
     # --------------------------------------------------------------- tick
 
@@ -270,6 +294,17 @@ class Server:
         timeouts, heartbeat expiry."""
         t = now if now is not None else time.time()
         self.eval_broker.tick(t)
+        # delivery-limit failures: mark failed in state (apply_eval_update
+        # then creates the delayed follow-up)
+        reaped = self.eval_broker.drain_failed()
+        if reaped:
+            updates = []
+            for ev in reaped:
+                f = ev.copy()
+                f.status = EVAL_STATUS_FAILED
+                f.status_description = "maximum delivery attempts exceeded"
+                updates.append(f)
+            self.apply_eval_update(updates, now=t)
         for node_id in self.heartbeats.expired(t):
             evals = invalidate_heartbeat(self.state, node_id, t)
             self.apply_eval_update(evals, now=t)

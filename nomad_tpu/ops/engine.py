@@ -31,13 +31,16 @@ from nomad_tpu.structs import (
 from .feasibility import feasible_mask_jit
 from .preempt import Preemptor, preemption_enabled
 from .select import (
-    PlacementInputs, PlacementOutputs, place_bulk_jit, place_jit)
+    BulkInputs, PlacementInputs, PlacementOutputs, place_bulk_packed_jit,
+    place_packed_jit)
 
 # Minimum homogeneous batch size before the rounds-based bulk kernel beats
 # the per-placement scan (scan is exact sequential semantics; bulk commits
 # whole rounds between state refreshes).
 BULK_THRESHOLD = 64
 BULK_ROUND = 1024
+
+_scatter_add_jit = jax.jit(lambda u, r, v: u.at[r].add(v))
 
 
 @dataclass
@@ -64,6 +67,64 @@ def _pad_pow2(x: int, lo: int = 8) -> int:
     return p
 
 
+def _unpack_bulk_compact(buf: np.ndarray, round_size: int, p_real: int,
+                         with_scores: bool = False):
+    """Expand the bulk kernel's compact per-round buffer (see
+    select.place_bulk_packed for the layout) into per-placement picks plus
+    the per-round metric block.  Placements within a round are
+    interchangeable, so per-node fill counts expand with np.repeat."""
+    n_rounds = buf.shape[0]
+    fills = buf[:, :round_size]
+    off = 2 * round_size if with_scores else round_size
+    sc_r = buf[:, round_size:off].view(np.float32) if with_scores else None
+    meta = buf[:, off:]
+    rows_r = fills >> 11
+    cnt_r = fills & 2047
+    placed_r = meta[:, 12]
+
+    p_pad = n_rounds * round_size
+    picks = np.full(p_pad, -1, np.int32)
+    scores = np.zeros(p_pad, np.float32)
+    for r in range(n_rounds):
+        lo = r * round_size
+        k = int(placed_r[r])
+        if k <= 0:
+            continue
+        nz = cnt_r[r].nonzero()[0]
+        picks[lo:lo + k] = np.repeat(rows_r[r, nz], cnt_r[r, nz])[:k]
+        if with_scores:
+            scores[lo:lo + k] = np.repeat(sc_r[r, nz], cnt_r[r, nz])[:k]
+    return picks[:p_real], scores[:p_real], meta
+
+
+def _unpack_bulk(buf: np.ndarray, round_size: int, p_real: int, n: int):
+    """Per-placement expansion of the compact buffer (exact-API path)."""
+    picks, scores, meta = _unpack_bulk_compact(
+        buf, round_size, p_real, with_scores=True)
+    n_rounds = buf.shape[0]
+    rep = np.repeat(np.arange(n_rounds), round_size)[:p_real]
+    m = meta[rep]
+    return (picks, scores,
+            m[:, 0:3], m[:, 3:6].view(np.float32),
+            m[:, 6], m[:, 7], m[:, 8], m[:, 9:12])
+
+
+@dataclass
+class BulkDecisions:
+    """Array-form result of a homogeneous placement batch: one shared
+    AllocMetric per water-fill round instead of per-placement objects.
+    Building 100k PlacementDecision + AllocMetric objects cost more than
+    the device work; the scheduler materializes allocs straight from
+    `picks`."""
+    tg_name: str
+    picks: np.ndarray                  # [P] node row or -1
+    node_ids: List[str]                # row -> node id (shared, read-only)
+    round_size: int
+    metrics: List[AllocMetric]         # one per round, shared by the round
+    evictions: Dict[int, List] = field(default_factory=dict)
+    nodes_evaluated: int = 0
+
+
 class PlacementEngine:
     """Owns a ClusterPacker + device caches for one scheduling session."""
 
@@ -71,6 +132,10 @@ class PlacementEngine:
         self.packer = packer or ClusterPacker()
         self._dev_cache: Dict[str, object] = {}
         self._cache_version: Tuple[int, int] = (-1, -1)
+        self._used_version: int = -1
+        self._used_dev = None
+        self._const_cache: Dict[tuple, object] = {}
+        self._dc_cache: Optional[Tuple[int, Dict[str, int]]] = None
 
     # ------------------------------------------------------------ devices
 
@@ -80,14 +145,80 @@ class PlacementEngine:
         attrs after a build without bumping the row version."""
         key = (t.version, len(self.packer.interner), t.attrs.shape[1])
         if self._cache_version != key:
-            self._dev_cache = {
-                "attrs": jnp.asarray(t.attrs),
-                "cap": jnp.asarray(t.cap),
-                "used": jnp.asarray(t.used),
-                "elig": jnp.asarray(t.elig),
-            }
-            self._cache_version = key
+            # packer.lock: a concurrent update()/_on_allocs in another
+            # thread mutates these arrays in place — copying mid-mutation
+            # would cache a torn tensor under a version that claims
+            # consistency.  jnp.array (copy=True): on the CPU backend
+            # jnp.asarray zero-copies the numpy buffer, and the packer
+            # mutates it after the copy too.
+            with self.packer.lock:
+                self._dev_cache = {
+                    "attrs": jnp.array(t.attrs),
+                    "cap": jnp.array(t.cap),
+                    "elig": jnp.array(t.elig),
+                }
+                self._cache_version = key
+                self._used_version = -1
+                self._used_dev = None
         return self._dev_cache
+
+    def _used_device(self, t: NodeTensors):
+        """Device-resident usage tensor.  Plan applies dirty `used` every
+        eval; re-uploading [N,3] per eval costs ~0.2s at 50k nodes over the
+        tunnel, so the packer's delta log is replayed as an on-device
+        scatter-add (upload size O(changed rows), not O(N))."""
+        # The whole read-version → fetch-deltas → commit sequence holds the
+        # packer lock: the applier thread appends deltas and bumps
+        # t.used_version concurrently, and an unlocked interleave can
+        # record a version whose delta was never applied (ghost capacity)
+        # or apply one twice.  The lock also keeps the full t.used copy
+        # from reading a torn mid-scatter tensor.
+        with self.packer.lock:
+            ver = t.used_version
+            if self._used_dev is not None and self._used_version == ver:
+                return self._used_dev
+            deltas = None
+            if self._used_dev is not None:
+                deltas = self.packer.used_deltas_since(self._used_version)
+            if deltas is not None:
+                rows = np.concatenate([d[0] for d in deltas])
+                vals = np.concatenate([d[1] for d in deltas])
+                pad = _pad_pow2(max(len(rows), 1))
+                if pad != len(rows):
+                    rows = np.concatenate(
+                        [rows, np.zeros(pad - len(rows), rows.dtype)])
+                    vals = np.concatenate(
+                        [vals, np.zeros((pad - len(vals), 3), vals.dtype)])
+                self._used_dev = _scatter_add_jit(
+                    self._used_dev, jnp.asarray(rows), jnp.asarray(vals))
+            else:
+                # copy=True: t.used is mutated in place by the packer's
+                # delta accounting; an aliased upload double-applies
+                # future deltas
+                self._used_dev = jnp.array(t.used)
+            self._used_version = ver
+            return self._used_dev
+
+    def _dev_const(self, key, builder):
+        """Small per-eval tensors that repeat across evals (empty spread
+        rows, zero job counts, dc/pool masks, the LUT matrix) — uploaded
+        once and reused by cache key."""
+        # LRU via dict insertion order: hits re-insert at the end so the
+        # eviction prefix holds genuinely cold keys (stale version-embedded
+        # masks), not the long-lived LUT matrix inserted at the first eval.
+        # The packer lock guards against concurrent worker-thread eviction.
+        with self.packer.lock:
+            hit = self._const_cache.pop(key, None)
+            if hit is not None:
+                self._const_cache[key] = hit
+                return hit
+        val = jnp.asarray(builder())
+        with self.packer.lock:
+            if len(self._const_cache) > 256:
+                for old in list(self._const_cache)[:64]:
+                    self._const_cache.pop(old, None)
+            self._const_cache[key] = val
+        return val
 
     # -------------------------------------------------------------- solve
 
@@ -95,7 +226,9 @@ class PlacementEngine:
               requests: Sequence[PlacementRequest],
               tensors: Optional[NodeTensors] = None,
               stopped_allocs: Sequence = (),
-              ) -> List[PlacementDecision]:
+              bulk_api: bool = False,
+              seed: int = 0,
+              ):
         """Score + select nodes for `requests` (placements of `tgs`).
         Returns one decision per request, in order.
 
@@ -103,6 +236,11 @@ class PlacementEngine:
         their usage (and job-count, for this job) is subtracted before
         scoring, mirroring the reference's proposed-allocation view that
         folds plan.NodeUpdate into capacity (plan_apply.go evaluateNodePlan).
+
+        `seed`: per-eval tie-break for equal-score nodes (the TPU-native
+        analog of the reference's per-eval shuffled node order); without
+        it concurrent workers pick identical nodes and the plan applier
+        refutes all but the first (see select._tiebreak_noise).
         """
         if not requests:
             return []
@@ -114,25 +252,15 @@ class PlacementEngine:
 
         tg_tensors: TGTensors = self.packer.lower_task_groups(job, tgs)
         ctx: JobContext = self.packer.job_context(job, snapshot, t)
-        sp: SpreadTensors = lower_spreads(self.packer, job, t, snapshot)
 
         name_to_g = {name: i for i, name in enumerate(tg_tensors.names)}
         p_real = len(requests)
         p_pad = _pad_pow2(p_real)
-        tg_idx = np.zeros(p_pad, np.int32)
-        prev_row = np.full(p_pad, -1, np.int32)
-        active = np.zeros(p_pad, bool)
-        for i, r in enumerate(requests):
-            tg_idx[i] = name_to_g[r.tg_name]
-            if r.prev_node_id:
-                prev_row[i] = t.id_to_row.get(r.prev_node_id, -1)
-            active[i] = True
 
         desired = np.array([tg.count for tg in tgs], np.int32)
-        pd = self.packer.lower_distinct(job, tgs, tg_tensors, t, snapshot)
         algo = snapshot.scheduler_config().scheduler_algorithm
         dev = self._node_arrays(t)
-        used0 = dev["used"]
+        used0 = self._used_device(t)
         job_count = ctx.job_count
         if stopped_allocs:
             delta = np.zeros((n, 3), np.int32)
@@ -147,80 +275,121 @@ class PlacementEngine:
                 if a.job_id == job.id and job_count[row] > 0:
                     job_count[row] -= 1
             used0 = used0 + jnp.asarray(delta)
-        inp = PlacementInputs(
-            attrs=dev["attrs"], cap=dev["cap"], used0=used0,
-            elig=dev["elig"],
-            dc_mask=jnp.asarray(ctx.dc_mask),
-            pool_mask=jnp.asarray(ctx.pool_mask),
-            luts=jnp.asarray(tg_tensors.luts),
-            con=jnp.asarray(tg_tensors.con),
-            aff=jnp.asarray(tg_tensors.aff),
-            req=jnp.asarray(tg_tensors.req),
-            desired=jnp.asarray(desired),
-            dh_limit=jnp.asarray(tg_tensors.dh_limit),
-            sp_nodeval=jnp.asarray(sp.sp_nodeval),
-            sp_weight=jnp.asarray(sp.sp_weight),
-            sp_expected=jnp.asarray(sp.sp_expected),
-            sp_counts0=jnp.asarray(sp.sp_counts0),
-            pd_nodeval=jnp.asarray(pd.pd_nodeval),
-            pd_limit=jnp.asarray(pd.pd_limit),
-            pd_apply=jnp.asarray(pd.pd_apply),
-            pd_counts0=jnp.asarray(pd.pd_counts0),
-            tg_idx=jnp.asarray(tg_idx),
-            prev_row=jnp.asarray(prev_row),
-            active=jnp.asarray(active),
-            job_count0=jnp.asarray(job_count),
-            spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
-        )
+
+        # cached per-eval device constants (the tunnel moves ~3MB/s; every
+        # [N]-sized upload that repeats across evals must be cached)
+        dcm = self._dev_const(
+            ("dc", t.version, tuple(job.datacenters)),
+            lambda: ctx.dc_mask)
+        pm = self._dev_const(
+            ("pool", t.version, job.node_pool), lambda: ctx.pool_mask)
+        luts_dev = self._dev_const(
+            ("luts", self.packer.lut_epoch, tg_tensors.luts.shape),
+            lambda: tg_tensors.luts)
+        if job_count.any():
+            jc_dev = jnp.asarray(job_count)
+        else:
+            jc_dev = self._dev_const(("zjc", n), lambda: np.zeros(n, np.int32))
+
+        has_spread = bool(job.spreads) or any(tg.spreads for tg in tgs)
+        has_distinct = any(tg_tensors.distinct)
         bulk_ok = (
             p_real >= BULK_THRESHOLD
             and len({r.tg_name for r in requests}) == 1
-            and not np.any(sp.sp_weight > 0)
-            and not np.any(pd.pd_limit > 0)
+            and not has_spread and not has_distinct
             and all(not r.prev_node_id for r in requests))
+
+        # ONE packed device->host transfer: the chip sits behind a network
+        # transport with a large fixed cost per array fetch, so the kernels
+        # bitcast every output into a single int32 buffer.  used/job_count
+        # stay on device, fetched only on the preemption fallback path.
         if bulk_ok:
-            out = place_bulk_jit(inp, min(BULK_ROUND, p_pad))
+            g_idx = name_to_g[requests[0].tg_name]
+            round_size = min(BULK_ROUND, p_pad)
+            n_rounds = p_pad // round_size
+            binp = BulkInputs(
+                attrs=dev["attrs"], cap=dev["cap"], used0=used0,
+                elig=dev["elig"], dc_mask=dcm, pool_mask=pm, luts=luts_dev,
+                con=jnp.asarray(tg_tensors.con),
+                aff=jnp.asarray(tg_tensors.aff),
+                req=jnp.asarray(tg_tensors.req),
+                desired=jnp.asarray(desired),
+                dh_limit=jnp.asarray(tg_tensors.dh_limit),
+                job_count0=jc_dev,
+                spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
+                g=jnp.asarray(g_idx, jnp.int32),
+                p_real=jnp.asarray(p_real, jnp.int32),
+                seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
+            )
+            buf, used_dev, job_count_dev = place_bulk_packed_jit(
+                binp, round_size, n_rounds, not bulk_api)
+            tg_idx = np.full(p_real, g_idx, np.int32)
+            if bulk_api:
+                picks, _, meta = _unpack_bulk_compact(
+                    np.asarray(buf), round_size, p_real)
+                return self._bulk_decisions(
+                    requests[0].tg_name, picks, meta, round_size, t, ctx,
+                    snapshot, job, binp, tg_tensors, tg_idx, used_dev,
+                    job_count_dev, p_real, n, t0)
+            (picks, scores, topk_rows, topk_scores,
+             n_feas, n_filt, n_exh, dim_exh) = _unpack_bulk(
+                np.asarray(buf), round_size, p_real, n)
+            inp = binp      # _preempt_fallback field source
         else:
-            out = place_jit(inp)
-        # single host<->device round trip for every output (the chip sits
-        # behind a network transport; per-array reads each pay the RTT)
-        out = PlacementOutputs(*jax.device_get(tuple(out)))
-        picks = out.picks[:p_real].copy()
-        scores = out.scores[:p_real]
-        topk_rows = out.topk_rows[:p_real]
-        topk_scores = out.topk_scores[:p_real]
-        n_feas = out.n_feasible[:p_real]
-        n_filt = out.n_filtered[:p_real]
-        n_exh = out.n_exhausted[:p_real]
-        dim_exh = out.dim_exhausted[:p_real]
+            sp: SpreadTensors = lower_spreads(self.packer, job, t, snapshot)
+            pd = self.packer.lower_distinct(job, tgs, tg_tensors, t, snapshot)
+            tg_idx = np.zeros(p_pad, np.int32)
+            prev_row = np.full(p_pad, -1, np.int32)
+            active = np.zeros(p_pad, bool)
+            for i, r in enumerate(requests):
+                tg_idx[i] = name_to_g[r.tg_name]
+                if r.prev_node_id:
+                    prev_row[i] = t.id_to_row.get(r.prev_node_id, -1)
+                active[i] = True
+            inp = PlacementInputs(
+                attrs=dev["attrs"], cap=dev["cap"], used0=used0,
+                elig=dev["elig"],
+                dc_mask=dcm,
+                pool_mask=pm,
+                luts=luts_dev,
+                con=jnp.asarray(tg_tensors.con),
+                aff=jnp.asarray(tg_tensors.aff),
+                req=jnp.asarray(tg_tensors.req),
+                desired=jnp.asarray(desired),
+                dh_limit=jnp.asarray(tg_tensors.dh_limit),
+                sp_nodeval=jnp.asarray(sp.sp_nodeval),
+                sp_weight=jnp.asarray(sp.sp_weight),
+                sp_expected=jnp.asarray(sp.sp_expected),
+                sp_counts0=jnp.asarray(sp.sp_counts0),
+                pd_nodeval=jnp.asarray(pd.pd_nodeval),
+                pd_limit=jnp.asarray(pd.pd_limit),
+                pd_apply=jnp.asarray(pd.pd_apply),
+                pd_counts0=jnp.asarray(pd.pd_counts0),
+                tg_idx=jnp.asarray(tg_idx),
+                prev_row=jnp.asarray(prev_row),
+                active=jnp.asarray(active),
+                job_count0=jc_dev,
+                spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
+                seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
+            )
+            buf, used_dev, job_count_dev = place_packed_jit(inp)
+            b = np.asarray(buf)[:p_real]
+            picks = b[:, 0].copy()
+            scores = b[:, 1].view(np.float32)
+            topk_rows = b[:, 2:5]
+            topk_scores = b[:, 5:8].view(np.float32)
+            n_feas = b[:, 8]
+            n_filt = b[:, 9]
+            n_exh = b[:, 10]
+            dim_exh = b[:, 11:14]
         elapsed = (time.perf_counter_ns() - t0) // max(p_real, 1)
 
         # ---- preemption fallback for failed placements ----
-        # (reference: BinPackIterator drives Preemptor when Fit fails and
-        # preemption is enabled for the scheduler type)
-        evictions_by_req: Dict[int, List] = {}
-        if (np.any(picks < 0)
-                and preemption_enabled(snapshot.scheduler_config(), job.type)):
-            static = np.asarray(feasible_mask_jit(
-                inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
-                inp.con, inp.luts))
-            preemptor = Preemptor(job, snapshot, t, static,
-                                  np.asarray(out.used),
-                                  job_count=np.asarray(out.job_count),
-                                  dh_limit=tg_tensors.dh_limit)
-            for i in range(p_real):
-                if picks[i] >= 0:
-                    continue
-                g = int(tg_idx[i])
-                res = preemptor.preempt_for(g, tg_tensors.req[g].astype(np.int64))
-                if res is not None:
-                    picks[i] = res.node_row
-                    evictions_by_req[i] = res.evictions
+        evictions_by_req = self._preempt_fallback(
+            picks, snapshot, job, inp, tg_tensors, tg_idx,
+            t, used_dev, job_count_dev, p_real)
 
-        dc_counts: Dict[str, int] = {}
-        for nd in snapshot.nodes():
-            if nd.ready():
-                dc_counts[nd.datacenter] = dc_counts.get(nd.datacenter, 0) + 1
+        dc_counts = self._dc_counts(t)
 
         # native-python views once, not one numpy-scalar box per field
         picks_l = picks.tolist()
@@ -270,6 +439,84 @@ class PlacementEngine:
                 score=scores_l[i], metric=metric,
                 evictions=evictions_by_req.get(i, [])))
         return decisions
+
+    def _preempt_fallback(self, picks, snapshot, job, inp, tg_tensors,
+                          tg_idx, t, used_dev, job_count_dev, p_real
+                          ) -> Dict[int, List]:
+        """Host-side preemption for placements the kernel could not fit
+        (reference: BinPackIterator drives the Preemptor when Fit fails and
+        preemption is enabled for the scheduler type).  Mutates `picks`."""
+        evictions_by_req: Dict[int, List] = {}
+        if (not np.any(picks < 0)
+                or not preemption_enabled(snapshot.scheduler_config(),
+                                          job.type)):
+            return evictions_by_req
+        static = np.asarray(feasible_mask_jit(
+            inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
+            inp.con, inp.luts))
+        preemptor = Preemptor(job, snapshot, t, static,
+                              np.asarray(used_dev),
+                              job_count=np.asarray(job_count_dev),
+                              dh_limit=tg_tensors.dh_limit)
+        for i in range(p_real):
+            if picks[i] >= 0:
+                continue
+            g = int(tg_idx[i])
+            res = preemptor.preempt_for(g, tg_tensors.req[g].astype(np.int64))
+            if res is not None:
+                picks[i] = res.node_row
+                evictions_by_req[i] = res.evictions
+        return evictions_by_req
+
+    def _dc_counts(self, t: NodeTensors) -> Dict[str, int]:
+        """Ready-node count per datacenter (AllocMetric.nodes_available),
+        computed vectorized from the packed tensors and cached per row
+        version — the object-walk over 50k nodes cost more than the kernel."""
+        if self._dc_cache is not None and self._dc_cache[0] == t.version:
+            return self._dc_cache[1]
+        counts: Dict[str, int] = {}
+        if t.n:
+            bc = np.bincount(t.dc[t.elig])
+            for vid in np.nonzero(bc)[0]:
+                counts[self.packer.interner.string(int(vid))] = int(bc[vid])
+        self._dc_cache = (t.version, counts)
+        return counts
+
+    def _bulk_decisions(self, tg_name, picks, meta, round_size, t, ctx,
+                        snapshot, job, inp, tg_tensors, tg_idx, used_dev,
+                        job_count_dev, p_real, n, t0) -> BulkDecisions:
+        evictions = self._preempt_fallback(
+            picks, snapshot, job, inp, tg_tensors, tg_idx,
+            t, used_dev, job_count_dev, p_real)
+        elapsed = int(time.perf_counter_ns() - t0) // max(p_real, 1)
+        dc_counts = self._dc_counts(t)
+        n_in_pool = int(ctx.pool_mask.sum())
+        node_ids = t.node_ids
+        dims = ("cpu", "memory", "disk")
+        tsc = meta[:, 3:6].view(np.float32).tolist()
+        metrics: List[AllocMetric] = []
+        for r, row in enumerate(meta.tolist()):
+            metric = AllocMetric(
+                nodes_evaluated=n,
+                nodes_filtered=row[7],
+                nodes_in_pool=n_in_pool,
+                nodes_available=dc_counts,
+                nodes_exhausted=row[8],
+                allocation_time_ns=elapsed,
+            )
+            if row[9] or row[10] or row[11]:
+                for d in range(3):
+                    if row[9 + d]:
+                        metric.dimension_exhausted[dims[d]] = row[9 + d]
+            metric.score_meta_data = [
+                NodeScoreMeta(node_id=node_ids[kr],
+                              scores={"final": ks}, norm_score=ks)
+                for kr, ks in zip(row[0:3], tsc[r]) if kr >= 0]
+            metrics.append(metric)
+        return BulkDecisions(
+            tg_name=tg_name, picks=picks, node_ids=node_ids,
+            round_size=round_size, metrics=metrics, evictions=evictions,
+            nodes_evaluated=n)
 
     def _no_nodes_decision(self, r: PlacementRequest, snapshot, job: Job
                            ) -> PlacementDecision:

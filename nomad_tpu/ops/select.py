@@ -40,6 +40,22 @@ NEG_INF = -1e30
 TOP_K = 3
 
 
+def tiebreak_noise(seed, rows):
+    """Per-eval selection-order jitter over (global) node row indices,
+    magnitude 1e-6 — far below any real score difference (one alloc's
+    binpack delta is ~1e-3), so it only reorders exact ties.  seed 0
+    disables it (test determinism).  A counter-based integer hash rather
+    than a PRNG stream so a sharded kernel computes identical noise for a
+    given GLOBAL row on every shard (and for any gathered row id)."""
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ seed * jnp.uint32(0x85EBCA77))
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x.astype(jnp.float32) * jnp.float32(1e-6 / 2**32)
+            * (seed != jnp.uint32(0)))
+
+
 class PlacementInputs(NamedTuple):
     """Device inputs for one eval's placement batch."""
     # node state
@@ -74,6 +90,13 @@ class PlacementInputs(NamedTuple):
     job_count0: jnp.ndarray  # [N] int32 (existing allocs of this job)
     # config
     spread_algo: jnp.ndarray  # [] bool (SchedulerAlgorithm == "spread")
+    # per-eval tie-break seed (0 = deterministic row order).  The reference
+    # shuffles node order per eval (scheduler/feasible.go RandomIterator),
+    # which is what keeps concurrent eval workers from colliding on the
+    # same nodes; full-cluster argmax is deterministic, so equal-score
+    # ties must be broken per-eval or every worker picks identical nodes
+    # and optimistic plan-apply refutes all but one (livelock under load).
+    seed: jnp.ndarray = jnp.uint32(0)   # [] uint32
 
 
 class PlacementOutputs(NamedTuple):
@@ -98,6 +121,7 @@ def place(inp: PlacementInputs) -> PlacementOutputs:
     aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)        # [G]
     sp_any = jnp.any(inp.sp_weight > 0)
     capf = inp.cap.astype(jnp.float32)
+    noise = tiebreak_noise(inp.seed, jnp.arange(n))
 
     def step(carry, xs):
         used, job_count, sp_counts, pd_counts = carry
@@ -139,8 +163,10 @@ def place(inp: PlacementInputs) -> PlacementOutputs:
         ])
         final = normalize_scores(comps, act_mask)
 
+        # selection order gets the tie-break noise; reported scores do not
         masked = jnp.where(feas, final, NEG_INF)
-        top_sc, top_rows = jax.lax.top_k(masked, top_k)
+        nsc, top_rows = jax.lax.top_k(masked + noise, top_k)
+        top_sc = jnp.where(nsc > NEG_INF / 2, final[top_rows], NEG_INF)
         pick = top_rows[0]
         ok = act & (top_sc[0] > NEG_INF / 2)
         pick = jnp.where(ok, pick, -1)
@@ -196,6 +222,259 @@ def place(inp: PlacementInputs) -> PlacementOutputs:
 place_jit = jax.jit(place)
 
 
+def place_packed(inp: PlacementInputs):
+    """`place` with every per-placement output packed into ONE int32 buffer
+    `[P, 14]` (floats bitcast) so the host pays a single device→host
+    round trip — the PJRT transport here is a network tunnel with a
+    ~30-100ms fixed cost per array fetch, which dominated eval latency
+    when the engine fetched ten arrays per batch.
+
+    Column layout: 0 pick | 1 score | 2-4 topk_rows | 5-7 topk_scores |
+    8 n_feasible | 9 n_filtered | 10 n_exhausted | 11-13 dim_exhausted.
+    Returns (buf, used, job_count); used/job_count are fetched lazily by
+    the engine only on the preemption fallback path.
+    """
+    out = place(inp)
+    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    p, top_k = out.topk_rows.shape
+    pad_k = jnp.full((p, 3 - top_k), -1, jnp.int32)
+    buf = jnp.concatenate([
+        out.picks[:, None], f2i(out.scores)[:, None],
+        jnp.concatenate([out.topk_rows, pad_k], axis=1),
+        jnp.concatenate([f2i(out.topk_scores),
+                         jnp.zeros((p, 3 - top_k), jnp.int32)], axis=1),
+        out.n_feasible[:, None], out.n_filtered[:, None],
+        out.n_exhausted[:, None], out.dim_exhausted,
+    ], axis=1)
+    return buf, out.used, out.job_count
+
+
+place_packed_jit = jax.jit(place_packed)
+
+
+class BulkInputs(NamedTuple):
+    """Reduced device inputs for the bulk kernel: no per-placement arrays
+    (the homogeneous batch is described by the scalars `g` and `p_real`)
+    and no spread/distinct state (the engine routes only spread-free
+    batches here).  Uploading [P]-sized index arrays cost more than the
+    kernel at 100k placements — the transport moves ~3MB/s."""
+    attrs: jnp.ndarray       # [N, A] int32
+    cap: jnp.ndarray         # [N, 3] int32
+    used0: jnp.ndarray       # [N, 3] int32
+    elig: jnp.ndarray        # [N] bool
+    dc_mask: jnp.ndarray     # [N] bool
+    pool_mask: jnp.ndarray   # [N] bool
+    luts: jnp.ndarray        # [L, V] bool
+    con: jnp.ndarray         # [G, C, 3] int32
+    aff: jnp.ndarray         # [G, Af, 4] int32
+    req: jnp.ndarray         # [G, 3] int32
+    desired: jnp.ndarray     # [G] int32
+    dh_limit: jnp.ndarray    # [G] int32
+    job_count0: jnp.ndarray  # [N] int32
+    spread_algo: jnp.ndarray  # [] bool
+    g: jnp.ndarray           # [] int32  the task-group row being placed
+    p_real: jnp.ndarray      # [] int32  real placement count (<= R*round)
+    seed: jnp.ndarray = jnp.uint32(0)  # [] per-eval tie-break (see above)
+
+
+def _to_bulk_inputs(inp: PlacementInputs) -> BulkInputs:
+    return BulkInputs(
+        attrs=inp.attrs, cap=inp.cap, used0=inp.used0, elig=inp.elig,
+        dc_mask=inp.dc_mask, pool_mask=inp.pool_mask, luts=inp.luts,
+        con=inp.con, aff=inp.aff, req=inp.req, desired=inp.desired,
+        dh_limit=inp.dh_limit, job_count0=inp.job_count0,
+        spread_algo=inp.spread_algo, g=inp.tg_idx[0],
+        p_real=jnp.sum(inp.active).astype(jnp.int32),
+        seed=inp.seed)
+
+
+def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
+               carry, want):
+    """One water-fill round of the bulk kernel.  Returns compact per-round
+    outputs: the sorted fill prefix (node rows + per-node fill counts +
+    scores, length `round_size`) and shared round metrics — everything the
+    host needs, at O(round_size) not O(N) per round.
+
+    `static_t` is the loop-invariant (feasibility mask, affinity scores)
+    triple, computed once in _bulk_scan and closed over — recomputing it
+    per round would multiply the gather/reduce chain by the round count.
+    """
+    n = inp.attrs.shape[0]
+    g = inp.g
+    req = inp.req[g]
+    capf = inp.cap.astype(jnp.float32)
+    big = jnp.int32(round_size)
+
+    static, aff_sc, aff_any, noise = static_t
+
+    used, job_count = carry
+    free = inp.cap - used
+    per_dim = jnp.where(req[None, :] > 0,
+                        free // jnp.maximum(req[None, :], 1), big)
+    k_i = jnp.clip(jnp.min(per_dim, axis=1), 0, big)
+    # a node over capacity in ANY dimension (e.g. shrunk re-registration)
+    # is infeasible even if that dimension isn't requested — matches
+    # capacity_fit's all-dims check in the exact scan kernel
+    k_i = jnp.where(jnp.any(free < 0, axis=1), 0, k_i)
+    k_i = jnp.where(inp.dh_limit[g] > 0,
+                    jnp.minimum(k_i, jnp.clip(
+                        inp.dh_limit[g] - job_count, 0, big)),
+                    k_i)
+    k_i = jnp.where(static, k_i, 0)
+
+    # rank chain at the current proposed state
+    bp = binpack_score(capf, used.astype(jnp.float32),
+                       req.astype(jnp.float32), inp.spread_algo) / 18.0
+    aa = job_anti_affinity(job_count, inp.desired[g])
+    comps = jnp.stack([bp, aa, aff_sc])
+    act_mask = jnp.stack([
+        jnp.ones(n, bool),
+        job_count > 0,
+        jnp.broadcast_to(aff_any, (n,)),
+    ])
+    score = normalize_scores(comps, act_mask)
+
+    # spread algorithm: cap per-node intake so a round fans out
+    viable = jnp.maximum(jnp.sum(k_i > 0), 1)
+    cap_round = jnp.where(
+        inp.spread_algo,
+        jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
+    k_round = jnp.minimum(k_i, cap_round)
+
+    # water-fill the top-K nodes up to `want`.  K = round_size suffices:
+    # every selected node absorbs >= 1 alloc, so at most `want` <= K nodes
+    # fill.  top_k over [N] then O(K) arithmetic beats a full [N] argsort
+    # per round (the old form) by ~50x at 50k nodes.
+    # selection order gets the tie-break noise; reported scores do not
+    masked = jnp.where(k_round > 0, score, NEG_INF)
+    kk = min(round_size, n)
+    nsc_k, order_k = jax.lax.top_k(masked + noise, kk)
+    sc_k = jnp.where(nsc_k > NEG_INF / 2, score[order_k], NEG_INF)
+    k_sorted = jnp.where(sc_k > NEG_INF / 2, k_round[order_k], 0)
+    csum = jnp.cumsum(k_sorted)
+    c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
+    placed_total = jnp.sum(c_sorted)
+
+    # commit the round
+    c_i = (jnp.zeros(n, jnp.int32)
+           .at[order_k].add(c_sorted.astype(jnp.int32), mode="drop"))
+    used = used + c_i[:, None] * req[None, :]
+    job_count = job_count + c_i
+
+    # compact fill prefix (pad up to round_size when the cluster is small)
+    pad = round_size - kk
+    if pad:
+        rows_p = jnp.concatenate([order_k, jnp.zeros(pad, order_k.dtype)])
+        cnt_p = jnp.concatenate(
+            [c_sorted.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
+        sc_p = jnp.concatenate([sc_k, jnp.full(pad, NEG_INF, sc_k.dtype)])
+    else:
+        rows_p = order_k
+        cnt_p = c_sorted.astype(jnp.int32)
+        sc_p = sc_k
+
+    # round metrics (shared by every placement of the round)
+    top_sc = sc_k[:top_k]
+    top_rows = order_k[:top_k]
+    top_rows = jnp.where(top_sc > NEG_INF / 2, top_rows, -1)
+    top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
+    n_feas = jnp.sum(k_round > 0).astype(jnp.int32)
+    n_filt = jnp.sum(~static).astype(jnp.int32)
+    # exhaustion is reported POST-commit: a placement that failed inside
+    # this round failed against capacity already consumed by the round's
+    # earlier fills (sequential semantics), and for successful rounds the
+    # stock metric likewise counts nodes filled by earlier placements
+    free2 = inp.cap - used
+    fit2 = jnp.all(free2 >= req[None, :], axis=1) & jnp.all(
+        free2 >= 0, axis=1)
+    dh_ok2 = jnp.where(inp.dh_limit[g] > 0,
+                       job_count < inp.dh_limit[g], True)
+    exhausted2 = static & ~(fit2 & dh_ok2)
+    n_exh = jnp.sum(exhausted2).astype(jnp.int32)
+    dim_ex = jnp.sum(
+        exhausted2[:, None] & (free2 < req[None, :]),
+        axis=0).astype(jnp.int32)
+
+    out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
+           n_feas, n_filt, n_exh, dim_ex,
+           placed_total.astype(jnp.int32))
+    return (used, job_count), out
+
+
+def _bulk_static(inp: BulkInputs, g):
+    static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
+                           inp.con, inp.luts)[g]             # [N]
+    aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)[g]  # [N]
+    aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)[g]
+    noise = tiebreak_noise(inp.seed, jnp.arange(inp.attrs.shape[0]))
+    return static, aff_sc, aff_any, noise
+
+
+def _bulk_scan(inp: BulkInputs, round_size: int, n_rounds: int, top_k: int):
+    # placements are a contiguous prefix of the padded batch, so each
+    # round's demand derives from the p_real scalar — no [P] active array
+    want_r = jnp.clip(
+        inp.p_real - jnp.arange(n_rounds, dtype=jnp.int32) * round_size,
+        0, round_size)
+    carry0 = (inp.used0, inp.job_count0)
+    static_t = _bulk_static(inp, inp.g)
+    return jax.lax.scan(
+        partial(_bulk_step, inp, round_size, top_k, static_t),
+        carry0, want_r)
+
+
+def place_bulk_packed(inp: BulkInputs, round_size: int, n_rounds: int,
+                      with_scores: bool = False):
+    """Bulk kernel with compact per-round outputs packed into ONE int32
+    buffer `[R, round_size + 16]` — a single device→host transfer whose
+    size scales with rounds, not placements or nodes.
+
+    Row layout per round r:
+      [0 : round_size)               fill prefix, row*2048 + count packed
+                                     (count <= round_size <= 1024 < 2048;
+                                     asserts n < 2^20 nodes)
+      [round_size : +16)             topk_rows(3) | bitcast topk_scores(3) |
+                                     n_feasible | n_filtered | n_exhausted |
+                                     dim_exhausted(3) | placed_total | pad(3)
+
+    With `with_scores=True` a bitcast per-slot score block is inserted
+    between fills and meta (buffer `[R, 2*round_size + 16]`) so the host
+    can expand real per-placement scores; the default drops it because the
+    hot BulkDecisions path never reads per-placement scores and the tunnel
+    transfer cost scales with buffer bytes.
+
+    The host expands fills to per-placement picks with np.repeat — placements
+    within a round are interchangeable (same task group, no per-placement
+    state), so fill order IS the placement order.
+    Returns (buf, used, job_count).
+    """
+    n = inp.attrs.shape[0]
+    assert n < (1 << 20), "packed fill rows support < 2^20 nodes"
+    assert round_size <= 1024, "packed fill counts support rounds <= 1024"
+    top_k = min(TOP_K, n)
+    (used, job_count), outs = _bulk_scan(inp, round_size, n_rounds, top_k)
+    (rows_p, cnt_p, sc_p, top_rows, top_sc,
+     n_feas, n_filt, n_exh, dim_ex, placed) = outs
+    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
+    r = top_rows.shape[0]
+    meta = jnp.concatenate([
+        jnp.concatenate([top_rows,
+                         jnp.full((r, 3 - top_k), -1, jnp.int32)], axis=1),
+        jnp.concatenate([f2i(top_sc),
+                         jnp.zeros((r, 3 - top_k), jnp.int32)], axis=1),
+        n_feas[:, None], n_filt[:, None], n_exh[:, None],
+        dim_ex, placed[:, None],
+        jnp.zeros((fills.shape[0], 3), jnp.int32),
+    ], axis=1)
+    parts = [fills, f2i(sc_p), meta] if with_scores else [fills, meta]
+    buf = jnp.concatenate(parts, axis=1)
+    return buf, used, job_count
+
+
+place_bulk_packed_jit = jax.jit(place_bulk_packed, static_argnums=(1, 2, 3))
+
+
 def place_bulk(inp: PlacementInputs, round_size: int) -> PlacementOutputs:
     """Fast path for homogeneous placement batches: one task group, no
     spread stanza, no distinct_property, no reschedule penalties (the
@@ -212,122 +491,43 @@ def place_bulk(inp: PlacementInputs, round_size: int) -> PlacementOutputs:
     per-node intake is capped to spread the wave).
 
     Device cost: O(P/R) scan steps of O(N log N) each, vs O(P) steps for
-    `place` — ~R× fewer sequential launches.
+    `place` — ~R× fewer sequential launches.  (The engine uses the
+    `place_bulk_packed` variant below; this expanded-output form is the
+    reference API for tests and the sharded mesh path.)
     """
     n = inp.attrs.shape[0]
     p_pad = inp.tg_idx.shape[0]
     assert p_pad % round_size == 0
-    n_rounds = p_pad // round_size
     top_k = min(TOP_K, n)
-    g = inp.tg_idx[0]
+    (used, job_count), outs = _bulk_scan(
+        _to_bulk_inputs(inp), round_size, p_pad // round_size, top_k)
+    (rows_p, cnt_p, sc_p, top_rows, top_sc,
+     n_feas, n_filt, n_exh, dim_ex, placed) = outs
 
-    static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
-                           inp.con, inp.luts)[g]             # [N]
-    aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)[g]  # [N]
-    aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)[g]
-    capf = inp.cap.astype(jnp.float32)
-    req = inp.req[g]                                          # [3]
-    # per-node capacity never needs to exceed one round's demand; clamping
-    # here also keeps the water-fill cumsum far from int32 overflow
-    big = jnp.int32(round_size)
-
-    # placements requested per round (active padding is a suffix)
-    want_r = jnp.sum(
-        inp.active.reshape(n_rounds, round_size), axis=1).astype(jnp.int32)
-
-    def step(carry, want):
-        used, job_count = carry
-        free = inp.cap - used
-        # multi-alloc capacity per node: floor(free/req) over req>0 dims
-        per_dim = jnp.where(req[None, :] > 0,
-                            free // jnp.maximum(req[None, :], 1), big)
-        k_i = jnp.clip(jnp.min(per_dim, axis=1), 0, big)
-        # a node over capacity in ANY dimension (e.g. shrunk re-registration)
-        # is infeasible even if that dimension isn't requested — matches
-        # capacity_fit's all-dims check in the exact scan kernel
-        k_i = jnp.where(jnp.any(free < 0, axis=1), 0, k_i)
-        k_i = jnp.where(inp.dh_limit[g] > 0,
-                        jnp.minimum(k_i, jnp.clip(
-                            inp.dh_limit[g] - job_count, 0, big)),
-                        k_i)
-        k_i = jnp.where(static, k_i, 0)
-
-        # rank chain at the current proposed state
-        bp = binpack_score(capf, used.astype(jnp.float32),
-                           req.astype(jnp.float32), inp.spread_algo) / 18.0
-        aa = job_anti_affinity(job_count, inp.desired[g])
-        comps = jnp.stack([bp, aa, aff_sc])
-        act_mask = jnp.stack([
-            jnp.ones(n, bool),
-            job_count > 0,
-            jnp.broadcast_to(aff_any, (n,)),
-        ])
-        score = normalize_scores(comps, act_mask)
-
-        # spread algorithm: cap per-node intake so a round fans out
-        viable = jnp.maximum(jnp.sum(k_i > 0), 1)
-        cap_round = jnp.where(
-            inp.spread_algo,
-            jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
-        k_round = jnp.minimum(k_i, cap_round)
-
-        # water-fill sorted nodes up to `want`
-        masked = jnp.where(k_round > 0, score, NEG_INF)
-        order = jnp.argsort(-masked)
-        k_sorted = k_round[order]
-        k_sorted = jnp.where(masked[order] > NEG_INF / 2, k_sorted, 0)
-        csum = jnp.cumsum(k_sorted)
-        c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
-        placed_total = jnp.sum(c_sorted)
-
-        # expand node fills to per-placement picks
-        fill_edges = jnp.cumsum(c_sorted)
+    # expand per-round fill prefixes to per-placement picks
+    def expand(rows_r, cnt_r, sc_r, placed_r):
+        fill_edges = jnp.cumsum(cnt_r)
         p_idx = jnp.arange(round_size)
         slot = jnp.searchsorted(fill_edges, p_idx, side="right")
-        pick = jnp.where(p_idx < placed_total,
-                         order[jnp.clip(slot, 0, n - 1)], -1)
-        pick_score = jnp.where(pick >= 0,
-                               score[jnp.maximum(pick, 0)], 0.0)
+        slot = jnp.clip(slot, 0, rows_r.shape[0] - 1)
+        pick = jnp.where(p_idx < placed_r, rows_r[slot], -1)
+        pick_score = jnp.where(pick >= 0, sc_r[slot], 0.0)
+        return pick, pick_score
 
-        # commit the round
-        c_i = jnp.zeros(n, jnp.int32).at[order].set(
-            c_sorted.astype(jnp.int32))
-        used = used + c_i[:, None] * req[None, :]
-        job_count = job_count + c_i
-
-        # metrics (shared by every placement of the round)
-        top_sc, top_rows = jax.lax.top_k(masked, top_k)
-        top_rows = jnp.where(top_sc > NEG_INF / 2, top_rows, -1)
-        top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
-        n_feas = jnp.sum(k_round > 0).astype(jnp.int32)
-        n_filt = jnp.sum(~static).astype(jnp.int32)
-        exhausted = static & (k_i == 0)
-        n_exh = jnp.sum(exhausted).astype(jnp.int32)
-        dim_ex = jnp.sum(
-            (static & (k_i == 0))[:, None] & (free < req[None, :]),
-            axis=0).astype(jnp.int32)
-
-        out = (pick,
-               pick_score,
-               jnp.broadcast_to(top_rows, (round_size, top_k)),
-               jnp.broadcast_to(top_sc, (round_size, top_k)),
-               jnp.broadcast_to(n_feas, (round_size,)),
-               jnp.broadcast_to(n_filt, (round_size,)),
-               jnp.broadcast_to(n_exh, (round_size,)),
-               jnp.broadcast_to(dim_ex, (round_size, 3)))
-        return (used, job_count), out
-
-    carry0 = (inp.used0, inp.job_count0)
-    (used, job_count), outs = jax.lax.scan(step, carry0, want_r)
+    picks_r, scores_r = jax.vmap(expand)(rows_p, cnt_p, sc_p, placed)
 
     def flat(x):
         return x.reshape((p_pad,) + x.shape[2:])
 
+    def rep(x):
+        return flat(jnp.broadcast_to(
+            x[:, None], (x.shape[0], round_size) + x.shape[1:]))
+
     return PlacementOutputs(
-        picks=flat(outs[0]), scores=flat(outs[1]),
-        topk_rows=flat(outs[2]), topk_scores=flat(outs[3]),
-        n_feasible=flat(outs[4]), n_filtered=flat(outs[5]),
-        n_exhausted=flat(outs[6]), dim_exhausted=flat(outs[7]),
+        picks=flat(picks_r), scores=flat(scores_r),
+        topk_rows=rep(top_rows), topk_scores=rep(top_sc),
+        n_feasible=rep(n_feas), n_filtered=rep(n_filt),
+        n_exhausted=rep(n_exh), dim_exhausted=rep(dim_ex),
         used=used, job_count=job_count)
 
 

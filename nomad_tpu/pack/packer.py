@@ -22,6 +22,7 @@ rebuildable cache of a state snapshot (never the source of truth).
 from __future__ import annotations
 
 import re
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -112,6 +113,8 @@ class NodeTensors:
     pool: np.ndarray         # [N] int32
     klass: np.ndarray        # [N] int32  (computed-class id)
     version: int = 0         # bumped on every row change (device cache key)
+    used_version: int = 0    # bumped on usage-only deltas (separate upload
+                             # key: plan applies touch used, not attrs)
 
     @property
     def n(self) -> int:
@@ -126,12 +129,19 @@ class ClusterPacker:
     """
 
     def __init__(self, interner: Optional[Interner] = None) -> None:
+        # guards tensor mutation (update/build/_on_allocs) and the delta
+        # log against concurrent readers: in threaded mode the plan-applier
+        # thread fires alloc events into _on_allocs while worker threads
+        # run update() and sync device copies of `used` from the log
+        self.lock = threading.RLock()
         self.interner = interner or Interner()
         self.columns: Dict[str, int] = {}
         self._tensors: Optional[NodeTensors] = None
         self._dirty: Set[str] = set()
         self._all_dirty = True
         self._attached = False
+        self._store = None            # set by attach()
+        self._events_index = -1       # highest store index seen via events
         self._seq = 0                 # monotone tensor version source
         self._last_index = -1         # state index the tensors reflect
         self._last_store = None       # store identity the tensors reflect
@@ -140,6 +150,20 @@ class ClusterPacker:
         # matrix stays O(#distinct predicates), not O(#evals).
         self._lut_cache: Dict[Tuple[str, str], List[int]] = {}
         self._luts: List[np.ndarray] = []
+        # usage accounting: which allocs are counted in `used`, and where.
+        # Alloc store events apply O(1) arithmetic deltas to t.used instead
+        # of rescanning a node's alloc list (the alloc list only grows —
+        # terminal allocs linger until GC — so rescans get slower forever).
+        self._alloc_node: Dict[str, str] = {}       # alloc id -> node id
+        self._counted: Dict[str, Dict[str, Tuple[int, int, int]]] = {}
+        # replay log of usage deltas for device-resident `used` tensors:
+        # entries are (used_version, rows, vals) or (used_version, None,
+        # None) — the sentinel marks a full/row rescan (device copies must
+        # re-upload).  Bounded; consumers older than the window re-upload.
+        self._delta_log: List[Tuple[int, Optional[np.ndarray],
+                                    Optional[np.ndarray]]] = []
+        self._used_seq = 0
+        self.lut_epoch = 0
 
     # ------------------------------------------------------------ columns
 
@@ -161,25 +185,127 @@ class ClusterPacker:
         """Subscribe to a StateStore for dirty-row tracking."""
 
         self._attached = True
+        self._store = store
 
         def on_event(topic: str, index: int, payload) -> None:
-            if topic == "Node":
-                nid = payload if isinstance(payload, str) else payload.id
-                self._dirty.add(nid)
-            elif topic == "Allocation":
-                if payload.node_id:
-                    self._dirty.add(payload.node_id)
-            elif topic == "PlanResult":
-                for table in (payload.node_update, payload.node_allocation,
-                              payload.node_preemptions):
-                    self._dirty.update(table.keys())
+            # every branch under self.lock: _update_locked iterates _dirty
+            # and readers rely on _events_index/ledger advancing together
+            with self.lock:
+                self._events_index = max(self._events_index, index)
+                if topic == "Node":
+                    nid = payload if isinstance(payload, str) else payload.id
+                    self._dirty.add(nid)
+                elif topic == "Allocations":
+                    self._on_allocs_locked(payload)
 
         store.subscribe(on_event)
 
+    def _on_allocs_locked(self, allocs) -> None:
+        """Apply a batch of alloc upserts as usage deltas (plan applies and
+        client status updates both land here).  One np.add.at scatter for
+        the whole batch instead of per-alloc numpy scalar writes."""
+        t = self._tensors
+        if t is None:
+            return                      # next build() scans from scratch
+        rows: List[int] = []
+        vals: List[Tuple[int, int, int]] = []
+        alloc_node = self._alloc_node
+        counted = self._counted
+        id_to_row = t.id_to_row
+        for a in allocs:
+            aid = a.id
+            old_node = alloc_node.get(aid)
+            if old_node is not None:
+                res = counted[old_node].pop(aid, None)
+                del alloc_node[aid]
+                if res is not None:
+                    row = id_to_row.get(old_node)
+                    if row is not None:
+                        rows.append(row)
+                        vals.append((-res[0], -res[1], -res[2]))
+            nid = a.node_id
+            if nid and not a.terminal_status():
+                r = a.resources
+                res = (r.cpu, r.memory_mb, r.disk_mb)
+                c = counted.get(nid)
+                if c is None:
+                    counted[nid] = c = {}
+                c[aid] = res
+                alloc_node[aid] = nid
+                row = id_to_row.get(nid)
+                if row is not None:
+                    rows.append(row)
+                    vals.append(res)
+        if rows:
+            r = np.asarray(rows, np.intp)
+            v = np.asarray(vals, np.int32)
+            np.add.at(t.used, r, v)
+            t.used_version = self._log_delta(r, v)
+        # else: the batch touched no tensor rows — leave the version alone
+        # so device caches stay hits and the bounded replay window isn't
+        # consumed by no-op entries
+
+    def _log_delta(self, rows, vals) -> int:
+        """Append one used-version bump to the replay log.  `rows is None`
+        marks a full/row rescan (device copies must re-upload).  Versions
+        in the log are consecutive, which makes continuity provable."""
+        self._used_seq += 1
+        log = self._delta_log
+        log.append((self._used_seq, rows, vals))
+        if len(log) > 256:
+            del log[:128]
+        return self._used_seq
+
+    def used_deltas_since(self, version: int
+                          ) -> Optional[List[Tuple[np.ndarray, np.ndarray]]]:
+        """Usage deltas with used_version > `version`, oldest first, or
+        None when a rescan intervened / the window was trimmed (the caller
+        must re-upload the full tensor)."""
+        if version == self._used_seq:
+            return []
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        expect = version + 1
+        for v, rows, vals in self._delta_log:
+            if v < expect:
+                continue
+            if v != expect or rows is None:
+                return None
+            out.append((rows, vals))
+            expect += 1
+        if expect != self._used_seq + 1:
+            return None
+        return out
+
     # ------------------------------------------------------------- build
+
+    def _fresh_enough(self, snapshot) -> bool:
+        return (not self._attached or self._store is None
+                or getattr(snapshot, "index", -1) >= self._events_index)
 
     def build(self, snapshot) -> NodeTensors:
         """Full rebuild from a snapshot."""
+        snapshot = self._refresh_snapshot(snapshot)
+        with self.lock:
+            return self._build_locked(snapshot)
+
+    def _refresh_snapshot(self, snapshot):
+        """When events have advanced the usage ledger past `snapshot`,
+        swap in a fresh snapshot from the attached store: a rebuild from
+        an older snapshot would reset tensors+ledger to a state whose
+        missing events never re-fire (persistent ghost/lost usage).
+        store.snapshot() must be called OUTSIDE self.lock — events publish
+        under the store lock and then take self.lock in _on_allocs, so the
+        reverse order would deadlock.  Retried because a write can land
+        between snapshot() and the locked check; each retry observes a
+        strictly newer index, so this converges immediately in practice."""
+        for _ in range(4):
+            with self.lock:
+                if self._fresh_enough(snapshot):
+                    return snapshot
+            snapshot = self._store.snapshot()
+        return snapshot
+
+    def _build_locked(self, snapshot) -> NodeTensors:
         nodes = snapshot.nodes()
         n = len(nodes)
         # discover all columns first so attrs has stable width this build
@@ -199,10 +325,13 @@ class ClusterPacker:
             pool=np.zeros(n, np.int32),
             klass=np.zeros(n, np.int32),
         )
+        self._alloc_node.clear()
+        self._counted.clear()
         for i, nd in enumerate(nodes):
             self._fill_row(t, i, nd, snapshot, prop_maps[i])
         self._seq += 1
         t.version = self._seq
+        t.used_version = self._log_delta(None, None)
         self._tensors = t
         self._dirty.clear()
         self._all_dirty = False
@@ -217,21 +346,26 @@ class ClusterPacker:
         state index (or of the backing store identity) forces a full rebuild
         (correct, just slower); an unchanged (store, index) returns the
         cached tensors as-is."""
+        snapshot = self._refresh_snapshot(snapshot)
+        with self.lock:
+            return self._update_locked(snapshot)
+
+    def _update_locked(self, snapshot) -> NodeTensors:
         t = self._tensors
         if t is None or self._all_dirty:
-            return self.build(snapshot)
+            return self._build_locked(snapshot)
         if getattr(snapshot, "store_id", None) != self._last_store:
-            return self.build(snapshot)
+            return self._build_locked(snapshot)
         if not self._attached:
             if getattr(snapshot, "index", -1) == self._last_index:
                 return t
-            return self.build(snapshot)
+            return self._build_locked(snapshot)
         live_ids = {nd.id for nd in snapshot.nodes()}
         removed = [nid for nid in t.node_ids if nid not in live_ids]
         added = [nid for nid in live_ids if nid not in t.id_to_row]
         if removed or added:
             # membership change: full rebuild keeps row mapping simple
-            return self.build(snapshot)
+            return self._build_locked(snapshot)
         if not self._dirty:
             self._last_index = getattr(snapshot, "index", self._last_index)
             return t
@@ -246,25 +380,52 @@ class ClusterPacker:
             for k in pm:
                 self.ensure_column(k)
             t.attrs[row, :] = UNSET
-            self._fill_row(t, row, nd, snapshot, pm)
+            self._fill_row(t, row, nd, snapshot, pm, from_ledger=True)
         self._seq += 1
         t.version = self._seq
+        t.used_version = self._log_delta(None, None)
         self._dirty.clear()
         self._last_index = getattr(snapshot, "index", self._last_index)
         return t
 
-    def _fill_row(self, t: NodeTensors, i: int, nd: Node, snapshot, pm) -> None:
+    def _fill_row(self, t: NodeTensors, i: int, nd: Node, snapshot, pm,
+                  from_ledger: bool = False) -> None:
         t.cap[i] = (nd.resources.cpu - nd.reserved.cpu,
                     nd.resources.memory_mb - nd.reserved.memory_mb,
                     nd.resources.disk_mb - nd.reserved.disk_mb)
-        used = [0, 0, 0]
-        for alc in snapshot.allocs_by_node(nd.id):
-            if alc.terminal_status():
-                continue
-            used[0] += alc.resources.cpu
-            used[1] += alc.resources.memory_mb
-            used[2] += alc.resources.disk_mb
-        t.used[i] = used
+        if from_ledger:
+            # dirty-row refill while attached: the counted/_alloc_node
+            # ledger is advanced synchronously by Allocations events and
+            # may be AHEAD of the worker's snapshot — re-anchoring from
+            # the snapshot would durably desync it (a terminal alloc's
+            # removal event never re-fires).  Usage comes from the ledger;
+            # node attrs/capacity come from the snapshot's node object.
+            used = [0, 0, 0]
+            for res in self._counted.get(nd.id, {}).values():
+                used[0] += res[0]
+                used[1] += res[1]
+                used[2] += res[2]
+            t.used[i] = used
+        else:
+            # full usage rescan for this row: re-anchor the delta accounting
+            old = self._counted.get(nd.id)
+            if old:
+                for aid in old:
+                    if self._alloc_node.get(aid) == nd.id:
+                        del self._alloc_node[aid]
+            counted: Dict[str, Tuple[int, int, int]] = {}
+            used = [0, 0, 0]
+            for alc in snapshot.allocs_by_node(nd.id):
+                if alc.terminal_status():
+                    continue
+                r = alc.resources
+                used[0] += r.cpu
+                used[1] += r.memory_mb
+                used[2] += r.disk_mb
+                counted[alc.id] = (r.cpu, r.memory_mb, r.disk_mb)
+                self._alloc_node[alc.id] = nd.id
+            self._counted[nd.id] = counted
+            t.used[i] = used
         t.elig[i] = nd.ready()
         t.dc[i] = self.interner.intern(nd.datacenter)
         t.pool[i] = self.interner.intern(nd.node_pool)
@@ -301,12 +462,14 @@ class ClusterPacker:
                     dtype=bool, count=v - built)
                 self._luts[lid] = np.concatenate([self._luts[lid], ext])
                 hit[1] = v
+                self.lut_epoch += 1
             return lid
         pred = _string_predicate(operand, rtarget)
         lut = self.interner.build_lut(pred)
         lid = len(self._luts)
         self._luts.append(lut)
         self._lut_cache[key] = [lid, v]
+        self.lut_epoch += 1
         return lid
 
     def lut_matrix(self) -> np.ndarray:

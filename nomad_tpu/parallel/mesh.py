@@ -38,6 +38,7 @@ from nomad_tpu.ops.select import (
     TOP_K,
     PlacementInputs,
     PlacementOutputs,
+    tiebreak_noise,
 )
 
 AXIS = "nodes"
@@ -70,6 +71,9 @@ def _place_local(inp: PlacementInputs) -> PlacementOutputs:
     aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)
     sp_any = jnp.any(inp.sp_weight > 0)
     capf = inp.cap.astype(jnp.float32)
+    # global-row-keyed tie-break: identical for a given global row on every
+    # shard, so the two-stage top-k stays consistent across the mesh
+    noise = tiebreak_noise(inp.seed, global_rows)
 
     def step(carry, xs):
         used, job_count, sp_counts, pd_counts = carry
@@ -105,7 +109,9 @@ def _place_local(inp: PlacementInputs) -> PlacementOutputs:
             jnp.broadcast_to(sp_any, (n_loc,)),
         ])
         final = normalize_scores(comps, act_mask)
-        masked = jnp.where(feas, final, NEG_INF)
+        # selection order gets the tie-break noise; reported scores recover
+        # the true value by re-hashing the chosen global rows
+        masked = jnp.where(feas, final, NEG_INF) + noise
 
         # ---- two-stage top-k: local, then global over shard winners ----
         loc_sc, loc_rows = jax.lax.top_k(masked, k_loc)
@@ -114,8 +120,12 @@ def _place_local(inp: PlacementInputs) -> PlacementOutputs:
         all_sc = jax.lax.all_gather(loc_sc, AXIS).reshape(-1)
         all_rows = jax.lax.all_gather(loc_grows, AXIS).reshape(-1)
         k_glob = min(TOP_K, all_sc.shape[0])
-        top_sc, top_idx = jax.lax.top_k(all_sc, k_glob)
+        top_nsc, top_idx = jax.lax.top_k(all_sc, k_glob)
         top_rows = all_rows[top_idx]
+        top_sc = jnp.where(
+            top_nsc > NEG_INF / 2,
+            top_nsc - tiebreak_noise(inp.seed, jnp.maximum(top_rows, 0)),
+            NEG_INF)
         pick = top_rows[0]
         ok = act & (top_sc[0] > NEG_INF / 2)
         pick = jnp.where(ok, pick, -1)
@@ -187,7 +197,7 @@ def place_sharded_fn(mesh: Mesh):
         sp_counts0=P(),
         pd_nodeval=P(None, AXIS), pd_limit=P(), pd_apply=P(), pd_counts0=P(),
         tg_idx=P(), prev_row=P(), active=P(), job_count0=spec_n,
-        spread_algo=P(),
+        spread_algo=P(), seed=P(),
     )
     out_specs = PlacementOutputs(
         picks=P(), scores=P(), topk_rows=P(), topk_scores=P(),

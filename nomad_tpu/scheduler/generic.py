@@ -14,9 +14,11 @@ in a single device round-trip.
 from __future__ import annotations
 
 import time
+import zlib
 from typing import Dict, List, Optional
 
 from nomad_tpu.ops import PlacementEngine, PlacementRequest
+from nomad_tpu.ops.engine import BulkDecisions
 from nomad_tpu.structs import (
     Allocation,
     AllocMetric,
@@ -27,6 +29,8 @@ from nomad_tpu.structs import (
     Plan,
     PlanAnnotations,
     TRIGGER_QUEUED_ALLOCS,
+    new_id,
+    new_ids,
 )
 
 from .base import Planner, Scheduler
@@ -72,6 +76,11 @@ class GenericScheduler(Scheduler):
 
     def process(self, evaluation: Evaluation) -> Optional[Exception]:
         for attempt in range(self.max_attempts):
+            # per-(eval, attempt) tie-break seed: concurrent workers (and
+            # their refutation retries) must diverge on equal-score nodes
+            # or they re-collide every attempt (see select._tiebreak_noise)
+            self._seed = ((zlib.crc32(evaluation.id.encode())
+                           + attempt * 0x9E3779B9) & 0xFFFFFFFF) or 1
             done, err = self._process_once(evaluation)
             if err is not None:
                 self._update_eval_status(evaluation, "failed", str(err))
@@ -203,7 +212,12 @@ class GenericScheduler(Scheduler):
         # allocs this plan is stopping free their capacity for placement
         stopped = [a for allocs in plan.node_update.values() for a in allocs]
         decisions = self.engine.place(self.state, job, tgs, reqs,
-                                      stopped_allocs=stopped)
+                                      stopped_allocs=stopped, bulk_api=True,
+                                      seed=getattr(self, "_seed", 0))
+        if isinstance(decisions, BulkDecisions):
+            self._materialize_bulk(plan, job, places, decisions,
+                                   evaluation, results)
+            return
 
         # host-side port assignment per chosen node (reference: AllocsFit's
         # NetworkIndex, kept off-device per SURVEY §7 P1).  Preemption
@@ -211,8 +225,16 @@ class GenericScheduler(Scheduler):
         net_idx: Dict[str, NetworkIndex] = {}
         victim_ids = {v.id for d in decisions for v in d.evictions}
 
-        # one combined-resources template per task group; copied per alloc
+        # one combined-resources template per task group.  When the group
+        # asks for no ports the template is shared by every alloc of the
+        # group (immutable once inserted, the store's ownership convention);
+        # with networks each alloc gets a copy carrying its port assignment.
         ask_templates: Dict[str, object] = {}
+        # alloc construction is the host-side hot path at bench scale
+        # (100k placements/plan): build one fully-initialized template
+        # alloc per task group and clone via dict copy instead of running
+        # the 40-field dataclass constructor per placement.
+        alloc_templates: Dict[str, Allocation] = {}
 
         for p, d in zip(places, decisions):
             tg = p.tg
@@ -223,7 +245,9 @@ class GenericScheduler(Scheduler):
             ask = ask_templates.get(tg.name)
             if ask is None:
                 ask_templates[tg.name] = ask = tg.combined_resources()
-            ask = ask.copy()
+            has_net = bool(ask.networks)
+            if has_net:
+                ask = ask.copy()
             if ask.networks:
                 ni = net_idx.get(d.node_id)
                 if ni is None:
@@ -242,23 +266,31 @@ class GenericScheduler(Scheduler):
                     continue
                 ni.commit(ports)
 
-            alloc = Allocation(
-                namespace=job.namespace,
-                eval_id=evaluation.id,
-                name=p.name,
-                node_id=d.node_id,
-                job_id=job.id,
-                job=job,
-                task_group=tg.name,
-                resources=ask,
-                allocated_ports=ports or {},
-                desired_status="run",
-                client_status="pending",
-                job_version=job.version,
-                metrics=d.metric,
-                create_time=self.now,
-                modify_time=self.now,
-            )
+            tmpl = alloc_templates.get(tg.name)
+            if tmpl is None:
+                alloc_templates[tg.name] = tmpl = Allocation(
+                    namespace=job.namespace,
+                    eval_id=evaluation.id,
+                    job_id=job.id,
+                    job=job,
+                    task_group=tg.name,
+                    desired_status="run",
+                    client_status="pending",
+                    job_version=job.version,
+                    create_time=self.now,
+                    modify_time=self.now,
+                )
+            alloc = Allocation.__new__(Allocation)
+            ad = dict(tmpl.__dict__)
+            alloc.__dict__ = ad
+            ad["id"] = new_id()
+            ad["name"] = p.name
+            ad["node_id"] = d.node_id
+            ad["resources"] = ask
+            ad["allocated_ports"] = ports or {}
+            ad["metrics"] = d.metric
+            # per-alloc mutable state: runners write task_states in place
+            ad["task_states"] = {}
             if d.evictions:
                 for victim in d.evictions:
                     plan.append_preempted_alloc(victim, alloc.id)
@@ -272,6 +304,118 @@ class GenericScheduler(Scheduler):
                     append_reschedule_tracker(alloc, p.previous_alloc, self.now)
                     alloc.desired_description = ALLOC_RESCHEDULED
             plan.append_alloc(alloc)
+
+    def _materialize_bulk(self, plan: Plan, job: Job,
+                          places: List[RPlace], bd,
+                          evaluation: Evaluation,
+                          results: ReconcileResults) -> None:
+        """Materialize allocations straight from a BulkDecisions array —
+        the per-placement twin loop of `_compute_placements`, with every
+        per-alloc object cost stripped: template-dict clones, batched ids,
+        a shared per-round AllocMetric, and a shared resources object when
+        the group asks for no ports."""
+        tg = places[0].tg
+        ask = tg.combined_resources()
+        has_net = bool(ask.networks)
+        tmpl = Allocation(
+            namespace=job.namespace,
+            eval_id=evaluation.id,
+            job_id=job.id,
+            job=job,
+            task_group=tg.name,
+            resources=ask,
+            desired_status="run",
+            client_status="pending",
+            job_version=job.version,
+            create_time=self.now,
+            modify_time=self.now,
+        )
+        if results.deployment is not None:
+            tmpl.deployment_id = results.deployment.id
+        tmpl_d = tmpl.__dict__
+        ids = new_ids(len(places))
+        picks_l = bd.picks.tolist()
+        node_ids = bd.node_ids
+        metrics = bd.metrics
+        rs = bd.round_size
+        node_alloc = plan.node_allocation
+        victim_ids = {v.id for vs in bd.evictions.values() for v in vs}
+        net_idx: Dict[str, NetworkIndex] = {}
+        last_nid = None
+        last_list = None
+
+        for i, p in enumerate(places):
+            pick = picks_l[i]
+            m = metrics[i // rs]
+            if pick < 0:
+                self._record_failure_shared(tg.name, m)
+                continue
+            nid = node_ids[pick]
+            alloc = Allocation.__new__(Allocation)
+            d2 = dict(tmpl_d)
+            alloc.__dict__ = d2
+            d2["id"] = ids[i]
+            d2["name"] = p.name
+            d2["node_id"] = nid
+            d2["metrics"] = m
+            d2["task_states"] = {}
+            if has_net:
+                a2 = ask.copy()
+                ni = net_idx.get(nid)
+                if ni is None:
+                    ni = NetworkIndex()
+                    node = self.state.node_by_id(nid)
+                    if node is not None:
+                        ni.set_node(node)
+                    ni.add_allocs(
+                        a for a in self.state.allocs_by_node(nid)
+                        if a.id not in victim_ids)
+                    net_idx[nid] = ni
+                ports, fail = ni.assign_ports(a2.networks)
+                if ports is None:
+                    # never mutate the round-shared metric: exhausted_node
+                    # writes dimension_exhausted on a private copy
+                    fm = m.copy()
+                    fm.exhausted_node(fail)
+                    self._record_failure_shared(tg.name, fm, copied=True)
+                    continue
+                ni.commit(ports)
+                d2["resources"] = a2
+                d2["allocated_ports"] = ports
+            ev = bd.evictions.get(i)
+            if ev:
+                for victim in ev:
+                    plan.append_preempted_alloc(victim, alloc.id)
+                d2["preempted_allocations"] = [v.id for v in ev]
+            if p.previous_alloc is not None:
+                d2["previous_allocation"] = p.previous_alloc.id
+                if p.reschedule:
+                    from .util import append_reschedule_tracker
+                    append_reschedule_tracker(alloc, p.previous_alloc,
+                                              self.now)
+                    d2["desired_description"] = ALLOC_RESCHEDULED
+            if nid is last_nid:
+                last_list.append(alloc)
+            else:
+                last_nid = nid
+                last_list = node_alloc.get(nid)
+                if last_list is None:
+                    node_alloc[nid] = last_list = []
+                last_list.append(alloc)
+
+    def _record_failure_shared(self, tg_name: str, metric: AllocMetric,
+                               copied: bool = False) -> None:
+        """_record_failure for metrics shared across a bulk round: the
+        stored (mutated) instance must not be the one attached to placed
+        allocs, so the first failure stores a copy with its own mutable
+        counter dicts."""
+        if tg_name in self.failed_tg_allocs:
+            # only the coalesced counter is bumped; skip the dict copies
+            # (a full-cluster 100k-placement failure calls this per pick)
+            self._record_failure(tg_name, metric)
+        else:
+            self._record_failure(
+                tg_name, metric if copied else metric.copy())
 
     def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
         prev = self.failed_tg_allocs.get(tg_name)

@@ -26,6 +26,13 @@ class Harness:
 
     def __init__(self, state: Optional[StateStore] = None) -> None:
         self.state = state or StateStore()
+        # One engine for the harness's lifetime, attached to the store for
+        # dirty-row tracking: packed node tensors and their device uploads
+        # survive across process() calls exactly like the server's shared
+        # engine (worker.py), instead of rebuilding per eval.
+        from nomad_tpu.ops import PlacementEngine
+        self.engine = PlacementEngine()
+        self.engine.packer.attach(self.state)
         self.plans: List[Plan] = []
         self.evals: List[Evaluation] = []          # update_eval calls
         self.create_evals: List[Evaluation] = []
@@ -78,6 +85,7 @@ class Harness:
                 **kwargs) -> Optional[Exception]:
         """reference: Harness.Process — snapshot state, build the scheduler,
         run one eval through it."""
+        kwargs.setdefault("engine", self.engine)
         sched: Scheduler = new_scheduler(scheduler_name, self.snapshot(),
                                          self, **kwargs)
         return sched.process(evaluation)

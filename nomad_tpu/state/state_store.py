@@ -258,7 +258,8 @@ class StateStore:
             self._insert_allocs(allocs, idx)
             return idx
 
-    def _insert_allocs(self, allocs: Iterable[Allocation], idx: int) -> None:
+    def _insert_allocs(self, allocs: Iterable[Allocation], idx: int,
+                       copy: bool = True) -> None:
         table = dict(self._allocs)
         by_node = dict(self._allocs_by_node)
         by_job = dict(self._allocs_by_job)
@@ -267,39 +268,46 @@ class StateStore:
         # plan for one job would otherwise copy the job bucket 10k times).
         fresh_node: set = set()
         fresh_job: set = set()
-
-        def node_bucket(nid):
-            if nid not in fresh_node:
-                by_node[nid] = dict(by_node.get(nid, {}))
-                fresh_node.add(nid)
-            return by_node[nid]
-
-        def job_bucket(key):
-            if key not in fresh_job:
-                by_job[key] = dict(by_job.get(key, {}))
-                fresh_job.add(key)
-            return by_job[key]
-
+        fn_add = fresh_node.add
+        fj_add = fresh_job.add
+        table_get = table.get
         inserted = []
+        ins_append = inserted.append
         for a in allocs:
-            prev = table.get(a.id)
-            a = a.copy_skip_job()   # embedded job pointer shared by design
+            aid = a.id
+            prev = table_get(aid)
+            if copy:
+                a = a.copy_skip_job()   # embedded job ptr shared by design
             a.create_index = prev.create_index if prev else idx
             a.modify_index = idx
             if prev is not None and a.job is None:
                 a.job = prev.job
-            table[a.id] = a
-            if prev is not None and prev.node_id and prev.node_id != a.node_id:
-                node_bucket(prev.node_id).pop(a.id, None)
-            if a.node_id:
-                node_bucket(a.node_id)[a.id] = a
-            job_bucket((a.namespace, a.job_id))[a.id] = a
-            inserted.append(a)
+            table[aid] = a
+            nid = a.node_id
+            if prev is not None and prev.node_id and prev.node_id != nid:
+                pnid = prev.node_id
+                if pnid not in fresh_node:
+                    by_node[pnid] = dict(by_node.get(pnid, {}))
+                    fn_add(pnid)
+                by_node[pnid].pop(aid, None)
+            if nid:
+                if nid not in fresh_node:
+                    by_node[nid] = dict(by_node.get(nid, {}))
+                    fn_add(nid)
+                by_node[nid][aid] = a
+            jkey = (a.namespace, a.job_id)
+            if jkey not in fresh_job:
+                by_job[jkey] = dict(by_job.get(jkey, {}))
+                fj_add(jkey)
+            by_job[jkey][aid] = a
+            ins_append(a)
         self._allocs = table
         self._allocs_by_node = by_node
         self._allocs_by_job = by_job
-        for a in inserted:
-            self._emit("Allocation", idx, a)
+        # one event per transaction, not per alloc: a 100k-alloc plan fires
+        # one list-payload event (subscribers loop internally, vectorized)
+        if inserted:
+            self._emit("Allocations", idx, inserted)
 
     def update_allocs_from_client(self, updates: Iterable[Allocation]) -> int:
         """Client-side status updates (reference: FSM AllocClientUpdate):
@@ -352,7 +360,13 @@ class StateStore:
                 allocs.extend(node_allocs)
             for node_allocs in result.node_allocation.values():
                 allocs.extend(node_allocs)
-            self._insert_allocs(allocs, idx)
+            # Ownership transfer, no defensive copy: every alloc in a plan
+            # is freshly constructed (placements) or already a private copy
+            # (stops/updates via copy_skip_job in the scheduler), and by the
+            # go-memdb convention the reference itself relies on, objects
+            # are immutable once inserted (state.UpsertPlanResults stores
+            # the submitted pointers directly).
+            self._insert_allocs(allocs, idx, copy=False)
             if result.deployment is not None:
                 dep = result.deployment.copy()
                 prev = self._deployments.get(dep.id)

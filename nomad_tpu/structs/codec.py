@@ -126,7 +126,10 @@ def _decode_duration(raw: Any) -> Optional[float]:
     if isinstance(raw, str):
         from nomad_tpu.jobspec.schema import parse_duration
         return parse_duration(raw)
-    # nanosecond int (Go time.Duration wire form)
-    if isinstance(raw, int) and abs(raw) >= 1_000_000:
+    # Go time.Duration marshals to a nanosecond integer — always, even for
+    # sub-millisecond values, so ints convert unconditionally (a 500_000
+    # wire int is 0.5ms, not 500k seconds).  Floats only appear from our
+    # own encoder, which writes seconds.
+    if isinstance(raw, int):
         return raw / 1e9
     return float(raw)

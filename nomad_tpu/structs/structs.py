@@ -110,11 +110,21 @@ MIN_DYNAMIC_PORT = 20000
 MAX_DYNAMIC_PORT = 32000
 
 
+def new_ids(count: int) -> List[str]:
+    """Batch of UUIDv4-shaped random ids: one urandom syscall + one hex
+    conversion for the whole batch (a 100k-alloc plan mints 100k ids;
+    os.urandom + slicing is ~3x faster than uuid.uuid4())."""
+    h = os.urandom(16 * count).hex()
+    out: List[str] = []
+    append = out.append
+    for i in range(0, 32 * count, 32):
+        s = h[i:i + 32]
+        append(f"{s[:8]}-{s[8:12]}-4{s[13:16]}-{s[16:20]}-{s[20:]}")
+    return out
+
+
 def new_id() -> str:
-    """UUIDv4-shaped random id; os.urandom + slicing is ~3x faster than
-    uuid.uuid4() and ids are minted per alloc on the placement hot path."""
-    h = os.urandom(16).hex()
-    return f"{h[:8]}-{h[8:12]}-4{h[13:16]}-{h[16:20]}-{h[20:]}"
+    return new_ids(1)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -535,6 +545,21 @@ class AllocMetric:
     allocation_time_ns: int = 0
     coalesced_failures: int = 0
 
+    def copy(self) -> "AllocMetric":
+        """The ONE metric copy path (alloc cloning, bulk-round failure
+        accounting): every mutable container gets its own instance so
+        later in-place writes never bleed across shared metrics."""
+        nm = AllocMetric.__new__(AllocMetric)
+        nm.__dict__ = dict(self.__dict__)
+        nm.nodes_available = dict(self.nodes_available)
+        nm.class_filtered = dict(self.class_filtered)
+        nm.constraint_filtered = dict(self.constraint_filtered)
+        nm.class_exhausted = dict(self.class_exhausted)
+        nm.dimension_exhausted = dict(self.dimension_exhausted)
+        nm.quota_exhausted = list(self.quota_exhausted)
+        nm.score_meta_data = list(self.score_meta_data)
+        return nm
+
     def exhausted_node(self, dimension: str) -> None:
         self.nodes_exhausted += 1
         if dimension:
@@ -723,17 +748,7 @@ class Allocation:
             d["reschedule_tracker"] = RescheduleTracker(
                 events=list(self.reschedule_tracker.events))
         d["preempted_allocations"] = list(self.preempted_allocations)
-        m = self.metrics
-        nm = AllocMetric.__new__(AllocMetric)
-        nm.__dict__ = dict(m.__dict__)
-        nm.nodes_available = dict(m.nodes_available)
-        nm.class_filtered = dict(m.class_filtered)
-        nm.constraint_filtered = dict(m.constraint_filtered)
-        nm.class_exhausted = dict(m.class_exhausted)
-        nm.dimension_exhausted = dict(m.dimension_exhausted)
-        nm.quota_exhausted = list(m.quota_exhausted)
-        nm.score_meta_data = list(m.score_meta_data)
-        d["metrics"] = nm
+        d["metrics"] = self.metrics.copy()
         return out
 
 

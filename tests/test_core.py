@@ -253,6 +253,56 @@ class TestServer:
             s.shutdown()
 
 
+class TestFailedEvalFollowUp:
+    def test_failed_eval_creates_delayed_follow_up(self):
+        # reference: leader.go reapFailedEvaluations — a failed eval must
+        # leave a delayed follow-up so its job isn't stranded until the
+        # next unrelated state change.
+        s = Server(dev_mode=True, failed_follow_up_delay=(5.0, 5.0))
+        s.establish_leadership()
+        ev = mock.eval(job_id="j-stranded")
+        ev.status = "failed"
+        ev.status_description = "maximum attempts reached (2)"
+        s.apply_eval_update([ev], now=100.0)
+        snap = s.state.snapshot()
+        fus = [e for e in snap.evals()
+               if e.triggered_by == "failed-follow-up"]
+        assert len(fus) == 1
+        fu = fus[0]
+        assert fu.job_id == "j-stranded"
+        assert fu.previous_eval == ev.id
+        assert fu.wait_until == 105.0
+        assert fu.status == "pending"
+        # held by the broker until its time arrives
+        got, _ = s.eval_broker.dequeue(["service"], now=101.0, timeout=0.0)
+        assert got is None
+        s.eval_broker.tick(106.0)
+        got, _ = s.eval_broker.dequeue(["service"], now=106.0, timeout=0.0)
+        assert got is not None and got.id == fu.id
+        # re-upserting the same failed eval (redelivery) must NOT mint
+        # another follow-up — only the transition to failed does
+        s.apply_eval_update([ev], now=110.0)
+        fus2 = [e for e in s.state.snapshot().evals()
+                if e.triggered_by == "failed-follow-up"]
+        assert len(fus2) == 1
+
+    def test_delivery_limit_failure_reaped_on_tick(self):
+        s = Server(dev_mode=True, failed_follow_up_delay=(5.0, 5.0))
+        s.eval_broker.delivery_limit = 1
+        s.establish_leadership()
+        ev = mock.eval(job_id="j-nacked")
+        s.apply_eval_update([ev], now=100.0)
+        got, tok = s.eval_broker.dequeue(["service"], now=100.0, timeout=0.0)
+        s.eval_broker.nack(got.id, tok, now=100.0)   # limit 1 -> failed
+        s.tick(now=101.0)
+        snap = s.state.snapshot()
+        stored = snap.eval_by_id(ev.id)
+        assert stored.status == "failed"
+        fus = [e for e in snap.evals()
+               if e.triggered_by == "failed-follow-up"]
+        assert len(fus) == 1 and fus[0].previous_eval == ev.id
+
+
 class TestReviewRegressions:
     def test_waiters_released_when_eval_fails(self):
         # An eval hitting the delivery limit must not strand same-job waiters.

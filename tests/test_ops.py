@@ -295,6 +295,63 @@ class TestReviewRegressions:
         assert d.node_id is None
         assert d.metric.dimension_exhausted.get("cpu", 0) > 0
 
+    def test_tiebreak_seed_diversifies_equal_nodes(self):
+        # Equal-score nodes must be picked differently by different eval
+        # seeds (the reference's shuffled-node-order analog) or concurrent
+        # workers collide on identical nodes and refute each other's plans.
+        h = Harness()
+        for _ in range(32):
+            h.state.upsert_node(mock.node())
+        job = mock.batch_job()
+        h.state.upsert_job(job)
+        snap = h.snapshot()
+        eng = PlacementEngine()
+        tg = job.task_groups[0]
+        reqs = [PlacementRequest(tg_name=tg.name)]
+        picks = {eng.place(snap, job, [tg], reqs, seed=s)[0].node_id
+                 for s in (1, 2, 3, 4, 5, 6)}
+        assert len(picks) > 1, "seeds did not diversify tie-break"
+        # seed 0 stays deterministic
+        a = eng.place(snap, job, [tg], reqs, seed=0)[0].node_id
+        b = eng.place(snap, job, [tg], reqs, seed=0)[0].node_id
+        assert a == b
+
+    def test_used_delta_replay_concurrent_with_alloc_events(self):
+        # Applier-thread alloc events racing a worker's device `used` sync
+        # must neither skip nor double-apply deltas: the engine holds the
+        # packer lock across read-version -> fetch-deltas -> commit.
+        import threading
+
+        h = Harness()
+        nodes = [mock.node() for _ in range(16)]
+        for n in nodes:
+            h.state.upsert_node(n)
+        eng = PlacementEngine()
+        eng.packer.attach(h.state)
+        eng.packer.update(h.snapshot())
+        job = mock.job()
+        errors = []
+
+        def writer():
+            try:
+                # > the 256-entry replay window, so the trimmed-window
+                # full-re-upload path races too
+                for i in range(300):
+                    a = mock.alloc(job=job, node_id=nodes[i % 16].id)
+                    h.state.upsert_allocs([a])
+            except Exception as e:  # pragma: no cover - fail loudly below
+                errors.append(e)
+
+        th = threading.Thread(target=writer)
+        th.start()
+        while th.is_alive():
+            eng._used_device(eng.packer._tensors)
+        th.join()
+        assert not errors
+        t = eng.packer._tensors
+        dev = np.asarray(eng._used_device(t))
+        assert (dev == t.used).all()
+
     def test_distinct_property_enforced(self):
         # 4 nodes in 2 racks; distinct_property on meta.rack with limit 1
         # must place at most one alloc per rack.
