@@ -38,6 +38,7 @@ from nomad_tpu.structs import (
 )
 
 from .blocked_evals import BlockedEvals
+from .deployment_watcher import DeploymentWatcher
 from .eval_broker import EvalBroker
 from .heartbeat import HeartbeatTimers, build_node_evals, invalidate_heartbeat
 from .plan_apply import PlanApplier, PlanQueue
@@ -54,6 +55,7 @@ class Server:
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.state, self.plan_queue)
         self.heartbeats = HeartbeatTimers(ttl=heartbeat_ttl)
+        self.deployments = DeploymentWatcher(self)
         self.engine = PlacementEngine()
         self.engine.packer.attach(self.state)
         self.dev_mode = dev_mode
@@ -308,6 +310,7 @@ class Server:
         for node_id in self.heartbeats.expired(t):
             evals = invalidate_heartbeat(self.state, node_id, t)
             self.apply_eval_update(evals, now=t)
+        self.deployments.tick(t)
 
     # ---------------------------------------------------------- dev drive
 

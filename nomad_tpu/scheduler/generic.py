@@ -147,6 +147,13 @@ class GenericScheduler(Scheduler):
             upd = a.copy_skip_job()
             upd.job = job
             upd.job_version = job.version
+            if results.deployment is not None:
+                # a running alloc updated in place (count/meta change)
+                # joins the deployment already healthy — its tasks never
+                # restarted, so there is nothing to re-check, and without
+                # this the deployment's desired_total can never be met
+                upd.deployment_id = results.deployment.id
+                upd.deployment_status = {"healthy": True, "ts": self.now}
             plan.append_alloc(upd)
 
         # ---- destructive updates: stop old + place new ----
@@ -297,6 +304,10 @@ class GenericScheduler(Scheduler):
                 alloc.preempted_allocations = [v.id for v in d.evictions]
             if results.deployment is not None:
                 alloc.deployment_id = results.deployment.id
+                if p.canary:
+                    dstate = results.deployment.task_groups.get(tg.name)
+                    if dstate is not None:
+                        dstate.placed_canaries.append(alloc.id)
             if p.previous_alloc is not None:
                 alloc.previous_allocation = p.previous_alloc.id
                 if p.reschedule:
@@ -387,6 +398,10 @@ class GenericScheduler(Scheduler):
                 for victim in ev:
                     plan.append_preempted_alloc(victim, alloc.id)
                 d2["preempted_allocations"] = [v.id for v in ev]
+            if p.canary and results.deployment is not None:
+                dstate = results.deployment.task_groups.get(tg.name)
+                if dstate is not None:
+                    dstate.placed_canaries.append(alloc.id)
             if p.previous_alloc is not None:
                 d2["previous_allocation"] = p.previous_alloc.id
                 if p.reschedule:

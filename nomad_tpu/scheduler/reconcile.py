@@ -21,6 +21,8 @@ from nomad_tpu.structs import (
     ALLOC_CLIENT_LOST,
     ALLOC_DESIRED_STOP,
     Allocation,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
     Deployment,
     DeploymentState,
     DeploymentStatusUpdate,
@@ -103,6 +105,22 @@ def reconcile(job: Optional[Job],
     """
     r = ReconcileResults()
 
+    # a still-active deployment that no longer matches the job (version
+    # superseded, job stopped/deregistered) is cancelled unconditionally —
+    # not only when the successor creates its own deployment (reference:
+    # reconcile.go cancelUnneededDeployments)
+    if (existing_deployment is not None and existing_deployment.active()
+            and (job is None or job_stopped
+                 or existing_deployment.job_version != job.version)):
+        r.deployment_updates.append(DeploymentStatusUpdate(
+            deployment_id=existing_deployment.id,
+            status=DEPLOYMENT_STATUS_CANCELLED,
+            status_description=(
+                "cancelled because job is no longer the same version"
+                if job is not None and not job_stopped
+                else "cancelled because job is stopped"),
+        ))
+
     live = [a for a in allocs if not a.terminal_status()]
     if job is None or job_stopped:
         for a in live:
@@ -135,6 +153,56 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
                      deployment: Optional[Deployment]) -> None:
     du = DesiredUpdates()
     r.desired_tg_updates[tg.name] = du
+
+    # ---- deployment context for this job version / group ----
+    update = tg.update or job.update
+    dstate = None
+    dep_failed_version = False
+    dep_concluded_version = False
+    if (deployment is not None and deployment.job_version == job.version
+            and job.type == "service"):
+        if deployment.active():
+            dstate = deployment.task_groups.get(tg.name)
+        else:
+            # this version's deployment already concluded — replacements
+            # and reschedules must not mint a fresh one (a node failure
+            # would otherwise restart deployment tracking and, worse,
+            # progress-deadline-fail + auto-revert a healthy job)
+            dep_concluded_version = True
+            if deployment.status == DEPLOYMENT_STATUS_FAILED:
+                # failed additionally halts further rollout; recovery is
+                # job revert / new version (reference: reconcile.go
+                # deploymentFailed handling)
+                dep_failed_version = True
+    promoted = dstate.promoted if dstate is not None else False
+    canary_ids = set(dstate.placed_canaries) if dstate is not None else set()
+
+    # unpromoted canaries are supernumerary: they run ALONGSIDE the old
+    # version and stay out of ALL slot-count math (including the
+    # lost/failed buckets) until promotion; dead/lost canaries are
+    # refilled by the canary placement below, not by regular replacement
+    canaries_live: List[Allocation] = []
+    if canary_ids and not promoted:
+        remaining: List[Allocation] = []
+        for a in allocs:
+            if a.id not in canary_ids:
+                remaining.append(a)
+                continue
+            if a.desired_status != "run" or a.client_terminal_status():
+                continue
+            if a.node_id in tainted:
+                node = tainted[a.node_id]
+                du.stop += 1
+                if node is None or node.status in ("down", "disconnected"):
+                    r.stop.append(StopRequest(
+                        a, ALLOC_LOST, client_status=ALLOC_CLIENT_LOST))
+                else:
+                    r.stop.append(StopRequest(a, ALLOC_MIGRATING))
+                continue
+            if a.client_status == ALLOC_CLIENT_FAILED:
+                continue
+            canaries_live.append(a)
+        allocs = remaining
 
     untainted: List[Allocation] = []
     migrate: List[Allocation] = []
@@ -200,13 +268,17 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
             r.ignore.append(a)
             failed_holding_slot.append(a)
 
-    # ---- count management: stop excess (highest indexes) BEFORE the
-    # update split, so a count decrease can shed old-version allocs too ----
+    # ---- count management: stop excess BEFORE the update split, so a
+    # count decrease can shed old-version allocs too.  Old-version allocs
+    # stop first (that is the post-promotion rollover), then highest
+    # name-indexes ----
     n_replacements = len(lost) + len(migrate) + len(reschedule_now)
     needed = (tg.count - len(untainted) - len(done_batch)
               - len(failed_holding_slot) - n_replacements)
     if needed < 0:
-        excess = sorted(untainted, key=lambda a: a.index(), reverse=True)
+        excess = sorted(untainted, key=lambda a: (
+            a.job is not None and a.job_version != job.version, a.index()),
+            reverse=True)
         to_stop = excess[:-needed]
         for a in to_stop:
             du.stop += 1
@@ -228,10 +300,28 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
         else:
             current.append(a)
 
+    canaries_desired = (update.canary
+                        if (update is not None and not is_batch
+                            and job.type == "service") else 0)
+    canarying = (canaries_desired > 0 and bool(destructive) and not promoted
+                 and not dep_failed_version)
+
     limit = len(destructive)
-    update = tg.update or job.update
     if update is not None and update.max_parallel > 0 and not is_batch:
         limit = min(limit, update.max_parallel)
+        if dstate is not None and deployment is not None:
+            # health-gated rolling: new-version allocs placed by this
+            # deployment but not yet healthy consume max_parallel slots,
+            # so the next wave waits for the previous one's health
+            inflight = sum(
+                1 for a in current
+                if a.deployment_id == deployment.id
+                and not (a.deployment_status or {}).get("healthy"))
+            limit = max(0, limit - inflight)
+    if canarying or dep_failed_version:
+        # while canarying (or after this version's deployment failed) the
+        # old version keeps running untouched
+        limit = 0
     for a in destructive[:limit]:
         du.destructive_update += 1
         r.destructive_update.append(a)
@@ -246,9 +336,14 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
     # destructively — the destructive replacement reuses the name/index)
     keep = current + inplace + destructive
 
-    # ---- place: replacements first (carry prev alloc), then new slots ----
-    indexes = free_indexes(keep + done_batch + failed_holding_slot, tg.count,
-                           extra=n_replacements + max(needed, 0))
+    # ---- place: replacements first (carry prev alloc), then new slots,
+    # then canaries — ONE shared index sequence so a replacement and a
+    # canary minted in the same reconcile can't collide on a name ----
+    n_canary_place = (max(0, canaries_desired - len(canaries_live))
+                      if canarying else 0)
+    indexes = free_indexes(
+        keep + done_batch + failed_holding_slot + canaries_live, tg.count,
+        extra=n_replacements + max(needed, 0) + n_canary_place)
     ptr = 0
 
     for a in lost + migrate:
@@ -269,21 +364,35 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
         ptr += 1
         du.place += 1
 
+    # missing canaries ride alongside the old version until promotion
+    for _ in range(n_canary_place):
+        r.place.append(PlaceRequest(
+            tg=tg, name=_name(job, tg, indexes[ptr]), index=indexes[ptr],
+            canary=True))
+        ptr += 1
+        du.canary += 1
+
     # kept-current allocs are untouched
-    du.ignore += len(current)
+    du.ignore += len(current) + len(canaries_live)
     r.ignore.extend(current)
+    r.ignore.extend(canaries_live)
 
     # ---- deployment bookkeeping (service jobs with update stanza) ----
     # Accumulate onto the deployment the previous task group created this
     # reconcile, so multi-group jobs share one deployment object.
-    if (not is_batch and update is not None
-            and (r.place or r.destructive_update)
+    if (not is_batch and update is not None and not dep_failed_version
+            and (r.place or r.destructive_update or canarying)
             and job.type == "service"):
         dep = r.deployment
         if dep is None:
             dep = deployment
             if (dep is None or dep.job_version != job.version
                     or not dep.active()):
+                if dep_concluded_version or job.stable:
+                    # this version already concluded a deployment (or was
+                    # marked stable by one): replacements/reschedules do
+                    # not restart deployment tracking
+                    return
                 dep = Deployment(
                     namespace=job.namespace, job_id=job.id,
                     job_version=job.version,
@@ -295,7 +404,7 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
             auto_promote=update.auto_promote,
             progress_deadline_s=update.progress_deadline_s)
         state.desired_total = tg.count
-        state.desired_canaries = update.canary
+        state.desired_canaries = canaries_desired
         dep.task_groups[tg.name] = state
         r.deployment = dep
 

@@ -177,16 +177,26 @@ class StateStore:
 
     # ---------------------------------------------------------------- jobs
 
-    def upsert_job(self, job: Job) -> int:
+    def upsert_job(self, job: Job, preserve_version: bool = False) -> int:
+        """`preserve_version=True` updates the job in place without minting
+        a new version (deployment watcher marking a version stable)."""
         with self._lock:
             idx = self._bump()
             key = job.ns_id()
             prev = self._jobs.get(key)
             job = job.copy()
+            # canonicalize: a job-level update stanza applies to every task
+            # group without its own (reference: jobspec canonicalization) —
+            # the client health hook reads tg.update
+            if job.update is not None:
+                for tg in job.task_groups:
+                    if tg.update is None:
+                        tg.update = job.update
             job.create_index = prev.create_index if prev else idx
             job.modify_index = idx
             job.job_modify_index = idx
-            if prev is not None and prev.version >= job.version:
+            if (not preserve_version and prev is not None
+                    and prev.version >= job.version):
                 job.version = prev.version + 1
             job.status = _job_initial_status(job)
             self._jobs = {**self._jobs, key: job}
@@ -458,6 +468,22 @@ class StateStore:
 
     def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
         return list(self._allocs_by_job.get((namespace, job_id), {}).values())
+
+    def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
+        return self._deployments.get(dep_id)
+
+    def latest_deployment_by_job(self, namespace: str, job_id: str
+                                 ) -> Optional[Deployment]:
+        best = None
+        for d in self._deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def job_by_id_and_version(self, namespace: str, job_id: str,
+                              version: int) -> Optional[Job]:
+        return self._job_versions.get((namespace, job_id), {}).get(version)
 
 
 class StateSnapshot:

@@ -480,6 +480,7 @@ class Job:
     meta: Dict[str, str] = field(default_factory=dict)
     status: str = JOB_STATUS_PENDING
     stop: bool = False
+    stable: bool = False     # this version completed a successful deployment
     version: int = 0
     create_index: int = 0
     modify_index: int = 0
