@@ -83,15 +83,21 @@ class AllocRunner:
     def _on_task_change(self, runner: TaskRunner) -> None:
         with self._lock:
             self.alloc.task_states[runner.task.name] = runner.state
-            self._recompute_status()
+            terminal = self._recompute_status()
         if self.on_update:
             self.on_update(self)
+        if terminal:
+            # set AFTER on_update: a wait()-er acting on "idle" must see
+            # the terminal status already queued for sync, or a final
+            # sync ships a stale running/pending status
+            self._done.set()
 
-    def _recompute_status(self) -> None:
-        """reference: alloc_runner.go clientStatus derivation."""
+    def _recompute_status(self) -> bool:
+        """reference: alloc_runner.go clientStatus derivation.
+        Returns True when the alloc reached a terminal client status."""
         states = [tr.state for tr in self.task_runners]
         if not states:
-            return
+            return False
         if any(s.state == TASK_STATE_DEAD and s.failed for s in states):
             self.alloc.client_status = ALLOC_CLIENT_FAILED
         elif all(s.state == TASK_STATE_DEAD for s in states):
@@ -100,9 +106,8 @@ class AllocRunner:
             self.alloc.client_status = ALLOC_CLIENT_RUNNING
         else:
             self.alloc.client_status = ALLOC_CLIENT_PENDING
-        if self.alloc.client_status in (ALLOC_CLIENT_FAILED,
-                                        ALLOC_CLIENT_COMPLETE):
-            self._done.set()
+        return self.alloc.client_status in (ALLOC_CLIENT_FAILED,
+                                            ALLOC_CLIENT_COMPLETE)
 
     def client_update(self):
         """Consistent copy of (client_status, deployment_status,

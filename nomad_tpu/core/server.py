@@ -33,13 +33,16 @@ from nomad_tpu.structs import (
     TRIGGER_ALLOC_STOP,
     TRIGGER_JOB_DEREGISTER,
     TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_DRAIN,
     TRIGGER_NODE_UPDATE,
     new_id,
 )
 
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
+from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
+from .periodic import PeriodicDispatch, dispatch_job
 from .heartbeat import HeartbeatTimers, build_node_evals, invalidate_heartbeat
 from .plan_apply import PlanApplier, PlanQueue
 from .worker import Worker
@@ -56,6 +59,8 @@ class Server:
         self.plan_applier = PlanApplier(self.state, self.plan_queue)
         self.heartbeats = HeartbeatTimers(ttl=heartbeat_ttl)
         self.deployments = DeploymentWatcher(self)
+        self.drainer = NodeDrainer(self)
+        self.periodic = PeriodicDispatch(self)
         self.engine = PlacementEngine()
         self.engine.packer.attach(self.state)
         self.dev_mode = dev_mode
@@ -86,6 +91,10 @@ class Server:
             elif ev.status == EVAL_STATUS_BLOCKED:
                 if not self.blocked_evals.block(ev):
                     self._cancel_eval(ev)
+        # restore periodic launch tracking (reference: restorePeriodicDispatch)
+        for j in snap.jobs():
+            if j.periodic is not None:
+                self.periodic.add(j, now=now)
 
     def start(self, tick_interval: float = 1.0) -> None:
         """Threaded mode: start applier + workers + the tick loop that
@@ -127,11 +136,24 @@ class Server:
 
     # ------------------------------------------------------- job endpoint
 
-    def register_job(self, job: Job, now: Optional[float] = None) -> Evaluation:
-        """reference: Job.Register RPC — upsert + eval create + enqueue."""
+    def register_job(self, job: Job,
+                     now: Optional[float] = None) -> Optional[Evaluation]:
+        """reference: Job.Register RPC — upsert + eval create + enqueue.
+        Periodic and parameterized PARENTS are never scheduled directly:
+        they get no eval; the dispatcher launches child jobs."""
         t = now if now is not None else time.time()
+        if job.periodic is not None and job.periodic.enabled:
+            # validate the cron spec BEFORE persisting: a bad spec must
+            # reject the registration, not leave an untracked parent
+            from .periodic import CronSpec
+            CronSpec(job.periodic.spec)
         self.state.upsert_job(job)
         stored = self.state.job_by_id(job.namespace, job.id)
+        if stored.periodic is not None:
+            self.periodic.add(stored, now=t)
+            return None
+        if stored.parameterized is not None:
+            return None
         ev = Evaluation(
             namespace=job.namespace,
             priority=stored.priority,
@@ -142,6 +164,13 @@ class Server:
         )
         self.apply_eval_update([ev], now=t)
         return ev
+
+    def dispatch_job(self, namespace: str, job_id: str, payload: bytes = b"",
+                     meta: Optional[Dict[str, str]] = None,
+                     now: Optional[float] = None):
+        """reference: Job.Dispatch RPC — mint a child of a parameterized
+        job with payload/meta merged in.  Returns (child_job, error)."""
+        return dispatch_job(self, namespace, job_id, payload, meta, now=now)
 
     def deregister_job(self, namespace: str, job_id: str,
                        purge: bool = False,
@@ -156,6 +185,7 @@ class Server:
         if purge:
             self.state.delete_job(namespace, job_id)
         self.blocked_evals.untrack(namespace, job_id)
+        self.periodic.remove(namespace, job_id)
         ev = Evaluation(
             namespace=namespace,
             priority=job.priority,
@@ -187,6 +217,45 @@ class Server:
             evals = build_node_evals(self.state.snapshot(), node_id)
         self.apply_eval_update(evals, now=t)
         return evals
+
+    def drain_node(self, node_id: str, strategy,
+                   now: Optional[float] = None) -> None:
+        """Start or cancel (strategy=None) a node drain
+        (reference: Node.UpdateDrain RPC → nomad/drainer/)."""
+        self.drainer.drain_node(node_id, strategy, now=now)
+
+    def set_node_eligibility(self, node_id: str, eligible: bool) -> None:
+        """reference: Node.UpdateEligibility RPC."""
+        self.state.update_node_eligibility(
+            node_id, "eligible" if eligible else "ineligible")
+
+    def update_alloc_desired_transition(self, alloc_ids, transition,
+                                        now: Optional[float] = None) -> None:
+        """Flag allocs for migration and re-evaluate their jobs
+        (reference: Alloc.UpdateDesiredTransition RPC)."""
+        t = now if now is not None else time.time()
+        self.state.update_alloc_desired_transition(alloc_ids, transition)
+        evals: List[Evaluation] = []
+        seen = set()
+        for aid in alloc_ids:
+            a = self.state.alloc_by_id(aid)
+            if a is None:
+                continue
+            key = (a.namespace, a.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = self.state.job_by_id(a.namespace, a.job_id)
+            if job is None:
+                continue
+            evals.append(Evaluation(
+                namespace=a.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=TRIGGER_NODE_DRAIN,
+                job_id=a.job_id,
+            ))
+        self.apply_eval_update(evals, now=t)
 
     def get_client_allocs(self, node_id: str, min_index: int,
                           timeout: float = 5.0):
@@ -311,6 +380,8 @@ class Server:
             evals = invalidate_heartbeat(self.state, node_id, t)
             self.apply_eval_update(evals, now=t)
         self.deployments.tick(t)
+        self.drainer.tick(t)
+        self.periodic.tick(t)
 
     # ---------------------------------------------------------- dev drive
 

@@ -192,12 +192,17 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
                 continue
             if a.node_id in tainted:
                 node = tainted[a.node_id]
-                du.stop += 1
                 if node is None or node.status in ("down", "disconnected"):
+                    du.stop += 1
                     r.stop.append(StopRequest(
                         a, ALLOC_LOST, client_status=ALLOC_CLIENT_LOST))
-                else:
+                elif a.desired_transition.migrate:
+                    # draining canaries follow the same drainer-flagged
+                    # batching as regular allocs
+                    du.migrate += 1
                     r.stop.append(StopRequest(a, ALLOC_MIGRATING))
+                else:
+                    canaries_live.append(a)
                 continue
             if a.client_status == ALLOC_CLIENT_FAILED:
                 continue
@@ -222,7 +227,13 @@ def _reconcile_group(r: ReconcileResults, job: Job, tg: TaskGroup,
             else:  # draining
                 if a.client_terminal_status():
                     continue
-                migrate.append(a)
+                if a.desired_transition.migrate:
+                    migrate.append(a)
+                else:
+                    # the drainer releases allocs in migrate.max_parallel
+                    # batches by flagging DesiredTransition.migrate; until
+                    # then the alloc keeps running on the draining node
+                    untainted.append(a)
             continue
         if a.client_status == ALLOC_CLIENT_FAILED:
             failed.append(a)

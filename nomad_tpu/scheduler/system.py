@@ -89,11 +89,24 @@ class SystemScheduler(Scheduler):
                 if node is None or node.status in ("down", "disconnected"):
                     plan.append_stopped_alloc(a, ALLOC_LOST,
                                               client_status=ALLOC_CLIENT_LOST)
-                else:
+                elif a.desired_transition.migrate:
+                    # draining system allocs wait for the drainer to flag
+                    # them (they drain LAST, after the node's service
+                    # allocs are gone)
                     plan.append_stopped_alloc(a, ALLOC_NOT_NEEDED)
                 continue
             if a.node_id not in all_eligible:
-                plan.append_stopped_alloc(a, ALLOC_NOT_NEEDED)
+                # stop only when the node left the job's placement domain;
+                # a merely-ineligible node (drain finished with
+                # ignore_system_jobs, manual eligibility -disable) keeps
+                # its system allocs running
+                node = state.node_by_id(a.node_id)
+                if node is None:
+                    plan.append_stopped_alloc(a, ALLOC_LOST,
+                                              client_status=ALLOC_CLIENT_LOST)
+                elif (node.datacenter not in job.datacenters
+                        or node.node_pool != job.node_pool):
+                    plan.append_stopped_alloc(a, ALLOC_NOT_NEEDED)
 
         # device feasibility over all nodes x TGs
         if nodes:

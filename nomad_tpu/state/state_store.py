@@ -24,6 +24,7 @@ from nomad_tpu.structs import (
     Allocation,
     CSIVolume,
     Deployment,
+    DesiredTransition,
     Evaluation,
     Job,
     JOB_STATUS_DEAD,
@@ -340,6 +341,28 @@ class StateStore:
                 a.modify_time = u.modify_time
                 merged.append(a)
             self._insert_allocs(merged, idx)
+            return idx
+
+    def update_alloc_desired_transition(self, alloc_ids: Iterable[str],
+                                        transition) -> int:
+        """Set DesiredTransition on a batch of allocs (reference: RPC
+        Alloc.UpdateDesiredTransition — the drainer's lever: the reconciler
+        only migrates draining-node allocs the drainer has flagged)."""
+        with self._lock:
+            idx = self._bump()
+            merged = []
+            for aid in alloc_ids:
+                cur = self._allocs.get(aid)
+                if cur is None:
+                    continue
+                a = cur.copy_skip_job()
+                a.desired_transition = DesiredTransition(
+                    migrate=transition.migrate,
+                    reschedule=transition.reschedule,
+                    force_reschedule=transition.force_reschedule,
+                    no_shutdown_delay=transition.no_shutdown_delay)
+                merged.append(a)
+            self._insert_allocs(merged, idx, copy=False)
             return idx
 
     # --------------------------------------------------------- deployments

@@ -1,0 +1,157 @@
+"""Node drain orchestration (reference: nomad/drainer/): batched release
+via migrate.max_parallel, system-jobs-last ordering, deadline forcing,
+drain completion."""
+
+from nomad_tpu import mock
+from nomad_tpu.core import Server
+from nomad_tpu.structs import DrainStrategy, MigrateStrategy
+
+NOW = 1000.0
+
+
+def _setup(n_nodes=4, count=4, max_parallel=1):
+    s = Server(dev_mode=True)
+    s.establish_leadership()
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        s.register_node(n, now=NOW)
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=max_parallel)
+    s.register_job(job, now=NOW)
+    s.process_all(now=NOW)
+    return s, nodes, job
+
+
+def _live_on(s, job, node_id):
+    return [a for a in s.state.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status() and a.node_id == node_id
+            and a.desired_status == "run"]
+
+
+def _finish_stops(s, job, now):
+    """Simulate clients completing stopped allocs (client_status=complete)."""
+    ups = []
+    for a in s.state.allocs_by_job(job.namespace, job.id):
+        if a.desired_status != "run" and not a.client_terminal_status():
+            u = a.copy_skip_job()
+            u.client_status = "complete"
+            ups.append(u)
+    if ups:
+        s.state.update_allocs_from_client(ups)
+
+
+class TestDrainBatching:
+    def test_drain_releases_in_max_parallel_batches(self):
+        s, nodes, job = _setup(n_nodes=4, count=4, max_parallel=1)
+        # concentrate: find a node with >= 2 allocs, else drain the busiest
+        by_node = {}
+        for a in s.state.allocs_by_job(job.namespace, job.id):
+            by_node.setdefault(a.node_id, []).append(a)
+        victim = max(by_node, key=lambda k: len(by_node[k]))
+        n_victim = len(by_node[victim])
+        if n_victim < 2:
+            # binpack normally stacks all four on one node; guard anyway
+            assert n_victim >= 1
+
+        s.drain_node(victim, DrainStrategy(deadline_s=3600), now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        migrating = [a for a in s.state.allocs_by_job(job.namespace, job.id)
+                     if a.desired_status != "run"
+                     and not a.client_terminal_status()]
+        assert len(migrating) == 1, \
+            "only max_parallel=1 alloc released per batch"
+
+        # old copy finishes -> next tick releases the next one
+        _finish_stops(s, job, NOW + 2)
+        s.tick(now=NOW + 2)
+        s.process_all(now=NOW + 2)
+        if n_victim >= 2:
+            migrating = [a for a in
+                         s.state.allocs_by_job(job.namespace, job.id)
+                         if a.desired_status != "run"
+                         and not a.client_terminal_status()]
+            assert len(migrating) == 1
+
+        # drive to completion
+        for i in range(3, 20):
+            _finish_stops(s, job, NOW + i)
+            s.tick(now=NOW + i)
+            s.process_all(now=NOW + i)
+            if not _live_on(s, job, victim):
+                break
+        assert not _live_on(s, job, victim)
+        node = s.state.node_by_id(victim)
+        assert node.drain is None, "drain cleared on completion"
+        assert node.scheduling_eligibility == "ineligible"
+        live = [a for a in s.state.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status() and a.desired_status == "run"]
+        assert len(live) == 4, "all allocs migrated elsewhere"
+        assert all(a.node_id != victim for a in live)
+
+    def test_deadline_forces_all_remaining(self):
+        s, nodes, job = _setup(n_nodes=4, count=4, max_parallel=1)
+        by_node = {}
+        for a in s.state.allocs_by_job(job.namespace, job.id):
+            by_node.setdefault(a.node_id, []).append(a)
+        victim = max(by_node, key=lambda k: len(by_node[k]))
+        s.drain_node(victim, DrainStrategy(deadline_s=10), now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        # past the deadline: everything left on the node is released
+        s.tick(now=NOW + 20)
+        s.process_all(now=NOW + 20)
+        assert not _live_on(s, job, victim)
+
+    def test_system_allocs_drain_last(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        nodes = [mock.node() for _ in range(2)]
+        for n in nodes:
+            s.register_node(n, now=NOW)
+        sysjob = mock.system_job()
+        s.register_job(sysjob, now=NOW)
+        svc = mock.job()
+        svc.task_groups[0].count = 1
+        svc.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+        s.register_job(svc, now=NOW)
+        s.process_all(now=NOW)
+
+        victim = next(a.node_id for a in
+                      s.state.allocs_by_job(svc.namespace, svc.id))
+        s.drain_node(victim, DrainStrategy(deadline_s=3600), now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        # system alloc still running while the service alloc migrates
+        assert _live_on(s, sysjob, victim), "system alloc drains last"
+
+        _finish_stops(s, svc, NOW + 2)
+        s.tick(now=NOW + 2)
+        s.process_all(now=NOW + 2)
+        assert not _live_on(s, sysjob, victim), \
+            "system alloc released once service allocs are gone"
+
+    def test_ignore_system_jobs(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        for _ in range(2):
+            s.register_node(mock.node(), now=NOW)
+        sysjob = mock.system_job()
+        s.register_job(sysjob, now=NOW)
+        s.process_all(now=NOW)
+        victim = next(a.node_id for a in
+                      s.state.allocs_by_job(sysjob.namespace, sysjob.id))
+        s.drain_node(victim,
+                     DrainStrategy(deadline_s=3600, ignore_system_jobs=True),
+                     now=NOW + 1)
+        s.tick(now=NOW + 2)
+        s.process_all(now=NOW + 2)
+        assert _live_on(s, sysjob, victim), "ignored system alloc untouched"
+        # drain still completes (nothing else drainable)
+        assert s.state.node_by_id(victim).drain is None
+        # a later system eval must NOT stop the preserved alloc just
+        # because the drained node is now merely ineligible
+        s.apply_eval_update(
+            [mock.eval(job_id=sysjob.id, type="system",
+                       triggered_by="node-update")], now=NOW + 3)
+        s.process_all(now=NOW + 3)
+        assert _live_on(s, sysjob, victim), \
+            "system alloc survives evals on the ineligible node"

@@ -128,16 +128,29 @@ class TestServiceScheduler:
         assert placed[0].previous_allocation == stops[0].id
 
     def test_drain_migrates(self):
+        from nomad_tpu.structs import DesiredTransition
         h, nodes = make_harness(3)
         job = mock.job()
         job.task_groups[0].count = 2
         e = register_and_eval(h, job)
         h.process("service", e, now=NOW)
         snap = h.snapshot()
-        victim = next(a.node_id for a in snap.allocs_by_job(job.namespace, job.id))
+        victim_alloc = next(a for a in snap.allocs_by_job(job.namespace, job.id))
+        victim = victim_alloc.node_id
         h.state.update_node_drain(victim, DrainStrategy(deadline_s=3600))
+
+        # an unflagged alloc on a draining node keeps running (the drainer
+        # releases batches by setting DesiredTransition.migrate) — the
+        # eval is a no-op, no plan is submitted
+        n_plans = len(h.plans)
         e2 = mock.eval(job_id=job.id, triggered_by="node-drain")
         h.process("service", e2, now=NOW)
+        assert len(h.plans) == n_plans
+
+        h.state.update_alloc_desired_transition(
+            [victim_alloc.id], DesiredTransition(migrate=True))
+        e3 = mock.eval(job_id=job.id, triggered_by="node-drain")
+        h.process("service", e3, now=NOW)
         plan = h.plans[-1]
         stops = [a for allocs in plan.node_update.values() for a in allocs]
         assert len(stops) == 1

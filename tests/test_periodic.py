@@ -1,0 +1,151 @@
+"""Periodic + parameterized dispatch (reference: nomad/periodic.go,
+Job.Dispatch)."""
+
+import calendar
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core import Server
+from nomad_tpu.core.periodic import CronSpec
+from nomad_tpu.structs import ParameterizedJobConfig, PeriodicConfig
+
+NOW = calendar.timegm((2026, 7, 1, 12, 0, 0))   # Wed Jul 1 2026 12:00 UTC
+
+
+class TestCronSpec:
+    def test_every_minute(self):
+        assert CronSpec("* * * * *").next(NOW) == NOW + 60
+
+    def test_specific_minute(self):
+        # next :30 after 12:00 is 12:30
+        assert CronSpec("30 * * * *").next(NOW) == NOW + 30 * 60
+
+    def test_step(self):
+        assert CronSpec("*/15 * * * *").next(NOW) == NOW + 15 * 60
+
+    def test_daily_shortcut(self):
+        nxt = CronSpec("@daily").next(NOW)
+        tm = time.gmtime(nxt)
+        assert (tm.tm_hour, tm.tm_min, tm.tm_mday) == (0, 0, 2)
+
+    def test_dow(self):
+        # next Sunday (dow 0) after Wed Jul 1 2026 is Jul 5
+        nxt = CronSpec("0 0 * * 0").next(NOW)
+        tm = time.gmtime(nxt)
+        assert tm.tm_mday == 5 and tm.tm_wday == 6   # Python Sunday=6
+
+    def test_bad_spec(self):
+        with pytest.raises(ValueError):
+            CronSpec("* * *")
+
+
+class TestPeriodicDispatch:
+    def _server(self):
+        s = Server(dev_mode=True, heartbeat_ttl=10**9)
+        s.establish_leadership()
+        for _ in range(3):
+            s.register_node(mock.node(), now=NOW)
+        return s
+
+    def test_parent_not_scheduled_child_launched(self):
+        s = self._server()
+        job = mock.batch_job()
+        job.periodic = PeriodicConfig(spec="*/5 * * * *")
+        ev = s.register_job(job, now=NOW)
+        assert ev is None, "periodic parent gets no eval"
+        s.process_all(now=NOW)
+        assert s.state.allocs_by_job(job.namespace, job.id) == []
+
+        s.tick(now=NOW + 5 * 60 + 1)
+        children = [j for j in s.state.snapshot().jobs()
+                    if j.parent_id == job.id]
+        assert len(children) == 1
+        assert children[0].id == f"{job.id}/periodic-{NOW + 5 * 60}"
+        assert children[0].periodic is None
+        s.process_all(now=NOW + 5 * 60 + 1)
+        assert s.state.allocs_by_job(job.namespace, children[0].id)
+
+    def test_prohibit_overlap(self):
+        s = self._server()
+        job = mock.batch_job()
+        job.periodic = PeriodicConfig(spec="* * * * *",
+                                      prohibit_overlap=True)
+        s.register_job(job, now=NOW)
+        s.tick(now=NOW + 61)
+        s.process_all(now=NOW + 61)
+        # first child is still running (allocs pending)
+        s.tick(now=NOW + 121)
+        children = [j for j in s.state.snapshot().jobs()
+                    if j.parent_id == job.id]
+        assert len(children) == 1, "overlapping launch suppressed"
+
+    def test_force_run(self):
+        s = self._server()
+        job = mock.batch_job()
+        job.periodic = PeriodicConfig(spec="0 0 1 1 *")   # yearly
+        s.register_job(job, now=NOW)
+        child = s.periodic.force_run(job.namespace, job.id, now=NOW + 1)
+        assert child is not None and child.parent_id == job.id
+
+    def test_leadership_restores_tracking(self):
+        s = self._server()
+        job = mock.batch_job()
+        job.periodic = PeriodicConfig(spec="*/5 * * * *")
+        s.register_job(job, now=NOW)
+        s2_tracker = s.periodic._tracked
+        assert job.ns_id() in s2_tracker
+        # a fresh leadership pass (e.g. leader flap) re-tracks from state
+        s.periodic._tracked.clear()
+        s.periodic._next.clear()
+        s.establish_leadership()
+        assert job.ns_id() in s.periodic._tracked
+
+
+class TestDispatch:
+    def _server(self):
+        s = Server(dev_mode=True, heartbeat_ttl=10**9)
+        s.establish_leadership()
+        for _ in range(3):
+            s.register_node(mock.node(), now=NOW)
+        return s
+
+    def _param_job(self, **cfg):
+        job = mock.batch_job()
+        job.parameterized = ParameterizedJobConfig(**cfg)
+        return job
+
+    def test_dispatch_creates_running_child(self):
+        s = self._server()
+        job = self._param_job(payload="optional",
+                              meta_required=["input"],
+                              meta_optional=["verbose"])
+        assert s.register_job(job, now=NOW) is None
+        child, err = s.dispatch_job(job.namespace, job.id,
+                                    payload=b"data",
+                                    meta={"input": "x"}, now=NOW + 1)
+        assert err == "" and child is not None
+        assert child.dispatched and child.payload == b"data"
+        assert child.meta["input"] == "x"
+        assert child.parameterized is None
+        s.process_all(now=NOW + 1)
+        assert s.state.allocs_by_job(job.namespace, child.id)
+
+    def test_dispatch_validation(self):
+        s = self._server()
+        job = self._param_job(payload="required", meta_required=["k"])
+        s.register_job(job, now=NOW)
+        _, err = s.dispatch_job(job.namespace, job.id, meta={"k": "v"})
+        assert "payload is required" in err
+        _, err = s.dispatch_job(job.namespace, job.id, payload=b"x")
+        assert "missing required meta" in err
+        _, err = s.dispatch_job(job.namespace, job.id, payload=b"x",
+                                meta={"k": "v", "zzz": "1"})
+        assert "unexpected meta" in err
+        _, err = s.dispatch_job(job.namespace, "nope")
+        assert err == "job not found"
+        plain = mock.batch_job()
+        s.register_job(plain, now=NOW)
+        _, err = s.dispatch_job(plain.namespace, plain.id)
+        assert "not parameterized" in err
