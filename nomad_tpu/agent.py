@@ -1,0 +1,89 @@
+"""Agent: one process running server and/or client plus the HTTP API
+(reference: command/agent/agent.go; `-dev` runs both)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from nomad_tpu.api.http_server import HTTPAPIServer
+from nomad_tpu.client.client import Client, InProcessRPC
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import Node
+
+
+class Agent:
+    def __init__(self, server_enabled: bool = True,
+                 client_enabled: bool = True,
+                 num_clients: int = 1,
+                 num_workers: int = 1,
+                 http_host: str = "127.0.0.1",
+                 http_port: int = 0,
+                 heartbeat_ttl: float = 30.0,
+                 nodes: Optional[List[Node]] = None) -> None:
+        if not server_enabled:
+            raise NotImplementedError(
+                "client-only agents need a remote RPC transport; "
+                "in-process agents always embed the server")
+        self.server = Server(num_workers=num_workers, dev_mode=False,
+                             heartbeat_ttl=heartbeat_ttl)
+        self.clients: List[Client] = []
+        if client_enabled:
+            rpc = InProcessRPC(self.server)
+            for i in range(num_clients):
+                node = nodes[i] if nodes and i < len(nodes) else None
+                self.clients.append(Client(rpc, node=node))
+        self.http = HTTPAPIServer(self, host=http_host, port=http_port)
+        self._started_at = time.time()
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> "Agent":
+        self.server.start()
+        for c in self.clients:
+            c.start()
+        self.http.start()
+        return self
+
+    def shutdown(self) -> None:
+        self.http.shutdown()
+        for c in self.clients:
+            c.shutdown()
+        self.server.shutdown()
+
+    @property
+    def address(self) -> str:
+        return self.http.addr
+
+    # -------------------------------------------------------------- intro
+
+    def stats(self) -> Dict:
+        s = self.server
+        return {
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "state_index": s.state.latest_index(),
+            "broker": dict(s.eval_broker.stats),
+            "workers": [w.stats for w in s.workers],
+            "plan_queue_depth_peak": s.plan_queue.stats["depth_peak"],
+            "clients": len(self.clients),
+            "threads": threading.active_count(),
+        }
+
+    def metrics(self) -> Dict:
+        """Load-bearing series per SURVEY.md §6.5."""
+        s = self.server
+        snap = s.state.snapshot()
+        return {
+            "nomad.broker.total_ready": s.eval_broker.pending_evals(),
+            "nomad.broker.acked": s.eval_broker.stats["acked"],
+            "nomad.broker.nacked": s.eval_broker.stats["nacked"],
+            "nomad.broker.failed": s.eval_broker.stats["failed"],
+            "nomad.blocked_evals.total_blocked":
+                s.blocked_evals.num_blocked(),
+            "nomad.plan.queue_depth": s.plan_queue.depth(),
+            "nomad.worker.invoked":
+                sum(w.stats["invoked"] for w in s.workers),
+            "nomad.state.nodes": len(snap.nodes()),
+            "nomad.state.jobs": len(snap.jobs()),
+        }

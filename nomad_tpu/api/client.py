@@ -1,0 +1,248 @@
+"""Python API SDK (reference: api/ — api.Client with Jobs, Nodes,
+Allocations, Evaluations, Deployments, Operator, System, Search, Events).
+
+Stdlib urllib only; JSON wire shapes match the HTTP API (and the
+reference's CamelCase forms).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class APIException(Exception):
+    def __init__(self, status: int, msg: str) -> None:
+        super().__init__(f"{status}: {msg}")
+        self.status = status
+
+
+class APIClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 namespace: str = "default", timeout: float = 35.0) -> None:
+        self.address = address.rstrip("/")
+        self.namespace = namespace
+        self.timeout = timeout
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.deployments = Deployments(self)
+        self.operator = Operator(self)
+        self.system = System(self)
+        self.agent = Agent(self)
+        self.events = Events(self)
+
+    # ---------------------------------------------------------- transport
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, Any]] = None,
+                body: Optional[Any] = None) -> Any:
+        params = dict(params or {})
+        params.setdefault("namespace", self.namespace)
+        url = f"{self.address}{path}?{urllib.parse.urlencode(params, doseq=True)}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("Error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise APIException(e.code, msg) from None
+
+    def get(self, path, **params):
+        return self.request("GET", path, params=params)
+
+    def put(self, path, body=None, **params):
+        return self.request("PUT", path, params=params, body=body)
+
+    def delete(self, path, **params):
+        return self.request("DELETE", path, params=params)
+
+
+class _Endpoint:
+    def __init__(self, client: APIClient) -> None:
+        self.c = client
+
+
+class Jobs(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/jobs")
+
+    def register(self, job_wire: Dict) -> Dict:
+        return self.c.put("/v1/jobs", body={"Job": job_wire})
+
+    def info(self, job_id: str) -> Dict:
+        return self.c.get(f"/v1/job/{urllib.parse.quote(job_id, safe='')}")
+
+    def deregister(self, job_id: str, purge: bool = False) -> Dict:
+        return self.c.delete(
+            f"/v1/job/{urllib.parse.quote(job_id, safe='')}",
+            purge=str(purge).lower())
+
+    def allocations(self, job_id: str) -> List[Dict]:
+        return self.c.get(
+            f"/v1/job/{urllib.parse.quote(job_id, safe='')}/allocations")
+
+    def evaluations(self, job_id: str) -> List[Dict]:
+        return self.c.get(
+            f"/v1/job/{urllib.parse.quote(job_id, safe='')}/evaluations")
+
+    def versions(self, job_id: str) -> Dict:
+        return self.c.get(
+            f"/v1/job/{urllib.parse.quote(job_id, safe='')}/versions")
+
+    def deployments(self, job_id: str) -> List[Dict]:
+        return self.c.get(
+            f"/v1/job/{urllib.parse.quote(job_id, safe='')}/deployments")
+
+    def latest_deployment(self, job_id: str) -> Optional[Dict]:
+        return self.c.get(
+            f"/v1/job/{urllib.parse.quote(job_id, safe='')}/deployment")
+
+    def plan(self, job_wire: Dict, diff: bool = False) -> Dict:
+        jid = urllib.parse.quote(job_wire["ID"], safe="")
+        return self.c.put(f"/v1/job/{jid}/plan",
+                          body={"Job": job_wire, "Diff": diff})
+
+    def dispatch(self, job_id: str, payload: bytes = b"",
+                 meta: Optional[Dict[str, str]] = None) -> Dict:
+        jid = urllib.parse.quote(job_id, safe="")
+        return self.c.put(
+            f"/v1/job/{jid}/dispatch",
+            body={"Payload": base64.b64encode(payload).decode(),
+                  "Meta": meta or {}})
+
+    def revert(self, job_id: str, version: int) -> Dict:
+        jid = urllib.parse.quote(job_id, safe="")
+        return self.c.put(f"/v1/job/{jid}/revert",
+                          body={"JobVersion": version})
+
+    def periodic_force(self, job_id: str) -> Dict:
+        jid = urllib.parse.quote(job_id, safe="")
+        return self.c.put(f"/v1/job/{jid}/periodic/force")
+
+
+class Nodes(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/nodes")
+
+    def info(self, node_id: str) -> Dict:
+        return self.c.get(f"/v1/node/{node_id}")
+
+    def allocations(self, node_id: str) -> List[Dict]:
+        return self.c.get(f"/v1/node/{node_id}/allocations")
+
+    def drain(self, node_id: str, deadline_s: float = 3600,
+              ignore_system_jobs: bool = False,
+              disable: bool = False) -> Dict:
+        spec = None if disable else {
+            "Deadline": int(deadline_s * 1e9),
+            "IgnoreSystemJobs": ignore_system_jobs}
+        return self.c.put(f"/v1/node/{node_id}/drain",
+                          body={"DrainSpec": spec})
+
+    def eligibility(self, node_id: str, eligible: bool) -> Dict:
+        return self.c.put(
+            f"/v1/node/{node_id}/eligibility",
+            body={"Eligibility":
+                  "eligible" if eligible else "ineligible"})
+
+
+class Allocations(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/allocations")
+
+    def info(self, alloc_id: str) -> Dict:
+        return self.c.get(f"/v1/allocation/{alloc_id}")
+
+    def stop(self, alloc_id: str) -> Dict:
+        return self.c.put(f"/v1/allocation/{alloc_id}/stop")
+
+
+class Evaluations(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/evaluations")
+
+    def info(self, eval_id: str) -> Dict:
+        return self.c.get(f"/v1/evaluation/{eval_id}")
+
+    def allocations(self, eval_id: str) -> List[Dict]:
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations")
+
+
+class Deployments(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/deployments")
+
+    def info(self, dep_id: str) -> Dict:
+        return self.c.get(f"/v1/deployment/{dep_id}")
+
+    def allocations(self, dep_id: str) -> List[Dict]:
+        return self.c.get(f"/v1/deployment/{dep_id}/allocations")
+
+    def promote(self, dep_id: str,
+                groups: Optional[List[str]] = None) -> Dict:
+        body = {"All": groups is None}
+        if groups is not None:
+            body["Groups"] = groups
+        return self.c.put(f"/v1/deployment/promote/{dep_id}", body=body)
+
+    def fail(self, dep_id: str) -> Dict:
+        return self.c.put(f"/v1/deployment/fail/{dep_id}")
+
+    def pause(self, dep_id: str, pause: bool = True) -> Dict:
+        return self.c.put(f"/v1/deployment/pause/{dep_id}",
+                          body={"Pause": pause})
+
+
+class Operator(_Endpoint):
+    def scheduler_config(self) -> Dict:
+        return self.c.get("/v1/operator/scheduler/configuration")
+
+    def set_scheduler_config(self, cfg_wire: Dict) -> Dict:
+        return self.c.put("/v1/operator/scheduler/configuration",
+                          body=cfg_wire)
+
+
+class System(_Endpoint):
+    def gc(self) -> Dict:
+        return self.c.put("/v1/system/gc")
+
+
+class Agent(_Endpoint):
+    def self(self) -> Dict:
+        return self.c.get("/v1/agent/self")
+
+    def members(self) -> Dict:
+        return self.c.get("/v1/agent/members")
+
+    def metrics(self) -> Dict:
+        return self.c.get("/v1/metrics")
+
+
+class Events(_Endpoint):
+    def stream(self, topics: Optional[List[str]] = None,
+               index: int = 0) -> Iterator[Dict]:
+        """Yields {"Index": N, "Events": [...]} batches until closed."""
+        params: Dict[str, Any] = {"namespace": self.c.namespace,
+                                  "index": index}
+        if topics:
+            params["topic"] = topics
+        url = (f"{self.c.address}/v1/event/stream?"
+               f"{urllib.parse.urlencode(params, doseq=True)}")
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req) as resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)

@@ -1,0 +1,573 @@
+"""HTTP API (reference: command/agent/http.go + *_endpoint.go).
+
+`/v1/...` JSON endpoints over the in-process Server, shaped like the
+reference's API (CamelCase wire forms via structs.codec).  Implemented on
+the stdlib ThreadingHTTPServer — no external dependencies.
+
+Blocking queries: list GETs accept `?index=N&wait=SECS` and long-poll the
+state store until its index passes N (reference: blockingRPC); responses
+carry `X-Nomad-Index`.
+
+`/v1/event/stream` streams newline-delimited JSON event batches with
+`?topic=Topic:Key` filters, mirroring the reference's endpoint.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import (
+    Allocation,
+    DrainStrategy,
+    Evaluation,
+    Job,
+    Node,
+    SchedulerConfiguration,
+    codec,
+)
+
+DEFAULT_NAMESPACE = "default"
+
+
+class APIError(Exception):
+    def __init__(self, status: int, msg: str) -> None:
+        super().__init__(msg)
+        self.status = status
+
+
+def _decode_job(wire: Dict, ns: str) -> Job:
+    """Wire Job -> struct; an ABSENT Namespace falls back to the request's
+    ?namespace= (the decoder's default-namespace output can't distinguish
+    'unset' from an explicit 'default')."""
+    job = codec.decode(Job, wire)
+    if "Namespace" not in wire:
+        job.namespace = ns
+    return job
+
+
+def _stub(job: Job) -> Dict[str, Any]:
+    return {
+        "ID": job.id, "Name": job.name, "Namespace": job.namespace,
+        "Type": job.type, "Priority": job.priority, "Status": job.status,
+        "Stop": job.stop, "Version": job.version,
+        "ParentID": job.parent_id,
+        "Periodic": job.periodic is not None,
+        "ParameterizedJob": job.parameterized is not None,
+        "JobModifyIndex": job.job_modify_index,
+        "ModifyIndex": job.modify_index,
+    }
+
+
+def _node_stub(n: Node) -> Dict[str, Any]:
+    return {
+        "ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
+        "NodePool": n.node_pool, "NodeClass": n.node_class,
+        "Status": n.status,
+        "SchedulingEligibility": n.scheduling_eligibility,
+        "Drain": n.drain is not None,
+        "ModifyIndex": n.modify_index,
+    }
+
+
+class Router:
+    """Maps (method, path) to handlers over an agent (server + clients)."""
+
+    def __init__(self, agent) -> None:
+        self.agent = agent
+
+    @property
+    def server(self):
+        return self.agent.server
+
+    # ------------------------------------------------------------ routing
+
+    def route(self, method: str, path: str, qs: Dict[str, List[str]],
+              body: Optional[Dict]) -> Tuple[int, Any]:
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise APIError(404, "not found")
+        parts = parts[1:]
+        ns = (qs.get("namespace") or [DEFAULT_NAMESPACE])[0]
+        try:
+            return 200, self._dispatch(method, parts, ns, qs, body)
+        except APIError:
+            raise
+        except (KeyError, IndexError) as e:
+            raise APIError(404, f"not found: {e}")
+
+    def _dispatch(self, method: str, p: List[str], ns: str,
+                  qs: Dict[str, List[str]], body: Optional[Dict]) -> Any:
+        s = self.server
+        head = p[0] if p else ""
+        if head == "jobs":
+            if method == "GET":
+                self._block(qs)
+                snap = s.state.snapshot()
+                out = [_stub(j) for j in snap.jobs()
+                       if j.namespace == ns or ns == "*"]
+                return sorted(out, key=lambda j: j["ID"])
+            if method in ("PUT", "POST"):
+                job = _decode_job((body or {}).get("Job") or {}, ns)
+                ev = s.register_job(job)
+                return {"EvalID": ev.id if ev else "",
+                        "JobModifyIndex": s.state.job_by_id(
+                            job.namespace, job.id).job_modify_index}
+        elif head == "job":
+            return self._job(method, p[1:], ns, qs, body)
+        elif head == "nodes":
+            if method == "GET":
+                self._block(qs)
+                return sorted((_node_stub(n)
+                               for n in s.state.snapshot().nodes()),
+                              key=lambda n: n["ID"])
+        elif head == "node":
+            return self._node(method, p[1:], qs, body)
+        elif head == "allocations":
+            if method == "GET":
+                self._block(qs)
+                snap = s.state.snapshot()
+                out = []
+                for j in snap.jobs():
+                    if not (j.namespace == ns or ns == "*"):
+                        continue
+                    out.extend(codec.encode(a) for a in
+                               snap.allocs_by_job(j.namespace, j.id))
+                return out
+        elif head == "allocation":
+            aid = p[1]
+            a = s.state.alloc_by_id(aid)
+            if a is None:
+                raise APIError(404, "alloc not found")
+            if method == "GET":
+                return codec.encode(a)
+            if method in ("PUT", "POST") and len(p) > 2 and p[2] == "stop":
+                stop = a.copy_skip_job()
+                stop.desired_status = "stop"
+                stop.desired_description = "alloc stopped via api"
+                s.state.upsert_allocs([stop])
+                ev = Evaluation(namespace=a.namespace, type="service",
+                                triggered_by="alloc-stop", job_id=a.job_id)
+                job = s.state.job_by_id(a.namespace, a.job_id)
+                if job is not None:
+                    ev.type = job.type
+                    ev.priority = job.priority
+                s.apply_eval_update([ev])
+                return {"EvalID": ev.id}
+        elif head == "evaluations":
+            if method == "GET":
+                self._block(qs)
+                return [codec.encode(e) for e in s.state.snapshot().evals()
+                        if e.namespace == ns or ns == "*"]
+        elif head == "evaluation":
+            eid = p[1]
+            ev = s.state.eval_by_id(eid)
+            if ev is None:
+                raise APIError(404, "eval not found")
+            if len(p) > 2 and p[2] == "allocations":
+                snap = s.state.snapshot()
+                return [codec.encode(a) for a in
+                        snap.allocs_by_job(ev.namespace, ev.job_id)
+                        if a.eval_id == eid]
+            return codec.encode(ev)
+        elif head == "deployments":
+            if method == "GET":
+                self._block(qs)
+                return [codec.encode(d)
+                        for d in s.state.snapshot().deployments()
+                        if d.namespace == ns or ns == "*"]
+        elif head == "deployment":
+            return self._deployment(method, p[1:], body)
+        elif head == "operator":
+            if p[1:2] == ["scheduler"] and p[2:3] == ["configuration"]:
+                if method == "GET":
+                    return {"SchedulerConfig":
+                            codec.encode(s.state.snapshot()
+                                         .scheduler_config())}
+                if method in ("PUT", "POST"):
+                    cfg = codec.decode(SchedulerConfiguration, body or {})
+                    s.state.set_scheduler_config(cfg)
+                    return {"Updated": True}
+        elif head == "system":
+            if p[1:2] == ["gc"] and method in ("PUT", "POST"):
+                s.force_gc()
+                return {}
+        elif head == "status":
+            if p[1:2] == ["leader"]:
+                return "local"           # single in-process server
+            if p[1:2] == ["peers"]:
+                return ["local"]
+        elif head == "agent":
+            if p[1:2] == ["self"]:
+                return {"config": {"Server": {"Enabled": True},
+                                   "Client": {
+                                       "Enabled": bool(self.agent.clients)}},
+                        "stats": self.agent.stats()}
+            if p[1:2] == ["members"]:
+                return {"Members": [{"Name": "local", "Status": "alive"}]}
+        elif head == "metrics":
+            return self.agent.metrics()
+        elif head == "search":
+            if method in ("PUT", "POST"):
+                return self._search(body or {}, ns)
+        elif head == "event":
+            # handled separately (streaming) — reaching here means the
+            # handler did not intercept it
+            raise APIError(400, "use GET /v1/event/stream")
+        raise APIError(404, f"no handler for {method} /v1/{'/'.join(p)}")
+
+    # ----------------------------------------------------------- sub-trees
+
+    def _job(self, method: str, p: List[str], ns: str,
+             qs: Dict[str, List[str]], body: Optional[Dict]) -> Any:
+        s = self.server
+        job_id = urllib.parse.unquote(p[0])
+        sub = p[1] if len(p) > 1 else ""
+        if method == "GET":
+            # block BEFORE reading: a watcher polling ?index=N must see
+            # the state as of the index that woke it, not the one before
+            self._block(qs)
+        job = s.state.job_by_id(ns, job_id)
+        if method == "GET":
+            if job is None:
+                raise APIError(404, "job not found")
+            if sub == "":
+                return codec.encode(job)
+            snap = s.state.snapshot()
+            if sub == "allocations":
+                return [codec.encode(a)
+                        for a in snap.allocs_by_job(ns, job_id)]
+            if sub == "evaluations":
+                return [codec.encode(e)
+                        for e in snap.evals_by_job(ns, job_id)]
+            if sub == "versions":
+                versions = []
+                v = job.version
+                while v >= 0:
+                    jv = snap.job_by_id_and_version(ns, job_id, v)
+                    if jv is not None:
+                        versions.append(codec.encode(jv))
+                    v -= 1
+                return {"Versions": versions}
+            if sub == "deployment":
+                d = snap.latest_deployment_by_job(ns, job_id)
+                return codec.encode(d) if d else None
+            if sub == "deployments":
+                return [codec.encode(d) for d in snap.deployments()
+                        if d.namespace == ns and d.job_id == job_id]
+        if method == "DELETE":
+            purge = (qs.get("purge") or ["false"])[0] == "true"
+            ev = s.deregister_job(ns, job_id, purge=purge)
+            return {"EvalID": ev.id if ev else ""}
+        if method in ("PUT", "POST"):
+            if sub == "" and body and "Job" in body:
+                ev = s.register_job(_decode_job(body["Job"], ns))
+                return {"EvalID": ev.id if ev else ""}
+            if sub == "plan":
+                # a plan dry-run works for not-yet-registered jobs too
+                j = _decode_job((body or {}).get("Job") or {}, ns)
+                diff = (body or {}).get("Diff", False)
+                return self._plan(j, diff)
+            if job is None:
+                raise APIError(404, "job not found")
+            if sub == "dispatch":
+                payload = base64.b64decode((body or {}).get("Payload") or "")
+                child, err = s.dispatch_job(
+                    ns, job_id, payload, (body or {}).get("Meta") or {})
+                if err:
+                    raise APIError(400, err)
+                return {"DispatchedJobID": child.id}
+            if sub == "revert":
+                version = int((body or {}).get("JobVersion", 0))
+                ev, err = s.revert_job(ns, job_id, version)
+                if err:
+                    raise APIError(400, err)
+                return {"EvalID": ev.id if ev else ""}
+            if sub == "periodic" and p[2:3] == ["force"]:
+                child = s.periodic.force_run(ns, job_id)
+                if child is None:
+                    raise APIError(400, "job is not periodic")
+                return {"DispatchedJobID": child.id}
+        raise APIError(404, f"no job handler for {method} {p}")
+
+    def _node(self, method: str, p: List[str],
+              qs: Dict[str, List[str]], body: Optional[Dict]) -> Any:
+        s = self.server
+        node_id = p[0]
+        sub = p[1] if len(p) > 1 else ""
+        node = s.state.node_by_id(node_id)
+        if node is None:
+            raise APIError(404, "node not found")
+        if method == "GET":
+            if sub == "allocations":
+                return [codec.encode(a)
+                        for a in s.state.snapshot().allocs_by_node(node_id)]
+            return codec.encode(node)
+        if method in ("PUT", "POST"):
+            if sub == "drain":
+                spec = (body or {}).get("DrainSpec")
+                strategy = None
+                if spec is not None:
+                    strategy = DrainStrategy(
+                        deadline_s=(spec.get("Deadline") or 0) / 1e9,
+                        ignore_system_jobs=spec.get(
+                            "IgnoreSystemJobs", False))
+                s.drain_node(node_id, strategy)
+                return {"NodeModifyIndex": s.state.latest_index()}
+            if sub == "eligibility":
+                elig = (body or {}).get("Eligibility", "eligible")
+                s.set_node_eligibility(node_id, elig == "eligible")
+                return {"NodeModifyIndex": s.state.latest_index()}
+            if sub == "purge":
+                s.state.delete_node(node_id)
+                return {}
+        raise APIError(404, f"no node handler for {method} {p}")
+
+    def _deployment(self, method: str, p: List[str],
+                    body: Optional[Dict]) -> Any:
+        s = self.server
+        if method in ("PUT", "POST") and len(p) == 2:
+            op, dep_id = p
+            if op == "promote":
+                groups = (body or {}).get("Groups")
+                err = s.deployments.promote(
+                    dep_id, groups if not (body or {}).get("All") else None)
+            elif op == "fail":
+                err = s.deployments.fail(dep_id)
+            elif op == "pause":
+                err = s.deployments.pause(
+                    dep_id, (body or {}).get("Pause", True))
+            else:
+                raise APIError(404, f"unknown deployment op {op}")
+            if err:
+                raise APIError(400, err)
+            return {"DeploymentModifyIndex": s.state.latest_index()}
+        dep = s.state.deployment_by_id(p[0])
+        if dep is None:
+            raise APIError(404, "deployment not found")
+        if len(p) > 1 and p[1] == "allocations":
+            snap = s.state.snapshot()
+            return [codec.encode(a) for a in
+                    snap.allocs_by_job(dep.namespace, dep.job_id)
+                    if a.deployment_id == dep.id]
+        return codec.encode(dep)
+
+    # ------------------------------------------------------------ helpers
+
+    def _block(self, qs: Dict[str, List[str]]) -> None:
+        """Minimal blocking-query support (reference: blockingRPC)."""
+        idx = qs.get("index")
+        if not idx:
+            return
+        wait = float((qs.get("wait") or ["5"])[0])
+        self.server.state.wait_for_index(int(idx[0]) + 1,
+                                         timeout=min(wait, 30.0))
+
+    def _plan(self, job: Job, diff: bool) -> Dict[str, Any]:
+        """Dry-run the scheduler on a snapshot with a no-op planner
+        (reference: Job.Plan + scheduler/annotate.go)."""
+        from nomad_tpu.scheduler import new_scheduler
+
+        s = self.server
+        snap = s.state.snapshot()
+
+        class _PlanPlanner:
+            plan = None
+
+            def submit_plan(self, p):
+                self.plan = p
+                return None, None, None
+
+            def update_eval(self, e):
+                pass
+
+            def create_eval(self, e):
+                pass
+
+            def reblock_eval(self, e):
+                pass
+
+        planner = _PlanPlanner()
+        ev = Evaluation(namespace=job.namespace, type=job.type,
+                        triggered_by="job-register", job_id=job.id,
+                        annotate_plan=True)
+        # plan against a state view with the submitted job in place
+        import copy as _copy
+        staged = _copy.copy(job)
+        staged.version = (s.state.job_by_id(job.namespace, job.id).version + 1
+                          if s.state.job_by_id(job.namespace, job.id)
+                          else 0)
+        sched = new_scheduler(job.type, _StagedState(snap, staged), planner,
+                              engine=s.engine)
+        sched.process(ev)
+        plan = planner.plan
+        out: Dict[str, Any] = {
+            "JobModifyIndex": staged.version,
+            "FailedTGAllocs": {k: codec.encode(m) for k, m in
+                               sched.failed_tg_allocs.items()},
+            "Annotations": codec.encode(plan.annotations)
+            if plan is not None and plan.annotations else None,
+        }
+        if plan is not None:
+            n_alloc = sum(len(v) for v in plan.node_allocation.values())
+            out["CreatedAllocs"] = n_alloc
+        return out
+
+    def _search(self, body: Dict, ns: str) -> Dict[str, Any]:
+        """Prefix search over ids (reference: Search.PrefixSearch)."""
+        prefix = body.get("Prefix", "")
+        context = body.get("Context", "all")
+        snap = self.server.state.snapshot()
+        out: Dict[str, List[str]] = {}
+        if context in ("all", "jobs"):
+            out["jobs"] = [j.id for j in snap.jobs()
+                           if j.id.startswith(prefix)][:20]
+        if context in ("all", "nodes"):
+            out["nodes"] = [n.id for n in snap.nodes()
+                            if n.id.startswith(prefix)][:20]
+        if context in ("all", "allocs"):
+            out["allocs"] = [a.id for j in snap.jobs() for a in
+                             snap.allocs_by_job(j.namespace, j.id)
+                             if a.id.startswith(prefix)][:20]
+        if context in ("all", "evals"):
+            out["evals"] = [e.id for e in snap.evals()
+                            if e.id.startswith(prefix)][:20]
+        if context in ("all", "deployment"):
+            out["deployment"] = [d.id for d in snap.deployments()
+                                 if d.id.startswith(prefix)][:20]
+        return {"Matches": out, "Truncations": {}}
+
+
+class _StagedState:
+    """Snapshot wrapper that overlays one not-yet-registered job (the
+    `nomad job plan` dry-run view)."""
+
+    def __init__(self, snap, job: Job) -> None:
+        self._snap = snap
+        self._job = job
+
+    def job_by_id(self, namespace: str, job_id: str):
+        if (namespace, job_id) == (self._job.namespace, self._job.id):
+            return self._job
+        return self._snap.job_by_id(namespace, job_id)
+
+    def __getattr__(self, name):
+        return getattr(self._snap, name)
+
+
+class HTTPAPIServer:
+    """Threaded HTTP server bound to an agent."""
+
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.agent = agent
+        router = Router(agent)
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _respond(self, status: int, payload: Any,
+                         index: Optional[int] = None) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-Nomad-Index", str(
+                    index if index is not None
+                    else router.server.state.latest_index()))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _handle(self, method: str) -> None:
+                parsed = urllib.parse.urlparse(self.path)
+                qs = urllib.parse.parse_qs(parsed.query)
+                if parsed.path == "/v1/event/stream" and method == "GET":
+                    return self._stream(qs)
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError:
+                        return self._respond(400, {"Error": "bad json"})
+                try:
+                    status, payload = router.route(
+                        method, parsed.path, qs, body)
+                    self._respond(status, payload)
+                except APIError as e:
+                    self._respond(e.status, {"Error": str(e)})
+                except Exception as e:  # noqa: BLE001 - endpoint isolation
+                    self._respond(500, {"Error": f"{type(e).__name__}: {e}"})
+
+            def _stream(self, qs: Dict[str, List[str]]) -> None:
+                topics: Dict[str, List[str]] = {}
+                for t in qs.get("topic", []):
+                    topic, _, key = t.partition(":")
+                    topics.setdefault(topic, []).append(key or "*")
+                from_index = int((qs.get("index") or ["0"])[0])
+                sub = router.server.events.subscribe(
+                    topics or None, from_index=from_index)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                import time as _time
+                last_write = _time.time()
+                try:
+                    while not sub.closed:
+                        ev = sub.next(timeout=0.5)
+                        if ev is not None:
+                            chunk(json.dumps(
+                                {"Index": ev.index,
+                                 "Events": [ev.wire()]}).encode() + b"\n")
+                            last_write = _time.time()
+                        elif _time.time() - last_write > 10:
+                            # heartbeat: the only way to notice a client
+                            # that disconnected while the stream was idle
+                            # (otherwise the subscription leaks forever)
+                            chunk(b"{}\n")
+                            last_write = _time.time()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    router.server.events.unsubscribe(sub)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.addr = f"http://{host}:{self.httpd.server_port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="http-api", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

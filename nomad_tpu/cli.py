@@ -1,0 +1,409 @@
+"""CLI (reference: command/ — `nomad <subcommand>` over the HTTP API).
+
+Subcommands mirror the reference's surface: job run/status/stop/plan/
+dispatch/revert/periodic-force/history, node status/drain/eligibility,
+alloc status, eval status/list, deployment status/list/promote/fail/pause,
+operator scheduler get-config/set-config, system gc, server members,
+status, and `agent -dev` (in-process server + client + HTTP API).
+
+Entry point: `python -m nomad_tpu <subcommand> ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from nomad_tpu.api.client import APIClient, APIException
+
+DEFAULT_ADDR = "http://127.0.0.1:4646"
+
+
+def _str2bool(v: str) -> bool:
+    if v.lower() in ("true", "1", "yes", "on"):
+        return True
+    if v.lower() in ("false", "0", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {v!r}")
+
+
+def _client(args) -> APIClient:
+    return APIClient(address=args.address, namespace=args.namespace)
+
+
+def _out(data) -> None:
+    print(json.dumps(data, indent=2, sort_keys=True))
+
+
+def _load_jobspec(path: str) -> dict:
+    """HCL2 or API-JSON jobspec -> wire Job dict."""
+    from nomad_tpu.jobspec import parse_file
+    from nomad_tpu.structs import codec
+    return codec.encode(parse_file(path))
+
+
+# ---------------------------------------------------------------- commands
+
+def cmd_agent(args) -> int:
+    from nomad_tpu.agent import Agent
+    host, _, port = args.bind.partition(":")
+    agent = Agent(num_clients=args.clients, num_workers=args.workers,
+                  http_host=host or "127.0.0.1",
+                  http_port=int(port or 4646))
+    agent.start()
+    print(f"==> agent started; HTTP API at {agent.address}")
+    print(f"==> {len(agent.clients)} in-process client node(s)")
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        print("==> shutting down")
+        agent.shutdown()
+    return 0
+
+
+def cmd_job_run(args) -> int:
+    wire = _load_jobspec(args.file)
+    resp = _client(args).jobs.register(wire)
+    print(f"job {wire['ID']!r} registered; eval {resp.get('EvalID', '')}")
+    return 0
+
+
+def cmd_job_status(args) -> int:
+    c = _client(args)
+    if not args.job_id:
+        for stub in c.jobs.list():
+            print(f"{stub['ID']:<40} {stub['Type']:<8} "
+                  f"{stub['Priority']:<4} {stub['Status']}")
+        return 0
+    _out(c.jobs.info(args.job_id))
+    allocs = c.jobs.allocations(args.job_id)
+    if allocs:
+        print(f"\nAllocations ({len(allocs)}):")
+        for a in allocs:
+            print(f"  {a['ID'][:8]}  {a.get('NodeID', '')[:8]}  "
+                  f"{a.get('TaskGroup', '')}  "
+                  f"{a.get('DesiredStatus', '')}/{a.get('ClientStatus', '')}")
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    resp = _client(args).jobs.deregister(args.job_id, purge=args.purge)
+    print(f"job {args.job_id!r} stopped; eval {resp.get('EvalID', '')}")
+    return 0
+
+
+def cmd_job_plan(args) -> int:
+    wire = _load_jobspec(args.file)
+    _out(_client(args).jobs.plan(wire, diff=True))
+    return 0
+
+
+def cmd_job_dispatch(args) -> int:
+    payload = b""
+    if args.payload_file:
+        with open(args.payload_file, "rb") as f:
+            payload = f.read()
+    meta = dict(kv.split("=", 1) for kv in args.meta or [])
+    resp = _client(args).jobs.dispatch(args.job_id, payload, meta)
+    print(f"dispatched {resp['DispatchedJobID']}")
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    resp = _client(args).jobs.revert(args.job_id, args.version)
+    print(f"reverted; eval {resp.get('EvalID', '')}")
+    return 0
+
+
+def cmd_job_history(args) -> int:
+    _out(_client(args).jobs.versions(args.job_id))
+    return 0
+
+
+def cmd_job_periodic_force(args) -> int:
+    resp = _client(args).jobs.periodic_force(args.job_id)
+    print(f"forced launch {resp['DispatchedJobID']}")
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    c = _client(args)
+    if not args.node_id:
+        for n in c.nodes.list():
+            print(f"{n['ID'][:8]}  {n['Name']:<16} {n['Datacenter']:<8} "
+                  f"{n['Status']:<6} {n['SchedulingEligibility']}"
+                  f"{'  (draining)' if n['Drain'] else ''}")
+        return 0
+    _out(c.nodes.info(args.node_id))
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    c = _client(args)
+    if args.disable:
+        c.nodes.drain(args.node_id, disable=True)
+        print("drain cancelled")
+    else:
+        c.nodes.drain(args.node_id, deadline_s=args.deadline,
+                      ignore_system_jobs=args.ignore_system)
+        print(f"draining node {args.node_id[:8]}")
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    _client(args).nodes.eligibility(args.node_id, args.enable)
+    print(f"node {args.node_id[:8]} "
+          f"{'eligible' if args.enable else 'ineligible'}")
+    return 0
+
+
+def cmd_alloc_status(args) -> int:
+    _out(_client(args).allocations.info(args.alloc_id))
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    resp = _client(args).allocations.stop(args.alloc_id)
+    print(f"stopping; eval {resp.get('EvalID', '')}")
+    return 0
+
+
+def cmd_eval_list(args) -> int:
+    for e in _client(args).evaluations.list():
+        print(f"{e['ID'][:8]}  {e.get('Type', ''):<8} "
+              f"{e.get('TriggeredBy', ''):<18} {e.get('JobID', '')[:24]:<24} "
+              f"{e.get('Status', '')}")
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    _out(_client(args).evaluations.info(args.eval_id))
+    return 0
+
+
+def cmd_deployment_list(args) -> int:
+    for d in _client(args).deployments.list():
+        print(f"{d['ID'][:8]}  {d.get('JobID', '')[:32]:<32} "
+              f"v{d.get('JobVersion', 0):<4} {d.get('Status', '')}")
+    return 0
+
+
+def cmd_deployment_status(args) -> int:
+    _out(_client(args).deployments.info(args.deployment_id))
+    return 0
+
+
+def cmd_deployment_promote(args) -> int:
+    _client(args).deployments.promote(
+        args.deployment_id, args.group or None)
+    print("promoted")
+    return 0
+
+
+def cmd_deployment_fail(args) -> int:
+    _client(args).deployments.fail(args.deployment_id)
+    print("failed")
+    return 0
+
+
+def cmd_deployment_pause(args) -> int:
+    _client(args).deployments.pause(args.deployment_id,
+                                    not args.resume)
+    print("resumed" if args.resume else "paused")
+    return 0
+
+
+def cmd_operator_scheduler_get(args) -> int:
+    _out(_client(args).operator.scheduler_config())
+    return 0
+
+
+def cmd_operator_scheduler_set(args) -> int:
+    c = _client(args)
+    cfg = c.operator.scheduler_config()["SchedulerConfig"]
+    if args.scheduler_algorithm:
+        cfg["SchedulerAlgorithm"] = args.scheduler_algorithm
+    if args.memory_oversubscription is not None:
+        cfg["MemoryOversubscriptionEnabled"] = args.memory_oversubscription
+    c.operator.set_scheduler_config(cfg)
+    print("scheduler configuration updated")
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    _client(args).system.gc()
+    print("gc forced")
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    _out(_client(args).agent.members())
+    return 0
+
+
+def cmd_status(args) -> int:
+    c = _client(args)
+    jobs = c.jobs.list()
+    if not jobs:
+        print("No running jobs")
+        return 0
+    for stub in jobs:
+        print(f"{stub['ID']:<40} {stub['Type']:<8} {stub['Status']}")
+    return 0
+
+
+# ------------------------------------------------------------------ parser
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nomad-tpu", description="TPU-native cluster scheduler CLI")
+    p.add_argument("-address", default=DEFAULT_ADDR)
+    p.add_argument("-namespace", default="default")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent", help="run an agent (server+client+http)")
+    ag.add_argument("-dev", action="store_true", default=True)
+    ag.add_argument("-bind", default="127.0.0.1:4646")
+    ag.add_argument("-clients", type=int, default=1)
+    ag.add_argument("-workers", type=int, default=1)
+    ag.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands").add_subparsers(
+        dest="job_cmd", required=True)
+    jr = job.add_parser("run")
+    jr.add_argument("file")
+    jr.set_defaults(fn=cmd_job_run)
+    js = job.add_parser("status")
+    js.add_argument("job_id", nargs="?", default="")
+    js.set_defaults(fn=cmd_job_status)
+    jst = job.add_parser("stop")
+    jst.add_argument("job_id")
+    jst.add_argument("-purge", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+    jp = job.add_parser("plan")
+    jp.add_argument("file")
+    jp.set_defaults(fn=cmd_job_plan)
+    jd = job.add_parser("dispatch")
+    jd.add_argument("job_id")
+    jd.add_argument("-payload-file", dest="payload_file", default="")
+    jd.add_argument("-meta", action="append")
+    jd.set_defaults(fn=cmd_job_dispatch)
+    jv = job.add_parser("revert")
+    jv.add_argument("job_id")
+    jv.add_argument("version", type=int)
+    jv.set_defaults(fn=cmd_job_revert)
+    jh = job.add_parser("history")
+    jh.add_argument("job_id")
+    jh.set_defaults(fn=cmd_job_history)
+    jpf = job.add_parser("periodic-force")
+    jpf.add_argument("job_id")
+    jpf.set_defaults(fn=cmd_job_periodic_force)
+
+    node = sub.add_parser("node", help="node commands").add_subparsers(
+        dest="node_cmd", required=True)
+    ns_ = node.add_parser("status")
+    ns_.add_argument("node_id", nargs="?", default="")
+    ns_.set_defaults(fn=cmd_node_status)
+    nd = node.add_parser("drain")
+    nd.add_argument("node_id")
+    nd.add_argument("-disable", action="store_true")
+    nd.add_argument("-deadline", type=float, default=3600)
+    nd.add_argument("-ignore-system", dest="ignore_system",
+                    action="store_true")
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = node.add_parser("eligibility")
+    ne.add_argument("node_id")
+    grp = ne.add_mutually_exclusive_group(required=True)
+    grp.add_argument("-enable", dest="enable", action="store_true")
+    grp.add_argument("-disable", dest="enable", action="store_false")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    alloc = sub.add_parser("alloc", help="alloc commands").add_subparsers(
+        dest="alloc_cmd", required=True)
+    als = alloc.add_parser("status")
+    als.add_argument("alloc_id")
+    als.set_defaults(fn=cmd_alloc_status)
+    alst = alloc.add_parser("stop")
+    alst.add_argument("alloc_id")
+    alst.set_defaults(fn=cmd_alloc_stop)
+
+    ev = sub.add_parser("eval", help="eval commands").add_subparsers(
+        dest="eval_cmd", required=True)
+    evl = ev.add_parser("list")
+    evl.set_defaults(fn=cmd_eval_list)
+    evs = ev.add_parser("status")
+    evs.add_argument("eval_id")
+    evs.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment",
+                         help="deployment commands").add_subparsers(
+        dest="dep_cmd", required=True)
+    dl = dep.add_parser("list")
+    dl.set_defaults(fn=cmd_deployment_list)
+    ds = dep.add_parser("status")
+    ds.add_argument("deployment_id")
+    ds.set_defaults(fn=cmd_deployment_status)
+    dp = dep.add_parser("promote")
+    dp.add_argument("deployment_id")
+    dp.add_argument("-group", action="append")
+    dp.set_defaults(fn=cmd_deployment_promote)
+    df = dep.add_parser("fail")
+    df.add_argument("deployment_id")
+    df.set_defaults(fn=cmd_deployment_fail)
+    dpa = dep.add_parser("pause")
+    dpa.add_argument("deployment_id")
+    dpa.add_argument("-resume", action="store_true")
+    dpa.set_defaults(fn=cmd_deployment_pause)
+
+    op = sub.add_parser("operator",
+                        help="operator commands").add_subparsers(
+        dest="op_cmd", required=True)
+    osch = op.add_parser("scheduler").add_subparsers(dest="sched_cmd",
+                                                     required=True)
+    og = osch.add_parser("get-config")
+    og.set_defaults(fn=cmd_operator_scheduler_get)
+    os_ = osch.add_parser("set-config")
+    os_.add_argument("-scheduler-algorithm", dest="scheduler_algorithm",
+                     choices=["binpack", "spread"], default="")
+    os_.add_argument("-memory-oversubscription",
+                     dest="memory_oversubscription", type=_str2bool,
+                     default=None)
+    os_.set_defaults(fn=cmd_operator_scheduler_set)
+
+    system = sub.add_parser("system").add_subparsers(dest="sys_cmd",
+                                                     required=True)
+    sgc = system.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
+
+    srv = sub.add_parser("server").add_subparsers(dest="srv_cmd",
+                                                  required=True)
+    sm = srv.add_parser("members")
+    sm.set_defaults(fn=cmd_server_members)
+
+    st = sub.add_parser("status")
+    st.set_defaults(fn=cmd_status)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except APIException as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,12 @@
 """Server core (reference: nomad/)."""
 
 from .blocked_evals import BlockedEvals  # noqa: F401
+from .deployment_watcher import DeploymentWatcher  # noqa: F401
+from .drainer import NodeDrainer  # noqa: F401
 from .eval_broker import EvalBroker  # noqa: F401
 from .heartbeat import HeartbeatTimers, invalidate_heartbeat  # noqa: F401
+from .periodic import CronSpec, PeriodicDispatch  # noqa: F401
 from .plan_apply import PendingPlan, PlanApplier, PlanQueue  # noqa: F401
 from .server import Server  # noqa: F401
+from .stream import Event, EventBroker as StreamBroker, Subscription  # noqa: F401,E501
 from .worker import Worker  # noqa: F401

@@ -43,6 +43,7 @@ from .deployment_watcher import DeploymentWatcher
 from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
 from .periodic import PeriodicDispatch, dispatch_job
+from .stream import EventBroker
 from .heartbeat import HeartbeatTimers, build_node_evals, invalidate_heartbeat
 from .plan_apply import PlanApplier, PlanQueue
 from .worker import Worker
@@ -61,6 +62,8 @@ class Server:
         self.deployments = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatch(self)
+        self.events = EventBroker()
+        self.events.attach(self.state)
         self.engine = PlacementEngine()
         self.engine.packer.attach(self.state)
         self.dev_mode = dev_mode
@@ -127,6 +130,7 @@ class Server:
             self.plan_applier.stop()
             self._applier_running = False
         self.eval_broker.set_enabled(False)
+        self.events.close()
 
     def maybe_apply_inline(self, pending) -> None:
         """dev_mode: the worker's submit_plan applies plans synchronously
@@ -171,6 +175,25 @@ class Server:
         """reference: Job.Dispatch RPC — mint a child of a parameterized
         job with payload/meta merged in.  Returns (child_job, error)."""
         return dispatch_job(self, namespace, job_id, payload, meta, now=now)
+
+    def revert_job(self, namespace: str, job_id: str, version: int,
+                   now: Optional[float] = None):
+        """reference: Job.Revert RPC — re-register a prior version's spec
+        as a NEW version.  Returns (eval_or_none, error)."""
+        prior = self.state.job_by_id_and_version(namespace, job_id, version)
+        if prior is None:
+            return None, f"job version {version} not found"
+        cur = self.state.job_by_id(namespace, job_id)
+        if cur is not None and cur.version == version:
+            return None, "can't revert to current version"
+        reverted = prior.copy()
+        reverted.stop = False
+        return self.register_job(reverted, now=now), ""
+
+    def force_gc(self, now: Optional[float] = None) -> None:
+        """reference: System.GarbageCollect RPC (`nomad system gc`)."""
+        self.apply_eval_update([Evaluation(
+            type="_core", job_id="force-gc", priority=100)], now=now)
 
     def deregister_job(self, namespace: str, job_id: str,
                        purge: bool = False,

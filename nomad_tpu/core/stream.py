@@ -1,0 +1,176 @@
+"""Event broker: in-memory pub/sub of state-change events
+(reference: nomad/stream/event_broker.go + nomad/state/events.go).
+
+The state store emits one callback per commit; this broker records raw
+(topic, index, payload) entries in a bounded replay buffer and fans out
+wire-shaped event records — `{Topic, Type, Key, Index, Payload}` — to
+subscribers with topic/key filtering.  Backs the HTTP `/v1/event/stream`
+endpoint and in-process consumers.
+
+Hot-path note: the store's commit callback runs under the store write
+lock (plan apply at bench scale lands here), so the callback only appends
+ONE raw tuple per commit — per-alloc Event expansion happens lazily, and
+only when subscribers exist.
+
+Filter semantics (reference: SubscribeRequest): `topics` maps topic name
+to a list of keys; `"*"` as a topic or key matches everything.  Events
+older than the buffer are dropped silently (subscribers start at the
+buffer head; the reference behaves the same once its buffer wraps).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.structs import codec
+
+TOPIC_ALL = "*"
+
+_TYPE_BY_TOPIC = {
+    "Node": "NodeRegistration",
+    "Job": "JobRegistered",
+    "Evaluation": "EvaluationUpdated",
+    "Allocations": "AllocationUpdated",
+    "Deployment": "DeploymentStatusUpdate",
+}
+
+
+@dataclass
+class Event:
+    topic: str
+    type: str
+    key: str
+    index: int
+    payload: object            # original struct (encoded lazily)
+
+    def wire(self) -> Dict:
+        return {
+            "Topic": self.topic,
+            "Type": self.type,
+            "Key": self.key,
+            "Index": self.index,
+            "Payload": codec.encode(self.payload),
+        }
+
+
+def _expand(topic: str, index: int, payload) -> List[Event]:
+    if topic == "Allocations":
+        return [Event("Allocation", "AllocationUpdated", a.id, index, a)
+                for a in payload]
+    if topic not in _TYPE_BY_TOPIC:
+        return []
+    if isinstance(payload, (str, tuple)):
+        key = payload if isinstance(payload, str) else payload[-1]
+        return [Event(topic, f"{topic}Deregistered", key, index, None)]
+    return [Event(topic, _TYPE_BY_TOPIC[topic],
+                  getattr(payload, "id", ""), index, payload)]
+
+
+class Subscription:
+    def __init__(self, topics: Dict[str, List[str]], maxsize: int) -> None:
+        self.topics = topics
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize)
+        self.closed = False
+
+    def matches(self, ev: Event) -> bool:
+        for topic, keys in self.topics.items():
+            if topic not in (TOPIC_ALL, ev.topic):
+                continue
+            if not keys or TOPIC_ALL in keys or ev.key in keys:
+                return True
+        return False
+
+    def offer(self, ev: Optional[Event]) -> None:
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            # slow consumer: drop oldest to keep the stream live
+            try:
+                self._q.get_nowait()
+                self._q.put_nowait(ev)
+            except queue.Empty:
+                pass
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Blocking pull; None on close sentinel or timeout."""
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if ev is None:
+            self.closed = True
+        return ev
+
+    def __iter__(self):
+        while not self.closed:
+            ev = self.next(timeout=0.5)
+            if ev is not None:
+                yield ev
+
+
+class EventBroker:
+    def __init__(self, buffer_size: int = 4096) -> None:
+        self._lock = threading.Lock()
+        # raw (topic, index, payload) commit records; one per store commit
+        self._buffer: List[Tuple[str, int, object]] = []
+        self._buffer_size = buffer_size
+        self._subs: List[Subscription] = []
+
+    # ------------------------------------------------------------- attach
+
+    def attach(self, store) -> None:
+        """Subscribe to a StateStore; its commit callbacks become events.
+        Runs under the store's write lock — O(1) append, no expansion."""
+        store.subscribe(self._on_state_event)
+
+    def _on_state_event(self, topic: str, index: int, payload) -> None:
+        if topic not in _TYPE_BY_TOPIC:
+            return
+        with self._lock:
+            self._buffer.append((topic, index, payload))
+            if len(self._buffer) > self._buffer_size:
+                del self._buffer[:len(self._buffer) - self._buffer_size]
+            subs = list(self._subs)
+        if not subs:
+            return
+        events = _expand(topic, index, payload)
+        for sub in subs:
+            for ev in events:
+                if sub.matches(ev):
+                    sub.offer(ev)
+
+    # ------------------------------------------------------------ pub/sub
+
+    def subscribe(self, topics: Optional[Dict[str, List[str]]] = None,
+                  from_index: int = 0, maxsize: int = 1024) -> Subscription:
+        """`topics={"Allocation": ["*"]}`; None/empty = everything.
+        Buffered events with index > from_index replay first.  The backlog
+        is offered while holding the broker lock so a concurrent publish
+        cannot enqueue a newer event ahead of the replay."""
+        sub = Subscription(topics or {TOPIC_ALL: [TOPIC_ALL]}, maxsize)
+        with self._lock:
+            for topic, index, payload in self._buffer:
+                if index <= from_index:
+                    continue
+                for ev in _expand(topic, index, payload):
+                    if sub.matches(ev):
+                        sub.offer(ev)
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        sub.closed = True
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def close(self) -> None:
+        """Wake and end every subscriber (server shutdown)."""
+        with self._lock:
+            subs = list(self._subs)
+            self._subs.clear()
+        for sub in subs:
+            sub.offer(None)

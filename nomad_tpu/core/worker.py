@@ -46,7 +46,10 @@ class Worker:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            # generous join: a worker mid-device-call must be allowed to
+            # finish — abandoning a daemon thread inside the PJRT plugin
+            # aborts the whole process at interpreter exit
+            self._thread.join(timeout=60)
 
     def _run(self) -> None:
         while not self._stop.is_set():

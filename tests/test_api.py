@@ -1,0 +1,204 @@
+"""HTTP API + SDK + event stream + CLI (reference: command/agent/http.go,
+api/, nomad/stream/)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient, APIException
+from nomad_tpu.structs import codec
+
+
+@pytest.fixture(scope="module")
+def agent():
+    ag = Agent(num_clients=2, num_workers=1, heartbeat_ttl=3600)
+    ag.start()
+    yield ag
+    ag.shutdown()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return APIClient(address=agent.address)
+
+
+def _wire_batch_job(count=2, run_for=300):
+    job = mock.batch_job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].config = {"run_for_s": run_for}
+    return codec.encode(job), job
+
+
+def _wait(fn, timeout=60, period=0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(period)
+    return fn()
+
+
+class TestJobsAPI:
+    def test_register_status_allocs_stop(self, api):
+        wire, job = _wire_batch_job()
+        resp = api.jobs.register(wire)
+        assert resp["EvalID"]
+
+        stubs = api.jobs.list()
+        assert any(s["ID"] == job.id for s in stubs)
+
+        info = api.jobs.info(job.id)
+        assert info["ID"] == job.id and info["Type"] == "batch"
+
+        allocs = _wait(lambda: api.jobs.allocations(job.id))
+        assert len(allocs) == 2
+        assert all(a["JobID"] == job.id for a in allocs)
+
+        evals = api.jobs.evaluations(job.id)
+        assert evals and evals[0]["JobID"] == job.id
+
+        resp = api.jobs.deregister(job.id)
+        stopped = _wait(lambda: api.jobs.info(job.id).get("Stop"))
+        assert stopped
+
+    def test_job_plan_dry_run(self, api):
+        wire, job = _wire_batch_job(count=3)
+        out = api.jobs.plan(wire, diff=True)
+        assert out["CreatedAllocs"] == 3
+        assert out["FailedTGAllocs"] == {}
+        # plan is a dry run: nothing registered
+        with pytest.raises(APIException):
+            api.jobs.info(job.id)
+
+    def test_dispatch_and_periodic(self, api):
+        job = mock.batch_job()
+        job.parameterized = None
+        from nomad_tpu.structs import ParameterizedJobConfig
+        job.parameterized = ParameterizedJobConfig(meta_required=["k"])
+        api.jobs.register(codec.encode(job))
+        resp = api.jobs.dispatch(job.id, b"payload", {"k": "v"})
+        assert resp["DispatchedJobID"].startswith(job.id + "/dispatch-")
+        with pytest.raises(APIException) as e:
+            api.jobs.dispatch(job.id, b"", {})
+        assert "missing required meta" in str(e.value)
+
+    def test_node_endpoints(self, api, agent):
+        nodes = api.nodes.list()
+        assert len(nodes) == 2
+        info = api.nodes.info(nodes[0]["ID"])
+        assert info["ID"] == nodes[0]["ID"]
+
+        api.nodes.eligibility(nodes[0]["ID"], False)
+        assert _wait(lambda: api.nodes.info(
+            nodes[0]["ID"])["SchedulingEligibility"] == "ineligible")
+        api.nodes.eligibility(nodes[0]["ID"], True)
+
+    def test_operator_scheduler_config(self, api):
+        cfg = api.operator.scheduler_config()["SchedulerConfig"]
+        assert cfg["SchedulerAlgorithm"] in ("binpack", "spread")
+        cfg["SchedulerAlgorithm"] = "spread"
+        api.operator.set_scheduler_config(cfg)
+        cfg2 = api.operator.scheduler_config()["SchedulerConfig"]
+        assert cfg2["SchedulerAlgorithm"] == "spread"
+        cfg2["SchedulerAlgorithm"] = "binpack"
+        api.operator.set_scheduler_config(cfg2)
+
+    def test_agent_and_metrics(self, api):
+        self_ = api.agent.self()
+        assert self_["config"]["Server"]["Enabled"]
+        m = api.agent.metrics()
+        assert "nomad.state.nodes" in m
+
+    def test_system_gc(self, api):
+        api.system.gc()   # must not error
+
+    def test_search(self, api, agent):
+        wire, job = _wire_batch_job()
+        api.jobs.register(wire)
+        resp = agent.server  # ensure registered
+        out = api.request("PUT", "/v1/search",
+                          body={"Prefix": job.id[:10], "Context": "jobs"})
+        assert job.id in out["Matches"]["jobs"]
+
+
+class TestEventStream:
+    def test_stream_delivers_job_events(self, api, agent):
+        wire, job = _wire_batch_job()
+        got = []
+        done = threading.Event()
+
+        def consume():
+            # replay may deliver earlier jobs' events first; wait for OURS
+            for batch in api.events.stream(topics=["Job:*"]):
+                got.extend(batch["Events"])
+                if any(e["Topic"] == "Job" and e["Key"] == job.id
+                       for e in got):
+                    done.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        api.jobs.register(wire)
+        assert done.wait(10), "no Job event for the registered job"
+        ev = next(e for e in got if e["Key"] == job.id)
+        assert ev["Payload"]["ID"] == job.id
+
+
+class TestBlockingQueries:
+    def test_jobs_list_blocks_until_index(self, api, agent):
+        idx = agent.server.state.latest_index()
+
+        result = {}
+
+        def blocked():
+            result["jobs"] = api.request(
+                "GET", "/v1/jobs", params={"index": idx, "wait": 10})
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        wire, job = _wire_batch_job()
+        api.jobs.register(wire)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert any(s["ID"] == job.id for s in result["jobs"])
+
+
+class TestCLI:
+    def test_cli_against_live_agent(self, agent, tmp_path, capsys):
+        from nomad_tpu.cli import main
+        addr = agent.address
+
+        spec = tmp_path / "cli-job.hcl"
+        spec.write_text('''
+job "cli-demo" {
+  datacenters = ["dc1"]
+  type = "batch"
+  group "g" {
+    count = 1
+    task "t" {
+      driver = "mock"
+      config { run_for_s = 300 }
+      resources { cpu = 100 memory = 64 }
+    }
+  }
+}
+''')
+        assert main(["-address", addr, "job", "run", str(spec)]) == 0
+        out = capsys.readouterr().out
+        assert "registered" in out
+
+        assert main(["-address", addr, "job", "status"]) == 0
+        assert "cli-demo" in capsys.readouterr().out
+
+        assert main(["-address", addr, "node", "status"]) == 0
+        assert main(["-address", addr, "eval", "list"]) == 0
+        assert main(["-address", addr, "operator", "scheduler",
+                     "get-config"]) == 0
+        assert main(["-address", addr, "job", "stop", "cli-demo"]) == 0
+        capsys.readouterr()
