@@ -244,5 +244,8 @@ class Events(_Endpoint):
         with urllib.request.urlopen(req) as resp:
             for line in resp:
                 line = line.strip()
-                if line:
-                    yield json.loads(line)
+                if not line:
+                    continue
+                batch = json.loads(line)
+                if batch.get("Events"):      # skip idle heartbeats ({})
+                    yield batch

@@ -112,7 +112,10 @@ class Router:
                        if j.namespace == ns or ns == "*"]
                 return sorted(out, key=lambda j: j["ID"])
             if method in ("PUT", "POST"):
-                job = _decode_job((body or {}).get("Job") or {}, ns)
+                wire = (body or {}).get("Job")
+                if not wire or not wire.get("ID"):
+                    raise APIError(400, "job must be specified")
+                job = _decode_job(wire, ns)
                 ev = s.register_job(job)
                 return {"EvalID": ev.id if ev else "",
                         "JobModifyIndex": s.state.job_by_id(
@@ -510,7 +513,10 @@ class HTTPAPIServer:
                 for t in qs.get("topic", []):
                     topic, _, key = t.partition(":")
                     topics.setdefault(topic, []).append(key or "*")
-                from_index = int((qs.get("index") or ["0"])[0])
+                try:
+                    from_index = int((qs.get("index") or ["0"])[0])
+                except ValueError:
+                    return self._respond(400, {"Error": "bad index"})
                 sub = router.server.events.subscribe(
                     topics or None, from_index=from_index)
                 self.send_response(200)
@@ -539,9 +545,15 @@ class HTTPAPIServer:
                             # (otherwise the subscription leaks forever)
                             chunk(b"{}\n")
                             last_write = _time.time()
+                    # graceful end (broker closed): terminate the chunked
+                    # body so the client's read() returns instead of
+                    # waiting for more chunks forever
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
+                    self.close_connection = True
                     router.server.events.unsubscribe(sub)
 
             def do_GET(self):

@@ -111,7 +111,14 @@ def cmd_job_dispatch(args) -> int:
     if args.payload_file:
         with open(args.payload_file, "rb") as f:
             payload = f.read()
-    meta = dict(kv.split("=", 1) for kv in args.meta or [])
+    meta = {}
+    for kv in args.meta or []:
+        if "=" not in kv:
+            print(f"Error: -meta expects key=value, got {kv!r}",
+                  file=sys.stderr)
+            return 1
+        k, v = kv.split("=", 1)
+        meta[k] = v
     resp = _client(args).jobs.dispatch(args.job_id, payload, meta)
     print(f"dispatched {resp['DispatchedJobID']}")
     return 0
@@ -394,11 +401,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    import urllib.error
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)
     except APIException as e:
         print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"Error connecting to {args.address}: {e.reason}",
+              file=sys.stderr)
         return 1
     except FileNotFoundError as e:
         print(f"Error: {e}", file=sys.stderr)

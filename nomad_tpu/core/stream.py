@@ -56,8 +56,25 @@ class Event:
         }
 
 
+class _AllocIds:
+    """Replay stub kept in the buffer instead of a full alloc list: a
+    100k-alloc plan apply must not stay pinned in the replay buffer.
+    Live fan-out still delivers full payloads; REPLAYED alloc events
+    always carry the key with a null payload (consumers re-fetch current
+    state) — deterministic regardless of who was subscribed at commit
+    time."""
+
+    __slots__ = ("ids",)
+
+    def __init__(self, ids) -> None:
+        self.ids = ids
+
+
 def _expand(topic: str, index: int, payload) -> List[Event]:
     if topic == "Allocations":
+        if isinstance(payload, _AllocIds):
+            return [Event("Allocation", "AllocationUpdated", aid, index,
+                          None) for aid in payload.ids]
         return [Event("Allocation", "AllocationUpdated", a.id, index, a)
                 for a in payload]
     if topic not in _TYPE_BY_TOPIC:
@@ -130,10 +147,13 @@ class EventBroker:
         if topic not in _TYPE_BY_TOPIC:
             return
         with self._lock:
-            self._buffer.append((topic, index, payload))
+            subs = list(self._subs)
+            buffered = payload
+            if topic == "Allocations":
+                buffered = _AllocIds([a.id for a in payload])
+            self._buffer.append((topic, index, buffered))
             if len(self._buffer) > self._buffer_size:
                 del self._buffer[:len(self._buffer) - self._buffer_size]
-            subs = list(self._subs)
         if not subs:
             return
         events = _expand(topic, index, payload)

@@ -1,0 +1,3 @@
+"""Native PJRT bridge bindings (SURVEY.md §7 P6)."""
+
+from .bridge import PjrtBridge, bridge_available, build_bridge  # noqa: F401
