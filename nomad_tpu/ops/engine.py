@@ -40,6 +40,10 @@ from .select import (
 BULK_THRESHOLD = 64
 BULK_ROUND = 1024
 
+# fixed-size chunks so the delta-replay scatter compiles ONCE, not once
+# per power-of-two delta size (a 100k-alloc plan's replay was paying a
+# multi-second device compile the first time each size appeared)
+SCATTER_CHUNK = 16384
 _scatter_add_jit = jax.jit(lambda u, r, v: u.at[r].add(v))
 
 
@@ -183,14 +187,34 @@ class PlacementEngine:
             if deltas is not None:
                 rows = np.concatenate([d[0] for d in deltas])
                 vals = np.concatenate([d[1] for d in deltas])
-                pad = _pad_pow2(max(len(rows), 1))
-                if pad != len(rows):
-                    rows = np.concatenate(
-                        [rows, np.zeros(pad - len(rows), rows.dtype)])
-                    vals = np.concatenate(
-                        [vals, np.zeros((pad - len(vals), 3), vals.dtype)])
-                self._used_dev = _scatter_add_jit(
-                    self._used_dev, jnp.asarray(rows), jnp.asarray(vals))
+                # aggregate per row first: a 100k-alloc plan touches far
+                # fewer distinct rows; the tunnel upload shrinks with it
+                if len(rows) > SCATTER_CHUNK:
+                    uniq, inv = np.unique(rows, return_inverse=True)
+                    agg = np.zeros((len(uniq), 3), vals.dtype)
+                    np.add.at(agg, inv, vals)
+                    rows, vals = uniq, agg
+                # fixed-size chunks -> one compiled scatter shape, ever
+                # a small ladder of pad buckets: bounded compile count
+                # (4 shapes ever) AND bounded upload waste (<= 4x) — the
+                # tunnel moves ~3MB/s, so padding a 600-row delta to the
+                # full 16384-row chunk would cost ~100ms per eval
+                dev = self._used_dev
+                for lo in range(0, len(rows), SCATTER_CHUNK):
+                    r_c = rows[lo:lo + SCATTER_CHUNK]
+                    v_c = vals[lo:lo + SCATTER_CHUNK]
+                    n_c = len(r_c)
+                    for pad in (512, 2048, 8192, SCATTER_CHUNK):
+                        if n_c <= pad:
+                            break
+                    if pad != n_c:
+                        r_c = np.concatenate(
+                            [r_c, np.zeros(pad - n_c, r_c.dtype)])
+                        v_c = np.concatenate(
+                            [v_c, np.zeros((pad - n_c, 3), v_c.dtype)])
+                    dev = _scatter_add_jit(
+                        dev, jnp.asarray(r_c), jnp.asarray(v_c))
+                self._used_dev = dev
             else:
                 # copy=True: t.used is mutated in place by the packer's
                 # delta accounting; an aliased upload double-applies
