@@ -21,13 +21,15 @@ class Agent:
                  http_host: str = "127.0.0.1",
                  http_port: int = 0,
                  heartbeat_ttl: float = 30.0,
+                 acl_enabled: bool = False,
                  nodes: Optional[List[Node]] = None) -> None:
         if not server_enabled:
             raise NotImplementedError(
                 "client-only agents need a remote RPC transport; "
                 "in-process agents always embed the server")
         self.server = Server(num_workers=num_workers, dev_mode=False,
-                             heartbeat_ttl=heartbeat_ttl)
+                             heartbeat_ttl=heartbeat_ttl,
+                             acl_enabled=acl_enabled)
         self.clients: List[Client] = []
         if client_enabled:
             rpc = InProcessRPC(self.server)

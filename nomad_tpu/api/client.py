@@ -23,10 +23,12 @@ class APIException(Exception):
 
 class APIClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
-                 namespace: str = "default", timeout: float = 35.0) -> None:
+                 namespace: str = "default", timeout: float = 35.0,
+                 token: str = "") -> None:
         self.address = address.rstrip("/")
         self.namespace = namespace
         self.timeout = timeout
+        self.token = token
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -36,6 +38,10 @@ class APIClient:
         self.system = System(self)
         self.agent = Agent(self)
         self.events = Events(self)
+        self.acl = ACLEndpoint(self)
+        self.namespaces = Namespaces(self)
+        self.node_pools = NodePools(self)
+        self.variables = Variables(self)
 
     # ---------------------------------------------------------- transport
 
@@ -46,9 +52,11 @@ class APIClient:
         params.setdefault("namespace", self.namespace)
         url = f"{self.address}{path}?{urllib.parse.urlencode(params, doseq=True)}"
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
         req = urllib.request.Request(url, data=data, method=method,
-                                     headers={"Content-Type":
-                                              "application/json"})
+                                     headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read() or b"null")
@@ -213,6 +221,12 @@ class Operator(_Endpoint):
         return self.c.put("/v1/operator/scheduler/configuration",
                           body=cfg_wire)
 
+    def snapshot_save(self) -> Dict:
+        return self.c.get("/v1/operator/snapshot")
+
+    def snapshot_restore(self, doc: Dict) -> Dict:
+        return self.c.put("/v1/operator/snapshot", body=doc)
+
 
 class System(_Endpoint):
     def gc(self) -> Dict:
@@ -228,6 +242,83 @@ class Agent(_Endpoint):
 
     def metrics(self) -> Dict:
         return self.c.get("/v1/metrics")
+
+
+class ACLEndpoint(_Endpoint):
+    def bootstrap(self) -> Dict:
+        return self.c.put("/v1/acl/bootstrap")
+
+    def policies(self) -> List[Dict]:
+        return self.c.get("/v1/acl/policies")
+
+    def policy(self, name: str) -> Dict:
+        return self.c.get(f"/v1/acl/policy/{name}")
+
+    def upsert_policy(self, name: str, rules: str,
+                      description: str = "") -> Dict:
+        return self.c.put(f"/v1/acl/policy/{name}",
+                          body={"Rules": rules,
+                                "Description": description})
+
+    def delete_policy(self, name: str) -> Dict:
+        return self.c.delete(f"/v1/acl/policy/{name}")
+
+    def tokens(self) -> List[Dict]:
+        return self.c.get("/v1/acl/tokens")
+
+    def create_token(self, name: str = "", type: str = "client",
+                     policies: Optional[List[str]] = None,
+                     global_: bool = False) -> Dict:
+        return self.c.put("/v1/acl/token",
+                          body={"Name": name, "Type": type,
+                                "Policies": policies or [],
+                                "Global": global_})
+
+    def token(self, accessor_id: str) -> Dict:
+        return self.c.get(f"/v1/acl/token/{accessor_id}")
+
+    def delete_token(self, accessor_id: str) -> Dict:
+        return self.c.delete(f"/v1/acl/token/{accessor_id}")
+
+
+class Namespaces(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/namespaces")
+
+    def apply(self, name: str, description: str = "") -> Dict:
+        return self.c.put(f"/v1/namespace/{name}",
+                          body={"Name": name, "Description": description})
+
+    def delete(self, name: str) -> Dict:
+        return self.c.delete(f"/v1/namespace/{name}")
+
+
+class NodePools(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/node_pools")
+
+    def apply(self, name: str, description: str = "",
+              scheduler_algorithm: str = "") -> Dict:
+        return self.c.put(f"/v1/node_pool/{name}",
+                          body={"Name": name, "Description": description,
+                                "SchedulerAlgorithm": scheduler_algorithm})
+
+    def delete(self, name: str) -> Dict:
+        return self.c.delete(f"/v1/node_pool/{name}")
+
+
+class Variables(_Endpoint):
+    def list(self, prefix: str = "") -> List[Dict]:
+        return self.c.get("/v1/vars", prefix=prefix)
+
+    def read(self, path: str) -> Dict:
+        return self.c.get(f"/v1/var/{path}")
+
+    def write(self, path: str, items: Dict[str, str]) -> Dict:
+        return self.c.put(f"/v1/var/{path}", body={"Items": items})
+
+    def delete(self, path: str) -> Dict:
+        return self.c.delete(f"/v1/var/{path}")
 
 
 class Events(_Endpoint):

@@ -63,6 +63,12 @@ def _stub(job: Job) -> Dict[str, Any]:
     }
 
 
+def _token_stub(t) -> Dict[str, Any]:
+    # the secret never appears in list responses
+    return {"AccessorID": t.accessor_id, "Name": t.name, "Type": t.type,
+            "Policies": list(t.policies), "Global": t.global_}
+
+
 def _node_stub(n: Node) -> Dict[str, Any]:
     return {
         "ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
@@ -87,21 +93,91 @@ class Router:
     # ------------------------------------------------------------ routing
 
     def route(self, method: str, path: str, qs: Dict[str, List[str]],
-              body: Optional[Dict]) -> Tuple[int, Any]:
+              body: Optional[Dict], token: str = "") -> Tuple[int, Any]:
         parts = [p for p in path.split("/") if p]
         if not parts or parts[0] != "v1":
             raise APIError(404, "not found")
         parts = parts[1:]
         ns = (qs.get("namespace") or [DEFAULT_NAMESPACE])[0]
+        acl = self._enforce(method, parts, ns, token)
         try:
-            return 200, self._dispatch(method, parts, ns, qs, body)
+            return 200, self._dispatch(method, parts, ns, qs, body, acl)
         except APIError:
             raise
         except (KeyError, IndexError) as e:
             raise APIError(404, f"not found: {e}")
 
+    @staticmethod
+    def _check_ns(acl, ns: str, cap: str) -> None:
+        """Re-check a namespace capability against the namespace an object
+        actually lives in (by-ID lookups and body-supplied namespaces must
+        not ride on the query-string namespace's grant)."""
+        if acl is not None and not acl.allow_namespace_operation(ns, cap):
+            raise APIError(403, f"permission denied: needs {cap} in {ns!r}")
+
+    # -------------------------------------------------------- enforcement
+
+    def _enforce(self, method: str, p: List[str], ns: str, token: str):
+        """Capability checks per endpoint family when ACLs are on
+        (reference: the aclObj checks at the top of every RPC handler).
+        Returns the compiled ACL (None when ACLs are disabled) so handlers
+        can re-check object namespaces."""
+        s = self.server
+        if not getattr(s, "acl_enabled", False):
+            return None
+        head = p[0] if p else ""
+        if head == "acl" and p[1:2] == ["bootstrap"]:
+            return None                 # one-shot, self-guarding
+        acl, err = s.resolve_token(token)
+        if acl is None:
+            raise APIError(403, err or "permission denied")
+        write = method in ("PUT", "POST", "DELETE")
+        if head == "acl":
+            if not acl.is_management():
+                raise APIError(403, "permission denied: management required")
+            return acl
+        if head == "operator" and p[1:2] == ["snapshot"]:
+            # a snapshot carries every token secret + all variables:
+            # management only, both directions (reference gates snapshot
+            # RPCs behind management tokens)
+            if not acl.is_management():
+                raise APIError(403, "permission denied: management required")
+            return acl
+        if head in ("jobs", "job", "allocations", "allocation",
+                    "evaluations", "evaluation", "deployments",
+                    "deployment", "search"):
+            cap = "submit-job" if write else "read-job"
+            if head in ("allocations", "allocation") and write:
+                cap = "alloc-lifecycle"
+            if not acl.allow_namespace_operation(ns, cap):
+                raise APIError(403, f"permission denied: needs {cap}")
+            return acl
+        if head in ("var", "vars"):
+            cap = "variables-write" if write else "variables-read"
+            if not acl.allow_namespace_operation(ns, cap):
+                raise APIError(403, f"permission denied: needs {cap}")
+            return acl
+        if head in ("nodes", "node"):
+            ok = acl.allow_node_write() if write else acl.allow_node_read()
+            if not ok:
+                raise APIError(403, "permission denied: node policy")
+            return acl
+        if head in ("operator", "system", "namespaces", "namespace",
+                    "node_pools", "node_pool"):
+            ok = (acl.allow_operator_write() if write
+                  else acl.allow_operator_read())
+            if not ok:
+                raise APIError(403, "permission denied: operator policy")
+            return acl
+        if head in ("agent", "metrics", "status", "event"):
+            if not acl.allow_agent_read():
+                raise APIError(403, "permission denied: agent policy")
+            return acl
+        return acl
+
     def _dispatch(self, method: str, p: List[str], ns: str,
-                  qs: Dict[str, List[str]], body: Optional[Dict]) -> Any:
+                  qs: Dict[str, List[str]], body: Optional[Dict],
+                  acl=None) -> Any:
         s = self.server
         head = p[0] if p else ""
         if head == "jobs":
@@ -116,12 +192,14 @@ class Router:
                 if not wire or not wire.get("ID"):
                     raise APIError(400, "job must be specified")
                 job = _decode_job(wire, ns)
+                if job.namespace != ns:
+                    self._check_ns(acl, job.namespace, "submit-job")
                 ev = s.register_job(job)
                 return {"EvalID": ev.id if ev else "",
                         "JobModifyIndex": s.state.job_by_id(
                             job.namespace, job.id).job_modify_index}
         elif head == "job":
-            return self._job(method, p[1:], ns, qs, body)
+            return self._job(method, p[1:], ns, qs, body, acl)
         elif head == "nodes":
             if method == "GET":
                 self._block(qs)
@@ -147,8 +225,10 @@ class Router:
             if a is None:
                 raise APIError(404, "alloc not found")
             if method == "GET":
+                self._check_ns(acl, a.namespace, "read-job")
                 return codec.encode(a)
             if method in ("PUT", "POST") and len(p) > 2 and p[2] == "stop":
+                self._check_ns(acl, a.namespace, "alloc-lifecycle")
                 stop = a.copy_skip_job()
                 stop.desired_status = "stop"
                 stop.desired_description = "alloc stopped via api"
@@ -171,6 +251,7 @@ class Router:
             ev = s.state.eval_by_id(eid)
             if ev is None:
                 raise APIError(404, "eval not found")
+            self._check_ns(acl, ev.namespace, "read-job")
             if len(p) > 2 and p[2] == "allocations":
                 snap = s.state.snapshot()
                 return [codec.encode(a) for a in
@@ -184,7 +265,7 @@ class Router:
                         for d in s.state.snapshot().deployments()
                         if d.namespace == ns or ns == "*"]
         elif head == "deployment":
-            return self._deployment(method, p[1:], body)
+            return self._deployment(method, p[1:], body, acl)
         elif head == "operator":
             if p[1:2] == ["scheduler"] and p[2:3] == ["configuration"]:
                 if method == "GET":
@@ -195,6 +276,33 @@ class Router:
                     cfg = codec.decode(SchedulerConfiguration, body or {})
                     s.state.set_scheduler_config(cfg)
                     return {"Updated": True}
+            if p[1:2] == ["snapshot"]:
+                if method == "GET":
+                    return s.save_snapshot()
+                if method in ("PUT", "POST"):
+                    s.restore_snapshot(body or {})
+                    return {"Restored": True}
+        elif head == "acl":
+            return self._acl(method, p[1:], body)
+        elif head == "namespaces":
+            if method == "GET":
+                return [codec.encode(n)
+                        for n in s.state.snapshot().namespaces()]
+        elif head == "namespace":
+            return self._namespace(method, p[1:], body)
+        elif head == "node_pools":
+            if method == "GET":
+                return [codec.encode(n)
+                        for n in s.state.snapshot().node_pools()]
+        elif head == "node_pool":
+            return self._node_pool(method, p[1:], body)
+        elif head == "vars":
+            if method == "GET":
+                prefix = (qs.get("prefix") or [""])[0]
+                return [codec.encode(v)
+                        for v in s.state.variables(ns, prefix)]
+        elif head == "var":
+            return self._var(method, p[1:], ns, body)
         elif head == "system":
             if p[1:2] == ["gc"] and method in ("PUT", "POST"):
                 s.force_gc()
@@ -226,7 +334,8 @@ class Router:
     # ----------------------------------------------------------- sub-trees
 
     def _job(self, method: str, p: List[str], ns: str,
-             qs: Dict[str, List[str]], body: Optional[Dict]) -> Any:
+             qs: Dict[str, List[str]], body: Optional[Dict],
+             acl=None) -> Any:
         s = self.server
         job_id = urllib.parse.unquote(p[0])
         sub = p[1] if len(p) > 1 else ""
@@ -268,11 +377,16 @@ class Router:
             return {"EvalID": ev.id if ev else ""}
         if method in ("PUT", "POST"):
             if sub == "" and body and "Job" in body:
-                ev = s.register_job(_decode_job(body["Job"], ns))
+                j = _decode_job(body["Job"], ns)
+                if j.namespace != ns:
+                    self._check_ns(acl, j.namespace, "submit-job")
+                ev = s.register_job(j)
                 return {"EvalID": ev.id if ev else ""}
             if sub == "plan":
                 # a plan dry-run works for not-yet-registered jobs too
                 j = _decode_job((body or {}).get("Job") or {}, ns)
+                if j.namespace != ns:
+                    self._check_ns(acl, j.namespace, "submit-job")
                 diff = (body or {}).get("Diff", False)
                 return self._plan(j, diff)
             if job is None:
@@ -331,10 +445,13 @@ class Router:
         raise APIError(404, f"no node handler for {method} {p}")
 
     def _deployment(self, method: str, p: List[str],
-                    body: Optional[Dict]) -> Any:
+                    body: Optional[Dict], acl=None) -> Any:
         s = self.server
         if method in ("PUT", "POST") and len(p) == 2:
             op, dep_id = p
+            cur = s.state.deployment_by_id(dep_id)
+            if cur is not None:
+                self._check_ns(acl, cur.namespace, "submit-job")
             if op == "promote":
                 groups = (body or {}).get("Groups")
                 err = s.deployments.promote(
@@ -352,12 +469,142 @@ class Router:
         dep = s.state.deployment_by_id(p[0])
         if dep is None:
             raise APIError(404, "deployment not found")
+        self._check_ns(acl, dep.namespace, "read-job")
         if len(p) > 1 and p[1] == "allocations":
             snap = s.state.snapshot()
             return [codec.encode(a) for a in
                     snap.allocs_by_job(dep.namespace, dep.job_id)
                     if a.deployment_id == dep.id]
         return codec.encode(dep)
+
+    def _acl(self, method: str, p: List[str], body: Optional[Dict]) -> Any:
+        from nomad_tpu.acl import parse_policy
+        from nomad_tpu.structs import ACLPolicy, ACLToken
+        s = self.server
+        head = p[0] if p else ""
+        if head == "bootstrap" and method in ("PUT", "POST"):
+            token, err = s.bootstrap_acl()
+            if err:
+                raise APIError(400, err)
+            return codec.encode(token)
+        if head == "policies" and method == "GET":
+            return [{"Name": x.name, "Description": x.description}
+                    for x in s.state.acl_policies()]
+        if head == "policy":
+            name = p[1]
+            if method == "GET":
+                pol = s.state.acl_policy_by_name(name)
+                if pol is None:
+                    raise APIError(404, "policy not found")
+                return codec.encode(pol)
+            if method in ("PUT", "POST"):
+                rules = (body or {}).get("Rules", "")
+                try:
+                    parse_policy(rules)
+                except Exception as e:  # noqa: BLE001 - surface parse error
+                    raise APIError(400, f"invalid policy: {e}")
+                s.state.upsert_acl_policy(ACLPolicy(
+                    name=name,
+                    description=(body or {}).get("Description", ""),
+                    rules=rules))
+                return {}
+            if method == "DELETE":
+                s.state.delete_acl_policy(name)
+                return {}
+        if head == "tokens" and method == "GET":
+            return [_token_stub(t) for t in s.state.acl_tokens()]
+        if head == "token":
+            if method in ("PUT", "POST") and len(p) == 1:
+                t = ACLToken(
+                    name=(body or {}).get("Name", ""),
+                    type=(body or {}).get("Type", "client"),
+                    policies=list((body or {}).get("Policies", [])),
+                    global_=(body or {}).get("Global", False),
+                    create_time=__import__("time").time())
+                s.state.upsert_acl_token(t)
+                return codec.encode(t)
+            accessor = p[1]
+            tok = s.state.acl_token_by_accessor(accessor)
+            if tok is None:
+                raise APIError(404, "token not found")
+            if method == "GET":
+                return codec.encode(tok)
+            if method == "DELETE":
+                s.state.delete_acl_token(accessor)
+                return {}
+        raise APIError(404, f"no acl handler for {method} {p}")
+
+    def _namespace(self, method: str, p: List[str],
+                   body: Optional[Dict]) -> Any:
+        from nomad_tpu.structs import Namespace
+        s = self.server
+        name = p[0]
+        if method == "GET":
+            for n in s.state.snapshot().namespaces():
+                if n.name == name:
+                    return codec.encode(n)
+            raise APIError(404, "namespace not found")
+        if method in ("PUT", "POST"):
+            s.state.upsert_namespace(Namespace(
+                name=(body or {}).get("Name", name),
+                description=(body or {}).get("Description", "")))
+            return {}
+        if method == "DELETE":
+            err = s.state.delete_namespace(name)
+            if err:
+                raise APIError(400, err)
+            return {}
+        raise APIError(404, "bad namespace request")
+
+    def _node_pool(self, method: str, p: List[str],
+                   body: Optional[Dict]) -> Any:
+        from nomad_tpu.structs import NodePool
+        s = self.server
+        name = p[0]
+        if method == "GET":
+            for n in s.state.snapshot().node_pools():
+                if n.name == name:
+                    return codec.encode(n)
+            raise APIError(404, "node pool not found")
+        if method in ("PUT", "POST"):
+            s.state.upsert_node_pool(NodePool(
+                name=(body or {}).get("Name", name),
+                description=(body or {}).get("Description", ""),
+                scheduler_algorithm=(body or {}).get(
+                    "SchedulerAlgorithm", "")))
+            return {}
+        if method == "DELETE":
+            err = s.state.delete_node_pool(name)
+            if err:
+                raise APIError(400, err)
+            return {}
+        raise APIError(404, "bad node pool request")
+
+    def _var(self, method: str, p: List[str], ns: str,
+             body: Optional[Dict]) -> Any:
+        from nomad_tpu.structs import VariableItem
+        s = self.server
+        path = "/".join(p)
+        if not path:
+            raise APIError(400, "variable path required")
+        if method == "GET":
+            v = s.state.variable_by_path(ns, path)
+            if v is None:
+                raise APIError(404, "variable not found")
+            return codec.encode(v)
+        if method in ("PUT", "POST"):
+            items = (body or {}).get("Items") or {}
+            if not isinstance(items, dict) or not all(
+                    isinstance(k, str) and isinstance(v, str)
+                    for k, v in items.items()):
+                raise APIError(400, "Items must be a string map")
+            s.state.upsert_variable(VariableItem(
+                path=path, namespace=ns, items=dict(items)))
+            return codec.encode(s.state.variable_by_path(ns, path))
+        if method == "DELETE":
+            s.state.delete_variable(ns, path)
+            return {}
+        raise APIError(404, "bad variable request")
 
     # ------------------------------------------------------------ helpers
 
@@ -499,9 +746,10 @@ class HTTPAPIServer:
                         body = json.loads(self.rfile.read(length) or b"{}")
                     except json.JSONDecodeError:
                         return self._respond(400, {"Error": "bad json"})
+                token = self.headers.get("X-Nomad-Token", "")
                 try:
                     status, payload = router.route(
-                        method, parsed.path, qs, body)
+                        method, parsed.path, qs, body, token=token)
                     self._respond(status, payload)
                 except APIError as e:
                     self._respond(e.status, {"Error": str(e)})

@@ -32,7 +32,10 @@ def _str2bool(v: str) -> bool:
 
 
 def _client(args) -> APIClient:
-    return APIClient(address=args.address, namespace=args.namespace)
+    import os
+    token = getattr(args, "token", "") or os.environ.get("NOMAD_TOKEN", "")
+    return APIClient(address=args.address, namespace=args.namespace,
+                     token=token)
 
 
 def _out(data) -> None:
@@ -245,6 +248,139 @@ def cmd_operator_scheduler_set(args) -> int:
     return 0
 
 
+def cmd_acl_bootstrap(args) -> int:
+    tok = _client(args).acl.bootstrap()
+    print(f"Accessor ID: {tok['AccessorID']}")
+    print(f"Secret  ID: {tok['SecretID']}")
+    return 0
+
+
+def cmd_acl_policy_apply(args) -> int:
+    with open(args.file) as f:
+        rules = f.read()
+    _client(args).acl.upsert_policy(args.name, rules,
+                                    description=args.description)
+    print(f"policy {args.name!r} applied")
+    return 0
+
+
+def cmd_acl_policy_list(args) -> int:
+    for p in _client(args).acl.policies():
+        print(f"{p['Name']:<24} {p['Description']}")
+    return 0
+
+
+def cmd_acl_policy_delete(args) -> int:
+    _client(args).acl.delete_policy(args.name)
+    print(f"policy {args.name!r} deleted")
+    return 0
+
+
+def cmd_acl_token_create(args) -> int:
+    tok = _client(args).acl.create_token(
+        name=args.name, type=args.type, policies=args.policy or [])
+    print(f"Accessor ID: {tok['AccessorID']}")
+    print(f"Secret  ID: {tok['SecretID']}")
+    return 0
+
+
+def cmd_acl_token_list(args) -> int:
+    for t in _client(args).acl.tokens():
+        print(f"{t['AccessorID'][:8]}  {t['Type']:<11} "
+              f"{t['Name']:<24} {','.join(t['Policies'])}")
+    return 0
+
+
+def cmd_acl_token_delete(args) -> int:
+    _client(args).acl.delete_token(args.accessor_id)
+    print("token deleted")
+    return 0
+
+
+def cmd_namespace_list(args) -> int:
+    for n in _client(args).namespaces.list():
+        print(f"{n['Name']:<24} {n.get('Description', '')}")
+    return 0
+
+
+def cmd_namespace_apply(args) -> int:
+    _client(args).namespaces.apply(args.name,
+                                   description=args.description)
+    print(f"namespace {args.name!r} applied")
+    return 0
+
+
+def cmd_namespace_delete(args) -> int:
+    _client(args).namespaces.delete(args.name)
+    print(f"namespace {args.name!r} deleted")
+    return 0
+
+
+def cmd_node_pool_list(args) -> int:
+    for n in _client(args).node_pools.list():
+        print(f"{n['Name']:<24} {n.get('Description', '')}")
+    return 0
+
+
+def cmd_node_pool_apply(args) -> int:
+    _client(args).node_pools.apply(args.name,
+                                   description=args.description)
+    print(f"node pool {args.name!r} applied")
+    return 0
+
+
+def cmd_node_pool_delete(args) -> int:
+    _client(args).node_pools.delete(args.name)
+    print(f"node pool {args.name!r} deleted")
+    return 0
+
+
+def cmd_var_put(args) -> int:
+    items = {}
+    for kv in args.items:
+        if "=" not in kv:
+            print(f"Error: expected key=value, got {kv!r}", file=sys.stderr)
+            return 1
+        k, v = kv.split("=", 1)
+        items[k] = v
+    _client(args).variables.write(args.path, items)
+    print(f"wrote {len(items)} item(s) to {args.path}")
+    return 0
+
+
+def cmd_var_get(args) -> int:
+    _out(_client(args).variables.read(args.path))
+    return 0
+
+
+def cmd_var_list(args) -> int:
+    for v in _client(args).variables.list(prefix=args.prefix):
+        print(f"{v['Path']:<40} {len(v.get('Items', {}))} item(s)")
+    return 0
+
+
+def cmd_var_purge(args) -> int:
+    _client(args).variables.delete(args.path)
+    print(f"purged {args.path}")
+    return 0
+
+
+def cmd_snapshot_save(args) -> int:
+    doc = _client(args).operator.snapshot_save()
+    with open(args.file, "w") as f:
+        json.dump(doc, f)
+    print(f"snapshot saved to {args.file} (index {doc.get('Index')})")
+    return 0
+
+
+def cmd_snapshot_restore(args) -> int:
+    with open(args.file) as f:
+        doc = json.load(f)
+    _client(args).operator.snapshot_restore(doc)
+    print(f"state restored from {args.file}")
+    return 0
+
+
 def cmd_system_gc(args) -> int:
     _client(args).system.gc()
     print("gc forced")
@@ -274,6 +410,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="nomad-tpu", description="TPU-native cluster scheduler CLI")
     p.add_argument("-address", default=DEFAULT_ADDR)
     p.add_argument("-namespace", default="default")
+    p.add_argument("-token", default="",
+                   help="ACL secret (or NOMAD_TOKEN env)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser("agent", help="run an agent (server+client+http)")
@@ -384,6 +522,86 @@ def build_parser() -> argparse.ArgumentParser:
                      dest="memory_oversubscription", type=_str2bool,
                      default=None)
     os_.set_defaults(fn=cmd_operator_scheduler_set)
+
+    osnap = op.add_parser("snapshot").add_subparsers(dest="snap_cmd",
+                                                     required=True)
+    osv = osnap.add_parser("save")
+    osv.add_argument("file")
+    osv.set_defaults(fn=cmd_snapshot_save)
+    ors = osnap.add_parser("restore")
+    ors.add_argument("file")
+    ors.set_defaults(fn=cmd_snapshot_restore)
+
+    acl = sub.add_parser("acl", help="ACL management").add_subparsers(
+        dest="acl_cmd", required=True)
+    ab = acl.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl_bootstrap)
+    apol = acl.add_parser("policy").add_subparsers(dest="pol_cmd",
+                                                   required=True)
+    apa = apol.add_parser("apply")
+    apa.add_argument("name")
+    apa.add_argument("file")
+    apa.add_argument("-description", default="")
+    apa.set_defaults(fn=cmd_acl_policy_apply)
+    apl = apol.add_parser("list")
+    apl.set_defaults(fn=cmd_acl_policy_list)
+    apd = apol.add_parser("delete")
+    apd.add_argument("name")
+    apd.set_defaults(fn=cmd_acl_policy_delete)
+    atok = acl.add_parser("token").add_subparsers(dest="tok_cmd",
+                                                  required=True)
+    atc = atok.add_parser("create")
+    atc.add_argument("-name", default="")
+    atc.add_argument("-type", default="client",
+                     choices=["client", "management"])
+    atc.add_argument("-policy", action="append")
+    atc.set_defaults(fn=cmd_acl_token_create)
+    atl = atok.add_parser("list")
+    atl.set_defaults(fn=cmd_acl_token_list)
+    atd = atok.add_parser("delete")
+    atd.add_argument("accessor_id")
+    atd.set_defaults(fn=cmd_acl_token_delete)
+
+    nsp = sub.add_parser("namespace",
+                         help="namespace management").add_subparsers(
+        dest="ns_cmd", required=True)
+    nsl = nsp.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace_list)
+    nsa = nsp.add_parser("apply")
+    nsa.add_argument("name")
+    nsa.add_argument("-description", default="")
+    nsa.set_defaults(fn=cmd_namespace_apply)
+    nsd = nsp.add_parser("delete")
+    nsd.add_argument("name")
+    nsd.set_defaults(fn=cmd_namespace_delete)
+
+    npp = node.add_parser("pool").add_subparsers(dest="pool_cmd",
+                                                 required=True)
+    npl = npp.add_parser("list")
+    npl.set_defaults(fn=cmd_node_pool_list)
+    npa = npp.add_parser("apply")
+    npa.add_argument("name")
+    npa.add_argument("-description", default="")
+    npa.set_defaults(fn=cmd_node_pool_apply)
+    npd = npp.add_parser("delete")
+    npd.add_argument("name")
+    npd.set_defaults(fn=cmd_node_pool_delete)
+
+    var = sub.add_parser("var", help="variables").add_subparsers(
+        dest="var_cmd", required=True)
+    vp = var.add_parser("put")
+    vp.add_argument("path")
+    vp.add_argument("items", nargs="+")
+    vp.set_defaults(fn=cmd_var_put)
+    vg = var.add_parser("get")
+    vg.add_argument("path")
+    vg.set_defaults(fn=cmd_var_get)
+    vl = var.add_parser("list")
+    vl.add_argument("-prefix", default="")
+    vl.set_defaults(fn=cmd_var_list)
+    vpu = var.add_parser("purge")
+    vpu.add_argument("path")
+    vpu.set_defaults(fn=cmd_var_purge)
 
     system = sub.add_parser("system").add_subparsers(dest="sys_cmd",
                                                      required=True)

@@ -52,7 +52,8 @@ from .worker import Worker
 class Server:
     def __init__(self, num_workers: int = 1, dev_mode: bool = True,
                  heartbeat_ttl: float = 30.0,
-                 failed_follow_up_delay: tuple = (60.0, 240.0)) -> None:
+                 failed_follow_up_delay: tuple = (60.0, 240.0),
+                 acl_enabled: bool = False) -> None:
         self.state = StateStore()
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.eval_broker)
@@ -71,6 +72,8 @@ class Server:
         # the queue (reference: evalFailedFollowupBaselineDelay 1min +
         # up to 4min jitter in nomad/leader.go)
         self.failed_follow_up_delay = failed_follow_up_delay
+        self.acl_enabled = acl_enabled
+        self._acl_cache: Dict[tuple, object] = {}
         self.workers = [Worker(self, i) for i in range(num_workers)]
         self._applier_running = False
         self._leader = False
@@ -194,6 +197,76 @@ class Server:
         """reference: System.GarbageCollect RPC (`nomad system gc`)."""
         self.apply_eval_update([Evaluation(
             type="_core", job_id="force-gc", priority=100)], now=now)
+
+    # ------------------------------------------------------------------ acl
+
+    def bootstrap_acl(self):
+        """Mint the initial management token (reference: ACL.Bootstrap).
+        Returns (token, error)."""
+        from nomad_tpu.structs import ACL_TOKEN_TYPE_MANAGEMENT, ACLToken
+        token = ACLToken(name="Bootstrap Token",
+                         type=ACL_TOKEN_TYPE_MANAGEMENT,
+                         global_=True, create_time=time.time())
+        # the exists-check and insert are one atomic store op: concurrent
+        # bootstrap requests must not each mint a management token
+        if not self.state.bootstrap_acl_token(token):
+            return None, "ACL bootstrap already done"
+        return token, ""
+
+    def resolve_token(self, secret_id: str):
+        """secret -> compiled ACL; (None, error) when unknown
+        (reference: Server.ResolveToken + its ACL cache)."""
+        from nomad_tpu.acl import compile_acl, management_acl, parse_policy
+        if not self.acl_enabled:
+            return management_acl(), ""
+        if not secret_id:
+            from nomad_tpu.acl import ACL
+            return ACL(), ""           # anonymous: no capabilities
+        token = self.state.acl_token_by_secret(secret_id)
+        if token is None:
+            return None, "ACL token not found"
+        if token.is_management():
+            return management_acl(), ""
+        pols = [(name, self.state.acl_policy_by_name(name))
+                for name in token.policies]
+        # compiled-ACL cache: HCL parse + compile is too hot for a
+        # per-request path; key on every contributing modify_index so
+        # token rotation / policy edits invalidate naturally
+        key = (token.accessor_id, token.modify_index,
+               tuple((n, p.modify_index if p else -1) for n, p in pols))
+        hit = self._acl_cache.get(key)
+        if hit is not None:
+            return hit, ""
+        acl = compile_acl([parse_policy(p.rules)
+                           for _, p in pols if p is not None])
+        if len(self._acl_cache) > 512:
+            self._acl_cache.clear()
+        self._acl_cache[key] = acl
+        return acl, ""
+
+    # ------------------------------------------------------ checkpointing
+
+    def save_snapshot(self) -> Dict:
+        """reference: `nomad operator snapshot save`."""
+        return self.state.snapshot_save()
+
+    def restore_snapshot(self, doc: Dict) -> None:
+        """reference: `nomad operator snapshot restore` — replace state,
+        then re-run the leadership restore path so brokers/trackers match
+        the restored state."""
+        self.eval_broker.set_enabled(False)    # drop stale queue contents
+        self.blocked_evals.set_enabled(False)
+        self.state.snapshot_restore(doc)
+        self._acl_cache.clear()
+        # heartbeat timers must track the RESTORED node set: restored
+        # nodes get a fresh TTL (their clients re-heartbeat or expire);
+        # timers for nodes absent from the snapshot are dropped
+        now = time.time()
+        self.heartbeats = HeartbeatTimers(ttl=self.heartbeats.ttl)
+        for n in self.state.snapshot().nodes():
+            if n.status == "ready":
+                self.heartbeats.reset(n.id, now)
+        self.establish_leadership()
 
     def deregister_job(self, namespace: str, job_id: str,
                        purge: bool = False,

@@ -197,6 +197,12 @@ class ClusterPacker:
                     self._dirty.add(nid)
                 elif topic == "Allocations":
                     self._on_allocs_locked(payload)
+                elif topic == "Restore":
+                    # full-state replacement: every tensor and the usage
+                    # ledger are stale; next update() rebuilds from scratch
+                    self._all_dirty = True
+                    self._counted.clear()
+                    self._alloc_node.clear()
 
         store.subscribe(on_event)
 

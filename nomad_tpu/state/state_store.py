@@ -21,11 +21,14 @@ import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from nomad_tpu.structs import (
+    ACLPolicy,
+    ACLToken,
     Allocation,
     CSIVolume,
     Deployment,
     DesiredTransition,
     Evaluation,
+    VariableItem,
     Job,
     JOB_STATUS_DEAD,
     JOB_STATUS_PENDING,
@@ -59,6 +62,10 @@ class StateStore:
         self._node_pools: Dict[str, NodePool] = {
             "default": NodePool("default"), "all": NodePool("all")}
         self._csi_volumes: Dict[Tuple[str, str], CSIVolume] = {}
+        self._acl_policies: Dict[str, ACLPolicy] = {}
+        self._acl_tokens: Dict[str, ACLToken] = {}       # accessor -> token
+        self._acl_by_secret: Dict[str, ACLToken] = {}
+        self._variables: Dict[Tuple[str, str], VariableItem] = {}
         self._scheduler_config = SchedulerConfiguration()
         # secondary indexes (bucket dicts are copy-on-write)
         self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
@@ -453,6 +460,249 @@ class StateStore:
             self._node_pools = {**self._node_pools, pool.name: pool}
             return idx
 
+    def delete_namespace(self, name: str) -> Optional[str]:
+        """Returns an error string when the namespace is non-empty."""
+        with self._lock:
+            if name == "default":
+                return "default namespace cannot be deleted"
+            if any(k[0] == name and j.status != JOB_STATUS_DEAD
+                   for k, j in self._jobs.items()):
+                return "namespace has non-terminal jobs"
+            self._bump()
+            nss = dict(self._namespaces)
+            nss.pop(name, None)
+            self._namespaces = nss
+            # variables are namespace-scoped: deleting the namespace must
+            # not leave (possibly secret-bearing) entries to be resurrected
+            # by a later namespace of the same name
+            if any(k[0] == name for k in self._variables):
+                self._variables = {k: v for k, v in self._variables.items()
+                                   if k[0] != name}
+            return None
+
+    def delete_node_pool(self, name: str) -> Optional[str]:
+        with self._lock:
+            if name in ("default", "all"):
+                return f"builtin node pool {name!r} cannot be deleted"
+            if any(n.node_pool == name for n in self._nodes.values()):
+                return "node pool has registered nodes"
+            self._bump()
+            pools = dict(self._node_pools)
+            pools.pop(name, None)
+            self._node_pools = pools
+            return None
+
+    # ------------------------------------------------------------------ acl
+
+    def upsert_acl_policy(self, policy: ACLPolicy) -> int:
+        with self._lock:
+            idx = self._bump()
+            prev = self._acl_policies.get(policy.name)
+            policy.create_index = prev.create_index if prev else idx
+            policy.modify_index = idx
+            self._acl_policies = {**self._acl_policies,
+                                  policy.name: policy}
+            return idx
+
+    def delete_acl_policy(self, name: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            pols = dict(self._acl_policies)
+            pols.pop(name, None)
+            self._acl_policies = pols
+            return idx
+
+    def acl_policy_by_name(self, name: str) -> Optional[ACLPolicy]:
+        return self._acl_policies.get(name)
+
+    def acl_policies(self) -> List[ACLPolicy]:
+        return list(self._acl_policies.values())
+
+    def upsert_acl_token(self, token: ACLToken) -> int:
+        with self._lock:
+            idx = self._bump()
+            prev = self._acl_tokens.get(token.accessor_id)
+            token.create_index = prev.create_index if prev else idx
+            token.modify_index = idx
+            self._acl_tokens = {**self._acl_tokens,
+                                token.accessor_id: token}
+            by_secret = dict(self._acl_by_secret)
+            if prev is not None and prev.secret_id != token.secret_id:
+                # rotation: the old secret must stop authenticating
+                by_secret.pop(prev.secret_id, None)
+            by_secret[token.secret_id] = token
+            self._acl_by_secret = by_secret
+            return idx
+
+    def bootstrap_acl_token(self, token: ACLToken) -> bool:
+        """Atomically insert the very first token (reference:
+        ACL.Bootstrap's reset-index guard).  False when already done."""
+        with self._lock:
+            if self._acl_tokens:
+                return False
+            idx = self._bump()
+            token.create_index = token.modify_index = idx
+            self._acl_tokens = {token.accessor_id: token}
+            self._acl_by_secret = {token.secret_id: token}
+            return True
+
+    def delete_acl_token(self, accessor_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            toks = dict(self._acl_tokens)
+            tok = toks.pop(accessor_id, None)
+            self._acl_tokens = toks
+            if tok is not None:
+                by_secret = dict(self._acl_by_secret)
+                by_secret.pop(tok.secret_id, None)
+                self._acl_by_secret = by_secret
+            return idx
+
+    def acl_token_by_accessor(self, accessor_id: str) -> Optional[ACLToken]:
+        return self._acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str) -> Optional[ACLToken]:
+        return self._acl_by_secret.get(secret_id)
+
+    def acl_tokens(self) -> List[ACLToken]:
+        return list(self._acl_tokens.values())
+
+    # ------------------------------------------------------------ variables
+
+    def upsert_variable(self, var: VariableItem) -> int:
+        with self._lock:
+            idx = self._bump()
+            key = (var.namespace, var.path)
+            prev = self._variables.get(key)
+            var.create_index = prev.create_index if prev else idx
+            var.modify_index = idx
+            self._variables = {**self._variables, key: var}
+            return idx
+
+    def delete_variable(self, namespace: str, path: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            vs = dict(self._variables)
+            vs.pop((namespace, path), None)
+            self._variables = vs
+            return idx
+
+    def variable_by_path(self, namespace: str,
+                         path: str) -> Optional[VariableItem]:
+        return self._variables.get((namespace, path))
+
+    def variables(self, namespace: Optional[str] = None,
+                  prefix: str = "") -> List[VariableItem]:
+        return [v for (ns, p), v in self._variables.items()
+                if (namespace is None or ns == namespace)
+                and p.startswith(prefix)]
+
+    # --------------------------------------------------- persist / restore
+
+    def snapshot_save(self) -> Dict:
+        """Serialize the full cluster state to one JSON-safe document
+        (reference: FSM Snapshot + `nomad operator snapshot save`).
+        Embedded job pointers on allocs are stripped and re-attached on
+        restore (they would otherwise duplicate every job per alloc)."""
+        from nomad_tpu.structs import codec
+        with self._lock:
+            allocs = []
+            for a in self._allocs.values():
+                slim = a.copy_skip_job()
+                slim.job = None
+                allocs.append(codec.encode(slim))
+            return {
+                "Index": self._index,
+                "Nodes": [codec.encode(n) for n in self._nodes.values()],
+                "Jobs": [codec.encode(j) for j in self._jobs.values()],
+                "JobVersions": [
+                    {"Namespace": k[0], "ID": k[1],
+                     "Versions": {str(v): codec.encode(j)
+                                  for v, j in vs.items()}}
+                    for k, vs in self._job_versions.items()],
+                "Evals": [codec.encode(e) for e in self._evals.values()],
+                "Allocs": allocs,
+                "Deployments": [codec.encode(d)
+                                for d in self._deployments.values()],
+                "Namespaces": [codec.encode(n)
+                               for n in self._namespaces.values()],
+                "NodePools": [codec.encode(p)
+                              for p in self._node_pools.values()],
+                "ACLPolicies": [codec.encode(p)
+                                for p in self._acl_policies.values()],
+                "ACLTokens": [codec.encode(t)
+                              for t in self._acl_tokens.values()],
+                "Variables": [codec.encode(v)
+                              for v in self._variables.values()],
+                "SchedulerConfig": codec.encode(self._scheduler_config),
+            }
+
+    def snapshot_restore(self, doc: Dict) -> None:
+        """Replace ALL state with a snapshot_save document
+        (reference: FSM Restore + `nomad operator snapshot restore`)."""
+        from nomad_tpu.structs import (
+            SchedulerConfiguration as SC, codec)
+        with self._lock:
+            self._nodes = {n.id: n for n in
+                           (codec.decode(Node, d) for d in doc["Nodes"])}
+            self._jobs = {}
+            for d in doc["Jobs"]:
+                j = codec.decode(Job, d)
+                self._jobs[j.ns_id()] = j
+            self._job_versions = {}
+            for entry in doc.get("JobVersions", []):
+                key = (entry["Namespace"], entry["ID"])
+                self._job_versions[key] = {
+                    int(v): codec.decode(Job, jd)
+                    for v, jd in entry["Versions"].items()}
+            self._evals = {e.id: e for e in
+                           (codec.decode(Evaluation, d)
+                            for d in doc["Evals"])}
+            self._allocs = {}
+            self._allocs_by_node = {}
+            self._allocs_by_job = {}
+            for d in doc["Allocs"]:
+                a = codec.decode(Allocation, d)
+                a.job = self._job_versions.get(
+                    (a.namespace, a.job_id), {}).get(a.job_version) \
+                    or self._jobs.get((a.namespace, a.job_id))
+                self._allocs[a.id] = a
+                if a.node_id:
+                    self._allocs_by_node.setdefault(a.node_id, {})[a.id] = a
+                self._allocs_by_job.setdefault(
+                    (a.namespace, a.job_id), {})[a.id] = a
+            self._evals_by_job = {}
+            for e in self._evals.values():
+                self._evals_by_job.setdefault(
+                    (e.namespace, e.job_id), {})[e.id] = e
+            self._deployments = {d.id: d for d in
+                                 (codec.decode(Deployment, x)
+                                  for x in doc["Deployments"])}
+            self._namespaces = {n.name: n for n in
+                                (codec.decode(Namespace, d)
+                                 for d in doc["Namespaces"])}
+            self._node_pools = {p.name: p for p in
+                                (codec.decode(NodePool, d)
+                                 for d in doc["NodePools"])}
+            self._acl_policies = {p.name: p for p in
+                                  (codec.decode(ACLPolicy, d)
+                                   for d in doc.get("ACLPolicies", []))}
+            self._acl_tokens = {}
+            self._acl_by_secret = {}
+            for d in doc.get("ACLTokens", []):
+                t = codec.decode(ACLToken, d)
+                self._acl_tokens[t.accessor_id] = t
+                self._acl_by_secret[t.secret_id] = t
+            self._variables = {}
+            for d in doc.get("Variables", []):
+                v = codec.decode(VariableItem, d)
+                self._variables[(v.namespace, v.path)] = v
+            self._scheduler_config = codec.decode(
+                SC, doc.get("SchedulerConfig") or {})
+            self._index = max(int(doc.get("Index", 0)), self._index) + 1
+            self._index_cv.notify_all()
+            self._emit("Restore", self._index, None)
+
     # ------------------------------------------------------------ snapshot
 
     def snapshot(self) -> "StateSnapshot":
@@ -613,6 +863,12 @@ class StateSnapshot:
 
     def node_pool_by_name(self, name: str) -> Optional[NodePool]:
         return self._node_pools.get(name)
+
+    def node_pools(self) -> List[NodePool]:
+        return list(self._node_pools.values())
+
+    def namespaces(self) -> List[Namespace]:
+        return list(self._namespaces.values())
 
     def scheduler_config(self) -> SchedulerConfiguration:
         return self._scheduler_config

@@ -1039,6 +1039,49 @@ NODE_POOL_ALL = "all"
 NODE_POOL_DEFAULT = "default"
 
 
+# ---------------------------------------------------------------------------
+# ACL (reference: structs ACLPolicy / ACLToken)
+# ---------------------------------------------------------------------------
+
+ACL_TOKEN_TYPE_CLIENT = "client"
+ACL_TOKEN_TYPE_MANAGEMENT = "management"
+
+
+@dataclass
+class ACLPolicy:
+    name: str = ""
+    description: str = ""
+    rules: str = ""              # HCL/JSON policy document
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class ACLToken:
+    accessor_id: str = field(default_factory=new_id)   # public handle
+    secret_id: str = field(default_factory=new_id)     # the bearer secret
+    name: str = ""
+    type: str = ACL_TOKEN_TYPE_CLIENT
+    policies: List[str] = field(default_factory=list)
+    global_: bool = False
+    create_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def is_management(self) -> bool:
+        return self.type == ACL_TOKEN_TYPE_MANAGEMENT
+
+
+@dataclass
+class VariableItem:
+    """Decrypted variable (reference: structs.VariableDecrypted)."""
+    path: str = ""
+    namespace: str = "default"
+    items: Dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+
 @dataclass
 class CSIVolume:
     id: str = ""

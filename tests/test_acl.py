@@ -1,0 +1,275 @@
+"""ACL policies/tokens + enforcement, namespaces, node pools, variables,
+operator snapshot (reference: acl/, nomad/acl.go, structs variables,
+`nomad operator snapshot`)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl import compile_acl, parse_policy
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient, APIException
+from nomad_tpu.core import Server
+from nomad_tpu.structs import codec
+
+HCL_POLICY = '''
+namespace "default" { policy = "write" }
+namespace "ops-*"   { capabilities = ["read-job", "list-jobs"] }
+node     { policy = "read" }
+operator { policy = "read" }
+'''
+
+
+class TestPolicyParsing:
+    def test_hcl_policy(self):
+        p = parse_policy(HCL_POLICY)
+        assert len(p.namespaces) == 2
+        assert p.namespaces[0].policy == "write"
+        assert p.node == "read" and p.operator == "read"
+
+    def test_json_policy(self):
+        p = parse_policy(
+            '{"Namespaces": {"default": {"Policy": "read"}}, '
+            '"Agent": "write"}')
+        assert p.namespaces[0].policy == "read"
+        assert p.agent == "write"
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_policy('namespace "x" { policy = "root" }')
+        with pytest.raises(ValueError):
+            parse_policy('namespace "x" { capabilities = ["fly"] }')
+
+    def test_compiled_acl_semantics(self):
+        acl = compile_acl([parse_policy(HCL_POLICY)])
+        assert acl.allow_namespace_operation("default", "submit-job")
+        assert acl.allow_namespace_operation("ops-east", "read-job")
+        assert not acl.allow_namespace_operation("ops-east", "submit-job")
+        assert not acl.allow_namespace_operation("secret", "read-job")
+        assert acl.allow_node_read() and not acl.allow_node_write()
+        assert acl.allow_operator_read() and not acl.allow_operator_write()
+        assert not acl.allow_agent_read()
+
+    def test_glob_longest_match_and_deny(self):
+        acl = compile_acl([parse_policy('''
+namespace "*"       { policy = "read" }
+namespace "secret*" { policy = "deny" }
+''')])
+        assert acl.allow_namespace_operation("web", "read-job")
+        assert not acl.allow_namespace_operation("secret-x", "read-job")
+
+
+@pytest.fixture(scope="module")
+def acl_agent():
+    ag = Agent(num_clients=1, heartbeat_ttl=3600, acl_enabled=True)
+    ag.start()
+    yield ag
+    ag.shutdown()
+
+
+class TestACLEnforcement:
+    def test_bootstrap_and_enforcement(self, acl_agent):
+        anon = APIClient(address=acl_agent.address)
+        with pytest.raises(APIException) as e:
+            anon.jobs.list()
+        assert e.value.status == 403
+
+        boot = anon.acl.bootstrap()
+        mgmt = APIClient(address=acl_agent.address,
+                         token=boot["SecretID"])
+        assert mgmt.jobs.list() == []
+
+        # second bootstrap rejected
+        with pytest.raises(APIException):
+            anon.acl.bootstrap()
+
+        # scoped client token: read-only default namespace
+        mgmt.acl.upsert_policy(
+            "readonly", 'namespace "default" { policy = "read" }')
+        tok = mgmt.acl.create_token(name="ro", policies=["readonly"])
+        ro = APIClient(address=acl_agent.address, token=tok["SecretID"])
+        assert ro.jobs.list() == []
+        job = mock.batch_job()
+        with pytest.raises(APIException) as e:
+            ro.jobs.register(codec.encode(job))
+        assert e.value.status == 403
+        mgmt.jobs.register(codec.encode(job))      # management can
+        assert any(s["ID"] == job.id for s in ro.jobs.list())
+
+        # token list hides secrets
+        toks = mgmt.acl.tokens()
+        assert all("SecretID" not in t for t in toks)
+
+        # unknown token
+        bad = APIClient(address=acl_agent.address, token="nope")
+        with pytest.raises(APIException) as e:
+            bad.jobs.list()
+        assert e.value.status == 403
+
+
+class TestACLSecurityRegressions:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        ag = Agent(num_clients=1, heartbeat_ttl=3600, acl_enabled=True)
+        ag.start()
+        anon = APIClient(address=ag.address)
+        boot = anon.acl.bootstrap()
+        mgmt = APIClient(address=ag.address, token=boot["SecretID"])
+        yield ag, mgmt
+        ag.shutdown()
+
+    def test_body_namespace_cannot_escape_grant(self, setup):
+        ag, mgmt = setup
+        mgmt.namespaces.apply("dev")
+        mgmt.namespaces.apply("prod2")
+        mgmt.acl.upsert_policy(
+            "dev-w", 'namespace "dev" { policy = "write" }')
+        tok = mgmt.acl.create_token(name="dev", policies=["dev-w"])
+        dev = APIClient(address=ag.address, namespace="dev",
+                        token=tok["SecretID"])
+        wire = codec.encode(mock.batch_job())
+        wire["Namespace"] = "prod2"
+        with pytest.raises(APIException) as e:
+            dev.jobs.register(wire)
+        assert e.value.status == 403, \
+            "body namespace must not escape the granted namespace"
+
+    def test_by_id_lookup_enforces_object_namespace(self, setup):
+        ag, mgmt = setup
+        job = mock.batch_job()
+        job.namespace = "prod2"
+        job.task_groups[0].count = 1
+        wire = codec.encode(job)
+        mgmt.request("PUT", "/v1/jobs", params={"namespace": "prod2"},
+                     body={"Job": wire})
+        import time
+        deadline = time.time() + 30
+        allocs = []
+        while time.time() < deadline and not allocs:
+            allocs = mgmt.request("GET", f"/v1/job/{job.id}/allocations",
+                                  params={"namespace": "prod2"})
+            time.sleep(0.3)
+        assert allocs, "prod2 job never placed"
+        aid = allocs[0]["ID"]
+        tok = mgmt.acl.create_token(name="dev2", policies=["dev-w"])
+        dev = APIClient(address=ag.address, namespace="dev",
+                        token=tok["SecretID"])
+        with pytest.raises(APIException) as e:
+            dev.allocations.info(aid)
+        assert e.value.status == 403
+        with pytest.raises(APIException) as e:
+            dev.allocations.stop(aid)
+        assert e.value.status == 403
+
+    def test_snapshot_requires_management(self, setup):
+        ag, mgmt = setup
+        mgmt.acl.upsert_policy(
+            "op-read", 'operator { policy = "read" }')
+        tok = mgmt.acl.create_token(name="op", policies=["op-read"])
+        op = APIClient(address=ag.address, token=tok["SecretID"])
+        assert op.operator.scheduler_config()    # operator read works
+        with pytest.raises(APIException) as e:
+            op.operator.snapshot_save()
+        assert e.value.status == 403
+        assert mgmt.operator.snapshot_save()["ACLTokens"]
+
+    def test_token_rotation_revokes_old_secret(self, setup):
+        ag, mgmt = setup
+        from nomad_tpu.structs import ACLToken
+        s = ag.server
+        t = ACLToken(name="rot", policies=["dev-w"])
+        s.state.upsert_acl_token(t)
+        old_secret = t.secret_id
+        import dataclasses
+        t2 = dataclasses.replace(t)
+        t2.secret_id = "new-" + old_secret
+        s.state.upsert_acl_token(t2)
+        assert s.state.acl_token_by_secret(old_secret) is None
+        assert s.state.acl_token_by_secret(t2.secret_id) is not None
+
+    def test_bootstrap_is_atomic(self):
+        import threading
+        from nomad_tpu.core import Server
+        s = Server(dev_mode=True, acl_enabled=True)
+        results = []
+
+        def boot():
+            tok, err = s.bootstrap_acl()
+            results.append(tok)
+
+        threads = [threading.Thread(target=boot) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(1 for r in results if r is not None) == 1
+
+
+class TestNamespacesAndPoolsAndVars:
+    @pytest.fixture(scope="class")
+    def api(self):
+        ag = Agent(num_clients=1, heartbeat_ttl=3600)
+        ag.start()
+        yield APIClient(address=ag.address)
+        ag.shutdown()
+
+    def test_namespace_crud(self, api):
+        api.namespaces.apply("prod", description="production")
+        names = {n["Name"] for n in api.namespaces.list()}
+        assert {"default", "prod"} <= names
+        api.namespaces.delete("prod")
+        assert "prod" not in {n["Name"] for n in api.namespaces.list()}
+        with pytest.raises(APIException):
+            api.namespaces.delete("default")
+
+    def test_node_pool_crud(self, api):
+        api.node_pools.apply("gpu", description="accelerators")
+        assert "gpu" in {n["Name"] for n in api.node_pools.list()}
+        api.node_pools.delete("gpu")
+        with pytest.raises(APIException):
+            api.node_pools.delete("all")
+
+    def test_variables_crud(self, api):
+        api.variables.write("app/config", {"db": "pg://x", "key": "v"})
+        v = api.variables.read("app/config")
+        assert v["Items"]["db"] == "pg://x"
+        assert [x["Path"] for x in api.variables.list(prefix="app/")] \
+            == ["app/config"]
+        api.variables.delete("app/config")
+        with pytest.raises(APIException):
+            api.variables.read("app/config")
+
+
+class TestSnapshot:
+    def test_save_restore_round_trip(self):
+        s = Server(dev_mode=True, heartbeat_ttl=10**9)
+        s.establish_leadership()
+        for _ in range(3):
+            s.register_node(mock.node(), now=1000.0)
+        job = mock.batch_job()
+        job.task_groups[0].count = 4
+        s.register_job(job, now=1000.0)
+        s.process_all(now=1000.0)
+        allocs_before = s.state.allocs_by_job(job.namespace, job.id)
+        assert len(allocs_before) == 4
+
+        doc = s.save_snapshot()
+
+        s2 = Server(dev_mode=True, heartbeat_ttl=10**9)
+        s2.restore_snapshot(doc)
+        snap = s2.state.snapshot()
+        assert len(snap.nodes()) == 3
+        restored_job = snap.job_by_id(job.namespace, job.id)
+        assert restored_job is not None
+        allocs = snap.allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 4
+        assert all(a.job is not None for a in allocs), \
+            "job pointers re-attached"
+        # the restored server keeps scheduling: kill a node's allocs
+        victim = allocs[0].node_id
+        s2.update_node_status(victim, "down", now=2000.0)
+        s2.process_all(now=2000.0)
+        live = [a for a in
+                s2.state.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status() and a.desired_status == "run"]
+        assert len(live) == 4, "reschedule works on restored state"
+        assert all(a.node_id != victim for a in live)
