@@ -39,6 +39,7 @@ class APIClient:
         self.agent = Agent(self)
         self.events = Events(self)
         self.acl = ACLEndpoint(self)
+        self.services = Services(self)
         self.namespaces = Namespaces(self)
         self.node_pools = NodePools(self)
         self.variables = Variables(self)
@@ -242,6 +243,14 @@ class Agent(_Endpoint):
 
     def metrics(self) -> Dict:
         return self.c.get("/v1/metrics")
+
+
+class Services(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/services")
+
+    def info(self, name: str) -> List[Dict]:
+        return self.c.get(f"/v1/service/{name}")
 
 
 class ACLEndpoint(_Endpoint):

@@ -145,7 +145,7 @@ class Router:
             return acl
         if head in ("jobs", "job", "allocations", "allocation",
                     "evaluations", "evaluation", "deployments",
-                    "deployment", "search"):
+                    "deployment", "search", "services", "service"):
             cap = "submit-job" if write else "read-job"
             if head in ("allocations", "allocation") and write:
                 cap = "alloc-lifecycle"
@@ -296,6 +296,23 @@ class Router:
                         for n in s.state.snapshot().node_pools()]
         elif head == "node_pool":
             return self._node_pool(method, p[1:], body)
+        elif head == "services":
+            if method == "GET":
+                regs = s.state.service_registrations(
+                    None if ns == "*" else ns)
+                by_name: Dict[str, set] = {}
+                for r in regs:
+                    by_name.setdefault(r.service_name, set()).update(r.tags)
+                return [{"Namespace": ns, "Services": [
+                    {"ServiceName": name, "Tags": sorted(tags)}
+                    for name, tags in sorted(by_name.items())]}]
+        elif head == "service":
+            if method == "GET":
+                regs = s.state.service_registrations(
+                    None if ns == "*" else ns, p[1])
+                if not regs:
+                    raise APIError(404, "service not found")
+                return [codec.encode(r) for r in regs]
         elif head == "vars":
             if method == "GET":
                 prefix = (qs.get("prefix") or [""])[0]

@@ -381,6 +381,22 @@ def cmd_snapshot_restore(args) -> int:
     return 0
 
 
+def cmd_service_list(args) -> int:
+    for nsrow in _client(args).services.list():
+        for svc in nsrow.get("Services", []):
+            print(f"{svc['ServiceName']:<32} "
+                  f"{','.join(svc.get('Tags', []))}")
+    return 0
+
+
+def cmd_service_info(args) -> int:
+    for r in _client(args).services.info(args.name):
+        print(f"{r['ID'][:40]:<42} {r.get('Address', '')}:"
+              f"{r.get('Port', 0):<6} {r.get('Status', ''):<9} "
+              f"node {r.get('NodeID', '')[:8]}")
+    return 0
+
+
 def cmd_system_gc(args) -> int:
     _client(args).system.gc()
     print("gc forced")
@@ -602,6 +618,15 @@ def build_parser() -> argparse.ArgumentParser:
     vpu = var.add_parser("purge")
     vpu.add_argument("path")
     vpu.set_defaults(fn=cmd_var_purge)
+
+    svc = sub.add_parser("service",
+                         help="service discovery").add_subparsers(
+        dest="svc_cmd", required=True)
+    svl = svc.add_parser("list")
+    svl.set_defaults(fn=cmd_service_list)
+    svi = svc.add_parser("info")
+    svi.add_argument("name")
+    svi.set_defaults(fn=cmd_service_info)
 
     system = sub.add_parser("system").add_subparsers(dest="sys_cmd",
                                                      required=True)

@@ -32,12 +32,14 @@ from .task_runner import TaskRunner
 class AllocRunner:
     def __init__(self, alloc: Allocation, drivers: Dict, node,
                  alloc_dir: str = "",
-                 on_update: Optional[Callable] = None) -> None:
+                 on_update: Optional[Callable] = None,
+                 checks_healthy: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.node = node
         self.drivers = drivers
         self.alloc_dir = alloc_dir
         self.on_update = on_update
+        self.checks_healthy = checks_healthy
         self.task_runners: List[TaskRunner] = []
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -162,9 +164,16 @@ class AllocRunner:
                     # leader completing is a normal completion
                     for tr in self.task_runners:
                         tr.dead.wait(5)
-            # deployment health
+            # deployment health; with `health_check = "checks"` the
+            # service checks must also pass (reference: health_hook.go's
+            # checks watcher)
             if self.alloc.deployment_id and self.health is None:
-                if all_running:
+                healthy_now = all_running
+                if (healthy_now and tg is not None and tg.update is not None
+                        and tg.update.health_check == "checks"
+                        and self.checks_healthy is not None):
+                    healthy_now = self.checks_healthy(self.alloc.id)
+                if healthy_now:
                     if healthy_since is None:
                         healthy_since = time.time()
                     elif time.time() - healthy_since >= min_healthy:

@@ -51,6 +51,12 @@ class InProcessRPC:
     def update_allocs(self, allocs: List[Allocation]) -> None:
         self.server.update_allocs_from_client(allocs)
 
+    def update_service_registrations(self, regs) -> None:
+        self.server.state.upsert_service_registrations(regs)
+
+    def remove_service_registrations(self, alloc_id: str) -> None:
+        self.server.state.delete_service_registrations_by_alloc(alloc_id)
+
 
 class Client:
     def __init__(self, rpc, node: Optional[Node] = None,
@@ -65,6 +71,8 @@ class Client:
         self.heartbeat_interval = heartbeat_interval
         self.sync_interval = sync_interval
         self.state_db = StateDB(data_dir)
+        from .services import ServiceManager
+        self.services = ServiceManager(rpc, self.node)
         self.alloc_runners: Dict[str, AllocRunner] = {}
         self._known_index = 0
         self._dirty_allocs: Dict[str, Allocation] = {}
@@ -93,6 +101,7 @@ class Client:
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.services.shutdown()
         for ar in list(self.alloc_runners.values()):
             ar.destroy()
         for t in self._threads:
@@ -139,7 +148,8 @@ class Client:
                     continue
                 ar = AllocRunner(alloc.copy(), self.drivers, self.node,
                                  alloc_dir=self.data_dir,
-                                 on_update=self._on_alloc_update)
+                                 on_update=self._on_alloc_update,
+                                 checks_healthy=self.services.checks_healthy)
                 with self._lock:
                     self.alloc_runners[alloc.id] = ar
                     self.state_db.put_allocation(alloc)
@@ -159,6 +169,17 @@ class Client:
 
     def _on_alloc_update(self, ar: AllocRunner) -> None:
         client_status, dep_status, task_states = ar.client_update()
+        # service registration rides status transitions: register when the
+        # alloc reaches running, deregister once it is terminal
+        # (reference: serviceregistration groupservice/task services hooks)
+        try:
+            if client_status == "running":
+                self.services.register_alloc(ar.alloc)   # idempotent
+            elif client_status in ("complete", "failed", "lost") \
+                    and self.services.is_registered(ar.alloc.id):
+                self.services.deregister_alloc(ar.alloc.id)
+        except Exception:  # noqa: BLE001 - discovery must not kill sync
+            pass
         with self._lock:
             if ar.alloc.id not in self.alloc_runners:
                 # server already dropped this alloc and run_allocs removed

@@ -274,7 +274,8 @@ class PlacementEngine:
         if n == 0:
             return [self._no_nodes_decision(r, snapshot, job) for r in requests]
 
-        tg_tensors: TGTensors = self.packer.lower_task_groups(job, tgs)
+        tg_tensors: TGTensors = self.packer.lower_task_groups(
+            job, tgs, snapshot=snapshot)
         ctx: JobContext = self.packer.job_context(job, snapshot, t)
 
         name_to_g = {name: i for i, name in enumerate(tg_tensors.names)}

@@ -491,7 +491,7 @@ class ClusterPacker:
     # --------------------------------------------------------- TG lowering
 
     def lower_task_groups(self, job: Job, tgs: Sequence[TaskGroup],
-                          ) -> "TGTensors":
+                          snapshot=None) -> "TGTensors":
         """Pack the placeable unit: per-TG resource asks + constraint rows +
         affinity rows.  Job-level constraints/affinities apply to every TG;
         task-level ones are merged up (the TG is the placement unit).
@@ -515,6 +515,23 @@ class ClusterPacker:
                 if task.driver:
                     crows.append((self.ensure_column("driver." + task.driver),
                                   DOP_EQ, self.interner.intern("1")))
+            # volume feasibility (reference: HostVolumeChecker /
+            # CSIVolumeChecker): host volumes require the named volume on
+            # the node; CSI volumes require the volume's controller plugin
+            # on the node (topology/claims are re-checked at plan apply)
+            for vreq in tg.volumes.values():
+                if vreq.type == "host" and vreq.source:
+                    crows.append((
+                        self.ensure_column("hostvol." + vreq.source),
+                        DOP_EQ, self.interner.intern("1")))
+                elif vreq.type == "csi" and vreq.source:
+                    vol = (snapshot.csi_volume_by_id(job.namespace,
+                                                     vreq.source)
+                           if snapshot is not None else None)
+                    if vol is not None and vol.plugin_id:
+                        crows.append((
+                            self.ensure_column("csi." + vol.plugin_id),
+                            DOP_EQ, self.interner.intern("1")))
             for scope, constraints in (
                     (None, job.constraints),
                     (tg.name, list(tg.constraints)

@@ -119,7 +119,8 @@ class SystemScheduler(Scheduler):
     def _place(self, plan: Plan, job: Job, nodes, by_node_tg, evaluation):
         packer = self.engine.packer
         t = packer.update(self.state)
-        tgt = packer.lower_task_groups(job, job.task_groups)
+        tgt = packer.lower_task_groups(job, job.task_groups,
+                                       snapshot=self.state)
         ctx = packer.job_context(job, self.state, t)
         mask = np.asarray(feasible_mask(
             jnp.asarray(t.attrs), jnp.asarray(t.elig),

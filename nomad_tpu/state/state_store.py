@@ -38,6 +38,7 @@ from nomad_tpu.structs import (
     Plan,
     PlanResult,
     SchedulerConfiguration,
+    ServiceRegistration,
     compute_class,
 )
 
@@ -66,6 +67,7 @@ class StateStore:
         self._acl_tokens: Dict[str, ACLToken] = {}       # accessor -> token
         self._acl_by_secret: Dict[str, ACLToken] = {}
         self._variables: Dict[Tuple[str, str], VariableItem] = {}
+        self._services: Dict[str, ServiceRegistration] = {}
         self._scheduler_config = SchedulerConfiguration()
         # secondary indexes (bucket dicts are copy-on-write)
         self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
@@ -319,6 +321,14 @@ class StateStore:
                 fj_add(jkey)
             by_job[jkey][aid] = a
             ins_append(a)
+        # terminal allocs lose their service registrations server-side
+        # (reference: state store deletes registrations on terminal alloc
+        # upserts — covers clients that died before deregistering)
+        dead = {a.id for a in inserted if a.terminal_status()}
+        if dead and any(r.alloc_id in dead
+                        for r in self._services.values()):
+            self._services = {k: r for k, r in self._services.items()
+                              if r.alloc_id not in dead}
         self._allocs = table
         self._allocs_by_node = by_node
         self._allocs_by_job = by_job
@@ -567,6 +577,38 @@ class StateStore:
     def acl_tokens(self) -> List[ACLToken]:
         return list(self._acl_tokens.values())
 
+    # ----------------------------------------------------------- services
+
+    def upsert_service_registrations(self, regs) -> int:
+        """reference: UpsertServiceRegistrations (Nomad-native services).
+        Copies on write like every other table — with in-process RPC the
+        caller keeps mutating its objects (check runners update status)."""
+        import dataclasses
+        with self._lock:
+            idx = self._bump()
+            table = dict(self._services)
+            for r in regs:
+                prev = table.get(r.id)
+                r = dataclasses.replace(r, tags=list(r.tags))
+                r.create_index = prev.create_index if prev else idx
+                r.modify_index = idx
+                table[r.id] = r
+            self._services = table
+            return idx
+
+    def delete_service_registrations_by_alloc(self, alloc_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._services = {k: v for k, v in self._services.items()
+                              if v.alloc_id != alloc_id}
+            return idx
+
+    def service_registrations(self, namespace: Optional[str] = None,
+                              name: Optional[str] = None):
+        return [r for r in self._services.values()
+                if (namespace is None or r.namespace == namespace)
+                and (name is None or r.service_name == name)]
+
     # ------------------------------------------------------------ variables
 
     def upsert_variable(self, var: VariableItem) -> int:
@@ -634,6 +676,8 @@ class StateStore:
                               for t in self._acl_tokens.values()],
                 "Variables": [codec.encode(v)
                               for v in self._variables.values()],
+                "Services": [codec.encode(r)
+                             for r in self._services.values()],
                 "SchedulerConfig": codec.encode(self._scheduler_config),
             }
 
@@ -697,6 +741,10 @@ class StateStore:
             for d in doc.get("Variables", []):
                 v = codec.decode(VariableItem, d)
                 self._variables[(v.namespace, v.path)] = v
+            self._services = {
+                r.id: r for r in
+                (codec.decode(ServiceRegistration, d)
+                 for d in doc.get("Services", []))}
             self._scheduler_config = codec.decode(
                 SC, doc.get("SchedulerConfig") or {})
             self._index = max(int(doc.get("Index", 0)), self._index) + 1

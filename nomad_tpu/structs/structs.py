@@ -1073,6 +1073,26 @@ class ACLToken:
 
 
 @dataclass
+class ServiceRegistration:
+    """One service instance (reference: structs.ServiceRegistration —
+    Nomad-native service discovery, provider="nomad")."""
+    id: str = ""                 # _nomad-task-<alloc>-<group|task>-<svc>
+    service_name: str = ""
+    namespace: str = "default"
+    node_id: str = ""
+    job_id: str = ""
+    alloc_id: str = ""
+    datacenter: str = ""
+    tags: List[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    # aggregate check status: "passing" | "critical" | "" (no checks)
+    status: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
 class VariableItem:
     """Decrypted variable (reference: structs.VariableDecrypted)."""
     path: str = ""

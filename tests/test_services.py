@@ -1,0 +1,152 @@
+"""Nomad-native service discovery + checks (reference:
+client/serviceregistration/, Service RPC endpoints) and volume
+feasibility (HostVolumeChecker / CSIVolumeChecker parity)."""
+
+import http.server
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.structs import (
+    Service,
+    UpdateStrategy,
+    VolumeRequest,
+    codec,
+)
+
+
+class TestVolumeFeasibility:
+    def test_host_volume_constrains_placement(self):
+        h = Harness()
+        good = mock.node()
+        good.host_volumes = {"certs": "/etc/certs"}
+        from nomad_tpu.structs import compute_class
+        good.computed_class = compute_class(good)
+        h.state.upsert_node(good)
+        for _ in range(4):
+            h.state.upsert_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].volumes = {
+            "certs": VolumeRequest(name="certs", type="host",
+                                   source="certs")}
+        h.state.upsert_job(job)
+        h.process("service", mock.eval(job_id=job.id, type=job.type))
+        placed = [a for allocs in h.plans[-1].node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 1
+        assert placed[0].node_id == good.id, \
+            "host-volume job must land on the node with the volume"
+
+
+class TestServiceDiscovery:
+    def test_services_register_and_checks_drive_status(self):
+        # real HTTP endpoint the check probes
+        class Ok(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *a):
+                pass
+
+        backend = http.server.HTTPServer(("127.0.0.1", 0), Ok)
+        port = backend.server_port
+        threading.Thread(target=backend.serve_forever, daemon=True).start()
+
+        ag = Agent(num_clients=1, heartbeat_ttl=3600)
+        ag.start()
+        try:
+            api = APIClient(address=ag.address)
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.update = UpdateStrategy(max_parallel=1,
+                                       health_check="checks",
+                                       min_healthy_time_s=0.2)
+            tg.tasks[0].driver = "mock"
+            tg.tasks[0].config = {"run_for_s": 600}
+            tg.services = [Service(
+                name="web-api", provider="nomad", tags=["v1"],
+                checks=[{"type": "http", "port": port,
+                         "path": "/", "interval": "1s",
+                         "timeout": "2s"}])]
+            api.jobs.register(codec.encode(job))
+
+            deadline = time.time() + 60
+            regs = []
+            while time.time() < deadline:
+                try:
+                    regs = api.services.info("web-api")
+                except Exception:
+                    regs = []
+                if regs and regs[0].get("Status") == "passing":
+                    break
+                time.sleep(0.5)
+            assert regs, "service never registered"
+            assert regs[0]["ServiceName"] == "web-api"
+            assert regs[0]["Status"] == "passing"
+            assert regs[0]["Tags"] == ["v1"]
+
+            listed = api.services.list()
+            assert any(s["ServiceName"] == "web-api"
+                       for row in listed for s in row["Services"])
+
+            # passing checks drive deployment health -> successful
+            deadline = time.time() + 60
+            dep = None
+            while time.time() < deadline:
+                dep = ag.server.state.latest_deployment_by_job(
+                    job.namespace, job.id)
+                if dep is not None and dep.status == "successful":
+                    break
+                time.sleep(0.5)
+            assert dep is not None and dep.status == "successful"
+
+            # stopping the job deregisters
+            api.jobs.deregister(job.id)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if not ag.server.state.service_registrations(
+                        name="web-api"):
+                    break
+                time.sleep(0.5)
+            assert not ag.server.state.service_registrations(
+                name="web-api")
+        finally:
+            ag.shutdown()
+            backend.shutdown()
+
+    def test_failing_check_reports_critical(self):
+        ag = Agent(num_clients=1, heartbeat_ttl=3600)
+        ag.start()
+        try:
+            api = APIClient(address=ag.address)
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "mock"
+            tg.tasks[0].config = {"run_for_s": 600}
+            tg.services = [Service(
+                name="dead-api", provider="nomad",
+                checks=[{"type": "tcp", "port": 1,
+                         "interval": "1s", "timeout": "1s"}])]
+            api.jobs.register(codec.encode(job))
+            deadline = time.time() + 60
+            regs = []
+            while time.time() < deadline:
+                try:
+                    regs = api.services.info("dead-api")
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert regs and regs[0]["Status"] == "critical"
+        finally:
+            ag.shutdown()
