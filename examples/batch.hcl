@@ -1,0 +1,22 @@
+# Parameterized batch job dispatched with payload + metadata.
+job "index-build" {
+  datacenters = ["dc1"]
+  type        = "batch"
+
+  parameterized {
+    payload       = "required"
+    meta_required = ["shard"]
+  }
+
+  group "builder" {
+    count = 1
+    task "build" {
+      driver = "mock"
+      config { run_for_s = 30 }
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}

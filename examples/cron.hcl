@@ -1,0 +1,22 @@
+# Periodic job launched every five minutes, no overlap.
+job "report" {
+  datacenters = ["dc1"]
+  type        = "batch"
+
+  periodic {
+    cron             = "*/5 * * * *"
+    prohibit_overlap = true
+  }
+
+  group "gen" {
+    count = 1
+    task "render" {
+      driver = "mock"
+      config { run_for_s = 10 }
+      resources {
+        cpu    = 200
+        memory = 128
+      }
+    }
+  }
+}

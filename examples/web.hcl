@@ -1,0 +1,33 @@
+# Service job with a rolling-update strategy and a native service check.
+variable "replicas" { default = 3 }
+
+job "web" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  update {
+    max_parallel      = 1
+    min_healthy_time  = "5s"
+    healthy_deadline  = "2m"
+    progress_deadline = "5m"
+    auto_revert       = true
+  }
+
+  group "frontend" {
+    count = var.replicas
+
+    task "server" {
+      driver = "mock"
+      config { run_for_s = 3600 }
+      resources {
+        cpu    = 250
+        memory = 128
+      }
+      service {
+        name     = "web-frontend"
+        provider = "nomad"
+        tags     = ["http"]
+      }
+    }
+  }
+}

@@ -754,6 +754,16 @@ class HTTPAPIServer:
             def _handle(self, method: str) -> None:
                 parsed = urllib.parse.urlparse(self.path)
                 qs = urllib.parse.parse_qs(parsed.query)
+                if parsed.path in ("/", "/ui", "/ui/") and method == "GET":
+                    from .ui import UI_HTML
+                    data = UI_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if parsed.path == "/v1/event/stream" and method == "GET":
                     return self._stream(qs)
                 body = None

@@ -1,0 +1,134 @@
+"""Minimal web UI (reference: ui/ — the reference ships a full Ember SPA;
+this is a deliberately small single-page dashboard over the same /v1 API:
+jobs with their allocations, nodes, deployments, and the live event
+stream).  Served at `/ui` by the HTTP API server."""
+
+UI_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<style>
+  :root { color-scheme: light dark; }
+  body { font: 14px/1.45 system-ui, sans-serif; margin: 0;
+         background: Canvas; color: CanvasText; }
+  header { padding: .7rem 1.2rem; border-bottom: 1px solid color-mix(in srgb, CanvasText 18%, Canvas);
+           display: flex; gap: 1rem; align-items: baseline; }
+  header h1 { font-size: 1.05rem; margin: 0; }
+  header span { opacity: .65; font-size: .85rem; }
+  main { display: grid; grid-template-columns: 1fr 1fr; gap: 1rem;
+         padding: 1rem 1.2rem; max-width: 1200px; }
+  section { border: 1px solid color-mix(in srgb, CanvasText 14%, Canvas);
+            border-radius: 8px; padding: .6rem .9rem; overflow: auto; }
+  section.wide { grid-column: 1 / -1; }
+  h2 { font-size: .82rem; text-transform: uppercase; letter-spacing: .06em;
+       opacity: .7; margin: .2rem 0 .6rem; }
+  table { border-collapse: collapse; width: 100%; font-size: .85rem; }
+  td, th { text-align: left; padding: .18rem .6rem .18rem 0;
+           white-space: nowrap; }
+  th { opacity: .6; font-weight: 600; }
+  .ok   { color: #2e9e57; } .warn { color: #c7831c; }
+  .bad  { color: #cc4125; } .dim  { opacity: .55; }
+  #events { font-family: ui-monospace, monospace; font-size: .78rem;
+            max-height: 14rem; }
+  code { font-family: ui-monospace, monospace; font-size: .92em; }
+</style>
+</head>
+<body>
+<header><h1>nomad-tpu</h1><span id="meta">connecting…</span></header>
+<main>
+  <section><h2>Jobs</h2><table id="jobs"></table></section>
+  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section><h2>Deployments</h2><table id="deps"></table></section>
+  <section><h2>Services</h2><table id="svcs"></table></section>
+  <section class="wide"><h2>Events</h2><div id="events"></div></section>
+</main>
+<script>
+const $ = id => document.getElementById(id);
+const cls = s => ({running:'ok', ready:'ok', successful:'ok',
+                   passing:'ok', complete:'dim', dead:'dim',
+                   pending:'warn', paused:'warn',
+                   failed:'bad', down:'bad', critical:'bad',
+                   lost:'bad'}[s] || '');
+const cell = (v, c) => `<td class="${c||''}">${v ?? ''}</td>`;
+const row = cells => `<tr>${cells.join('')}</tr>`;
+
+async function get(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(r.status);
+  return r.json();
+}
+
+async function refresh() {
+  try {
+    const [jobs, nodes, deps, svcs, metrics] = await Promise.all([
+      get('/v1/jobs?namespace=*'), get('/v1/nodes'),
+      get('/v1/deployments?namespace=*'), get('/v1/services?namespace=*'),
+      get('/v1/metrics')]);
+    $('meta').textContent =
+      `${metrics['nomad.state.jobs']} jobs · ` +
+      `${metrics['nomad.state.nodes']} nodes · ` +
+      `broker ready ${metrics['nomad.broker.total_ready']} · ` +
+      `blocked ${metrics['nomad.blocked_evals.total_blocked']}`;
+    $('jobs').innerHTML =
+      row([ '<th>ID</th>','<th>Type</th>','<th>NS</th>','<th>Status</th>' ]) +
+      jobs.map(j => row([cell(`<code>${j.ID}</code>`), cell(j.Type),
+        cell(j.Namespace), cell(j.Status, cls(j.Status))])).join('');
+    $('nodes').innerHTML =
+      row(['<th>ID</th>','<th>DC</th>','<th>Status</th>','<th>Elig</th>']) +
+      nodes.map(n => row([cell(`<code>${n.ID.slice(0,8)}</code>`),
+        cell(n.Datacenter), cell(n.Status, cls(n.Status)),
+        cell(n.Drain ? 'draining' : n.SchedulingEligibility,
+             n.Drain ? 'warn' : '')])).join('');
+    $('deps').innerHTML =
+      row(['<th>Job</th>','<th>Ver</th>','<th>Status</th>']) +
+      deps.map(d => row([cell(`<code>${d.JobID}</code>`),
+        cell('v' + d.JobVersion),
+        cell(d.Status, cls(d.Status))])).join('');
+    $('svcs').innerHTML =
+      row(['<th>Service</th>','<th>Tags</th>']) +
+      svcs.flatMap(nsr => (nsr.Services || []).map(s =>
+        row([cell(`<code>${s.ServiceName}</code>`),
+             cell((s.Tags || []).join(', '))]))).join('');
+  } catch (e) {
+    $('meta').textContent = 'disconnected: ' + e;
+  }
+}
+
+async function tailEvents() {
+  try {
+    const resp = await fetch('/v1/event/stream');
+    const rd = resp.body.getReader();
+    const dec = new TextDecoder();
+    let buf = '';
+    for (;;) {
+      const {value, done} = await rd.read();
+      if (done) break;
+      buf += dec.decode(value, {stream: true});
+      let i;
+      while ((i = buf.indexOf('\\n')) >= 0) {
+        const line = buf.slice(0, i); buf = buf.slice(i + 1);
+        if (!line.trim()) continue;
+        const batch = JSON.parse(line);
+        for (const ev of (batch.Events || [])) {
+          const el = document.createElement('div');
+          el.textContent =
+            `#${ev.Index} ${ev.Topic}/${ev.Type} ${ev.Key.slice(0,8)}`;
+          $('events').prepend(el);
+        }
+        while ($('events').childNodes.length > 60)
+          $('events').removeChild($('events').lastChild);
+        refresh();
+      }
+    }
+  } catch (e) { /* reconnect below */ }
+  setTimeout(tailEvents, 2000);
+}
+
+refresh();
+setInterval(refresh, 5000);
+tailEvents();
+</script>
+</body>
+</html>
+"""

@@ -655,6 +655,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"Error connecting to {args.address}: {e.reason}",
               file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # output piped into a pager/head that exited — not an error
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
     except FileNotFoundError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1

@@ -85,6 +85,14 @@ class Preemptor:
         self.evicted_ids: set = set()
         # candidate allocs per node row: (priority, resources array, alloc)
         self.cands: Dict[int, List[Tuple[int, np.ndarray, Allocation]]] = {}
+        # incrementally-maintained sum of preemptible resources per row
+        self._preemptible = np.zeros((tensors.n, 3), np.int64)
+        # eviction-plan cache: req-bytes -> row -> (evictions, cost).
+        # Evictions are strictly row-local, so a placement invalidates
+        # ONLY its chosen row — every other row's plan stays exact.  This
+        # is what keeps an eval with hundreds of preempting placements
+        # from re-solving every node each time.
+        self._plans: Dict[bytes, Dict[int, tuple]] = {}
         self._build(snapshot)
 
     def _build(self, snapshot) -> None:
@@ -105,6 +113,7 @@ class Preemptor:
                 lst.append((prio, res, a))
             if lst:
                 self.cands[row] = lst
+                self._preemptible[row] = np.sum([c[1] for c in lst], axis=0)
 
     # ------------------------------------------------------------- solve
 
@@ -115,13 +124,7 @@ class Preemptor:
         t = self.tensors
         cap = t.cap.astype(np.int64)
         used = self.used.astype(np.int64)
-        # preemptible resources per node (remaining candidates only)
-        preemptible = np.zeros_like(used)
-        for row, lst in self.cands.items():
-            live = [c for c in lst if c[2].id not in self.evicted_ids]
-            if live:
-                preemptible[row] = np.sum([c[1] for c in live], axis=0)
-        fits = np.all(used - preemptible + req <= cap, axis=1)
+        fits = np.all(used - self._preemptible + req <= cap, axis=1)
         fits &= self.static[g]
         if g < len(self.dh_limit) and self.dh_limit[g] > 0:
             fits &= self.job_count < self.dh_limit[g]
@@ -129,22 +132,37 @@ class Preemptor:
         if rows.size == 0:
             return None
         # node choice: minimize total preempted priority-weighted resources
+        # (row plans cached across placements; see _plans)
+        key = req.tobytes()
+        plans = self._plans.setdefault(key, {})
         best_row, best_cost, best_evict = -1, None, None
         for row in rows:
-            evict, cost = self._greedy_evict(int(row), req)
+            row = int(row)
+            plan = plans.get(row)
+            if plan is None:
+                plan = self._greedy_evict(row, req)
+                plans[row] = plan
+            evict, cost = plan
             if evict is None:
                 continue
             if best_cost is None or cost < best_cost:
-                best_row, best_cost, best_evict = int(row), cost, evict
+                best_row, best_cost, best_evict = row, cost, evict
         if best_evict is None:
             return None
+        freed = np.zeros(3, np.int64)
         for a in best_evict:
             self.evicted_ids.add(a.id)
-            self.used[best_row] -= np.array(
+            res = np.array(
                 [a.resources.cpu, a.resources.memory_mb, a.resources.disk_mb],
-                np.int32)
+                np.int64)
+            freed += res
+        self.used[best_row] -= freed.astype(np.int32)
         self.used[best_row] += req.astype(np.int32)
         self.job_count[best_row] += 1
+        self._preemptible[best_row] -= freed
+        # only the chosen row's state changed: drop its plans (all reqs)
+        for p in self._plans.values():
+            p.pop(best_row, None)
         return PreemptionResult(node_row=best_row, evictions=best_evict)
 
     def _greedy_evict(self, row: int, req: np.ndarray):
