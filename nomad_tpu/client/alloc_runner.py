@@ -33,13 +33,17 @@ class AllocRunner:
     def __init__(self, alloc: Allocation, drivers: Dict, node,
                  alloc_dir: str = "",
                  on_update: Optional[Callable] = None,
-                 checks_healthy: Optional[Callable] = None) -> None:
+                 checks_healthy: Optional[Callable] = None,
+                 restore_handles: Optional[Dict] = None,
+                 on_handle: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.node = node
         self.drivers = drivers
         self.alloc_dir = alloc_dir
         self.on_update = on_update
         self.checks_healthy = checks_healthy
+        self.restore_handles = restore_handles or {}
+        self._persist_handle = on_handle
         self.task_runners: List[TaskRunner] = []
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -78,9 +82,16 @@ class AllocRunner:
                 if self.alloc_dir else ""
             self.task_runners.append(TaskRunner(
                 self.alloc, task, driver, self.node, task_dir=tdir,
-                is_batch=is_batch, on_state_change=self._on_task_change))
+                is_batch=is_batch, on_state_change=self._on_task_change,
+                restore_handle=self.restore_handles.get(task.name),
+                on_handle=self._on_task_handle))
 
     # ------------------------------------------------------------ status
+
+    def _on_task_handle(self, runner: TaskRunner) -> None:
+        if self._persist_handle and runner.handle is not None:
+            self._persist_handle(self.alloc.id, runner.task.name,
+                                 runner.handle)
 
     def _on_task_change(self, runner: TaskRunner) -> None:
         with self._lock:
@@ -205,6 +216,12 @@ class AllocRunner:
         self.alloc.desired_description = alloc.desired_description
         if alloc.desired_status != "run":
             self.destroy()
+
+    def abandon(self) -> None:
+        """Stop supervising without killing tasks (see TaskRunner.abandon)."""
+        self._destroyed = True
+        for tr in self.task_runners:
+            tr.abandon()
 
     def destroy(self) -> None:
         self._destroyed = True

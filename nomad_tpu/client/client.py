@@ -149,7 +149,10 @@ class Client:
                 ar = AllocRunner(alloc.copy(), self.drivers, self.node,
                                  alloc_dir=self.data_dir,
                                  on_update=self._on_alloc_update,
-                                 checks_healthy=self.services.checks_healthy)
+                                 checks_healthy=self.services.checks_healthy,
+                                 restore_handles=self.state_db
+                                 .get_task_handles(alloc.id),
+                                 on_handle=self.state_db.put_task_handle)
                 with self._lock:
                     self.alloc_runners[alloc.id] = ar
                     self.state_db.put_allocation(alloc)

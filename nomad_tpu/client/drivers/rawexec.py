@@ -12,6 +12,25 @@ from typing import Dict, Optional
 from .base import Driver, DriverCapabilities, DriverError, TaskHandle, TaskResult
 
 
+def _proc_stat(pid: int):
+    """(state, start_ticks) from /proc/<pid>/stat; (None, None) if gone.
+    start_ticks (field 22) is the pid-reuse discriminator; state 'Z'/'X'
+    means the process is dead even though the pid still answers kill(0)
+    (zombies awaiting a reap)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # comm can contain spaces/parens: fields resume after the last ')'
+        fields = stat[stat.rfind(b")") + 2:].split()
+        return fields[0].decode(), int(fields[19])
+    except (OSError, IndexError, ValueError):
+        return None, None
+
+
+def _proc_start_ticks(pid: int):
+    return _proc_stat(pid)[1]
+
+
 class RawExecDriver(Driver):
     name = "raw_exec"
 
@@ -50,11 +69,32 @@ class RawExecDriver(Driver):
         proc = self._spawn(task_id, task, env, task_dir)
         with self._lock:
             self._procs[task_id] = proc
-        return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid)
+        return TaskHandle(task_id=task_id, driver=self.name, pid=proc.pid,
+                          driver_state={
+                              "proc_start": _proc_start_ticks(proc.pid)})
 
     def wait_task(self, handle, timeout=None) -> Optional[TaskResult]:
         proc = self._procs.get(handle.task_id)
         if proc is None:
+            if handle.pid:
+                # reattached after agent restart: the pid is not our
+                # child, so poll liveness instead of wait() (reference:
+                # executor reattach).  PermissionError means the pid was
+                # recycled to another user's process: OUR task is gone.
+                # The exit code is unknowable for a non-child; report it
+                # via `err` so restart/reschedule policy treats the exit
+                # as a failure rather than silently as success.
+                import time as _time
+                deadline = (None if timeout is None
+                            else _time.time() + timeout)
+                while True:
+                    if not self._same_process(handle):
+                        return TaskResult(
+                            exit_code=0,
+                            err="exit status unknown (reattached task)")
+                    if deadline is not None and _time.time() >= deadline:
+                        return None
+                    _time.sleep(0.1)
             return TaskResult(err="unknown task")
         try:
             rc = proc.wait(timeout)
@@ -66,7 +106,26 @@ class RawExecDriver(Driver):
 
     def stop_task(self, handle, kill_timeout: float = 5.0) -> None:
         proc = self._procs.get(handle.task_id)
-        if proc is None or proc.poll() is not None:
+        if proc is None:
+            # reattached task: TERM the group, wait out kill_timeout,
+            # escalate to KILL — same guarantee as the child path
+            if handle.pid and self._same_process(handle):
+                import time as _time
+                try:
+                    os.killpg(os.getpgid(handle.pid), _signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    return
+                deadline = _time.time() + kill_timeout
+                while _time.time() < deadline:
+                    if not self._same_process(handle):
+                        return
+                    _time.sleep(0.05)
+                try:
+                    os.killpg(os.getpgid(handle.pid), _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            return
+        if proc.poll() is not None:
             return
         try:
             os.killpg(os.getpgid(proc.pid), _signal.SIGTERM)
@@ -82,12 +141,21 @@ class RawExecDriver(Driver):
         if proc is not None and proc.poll() is None:
             proc.send_signal(signal_num)
 
+    def _same_process(self, handle) -> bool:
+        """The persisted pid still refers to OUR live process: running
+        (not a zombie) AND the kernel start time matches what start_task
+        recorded (a recycled pid has a different start tick)."""
+        state, ticks = _proc_stat(handle.pid)
+        if state is None or state in ("Z", "X"):
+            return False
+        recorded = handle.driver_state.get("proc_start")
+        if recorded is None:
+            return True           # pre-upgrade handle: best effort
+        return ticks == recorded
+
     def recover_task(self, handle) -> bool:
         """Re-adopt a live pid after agent restart (reference: executor
-        reattach). We can signal/poll it but not wait() a non-child; treat
-        liveness via kill(pid, 0)."""
-        try:
-            os.kill(handle.pid, 0)
-        except (ProcessLookupError, PermissionError):
-            return False
-        return True
+        reattach).  Rejects recycled pids via the recorded process start
+        time — adopting (and later killing) an unrelated process would be
+        far worse than restarting the task."""
+        return self._same_process(handle)

@@ -110,7 +110,9 @@ class TaskRunner:
     def __init__(self, alloc, task: Task, driver: Driver, node,
                  task_dir: str = "", is_batch: bool = False,
                  on_state_change: Optional[Callable] = None,
-                 update_interval: float = 0.0) -> None:
+                 update_interval: float = 0.0,
+                 restore_handle: Optional[TaskHandle] = None,
+                 on_handle: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -120,6 +122,10 @@ class TaskRunner:
         self.restart_tracker = RestartTracker(
             self._policy(), is_batch=is_batch)
         self.on_state_change = on_state_change
+        # agent-restart adoption: a persisted handle to recover instead of
+        # starting a fresh task (reference: task runner handle reattach)
+        self.restore_handle = restore_handle
+        self.on_handle = on_handle
         self.handle: Optional[TaskHandle] = None
         self.env: Dict[str, str] = {}
         self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
@@ -162,6 +168,13 @@ class TaskRunner:
                                         name=f"task-{self.task.name}")
         self._thread.start()
 
+    def abandon(self) -> None:
+        """Exit the runner WITHOUT touching the workload (agent going
+        down while tasks keep running, to be re-adopted on restart —
+        reference: the restore path's counterpart)."""
+        self.handle = None
+        self._kill.set()
+
     def run(self) -> None:
         self._event(TASK_RECEIVED)
         try:
@@ -200,20 +213,36 @@ class TaskRunner:
 
     def _run_loop(self) -> None:
         while not self._kill.is_set():
-            try:
-                task_id = f"{self.alloc.id[:8]}-{self.task.name}"
-                self.handle = self.driver.start_task(
-                    task_id, self.task, self.env, self.task_dir)
-            except DriverError as e:
-                self._event(TASK_DRIVER_FAILURE, message=str(e))
-                decision, delay = self.restart_tracker.next(-1, True)
-                if decision == KILL or self._kill.wait(delay):
-                    self._set_state(TASK_STATE_DEAD, failed=True)
-                    return
-                self._event(TASK_RESTARTING, restart_reason=str(e))
-                continue
+            reattached = False
+            if self.restore_handle is not None:
+                h, self.restore_handle = self.restore_handle, None
+                try:
+                    if self.driver.recover_task(h):
+                        self.handle = h
+                        reattached = True
+                except Exception:  # noqa: BLE001 - fall through to start
+                    pass
+            if not reattached:
+                try:
+                    task_id = f"{self.alloc.id[:8]}-{self.task.name}"
+                    self.handle = self.driver.start_task(
+                        task_id, self.task, self.env, self.task_dir)
+                except DriverError as e:
+                    self._event(TASK_DRIVER_FAILURE, message=str(e))
+                    decision, delay = self.restart_tracker.next(-1, True)
+                    if decision == KILL or self._kill.wait(delay):
+                        self._set_state(TASK_STATE_DEAD, failed=True)
+                        return
+                    self._event(TASK_RESTARTING, restart_reason=str(e))
+                    continue
+            if self.on_handle:
+                try:
+                    self.on_handle(self)
+                except Exception:  # noqa: BLE001 - persistence best-effort
+                    pass
 
-            self._event(TASK_STARTED)
+            self._event(TASK_STARTED,
+                        message="reattached" if reattached else "")
             self._set_state(TASK_STATE_RUNNING)
             for hook in self.hooks:
                 hook.poststart(self)
