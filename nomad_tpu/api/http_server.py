@@ -282,6 +282,23 @@ class Router:
                 if method in ("PUT", "POST"):
                     s.restore_snapshot(body or {})
                     return {"Restored": True}
+            if p[1:2] == ["debug"] and method == "GET":
+                # debug bundle (reference: `nomad operator debug` capture)
+                import sys as _sys
+                import threading as _threading
+                from nomad_tpu.core.logging import RING
+                return {
+                    "Stats": self.agent.stats(),
+                    "Metrics": self.agent.metrics(),
+                    "SchedulerConfig": codec.encode(
+                        s.state.snapshot().scheduler_config()),
+                    "Logs": RING.tail(500),
+                    "Threads": [
+                        {"Name": t.name, "Daemon": t.daemon,
+                         "Alive": t.is_alive()}
+                        for t in _threading.enumerate()],
+                    "Python": _sys.version,
+                }
         elif head == "acl":
             return self._acl(method, p[1:], body)
         elif head == "namespaces":
@@ -764,8 +781,19 @@ class HTTPAPIServer:
                     self.end_headers()
                     self.wfile.write(data)
                     return
-                if parsed.path == "/v1/event/stream" and method == "GET":
-                    return self._stream(qs)
+                if parsed.path in ("/v1/event/stream",
+                                   "/v1/agent/monitor") and method == "GET":
+                    # streaming endpoints bypass route(), but NOT the ACL
+                    token = self.headers.get("X-Nomad-Token", "")
+                    ns = (qs.get("namespace") or [DEFAULT_NAMESPACE])[0]
+                    try:
+                        router._enforce(
+                            "GET", parsed.path.split("/")[2:], ns, token)
+                    except APIError as e:
+                        return self._respond(e.status, {"Error": str(e)})
+                    if parsed.path == "/v1/event/stream":
+                        return self._stream(qs)
+                    return self._monitor(qs)
                 body = None
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
@@ -783,6 +811,43 @@ class HTTPAPIServer:
                 except Exception as e:  # noqa: BLE001 - endpoint isolation
                     self._respond(500, {"Error": f"{type(e).__name__}: {e}"})
 
+            def _chunked_loop(self, pull, cleanup) -> None:
+                """Shared chunked-streaming scaffold for the event and
+                monitor streams.  `pull(timeout) -> (line_bytes|None,
+                ended)`; 10s idle heartbeats detect dead clients; a
+                graceful end terminates the chunked body; `cleanup` always
+                runs (including on pre-body write failures)."""
+                import time as _time
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    last_write = _time.time()
+                    while True:
+                        line, ended = pull(0.5)
+                        if ended:
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                            break
+                        if line is not None:
+                            chunk(line)
+                            last_write = _time.time()
+                        elif _time.time() - last_write > 10:
+                            chunk(b"{}\n")   # idle: detect disconnects
+                            last_write = _time.time()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    self.close_connection = True
+                    cleanup()
+
             def _stream(self, qs: Dict[str, List[str]]) -> None:
                 topics: Dict[str, List[str]] = {}
                 for t in qs.get("topic", []):
@@ -794,42 +859,48 @@ class HTTPAPIServer:
                     return self._respond(400, {"Error": "bad index"})
                 sub = router.server.events.subscribe(
                     topics or None, from_index=from_index)
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
 
-                def chunk(data: bytes) -> None:
-                    self.wfile.write(f"{len(data):x}\r\n".encode())
-                    self.wfile.write(data + b"\r\n")
-                    self.wfile.flush()
+                def pull(timeout):
+                    if sub.closed:
+                        return None, True
+                    ev = sub.next(timeout=timeout)
+                    if ev is None:
+                        return (None, sub.closed)
+                    return (json.dumps(
+                        {"Index": ev.index,
+                         "Events": [ev.wire()]}).encode() + b"\n", False)
 
-                import time as _time
-                last_write = _time.time()
-                try:
-                    while not sub.closed:
-                        ev = sub.next(timeout=0.5)
-                        if ev is not None:
-                            chunk(json.dumps(
-                                {"Index": ev.index,
-                                 "Events": [ev.wire()]}).encode() + b"\n")
-                            last_write = _time.time()
-                        elif _time.time() - last_write > 10:
-                            # heartbeat: the only way to notice a client
-                            # that disconnected while the stream was idle
-                            # (otherwise the subscription leaks forever)
-                            chunk(b"{}\n")
-                            last_write = _time.time()
-                    # graceful end (broker closed): terminate the chunked
-                    # body so the client's read() returns instead of
-                    # waiting for more chunks forever
-                    self.wfile.write(b"0\r\n\r\n")
-                    self.wfile.flush()
-                except (BrokenPipeError, ConnectionResetError, OSError):
-                    pass
-                finally:
-                    self.close_connection = True
-                    router.server.events.unsubscribe(sub)
+                self._chunked_loop(
+                    pull, lambda: router.server.events.unsubscribe(sub))
+
+            def _monitor(self, qs: Dict[str, List[str]]) -> None:
+                """Stream the structured log ring (reference: the
+                `nomad monitor` RPC): backlog first, then live records,
+                as newline-delimited JSON."""
+                import queue as _queue
+                from nomad_tpu.core.logging import LEVELS, RING
+                min_level = (qs.get("log_level") or ["info"])[0]
+                lvl = LEVELS.get(min_level, 2)
+                # snapshot the backlog BEFORE subscribing: the reverse
+                # order delivers records landing in between twice
+                backlog = list(RING.tail(100, min_level))
+                sub = RING.subscribe()
+
+                def pull(timeout):
+                    if backlog:
+                        return json.dumps(backlog.pop(0)).encode() + b"\n", \
+                            False
+                    try:
+                        rec = sub.get(timeout=timeout)
+                    except _queue.Empty:
+                        return None, False
+                    if rec is None:
+                        return None, True
+                    if LEVELS.get(rec["level"], 2) < lvl:
+                        return None, False
+                    return json.dumps(rec).encode() + b"\n", False
+
+                self._chunked_loop(pull, lambda: RING.unsubscribe(sub))
 
             def do_GET(self):
                 self._handle("GET")

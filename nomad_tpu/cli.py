@@ -381,6 +381,45 @@ def cmd_snapshot_restore(args) -> int:
     return 0
 
 
+def cmd_monitor(args) -> int:
+    """Stream agent logs (reference: `nomad monitor`)."""
+    import datetime
+    import os
+    import urllib.request
+    url = (f"{args.address}/v1/agent/monitor?"
+           f"log_level={args.log_level}")
+    token = args.token or os.environ.get("NOMAD_TOKEN", "")
+    req = urllib.request.Request(
+        url, headers={"X-Nomad-Token": token} if token else {})
+    with urllib.request.urlopen(req) as resp:
+        for line in resp:
+            line = line.strip()
+            if not line or line == b"{}":
+                continue
+            rec = json.loads(line)
+            ts = datetime.datetime.fromtimestamp(
+                rec.get("ts", 0)).strftime("%H:%M:%S")
+            extra = {k: v for k, v in rec.items()
+                     if k not in ("ts", "level", "component", "msg")}
+            print(f"{ts} [{rec.get('level', ''):<5}] "
+                  f"{rec.get('component', '')}: {rec.get('msg', '')}"
+                  + (f"  {extra}" if extra else ""))
+    return 0
+
+
+def cmd_operator_debug(args) -> int:
+    bundle = _client(args).request("GET", "/v1/operator/debug")
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(bundle, f, indent=2)
+        print(f"debug bundle written to {args.output} "
+              f"({len(bundle.get('Logs', []))} log records, "
+              f"{len(bundle.get('Threads', []))} threads)")
+    else:
+        _out(bundle)
+    return 0
+
+
 def cmd_service_list(args) -> int:
     for nsrow in _client(args).services.list():
         for svc in nsrow.get("Services", []):
@@ -539,6 +578,9 @@ def build_parser() -> argparse.ArgumentParser:
                      default=None)
     os_.set_defaults(fn=cmd_operator_scheduler_set)
 
+    odbg = op.add_parser("debug")
+    odbg.add_argument("-output", default="")
+    odbg.set_defaults(fn=cmd_operator_debug)
     osnap = op.add_parser("snapshot").add_subparsers(dest="snap_cmd",
                                                      required=True)
     osv = osnap.add_parser("save")
@@ -637,6 +679,11 @@ def build_parser() -> argparse.ArgumentParser:
                                                   required=True)
     sm = srv.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", dest="log_level", default="debug",
+                     choices=["trace", "debug", "info", "warn", "error"])
+    mon.set_defaults(fn=cmd_monitor)
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)

@@ -25,6 +25,8 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+from nomad_tpu.core.logging import log
+
 from nomad_tpu.structs import (
     DEPLOYMENT_STATUS_FAILED,
     DEPLOYMENT_STATUS_PAUSED,
@@ -110,6 +112,8 @@ class DeploymentWatcher:
         if self._complete(updated):
             updated.status = DEPLOYMENT_STATUS_SUCCESSFUL
             updated.status_description = DESC_SUCCESSFUL
+            log("deployment", "info", "deployment successful",
+                deployment_id=updated.id, job_id=updated.job_id)
             self.server.state.upsert_deployment(updated)
             self._progress_by.pop(dep.id, None)
             self._mark_stable(updated)
@@ -204,6 +208,8 @@ class DeploymentWatcher:
         )], now=now)
 
     def _fail(self, dep: Deployment, desc: str, now: float) -> None:
+        log("deployment", "error", "deployment failed",
+            deployment_id=dep.id, job_id=dep.job_id, reason=desc)
         dep.status = DEPLOYMENT_STATUS_FAILED
         dep.status_description = desc
         self._progress_by.pop(dep.id, None)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.core.logging import log
 from nomad_tpu.structs import (
     DesiredTransition,
     DrainStrategy,
@@ -59,6 +60,9 @@ class NodeDrainer:
             if strategy.deadline_s > 0 and not strategy.force_deadline:
                 strategy.force_deadline = t + strategy.deadline_s
         self.server.state.update_node_drain(node_id, strategy)
+        log("drain", "info",
+            "drain started" if strategy is not None else "drain cancelled",
+            node_id=node_id)
         if strategy is not None:
             self.tick(t)   # release the first batch immediately
 
@@ -124,4 +128,5 @@ class NodeDrainer:
         remaining = service + ([] if drain.ignore_system_jobs else system)
         if not remaining:
             # drain complete: clear the marker, keep the node ineligible
+            log("drain", "info", "drain complete", node_id=node.id)
             self.server.state.update_node_drain(node.id, None)

@@ -1,0 +1,71 @@
+"""Structured in-process logging with a bounded ring + live subscribers
+(reference: hclog + the `nomad monitor` RPC in command/agent/monitor.go).
+
+`log(component, level, msg, **fields)` appends to a process-wide ring that
+`/v1/agent/monitor` streams and `operator debug` bundles.  Deliberately
+tiny: no handlers/formatters, one producer API, lock-protected ring."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+LEVELS = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
+
+
+class LogRing:
+    def __init__(self, size: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._buf: List[Dict] = []
+        self._size = size
+        self._subs: List["queue.Queue[Optional[Dict]]"] = []
+        # producer-side gate: records below this level are dropped before
+        # touching the lock (the ack log sits on the eval hot path)
+        self.min_level = "trace"
+
+    def log(self, component: str, level: str, msg: str, **fields) -> None:
+        if LEVELS.get(level, 2) < LEVELS.get(self.min_level, 0):
+            return
+        rec = {"ts": time.time(), "level": level,
+               "component": component, "msg": msg}
+        if fields:
+            rec.update(fields)
+        with self._lock:
+            self._buf.append(rec)
+            if len(self._buf) > self._size:
+                del self._buf[:self._size // 4]
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(rec)
+            except queue.Full:
+                pass
+
+    def tail(self, n: int = 200,
+             min_level: str = "trace") -> List[Dict]:
+        lvl = LEVELS.get(min_level, 0)
+        with self._lock:
+            recs = list(self._buf)
+        return [r for r in recs
+                if LEVELS.get(r["level"], 2) >= lvl][-n:]
+
+    def subscribe(self, maxsize: int = 512) -> "queue.Queue":
+        q: "queue.Queue[Optional[Dict]]" = queue.Queue(maxsize)
+        with self._lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q) -> None:
+        with self._lock:
+            if q in self._subs:
+                self._subs.remove(q)
+
+
+# process-wide default ring (one agent per process in practice)
+RING = LogRing()
+
+
+def log(component: str, level: str, msg: str, **fields) -> None:
+    RING.log(component, level, msg, **fields)

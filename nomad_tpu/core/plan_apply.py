@@ -18,6 +18,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.core.logging import log
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import (
     Allocation,
@@ -130,6 +131,10 @@ class PlanApplier:
     def apply_one(self, pending: PendingPlan) -> None:
         try:
             result = self.evaluate_plan(pending.plan)
+            if result.refuted_nodes:
+                log("plan", "warn", "plan partially refuted",
+                    eval_id=pending.plan.eval_id,
+                    refuted=len(result.refuted_nodes))
             self.state.upsert_plan_results(pending.plan, result)
             result.alloc_index = self.state.latest_index()
             pending.respond(result, None)

@@ -38,6 +38,7 @@ from nomad_tpu.structs import (
     new_id,
 )
 
+from .logging import log
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
 from .drainer import NodeDrainer
@@ -86,6 +87,7 @@ class Server:
         """reference: leaderLoop/establishLeadership — enable broker, plan
         queue, blocked evals; restore pending evals from state."""
         self._leader = True
+        log("server", "info", "leadership established")
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.plan_queue.set_enabled(True)
@@ -473,6 +475,8 @@ class Server:
                 updates.append(f)
             self.apply_eval_update(updates, now=t)
         for node_id in self.heartbeats.expired(t):
+            log("heartbeat", "warn", "node heartbeat missed; marking down",
+                node_id=node_id)
             evals = invalidate_heartbeat(self.state, node_id, t)
             self.apply_eval_update(evals, now=t)
         self.deployments.tick(t)

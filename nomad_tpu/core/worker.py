@@ -12,6 +12,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from nomad_tpu.core.logging import log
 from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.structs import Evaluation, Plan, PlanResult
@@ -75,9 +76,15 @@ class Worker:
         if err is None:
             broker.ack(evaluation.id, token)
             self.stats["acked"] += 1
+            log("worker", "debug", "eval acked", worker=self.id,
+                eval_id=evaluation.id, job_id=evaluation.job_id,
+                type=evaluation.type)
         else:
             broker.nack(evaluation.id, token, now=t)
             self.stats["nacked"] += 1
+            log("worker", "warn", "eval nacked", worker=self.id,
+                eval_id=evaluation.id, job_id=evaluation.job_id,
+                error=str(err))
         return True
 
     def _invoke(self, evaluation: Evaluation, now: float) -> Optional[Exception]:
