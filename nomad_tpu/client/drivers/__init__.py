@@ -7,14 +7,20 @@ inspect_task / signal_task / exec_task).
 """
 
 from .base import Driver, DriverCapabilities, TaskHandle, TaskResult
-from .mock import MockDriver
-from .rawexec import RawExecDriver
+from .docker import DockerDriver
 from .execdriver import ExecDriver
+from .java import JavaDriver
+from .mock import MockDriver
+from .qemu import QemuDriver
+from .rawexec import RawExecDriver
 
 BUILTIN_DRIVERS = {
     "mock": MockDriver,
     "raw_exec": RawExecDriver,
     "exec": ExecDriver,
+    "docker": DockerDriver,
+    "java": JavaDriver,
+    "qemu": QemuDriver,
 }
 
 

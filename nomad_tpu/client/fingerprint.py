@@ -119,7 +119,10 @@ class FingerprintManager:
             node.resources = NodeResources(cpu=cpu, memory_mb=mem,
                                            disk_mb=disk)
         for name, drv in self.drivers.items():
-            attrs.update(drv.fingerprint())
-            node.drivers[name] = True
+            fp = drv.fingerprint()
+            attrs.update(fp)
+            # a driver with an empty fingerprint (binary/daemon absent)
+            # is NOT healthy on this node — docker/java/qemu gate on it
+            node.drivers[name] = bool(fp)
         for fn in self.extra:
             attrs.update(fn())

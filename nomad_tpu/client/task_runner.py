@@ -255,6 +255,9 @@ class TaskRunner:
             failed = not result.successful()
             self._event(TASK_TERMINATED, exit_code=result.exit_code,
                         signal=result.signal, message=result.err or "")
+            # release driver resources of the EXITED instance (docker
+            # removes the container; process drivers no-op on a dead pid)
+            self._destroy_handle()
             decision, delay = self.restart_tracker.next(result.exit_code,
                                                         failed)
             if decision == KILL:
@@ -279,9 +282,19 @@ class TaskRunner:
             self._event(TASK_KILLING)
             self.driver.stop_task(self.handle, self.task.kill_timeout_s)
             self._event(TASK_KILLED)
+            self._destroy_handle()
         for hook in self.hooks:
             hook.stop(self)
         self._set_state(TASK_STATE_DEAD)
+
+    def _destroy_handle(self) -> None:
+        if self.handle is None:
+            return
+        try:
+            self.driver.destroy_task(self.handle)
+        except Exception:  # noqa: BLE001 - cleanup is best-effort
+            pass
+        self.handle = None
 
     def kill(self, wait: bool = True, timeout: float = 10.0,
              reason: str = "") -> None:

@@ -77,6 +77,55 @@ def test_raw_exec_nonzero_exit(tmp_path):
     assert res.exit_code == 7 and not res.successful()
 
 
+def test_unavailable_drivers_fingerprint_unhealthy():
+    """docker/java/qemu register but fingerprint unhealthy when their
+    binary/daemon is absent, so placement skips such nodes."""
+    from nomad_tpu.client.fingerprint import FingerprintManager
+    from nomad_tpu.structs import Node
+    reg = new_driver_registry()
+    assert {"docker", "java", "qemu"} <= set(reg)
+    node = Node()
+    FingerprintManager(reg).run(node)
+    for name in ("docker", "java", "qemu"):
+        drv = reg[name]
+        assert node.drivers[name] == drv.available()
+        if not drv.available():
+            assert f"driver.{name}" not in node.attributes
+    # the always-available drivers stay healthy
+    assert node.drivers["raw_exec"] and node.drivers["mock"]
+
+
+@pytest.mark.skipif(
+    __import__("shutil").which("docker") is None,
+    reason="docker not installed")
+def test_docker_driver_lifecycle(tmp_path):
+    from nomad_tpu.client.drivers import DockerDriver
+    d = DockerDriver()
+    if not d.available():
+        pytest.skip("docker daemon unreachable")
+    task = Task(name="t", driver="docker",
+                config={"image": "busybox",
+                        "command": "sh", "args": ["-c", "exit 4"]})
+    h = d.start_task("t1", task, {}, str(tmp_path))
+    try:
+        res = d.wait_task(h, timeout=60)
+        assert res is not None and res.exit_code == 4
+    finally:
+        d.destroy_task(h)
+
+
+@pytest.mark.skipif(
+    __import__("shutil").which("java") is None,
+    reason="java not installed")
+def test_java_driver_starts_jvm(tmp_path):
+    from nomad_tpu.client.drivers import JavaDriver
+    d = JavaDriver()
+    task = Task(name="t", driver="java", config={"class": "NoSuchMain"})
+    h = d.start_task("t1", task, {}, str(tmp_path))
+    res = d.wait_task(h, timeout=30)
+    assert res is not None and res.exit_code != 0   # JVM ran, class missing
+
+
 # ---------------------------------------------------------------- restarts
 
 def test_restart_tracker_batch_success_no_restart():
