@@ -1,0 +1,21 @@
+# Agent configuration (see nomad_tpu/agent_config.py for the full shape).
+bind_addr = "127.0.0.1"
+log_level = "debug"
+
+ports { http = 4646 }
+
+server {
+  enabled        = true
+  num_schedulers = 2
+  heartbeat_ttl  = "60s"
+}
+
+client {
+  enabled    = true
+  count      = 2
+  node_class = "compute"
+  datacenter = "dc1"
+  meta { rack = "r1" }
+}
+
+acl { enabled = false }

@@ -1,0 +1,141 @@
+"""Agent configuration files (reference: command/agent/config.go +
+config_parse.go — the HCL agent config plane of SURVEY §6.6a).
+
+Supported shape (a practical subset of the reference's):
+
+    bind_addr = "127.0.0.1"
+    log_level = "debug"
+    ports { http = 4646 }
+    server {
+      enabled        = true
+      num_schedulers = 2
+      heartbeat_ttl  = "30s"
+      acl_enabled    = false
+    }
+    client {
+      enabled    = true
+      count      = 2            # in-process client nodes (dev topology)
+      node_class = "compute"
+      datacenter = "dc1"
+      meta { rack = "r1" }
+    }
+    acl { enabled = true }
+
+Multiple `-config` files merge left to right; CLI flags win last
+(reference: config merge order)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class AgentConfig:
+    bind_addr: str = "127.0.0.1"
+    http_port: int = 4646
+    log_level: str = "info"
+    server_enabled: bool = True
+    num_workers: int = 1
+    heartbeat_ttl: float = 30.0
+    client_enabled: bool = True
+    client_count: int = 1
+    node_class: str = ""
+    datacenter: str = "dc1"
+    client_meta: Dict[str, str] = field(default_factory=dict)
+    acl_enabled: bool = False
+
+    def merge(self, other: "AgentConfig",
+              set_fields: set) -> "AgentConfig":
+        """Fields explicitly set in `other` override self."""
+        import dataclasses
+        out = dataclasses.replace(self)
+        for f in set_fields:
+            setattr(out, f, getattr(other, f))
+        return out
+
+
+_BLOCK_KEYS = {
+    "ports": {"http"},
+    "server": {"enabled", "num_schedulers", "heartbeat_ttl",
+               "acl_enabled"},
+    "client": {"enabled", "count", "node_class", "datacenter"},
+    "acl": {"enabled"},
+}
+
+
+def parse_agent_config(src: str):
+    """HCL text -> (AgentConfig, set of explicitly-set field names)."""
+    from nomad_tpu.jobspec.hcl import Attr, Block, parse
+    from nomad_tpu.acl.policy import _literal
+
+    cfg = AgentConfig()
+    set_fields: set = set()
+
+    def put(field_name: str, value: Any) -> None:
+        setattr(cfg, field_name, value)
+        set_fields.add(field_name)
+
+    for node in parse(src):
+        if isinstance(node, Attr):
+            v = _literal(node.expr)
+            if node.name == "bind_addr":
+                put("bind_addr", str(v))
+            elif node.name == "log_level":
+                put("log_level", str(v).lower())
+            else:
+                raise ValueError(f"unknown agent setting {node.name!r}")
+        elif isinstance(node, Block):
+            body = {a.name: _literal(a.expr) for a in node.body
+                    if isinstance(a, Attr)}
+            sub_blocks = [b for b in node.body if isinstance(b, Block)]
+            known = _BLOCK_KEYS.get(node.type)
+            if known is not None:
+                for key in body:
+                    if key not in known:
+                        raise ValueError(
+                            f"unknown {node.type} setting {key!r}")
+            if node.type == "ports":
+                if "http" in body:
+                    put("http_port", int(body["http"]))
+            elif node.type == "server":
+                if "enabled" in body:
+                    put("server_enabled", bool(body["enabled"]))
+                if "num_schedulers" in body:
+                    put("num_workers", int(body["num_schedulers"]))
+                if "heartbeat_ttl" in body:
+                    from nomad_tpu.jobspec.schema import parse_duration
+                    put("heartbeat_ttl",
+                        parse_duration(body["heartbeat_ttl"], 30.0))
+                if "acl_enabled" in body:
+                    put("acl_enabled", bool(body["acl_enabled"]))
+            elif node.type == "client":
+                if "enabled" in body:
+                    put("client_enabled", bool(body["enabled"]))
+                if "count" in body:
+                    put("client_count", int(body["count"]))
+                if "node_class" in body:
+                    put("node_class", str(body["node_class"]))
+                if "datacenter" in body:
+                    put("datacenter", str(body["datacenter"]))
+                for b in sub_blocks:
+                    if b.type == "meta":
+                        meta = {a.name: str(_literal(a.expr))
+                                for a in b.body if isinstance(a, Attr)}
+                        put("client_meta", meta)
+            elif node.type == "acl":
+                if "enabled" in body:
+                    put("acl_enabled", bool(body["enabled"]))
+            else:
+                raise ValueError(f"unknown agent block {node.type!r}")
+    return cfg, set_fields
+
+
+def load_agent_config(paths: List[str]) -> AgentConfig:
+    """Merge config files left to right (later files win)."""
+    cfg = AgentConfig()
+    for path in paths:
+        with open(path) as f:
+            parsed, set_fields = parse_agent_config(f.read())
+        cfg = cfg.merge(parsed, set_fields)
+    return cfg

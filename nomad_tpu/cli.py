@@ -53,13 +53,43 @@ def _load_jobspec(path: str) -> dict:
 
 def cmd_agent(args) -> int:
     from nomad_tpu.agent import Agent
-    host, _, port = args.bind.partition(":")
-    agent = Agent(num_clients=args.clients, num_workers=args.workers,
-                  http_host=host or "127.0.0.1",
-                  http_port=int(port or 4646))
+    from nomad_tpu.agent_config import AgentConfig, load_agent_config
+    from nomad_tpu.structs import Node
+
+    cfg = (load_agent_config(args.config) if args.config
+           else AgentConfig())
+    # CLI flags win over config files (reference merge order)
+    host, _, port = args.bind.partition(":") if args.bind else ("", "", "")
+    if host:
+        cfg.bind_addr = host
+    if port:
+        cfg.http_port = int(port)
+    if args.clients is not None:
+        cfg.client_count = args.clients
+    if args.workers is not None:
+        cfg.num_workers = args.workers
+
+    if not cfg.server_enabled:
+        print("Error: client-only agents need a remote RPC transport; "
+              "in-process agents always embed the server "
+              "(server { enabled = false } is not supported)",
+              file=sys.stderr)
+        return 1
+    nodes = [Node(node_class=cfg.node_class,
+                  datacenter=cfg.datacenter,
+                  meta=dict(cfg.client_meta))
+             for _ in range(cfg.client_count)]
+    agent = Agent(num_clients=cfg.client_count if cfg.client_enabled else 0,
+                  num_workers=cfg.num_workers,
+                  http_host=cfg.bind_addr,
+                  http_port=cfg.http_port,
+                  heartbeat_ttl=cfg.heartbeat_ttl,
+                  acl_enabled=cfg.acl_enabled,
+                  nodes=nodes)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address}")
-    print(f"==> {len(agent.clients)} in-process client node(s)")
+    print(f"==> {len(agent.clients)} in-process client node(s)"
+          + ("  [ACL enabled]" if cfg.acl_enabled else ""))
     stop = []
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
@@ -471,9 +501,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     ag = sub.add_parser("agent", help="run an agent (server+client+http)")
     ag.add_argument("-dev", action="store_true", default=True)
-    ag.add_argument("-bind", default="127.0.0.1:4646")
-    ag.add_argument("-clients", type=int, default=1)
-    ag.add_argument("-workers", type=int, default=1)
+    ag.add_argument("-config", action="append",
+                    help="agent HCL config file (repeatable; merged in "
+                         "order, flags win)")
+    ag.add_argument("-bind", default="")
+    ag.add_argument("-clients", type=int, default=None)
+    ag.add_argument("-workers", type=int, default=None)
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(

@@ -202,3 +202,39 @@ job "cli-demo" {
                      "get-config"]) == 0
         assert main(["-address", addr, "job", "stop", "cli-demo"]) == 0
         capsys.readouterr()
+
+
+class TestAgentConfig:
+    def test_parse_and_merge(self, tmp_path):
+        from nomad_tpu.agent_config import load_agent_config
+        base = tmp_path / "base.hcl"
+        base.write_text('''
+bind_addr = "0.0.0.0"
+server { num_schedulers = 4 heartbeat_ttl = "45s" }
+client { count = 3 meta { rack = "r9" } }
+''')
+        override = tmp_path / "override.hcl"
+        override.write_text('ports { http = 5555 }\nacl { enabled = true }')
+        cfg = load_agent_config([str(base), str(override)])
+        assert cfg.bind_addr == "0.0.0.0"
+        assert cfg.num_workers == 4
+        assert cfg.heartbeat_ttl == 45.0
+        assert cfg.client_count == 3
+        assert cfg.client_meta == {"rack": "r9"}
+        assert cfg.http_port == 5555
+        assert cfg.acl_enabled
+
+    def test_example_config_parses(self):
+        from pathlib import Path
+        from nomad_tpu.agent_config import load_agent_config
+        example = (Path(__file__).parent.parent / "examples"
+                   / "agent.hcl")
+        cfg = load_agent_config([str(example)])
+        assert cfg.num_workers == 2 and cfg.heartbeat_ttl == 60.0
+        assert cfg.node_class == "compute"
+
+    def test_unknown_setting_rejected(self):
+        import pytest as _pytest
+        from nomad_tpu.agent_config import parse_agent_config
+        with _pytest.raises(ValueError):
+            parse_agent_config("data_dir_typo = \"/x\"")
