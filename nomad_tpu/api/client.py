@@ -40,6 +40,7 @@ class APIClient:
         self.events = Events(self)
         self.acl = ACLEndpoint(self)
         self.services = Services(self)
+        self.volumes = Volumes(self)
         self.namespaces = Namespaces(self)
         self.node_pools = NodePools(self)
         self.variables = Variables(self)
@@ -139,6 +140,12 @@ class Jobs(_Endpoint):
     def periodic_force(self, job_id: str) -> Dict:
         jid = urllib.parse.quote(job_id, safe="")
         return self.c.put(f"/v1/job/{jid}/periodic/force")
+
+    def scale(self, job_id: str, group: str, count: int) -> Dict:
+        jid = urllib.parse.quote(job_id, safe="")
+        return self.c.put(f"/v1/job/{jid}/scale",
+                          body={"Target": {"Group": group},
+                                "Count": count})
 
 
 class Nodes(_Endpoint):
@@ -243,6 +250,23 @@ class Agent(_Endpoint):
 
     def metrics(self) -> Dict:
         return self.c.get("/v1/metrics")
+
+
+class Volumes(_Endpoint):
+    def list(self) -> List[Dict]:
+        return self.c.get("/v1/volumes")
+
+    def info(self, vol_id: str) -> Dict:
+        return self.c.get(f"/v1/volume/csi/{vol_id}")
+
+    def register(self, vol_id: str, plugin_id: str, **fields) -> Dict:
+        body = {"ID": vol_id, "PluginID": plugin_id}
+        body.update(fields)
+        return self.c.put(f"/v1/volume/csi/{vol_id}",
+                          body={"Volume": body})
+
+    def deregister(self, vol_id: str) -> Dict:
+        return self.c.delete(f"/v1/volume/csi/{vol_id}")
 
 
 class Services(_Endpoint):

@@ -145,7 +145,8 @@ class Router:
             return acl
         if head in ("jobs", "job", "allocations", "allocation",
                     "evaluations", "evaluation", "deployments",
-                    "deployment", "search", "services", "service"):
+                    "deployment", "search", "services", "service",
+                    "volumes", "volume"):
             cap = "submit-job" if write else "read-job"
             if head in ("allocations", "allocation") and write:
                 cap = "alloc-lifecycle"
@@ -227,6 +228,37 @@ class Router:
             if method == "GET":
                 self._check_ns(acl, a.namespace, "read-job")
                 return codec.encode(a)
+            if method in ("PUT", "POST") and len(p) > 2 \
+                    and p[2] in ("signal", "restart"):
+                # reference: Allocations.Signal / Restart client RPCs —
+                # routed to the in-process client owning the alloc
+                self._check_ns(acl, a.namespace, "alloc-lifecycle")
+                for c in self.agent.clients:
+                    ar = c.alloc_runners.get(aid)
+                    if ar is None:
+                        continue
+                    if p[2] == "signal":
+                        import signal as _sig
+                        num = (body or {}).get("Signal", "SIGUSR1")
+                        if isinstance(num, str):
+                            cand = getattr(_sig, num, None)
+                            signum = (cand if isinstance(
+                                cand, (int, _sig.Signals)) else None)
+                        else:
+                            signum = int(num)
+                        if signum is None:
+                            raise APIError(400, f"unknown signal {num!r}")
+                        for tr in ar.task_runners:
+                            if tr.handle is not None:
+                                tr.driver.signal_task(tr.handle,
+                                                      int(signum))
+                    else:
+                        for tr in ar.task_runners:
+                            if tr.handle is not None:
+                                tr.driver.stop_task(
+                                    tr.handle, tr.task.kill_timeout_s)
+                    return {}
+                raise APIError(404, "alloc not running on this agent")
             if method in ("PUT", "POST") and len(p) > 2 and p[2] == "stop":
                 self._check_ns(acl, a.namespace, "alloc-lifecycle")
                 stop = a.copy_skip_job()
@@ -330,6 +362,46 @@ class Router:
                 if not regs:
                     raise APIError(404, "service not found")
                 return [codec.encode(r) for r in regs]
+        elif head == "volumes":
+            if method == "GET":
+                return [{"ID": v.id, "Namespace": v.namespace,
+                         "PluginID": v.plugin_id,
+                         "AccessMode": v.access_mode,
+                         "Schedulable": v.schedulable,
+                         "ReadAllocs": len(v.read_allocs),
+                         "WriteAllocs": len(v.write_allocs)}
+                        for v in s.state.csi_volumes(
+                            None if ns == "*" else ns)]
+        elif head == "volume":
+            # /v1/volume/csi/<id> (reference path shape)
+            if p[1:2] != ["csi"]:
+                raise APIError(404, "only csi volumes")
+            vol_id = p[2]
+            if method == "GET":
+                v = s.state.snapshot().csi_volume_by_id(ns, vol_id)
+                if v is None:
+                    raise APIError(404, "volume not found")
+                return codec.encode(v)
+            if method in ("PUT", "POST"):
+                from nomad_tpu.structs import CSIVolume
+                wire = (body or {}).get("Volume") or body or {}
+                vol = codec.decode(CSIVolume, wire)
+                vol.id = vol.id or vol_id
+                if "Namespace" not in wire:
+                    vol.namespace = ns
+                elif vol.namespace != ns:
+                    self._check_ns(acl, vol.namespace, "submit-job")
+                if not vol.plugin_id:
+                    raise APIError(400, "PluginID required")
+                s.state.upsert_csi_volume(vol)
+                return {}
+            if method == "DELETE":
+                err = s.state.delete_csi_volume(ns, vol_id)
+                if err == "volume not found":
+                    raise APIError(404, err)
+                if err:
+                    raise APIError(400, err)
+                return {}
         elif head == "vars":
             if method == "GET":
                 prefix = (qs.get("prefix") or [""])[0]
@@ -443,6 +515,19 @@ class Router:
                 if child is None:
                     raise APIError(400, "job is not periodic")
                 return {"DispatchedJobID": child.id}
+            if sub == "scale":
+                # reference: Job.Scale RPC / `nomad job scale`
+                group = (body or {}).get("Target", {}).get("Group", "")
+                count = (body or {}).get("Count")
+                if count is None or not group:
+                    raise APIError(400, "Target.Group and Count required")
+                tg = job.lookup_task_group(group)
+                if tg is None:
+                    raise APIError(400, f"unknown task group {group!r}")
+                scaled = job.copy()
+                scaled.lookup_task_group(group).count = int(count)
+                ev = s.register_job(scaled)
+                return {"EvalID": ev.id if ev else ""}
         raise APIError(404, f"no job handler for {method} {p}")
 
     def _node(self, method: str, p: List[str],

@@ -163,6 +163,37 @@ def cmd_job_revert(args) -> int:
     return 0
 
 
+def cmd_job_scale(args) -> int:
+    resp = _client(args).jobs.scale(args.job_id, args.group, args.count)
+    print(f"scaled {args.job_id}/{args.group} to {args.count}; "
+          f"eval {resp.get('EvalID', '')}")
+    return 0
+
+
+def cmd_volume_register(args) -> int:
+    _client(args).volumes.register(args.volume_id, args.plugin)
+    print(f"volume {args.volume_id!r} registered")
+    return 0
+
+
+def cmd_volume_status(args) -> int:
+    c = _client(args)
+    if args.volume_id:
+        _out(c.volumes.info(args.volume_id))
+    else:
+        for v in c.volumes.list():
+            print(f"{v['ID']:<28} {v['PluginID']:<16} "
+                  f"{v['AccessMode']:<26} r{v['ReadAllocs']}/w"
+                  f"{v['WriteAllocs']}")
+    return 0
+
+
+def cmd_volume_deregister(args) -> int:
+    _client(args).volumes.deregister(args.volume_id)
+    print(f"volume {args.volume_id!r} deregistered")
+    return 0
+
+
 def cmd_job_history(args) -> int:
     _out(_client(args).jobs.versions(args.job_id))
     return 0
@@ -536,6 +567,11 @@ def build_parser() -> argparse.ArgumentParser:
     jh = job.add_parser("history")
     jh.add_argument("job_id")
     jh.set_defaults(fn=cmd_job_history)
+    jsc = job.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group")
+    jsc.add_argument("count", type=int)
+    jsc.set_defaults(fn=cmd_job_scale)
     jpf = job.add_parser("periodic-force")
     jpf.add_argument("job_id")
     jpf.set_defaults(fn=cmd_job_periodic_force)
@@ -677,6 +713,19 @@ def build_parser() -> argparse.ArgumentParser:
     npd = npp.add_parser("delete")
     npd.add_argument("name")
     npd.set_defaults(fn=cmd_node_pool_delete)
+
+    vol = sub.add_parser("volume", help="CSI volumes").add_subparsers(
+        dest="vol_cmd", required=True)
+    vr = vol.add_parser("register")
+    vr.add_argument("volume_id")
+    vr.add_argument("-plugin", required=True)
+    vr.set_defaults(fn=cmd_volume_register)
+    vs = vol.add_parser("status")
+    vs.add_argument("volume_id", nargs="?", default="")
+    vs.set_defaults(fn=cmd_volume_status)
+    vd = vol.add_parser("deregister")
+    vd.add_argument("volume_id")
+    vd.set_defaults(fn=cmd_volume_deregister)
 
     var = sub.add_parser("var", help="variables").add_subparsers(
         dest="var_cmd", required=True)

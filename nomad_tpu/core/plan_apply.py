@@ -179,4 +179,22 @@ class PlanApplier:
         for a in new_allocs:
             existing[a.id] = a   # same-id update replaces
         ok, _, _ = allocs_fit(node, list(existing.values()))
-        return ok
+        if not ok:
+            return False
+        # CSI claim re-check (reference: CSIVolumeChecker claim_ok at the
+        # serialization point): access-mode limits and schedulable=false
+        # refute here — the device mask only checks plugin presence.
+        # Known gap: two claims inside ONE plan are both checked against
+        # the pre-plan claim set.
+        for a in new_allocs:
+            tg = a.job.lookup_task_group(a.task_group) \
+                if a.job is not None else None
+            if tg is None or not tg.volumes:
+                continue
+            for vreq in tg.volumes.values():
+                if vreq.type != "csi" or not vreq.source:
+                    continue
+                vol = snap.csi_volume_by_id(a.namespace, vreq.source)
+                if vol is None or not vol.claim_ok(vreq.read_only):
+                    return False
+        return True

@@ -329,6 +329,8 @@ class StateStore:
                         for r in self._services.values()):
             self._services = {k: r for k, r in self._services.items()
                               if r.alloc_id not in dead}
+        if dead:
+            self._release_csi_claims_locked(dead)
         self._allocs = table
         self._allocs_by_node = by_node
         self._allocs_by_job = by_job
@@ -417,6 +419,16 @@ class StateStore:
             # are immutable once inserted (state.UpsertPlanResults stores
             # the submitted pointers directly).
             self._insert_allocs(allocs, idx, copy=False)
+            # CSI claims ride the plan commit (reference: the client's
+            # claim RPC; the applier's claim_ok re-check reads these).
+            # Released when the alloc goes terminal.  Changed volumes
+            # accumulate and merge ONCE, not per alloc.
+            changed_vols: Dict[Tuple[str, str], CSIVolume] = {}
+            for node_allocs in result.node_allocation.values():
+                for a in node_allocs:
+                    self._claim_csi_volumes_locked(a, changed_vols)
+            if changed_vols:
+                self._csi_volumes = {**self._csi_volumes, **changed_vols}
             if result.deployment is not None:
                 dep = result.deployment.copy()
                 prev = self._deployments.get(dep.id)
@@ -447,9 +459,78 @@ class StateStore:
     def upsert_csi_volume(self, vol: CSIVolume) -> int:
         with self._lock:
             idx = self._bump()
-            self._csi_volumes = {**self._csi_volumes,
-                                 (vol.namespace, vol.id): vol}
+            key = (vol.namespace, vol.id)
+            prev = self._csi_volumes.get(key)
+            if prev is not None:
+                # re-registration (idempotent retry) must not wipe live
+                # claims — they belong to running allocs, not the spec
+                import dataclasses
+                vol = dataclasses.replace(
+                    vol, read_allocs=dict(prev.read_allocs),
+                    write_allocs=dict(prev.write_allocs))
+            self._csi_volumes = {**self._csi_volumes, key: vol}
             return idx
+
+    def delete_csi_volume(self, namespace: str,
+                          vol_id: str) -> Optional[str]:
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is None:
+                return "volume not found"
+            if vol.read_allocs or vol.write_allocs:
+                return "volume has active claims"
+            self._bump()
+            vols = dict(self._csi_volumes)
+            vols.pop((namespace, vol_id), None)
+            self._csi_volumes = vols
+            return None
+
+    def csi_volumes(self, namespace: Optional[str] = None):
+        return [v for (ns, _), v in self._csi_volumes.items()
+                if namespace is None or ns == namespace]
+
+    def _claim_csi_volumes_locked(self, alloc: Allocation,
+                                  changed: Dict) -> None:
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        if tg is None or not tg.volumes:
+            return
+        import dataclasses
+        for vreq in tg.volumes.values():
+            if vreq.type != "csi" or not vreq.source:
+                continue
+            key = (alloc.namespace, vreq.source)
+            vol = changed.get(key) or self._csi_volumes.get(key)
+            if vol is None:
+                continue
+            if key not in changed:
+                vol = dataclasses.replace(
+                    vol, read_allocs=dict(vol.read_allocs),
+                    write_allocs=dict(vol.write_allocs))
+            if vreq.read_only:
+                vol.read_allocs[alloc.id] = True
+            else:
+                vol.write_allocs[alloc.id] = True
+            changed[key] = vol
+
+    def _release_csi_claims_locked(self, dead_ids: set) -> None:
+        """Volume-watcher semantics (reference: nomad/volumewatcher/):
+        terminal allocs lose their claims."""
+        changed = {}
+        for key, vol in self._csi_volumes.items():
+            if not (dead_ids & (set(vol.read_allocs)
+                                | set(vol.write_allocs))):
+                continue
+            import dataclasses
+            v = dataclasses.replace(
+                vol,
+                read_allocs={k: True for k in vol.read_allocs
+                             if k not in dead_ids},
+                write_allocs={k: True for k in vol.write_allocs
+                              if k not in dead_ids})
+            changed[key] = v
+        if changed:
+            self._csi_volumes = {**self._csi_volumes, **changed}
 
     def set_scheduler_config(self, cfg: SchedulerConfiguration) -> int:
         with self._lock:

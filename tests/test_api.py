@@ -238,3 +238,101 @@ client { count = 3 meta { rack = "r9" } }
         from nomad_tpu.agent_config import parse_agent_config
         with _pytest.raises(ValueError):
             parse_agent_config("data_dir_typo = \"/x\"")
+
+
+class TestScaleAndVolumes:
+    def test_job_scale(self, api, agent):
+        wire, job = _wire_batch_job(count=1)
+        api.jobs.register(wire)
+        _wait(lambda: api.jobs.allocations(job.id))
+        api.jobs.scale(job.id, "worker", 3)
+        allocs = _wait(lambda: len([
+            a for a in api.jobs.allocations(job.id)
+            if a["DesiredStatus"] == "run"]) == 3 or None)
+        assert allocs
+        info = api.jobs.info(job.id)
+        assert info["TaskGroups"][0]["Count"] == 3
+        with pytest.raises(APIException):
+            api.jobs.scale(job.id, "nope", 2)
+
+    def test_csi_volume_lifecycle_and_claims(self, api, agent):
+        from nomad_tpu.structs import VolumeRequest, compute_class
+        api.volumes.register("vol-data", "ebs-plugin",
+                             AccessMode="multi-node-multi-writer")
+        vols = api.volumes.list()
+        assert any(v["ID"] == "vol-data" for v in vols)
+
+        # node advertising the plugin; job claiming the volume
+        s = agent.server
+        node = s.state.nodes()[0] if hasattr(s.state, "nodes") else None
+        from nomad_tpu import mock
+        n = mock.node()
+        n.csi_node_plugins = {"ebs-plugin": True}
+        n.computed_class = compute_class(n)
+        s.register_node(n)
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].config = {"run_for_s": 300}
+        job.task_groups[0].volumes = {
+            "data": VolumeRequest(name="data", type="csi",
+                                  source="vol-data")}
+        api.jobs.register(codec.encode(job))
+        allocs = _wait(lambda: api.jobs.allocations(job.id))
+        assert allocs and allocs[0]["NodeID"] == n.id, \
+            "csi job must land on the plugin node"
+        vol = _wait(lambda: (api.volumes.info("vol-data")
+                             if api.volumes.info("vol-data")["WriteAllocs"]
+                             else None))
+        assert allocs[0]["ID"] in vol["WriteAllocs"]
+
+        # claimed volume cannot be deregistered
+        with pytest.raises(APIException):
+            api.volumes.deregister("vol-data")
+
+        # terminal alloc releases the claim
+        api.jobs.deregister(job.id, purge=True)
+        released = _wait(lambda: not api.volumes.info(
+            "vol-data")["WriteAllocs"] or None)
+        assert released
+        api.volumes.deregister("vol-data")
+        with pytest.raises(APIException):
+            api.volumes.info("vol-data")
+
+    def test_single_writer_volume_refuses_second_claim(self, api, agent):
+        from nomad_tpu import mock
+        from nomad_tpu.structs import VolumeRequest, compute_class
+        api.volumes.register("vol-sw", "ebs-plugin",
+                             AccessMode="single-node-writer")
+        s = agent.server
+        n = mock.node()
+        n.csi_node_plugins = {"ebs-plugin": True}
+        n.computed_class = compute_class(n)
+        s.register_node(n)
+
+        def vol_job():
+            j = mock.batch_job()
+            j.task_groups[0].count = 1
+            j.task_groups[0].tasks[0].config = {"run_for_s": 300}
+            j.task_groups[0].volumes = {
+                "d": VolumeRequest(name="d", type="csi", source="vol-sw")}
+            return j
+
+        j1 = vol_job()
+        api.jobs.register(codec.encode(j1))
+        assert _wait(lambda: api.jobs.allocations(j1.id))
+        assert _wait(lambda: api.volumes.info("vol-sw")["WriteAllocs"]
+                     or None)
+
+        j2 = vol_job()
+        api.jobs.register(codec.encode(j2))
+        # second writer is refuted at plan apply: eval fails or blocks,
+        # no alloc commits
+        time.sleep(3)
+        assert not [a for a in api.jobs.allocations(j2.id)
+                    if a["DesiredStatus"] == "run"], \
+            "single-writer volume accepted a second writer"
+        api.jobs.deregister(j1.id, purge=True)
+        api.jobs.deregister(j2.id, purge=True)
+        _wait(lambda: not api.volumes.info("vol-sw")["WriteAllocs"]
+              or None)
+        api.volumes.deregister("vol-sw")
