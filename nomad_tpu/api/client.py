@@ -257,16 +257,19 @@ class Volumes(_Endpoint):
         return self.c.get("/v1/volumes")
 
     def info(self, vol_id: str) -> Dict:
-        return self.c.get(f"/v1/volume/csi/{vol_id}")
+        q = urllib.parse.quote(vol_id, safe="")
+        return self.c.get(f"/v1/volume/csi/{q}")
 
     def register(self, vol_id: str, plugin_id: str, **fields) -> Dict:
         body = {"ID": vol_id, "PluginID": plugin_id}
         body.update(fields)
-        return self.c.put(f"/v1/volume/csi/{vol_id}",
+        q = urllib.parse.quote(vol_id, safe="")
+        return self.c.put(f"/v1/volume/csi/{q}",
                           body={"Volume": body})
 
     def deregister(self, vol_id: str) -> Dict:
-        return self.c.delete(f"/v1/volume/csi/{vol_id}")
+        q = urllib.parse.quote(vol_id, safe="")
+        return self.c.delete(f"/v1/volume/csi/{q}")
 
 
 class Services(_Endpoint):

@@ -240,23 +240,26 @@ class Router:
                     if p[2] == "signal":
                         import signal as _sig
                         num = (body or {}).get("Signal", "SIGUSR1")
+                        signum = None
                         if isinstance(num, str):
                             cand = getattr(_sig, num, None)
-                            signum = (cand if isinstance(
-                                cand, (int, _sig.Signals)) else None)
-                        else:
-                            signum = int(num)
+                            if isinstance(cand, (int, _sig.Signals)):
+                                signum = int(cand)
+                            elif num.isdigit():
+                                signum = int(num)
+                        elif isinstance(num, int):
+                            signum = num
                         if signum is None:
                             raise APIError(400, f"unknown signal {num!r}")
                         for tr in ar.task_runners:
                             if tr.handle is not None:
-                                tr.driver.signal_task(tr.handle,
-                                                      int(signum))
+                                tr.driver.signal_task(tr.handle, signum)
                     else:
+                        # restart must be unconditional — it bypasses the
+                        # restart-policy budget (reference: alloc restart
+                        # always restarts; only real failures count)
                         for tr in ar.task_runners:
-                            if tr.handle is not None:
-                                tr.driver.stop_task(
-                                    tr.handle, tr.task.kill_timeout_s)
+                            tr.restart()
                     return {}
                 raise APIError(404, "alloc not running on this agent")
             if method in ("PUT", "POST") and len(p) > 2 and p[2] == "stop":
@@ -376,6 +379,8 @@ class Router:
             # /v1/volume/csi/<id> (reference path shape)
             if p[1:2] != ["csi"]:
                 raise APIError(404, "only csi volumes")
+            if len(p) < 3 or not p[2]:
+                raise APIError(404, "volume id required")
             vol_id = p[2]
             if method == "GET":
                 v = s.state.snapshot().csi_volume_by_id(ns, vol_id)
@@ -386,6 +391,10 @@ class Router:
                 from nomad_tpu.structs import CSIVolume
                 wire = (body or {}).get("Volume") or body or {}
                 vol = codec.decode(CSIVolume, wire)
+                if vol.id and vol.id != vol_id:
+                    raise APIError(
+                        400, f"volume ID {vol.id!r} does not match "
+                             f"request path {vol_id!r}")
                 vol.id = vol.id or vol_id
                 if "Namespace" not in wire:
                     vol.namespace = ns

@@ -62,7 +62,8 @@ class Client:
     def __init__(self, rpc, node: Optional[Node] = None,
                  data_dir: str = "", drivers: Optional[Dict] = None,
                  heartbeat_interval: float = 10.0,
-                 sync_interval: float = 0.2) -> None:
+                 sync_interval: float = 0.2,
+                 devices=None) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
         self.drivers = drivers if drivers is not None \
@@ -80,7 +81,7 @@ class Client:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
-        fp = FingerprintManager(self.drivers, data_dir)
+        fp = FingerprintManager(self.drivers, data_dir, devices=devices)
         fp.run(self.node)
         self.node.status = NODE_STATUS_READY
         from nomad_tpu.structs import compute_class

@@ -80,6 +80,20 @@ def fp_nomad() -> Dict[str, str]:
     return {"nomad.version": VERSION, "nomad.revision": "tpu"}
 
 
+def fp_devices(devices) -> Dict[str, str]:
+    """Advertise configured/plugin-reported device groups as node attrs
+    (reference: client/devicemanager fingerprint channel feeding
+    structs.NodeDeviceResource).  Groups come from client config or an
+    external device plugin; there is no hardware probe here."""
+    attrs: Dict[str, str] = {}
+    for d in devices:
+        base = f"device.{d.id()}"
+        attrs[f"{base}.count"] = str(len(d.instance_ids))
+        for k, v in d.attributes.items():
+            attrs[f"{base}.attr.{k}"] = v
+    return attrs
+
+
 def fp_network() -> Dict[str, str]:
     """reference: fingerprint/network.go — advertise IP only; speed probing
     is out of scope in-process."""
@@ -97,9 +111,10 @@ class FingerprintManager:
     """reference: client/fingerprint_manager.go"""
 
     def __init__(self, drivers: Optional[Dict] = None,
-                 data_dir: str = "") -> None:
+                 data_dir: str = "", devices=None) -> None:
         self.drivers = drivers or {}
         self.data_dir = data_dir
+        self.devices = list(devices or [])
         self.extra: List[Callable[[], Dict[str, str]]] = []
 
     def run(self, node) -> None:
@@ -118,6 +133,11 @@ class FingerprintManager:
         if node.resources is None or node.resources.cpu == 0:
             node.resources = NodeResources(cpu=cpu, memory_mb=mem,
                                            disk_mb=disk)
+        if self.devices:
+            attrs.update(fp_devices(self.devices))
+            have = {d.id() for d in node.resources.devices}
+            node.resources.devices.extend(
+                d for d in self.devices if d.id() not in have)
         for name, drv in self.drivers.items():
             fp = drv.fingerprint()
             attrs.update(fp)

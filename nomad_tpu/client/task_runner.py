@@ -130,6 +130,7 @@ class TaskRunner:
         self.env: Dict[str, str] = {}
         self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
         self._kill = threading.Event()
+        self._restart_requested = False
         self._thread: Optional[threading.Thread] = None
         self.dead = threading.Event()
 
@@ -258,6 +259,16 @@ class TaskRunner:
             # release driver resources of the EXITED instance (docker
             # removes the container; process drivers no-op on a dead pid)
             self._destroy_handle()
+            if self._restart_requested:
+                # operator-requested restart (alloc restart endpoint):
+                # unconditional, never consumes the restart-policy budget
+                self._restart_requested = False
+                self.state.restarts += 1
+                self.state.last_restart = time.time()
+                self._set_state(TASK_STATE_PENDING)
+                self._event(TASK_RESTARTING,
+                            restart_reason="User requested restart")
+                continue
             decision, delay = self.restart_tracker.next(result.exit_code,
                                                         failed)
             if decision == KILL:
@@ -295,6 +306,19 @@ class TaskRunner:
         except Exception:  # noqa: BLE001 - cleanup is best-effort
             pass
         self.handle = None
+
+    def restart(self) -> None:
+        """Operator-requested restart (reference: Allocations.Restart RPC →
+        task runner Restart): stop the live instance and start a fresh one
+        unconditionally — bypasses the RestartTracker so it never burns the
+        policy's attempt budget or kills the task."""
+        self._restart_requested = True
+        h = self.handle
+        if h is not None:
+            try:
+                self.driver.stop_task(h, self.task.kill_timeout_s)
+            except Exception:  # noqa: BLE001 - the wait loop handles exit
+                pass
 
     def kill(self, wait: bool = True, timeout: float = 10.0,
              reason: str = "") -> None:

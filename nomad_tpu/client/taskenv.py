@@ -34,6 +34,16 @@ def build_task_env(alloc, task, node, task_dir: str = "",
     for label, port in alloc.allocated_ports.items():
         env[f"NOMAD_PORT_{label}"] = str(port)
         env[f"NOMAD_HOST_PORT_{label}"] = str(port)
+    # assigned device instances (reference: device_hook.go — drivers map
+    # these onto isolation primitives; exec-class drivers get env vars)
+    for ad in getattr(alloc, "allocated_devices", ()) or ():
+        if ad.task and ad.task != task.name:
+            continue
+        # key carries the full vendor/type/name id: two groups of the same
+        # type (nvidia/gpu + amd/gpu) must not overwrite each other
+        key = "_".join(p for p in (ad.vendor, ad.type, ad.name) if p)
+        key = key.upper().replace("-", "_").replace(".", "_")
+        env[f"NOMAD_DEVICE_{key}"] = ",".join(ad.device_ids)
     for k, v in (task.env or {}).items():
         env[k] = interpolate(v, env, node)
     return env

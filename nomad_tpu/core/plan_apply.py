@@ -178,14 +178,25 @@ class PlanApplier:
             existing.pop(a.id, None)
         for a in new_allocs:
             existing[a.id] = a   # same-id update replaces
-        ok, _, _ = allocs_fit(node, list(existing.values()))
+        # check_devices: a concurrent worker may have assigned the same
+        # device instances against its own stale snapshot — the refute
+        # here is what makes host-side device assignment race-safe
+        ok, _, _ = allocs_fit(node, list(existing.values()),
+                              check_devices=True)
         if not ok:
             return False
         # CSI claim re-check (reference: CSIVolumeChecker claim_ok at the
         # serialization point): access-mode limits and schedulable=false
         # refute here — the device mask only checks plugin presence.
+        # Claims held by allocs this plan removes anywhere (stops,
+        # preemptions, same-id replacements) count as released.
         # Known gap: two claims inside ONE plan are both checked against
         # the pre-plan claim set.
+        releasing = {a.id for allocs in plan.node_update.values()
+                     for a in allocs}
+        releasing |= {a.id for allocs in plan.node_preemptions.values()
+                      for a in allocs}
+        releasing |= {a.id for a in new_allocs}
         for a in new_allocs:
             tg = a.job.lookup_task_group(a.task_group) \
                 if a.job is not None else None
@@ -195,6 +206,7 @@ class PlanApplier:
                 if vreq.type != "csi" or not vreq.source:
                     continue
                 vol = snap.csi_volume_by_id(a.namespace, vreq.source)
-                if vol is None or not vol.claim_ok(vreq.read_only):
+                if vol is None or not vol.claim_ok(vreq.read_only,
+                                                   releasing):
                     return False
         return True

@@ -246,12 +246,52 @@ class PlacementEngine:
 
     # -------------------------------------------------------------- solve
 
+    def _device_mask(self, tgs: Sequence[TaskGroup], t: NodeTensors,
+                     snapshot, stopped_ids, device_in_use=None):
+        """Host-side DeviceChecker analog (scheduler/device.py): a
+        [G, N] bool mask of "node can satisfy this task group's device
+        requests", ANDed into the kernel's static feasibility.  None when
+        no group asks for devices (the common case — zero cost).
+
+        `device_in_use` overlays in-plan assignments the snapshot can't
+        see yet (the scheduler's retry loop threads it through so a node
+        whose instances were consumed earlier in the same plan stops
+        looking feasible)."""
+        from nomad_tpu.scheduler.device import (
+            InUseIndex, node_feasible, tg_device_requests)
+        reqs_by_g = [tg_device_requests(tg) for tg in tgs]
+        if not any(reqs_by_g):
+            return None
+        dev_nodes = []
+        for row, nid in enumerate(t.node_ids):
+            node = snapshot.node_by_id(nid)
+            if node is not None and node.resources.devices:
+                dev_nodes.append((row, node))
+        in_use = InUseIndex()
+        for row, node in dev_nodes:
+            for a in snapshot.allocs_by_node(node.id):
+                if a.terminal_status() or a.id in stopped_ids:
+                    continue
+                in_use.add_alloc(node.id, a)
+        if device_in_use is not None:
+            for node_id, gid, ids in device_in_use.items():
+                in_use.add(node_id, gid, ids)
+        mask = np.zeros((len(tgs), t.n), bool)
+        for g, tg in enumerate(tgs):
+            if not reqs_by_g[g]:
+                mask[g, :] = True
+                continue
+            for row, node in dev_nodes:
+                mask[g, row] = node_feasible(node, tg, in_use)
+        return mask
+
     def place(self, snapshot, job: Job, tgs: Sequence[TaskGroup],
               requests: Sequence[PlacementRequest],
               tensors: Optional[NodeTensors] = None,
               stopped_allocs: Sequence = (),
               bulk_api: bool = False,
               seed: int = 0,
+              device_in_use=None,
               ):
         """Score + select nodes for `requests` (placements of `tgs`).
         Returns one decision per request, in order.
@@ -316,12 +356,22 @@ class PlacementEngine:
         else:
             jc_dev = self._dev_const(("zjc", n), lambda: np.zeros(n, np.int32))
 
+        # device (GPU/...) feasibility: host-computed per-TG node mask
+        # (kernel capacity dims stay cpu/mem/disk; discrete instance
+        # matching is host work — scheduler/device.py)
+        dev_mask = self._device_mask(
+            tgs, t, snapshot, {a.id for a in stopped_allocs}, device_in_use)
+        extra_mask = None if dev_mask is None else jnp.asarray(dev_mask)
+
         has_spread = bool(job.spreads) or any(tg.spreads for tg in tgs)
         has_distinct = any(tg_tensors.distinct)
         bulk_ok = (
             p_real >= BULK_THRESHOLD
             and len({r.tg_name for r in requests}) == 1
             and not has_spread and not has_distinct
+            # device asks cap per-node intake by discrete instance counts,
+            # which the water-fill rounds can't see — exact scan only
+            and dev_mask is None
             and all(not r.prev_node_id for r in requests))
 
         # ONE packed device->host transfer: the chip sits behind a network
@@ -345,6 +395,7 @@ class PlacementEngine:
                 g=jnp.asarray(g_idx, jnp.int32),
                 p_real=jnp.asarray(p_real, jnp.int32),
                 seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
+                extra_mask=extra_mask,
             )
             buf, used_dev, job_count_dev = place_bulk_packed_jit(
                 binp, round_size, n_rounds, not bulk_api)
@@ -396,6 +447,7 @@ class PlacementEngine:
                 job_count0=jc_dev,
                 spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
                 seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
+                extra_mask=extra_mask,
             )
             buf, used_dev, job_count_dev = place_packed_jit(inp)
             b = np.asarray(buf)[:p_real]

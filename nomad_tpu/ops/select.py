@@ -97,6 +97,13 @@ class PlacementInputs(NamedTuple):
     # ties must be broken per-eval or every worker picks identical nodes
     # and optimistic plan-apply refutes all but one (livelock under load).
     seed: jnp.ndarray = jnp.uint32(0)   # [] uint32
+    # host-computed per-(taskgroup, node) feasibility AND-mask, or None.
+    # Carries checks whose inputs never reach the device — today the
+    # DeviceChecker analog (scheduler/device.py): discrete GPU/device
+    # instance availability.  None (the common case) adds nothing to the
+    # traced graph; a [G, N] bool (or broadcastable) array is ANDed into
+    # the static feasibility mask.
+    extra_mask: jnp.ndarray = None       # [G, N] bool | None
 
 
 class PlacementOutputs(NamedTuple):
@@ -117,6 +124,8 @@ def place(inp: PlacementInputs) -> PlacementOutputs:
     top_k = min(TOP_K, n)
     static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
                            inp.con, inp.luts)              # [G, N]
+    if inp.extra_mask is not None:
+        static = static & inp.extra_mask
     aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)  # [G, N]
     aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)        # [G]
     sp_any = jnp.any(inp.sp_weight > 0)
@@ -275,6 +284,7 @@ class BulkInputs(NamedTuple):
     g: jnp.ndarray           # [] int32  the task-group row being placed
     p_real: jnp.ndarray      # [] int32  real placement count (<= R*round)
     seed: jnp.ndarray = jnp.uint32(0)  # [] per-eval tie-break (see above)
+    extra_mask: jnp.ndarray = None     # [G, N] bool | None (see above)
 
 
 def _to_bulk_inputs(inp: PlacementInputs) -> BulkInputs:
@@ -285,7 +295,7 @@ def _to_bulk_inputs(inp: PlacementInputs) -> BulkInputs:
         dh_limit=inp.dh_limit, job_count0=inp.job_count0,
         spread_algo=inp.spread_algo, g=inp.tg_idx[0],
         p_real=jnp.sum(inp.active).astype(jnp.int32),
-        seed=inp.seed)
+        seed=inp.seed, extra_mask=inp.extra_mask)
 
 
 def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
@@ -402,8 +412,11 @@ def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
 
 
 def _bulk_static(inp: BulkInputs, g):
-    static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
-                           inp.con, inp.luts)[g]             # [N]
+    full = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
+                         inp.con, inp.luts)                  # [G, N]
+    if inp.extra_mask is not None:
+        full = full & inp.extra_mask
+    static = full[g]                                         # [N]
     aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)[g]  # [N]
     aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)[g]
     noise = tiebreak_noise(inp.seed, jnp.arange(inp.attrs.shape[0]))

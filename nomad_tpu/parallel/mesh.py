@@ -67,6 +67,8 @@ def _place_local(inp: PlacementInputs) -> PlacementOutputs:
 
     static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
                            inp.con, inp.luts)              # [G, N_loc]
+    if inp.extra_mask is not None:
+        static = static & inp.extra_mask
     aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)  # [G, N_loc]
     aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)
     sp_any = jnp.any(inp.sp_weight > 0)
@@ -198,6 +200,9 @@ def place_sharded_fn(mesh: Mesh):
         pd_nodeval=P(None, AXIS), pd_limit=P(), pd_apply=P(), pd_counts0=P(),
         tg_idx=P(), prev_row=P(), active=P(), job_count0=spec_n,
         spread_algo=P(), seed=P(),
+        # None when absent (empty pytree — the leaf spec prefix-broadcasts
+        # to nothing); a real [G, N] mask shards along the node axis
+        extra_mask=P(None, AXIS),
     )
     out_specs = PlacementOutputs(
         picks=P(), scores=P(), topk_rows=P(), topk_scores=P(),

@@ -1,0 +1,252 @@
+"""Device scheduling: feasibility + instance assignment
+(reference: scheduler/device.go AllocateDevice, scheduler/feasible.go
+DeviceChecker).
+
+Devices (GPUs, FPGAs, ...) are discrete, named, host-assigned resources:
+a node advertises device *groups* (vendor/type/name with instance IDs and
+attributes, reference: structs.NodeDeviceResource); a task asks for
+`count` instances of a device matching a name pattern plus optional
+constraints/affinities over device attributes (reference:
+structs.RequestedDevice).
+
+Unlike cpu/memory — which the placement kernels water-fill on device —
+device assignment is an exact small-cardinality matching problem over
+string-keyed inventories, so it stays host-side (SURVEY.md §7 P1's
+"strings never reach the device" stance):
+
+  * `feasibility_mask` produces a per-(taskgroup, node) boolean the engine
+    ANDs into the kernel's static feasibility (the DeviceChecker analog);
+  * `assign_devices` picks concrete instance IDs for a chosen node after
+    the kernel has placed (the AllocateDevice analog), with affinity
+    scoring across eligible device groups.
+
+Both consult an `InUseIndex` built from live allocations so instances are
+never double-assigned; the plan applier re-checks via
+`structs.funcs.allocs_fit(check_devices=True)` against the latest state
+(optimistic concurrency, reference: plan_apply.go evaluateNodePlan).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from nomad_tpu.structs import (
+    AllocatedDeviceResource,
+    Node,
+    NodeDeviceResource,
+    RequestedDevice,
+    TaskGroup,
+)
+from nomad_tpu.pack.packer import _string_predicate
+from nomad_tpu.structs.structs import (
+    OP_EQ,
+    OP_IS_NOT_SET,
+    OP_IS_SET,
+    OP_NEQ,
+)
+
+
+def id_matches(request_name: str, dev: NodeDeviceResource) -> bool:
+    """Match a request name against a device group's vendor/type/name
+    hierarchy (reference: structs.RequestedDevice.ID().Matches):
+    "gpu" matches by type; "nvidia/gpu" by vendor+type;
+    "nvidia/gpu/1080ti" by all three."""
+    parts = request_name.split("/")
+    if len(parts) == 1:
+        return dev.type == parts[0]
+    if len(parts) == 2:
+        return (dev.vendor, dev.type) == (parts[0], parts[1])
+    if len(parts) == 3:
+        return (dev.vendor, dev.type, dev.name) == tuple(parts)
+    return False
+
+
+def device_attr(dev: NodeDeviceResource, target: str) -> Optional[str]:
+    """Resolve a constraint/affinity LTarget against a device group
+    (reference: scheduler/device.go nodeDeviceMatches attribute plumbing).
+    Supported: ${device.vendor} ${device.type} ${device.model}
+    ${device.ids} ${device.attr.<name>}; bare names accepted too."""
+    t = target.strip()
+    if t.startswith("${") and t.endswith("}"):
+        t = t[2:-1]
+    if t.startswith("device."):
+        t = t[len("device."):]
+    if t == "vendor":
+        return dev.vendor
+    if t == "type":
+        return dev.type
+    if t in ("model", "name"):
+        return dev.name
+    if t == "ids":
+        return ",".join(dev.instance_ids)
+    if t.startswith("attr."):
+        return dev.attributes.get(t[len("attr."):])
+    return dev.attributes.get(t)
+
+
+def _check(operand: str, lval: Optional[str], rtarget: str) -> bool:
+    """Host-side constraint evaluation over device attribute strings —
+    the same operator table the packer lowers for node attrs
+    (reference: scheduler/feasible.go checkAttributeConstraint)."""
+    if operand == OP_IS_SET:
+        return lval is not None
+    if operand == OP_IS_NOT_SET:
+        return lval is None
+    if lval is None:
+        # absent attribute: != passes, everything else fails (reference
+        # semantics: missing attr fails the check except negative ops)
+        return operand == OP_NEQ
+    if operand == OP_EQ:
+        return lval == rtarget
+    if operand == OP_NEQ:
+        return lval != rtarget
+    return _string_predicate(operand, rtarget)(lval)
+
+
+def group_feasible(dev: NodeDeviceResource, req: RequestedDevice) -> bool:
+    """Static (usage-independent) group eligibility for a request."""
+    if not id_matches(req.name, dev):
+        return False
+    for c in req.constraints:
+        if not _check(c.operand, device_attr(dev, c.ltarget), c.rtarget):
+            return False
+    return True
+
+
+def group_affinity_score(dev: NodeDeviceResource,
+                         req: RequestedDevice) -> float:
+    """Normalized [-1, 1] affinity score of a group (reference:
+    scheduler/device.go deviceAllocator.AddAllocs scoring)."""
+    if not req.affinities:
+        return 0.0
+    total = 0.0
+    denom = 0.0
+    for a in req.affinities:
+        denom += abs(a.weight)
+        if _check(a.operand, device_attr(dev, a.ltarget), a.rtarget):
+            total += a.weight
+    if denom == 0:
+        return 0.0
+    return total / denom
+
+
+class InUseIndex:
+    """Which device instance IDs are taken, per node per device group —
+    built from live allocations' `allocated_devices`, extended in place as
+    a plan assigns more (intra-plan sequential semantics, SURVEY.md §4.3).
+    """
+
+    def __init__(self) -> None:
+        self._used: Dict[str, Dict[str, Set[str]]] = {}
+
+    def used(self, node_id: str, group_id: str) -> Set[str]:
+        return self._used.get(node_id, {}).get(group_id, set())
+
+    def items(self):
+        """(node_id, group_id, instance_id_set) triples."""
+        for node_id, groups in self._used.items():
+            for gid, ids in groups.items():
+                yield node_id, gid, ids
+
+    def add(self, node_id: str, group_id: str,
+            instance_ids: Iterable[str]) -> None:
+        self._used.setdefault(node_id, {}).setdefault(
+            group_id, set()).update(instance_ids)
+
+    def add_alloc(self, node_id: str, alloc) -> None:
+        for ad in getattr(alloc, "allocated_devices", ()) or ():
+            gid = f"{ad.vendor}/{ad.type}/{ad.name}"
+            self.add(node_id, gid, ad.device_ids)
+
+    @classmethod
+    def from_allocs(cls, allocs_by_node) -> "InUseIndex":
+        """allocs_by_node: iterable of (node_id, allocs)."""
+        idx = cls()
+        for node_id, allocs in allocs_by_node:
+            for a in allocs:
+                if a.terminal_status():
+                    continue
+                idx.add_alloc(node_id, a)
+        return idx
+
+
+def tg_device_requests(tg: TaskGroup) -> List[Tuple[str, RequestedDevice]]:
+    """(task_name, request) pairs for every device ask in the group."""
+    out = []
+    for t in tg.tasks:
+        for d in t.resources.devices:
+            out.append((t.name, d))
+    return out
+
+
+def node_feasible(node: Node, tg: TaskGroup, in_use: InUseIndex) -> bool:
+    """DeviceChecker analog: can `node` satisfy every device request of
+    `tg` simultaneously, given current instance usage?  Greedy over
+    groups in request order — matches the reference's sequential
+    AllocateDevice behavior within one allocation."""
+    reqs = tg_device_requests(tg)
+    if not reqs:
+        return True
+    if not node.resources.devices:
+        return False
+    taken: Dict[str, int] = {}
+    for _task, req in reqs:
+        need = max(req.count, 1)
+        placed = False
+        for dev in node.resources.devices:
+            if not group_feasible(dev, req):
+                continue
+            gid = dev.id()
+            free = (len(dev.instance_ids)
+                    - len(in_use.used(node.id, gid))
+                    - taken.get(gid, 0))
+            if free >= need:
+                taken[gid] = taken.get(gid, 0) + need
+                placed = True
+                break
+        if not placed:
+            return False
+    return True
+
+
+def assign_devices(node: Node, tg: TaskGroup, in_use: InUseIndex,
+                   ) -> Tuple[Optional[List[AllocatedDeviceResource]], str]:
+    """AllocateDevice analog: pick concrete instance IDs on `node` for
+    every device request of `tg`.  Per request, eligible groups are
+    scored by the request's affinities and the best group supplies the
+    instances.  On success the assignments are recorded in `in_use`
+    (so later placements in the same plan see them) and returned; on
+    shortfall returns (None, reason) with nothing recorded."""
+    reqs = tg_device_requests(tg)
+    if not reqs:
+        return [], ""
+    assigned: List[AllocatedDeviceResource] = []
+    staged: List[Tuple[str, str, List[str]]] = []
+    taken: Dict[str, Set[str]] = {}
+    for task_name, req in reqs:
+        need = max(req.count, 1)
+        best: Optional[NodeDeviceResource] = None
+        best_ids: List[str] = []
+        best_score = float("-inf")
+        for dev in node.resources.devices:
+            if not group_feasible(dev, req):
+                continue
+            gid = dev.id()
+            busy = in_use.used(node.id, gid) | taken.get(gid, set())
+            free = [i for i in dev.instance_ids if i not in busy]
+            if len(free) < need:
+                continue
+            score = group_affinity_score(dev, req)
+            if score > best_score:
+                best, best_ids, best_score = dev, free[:need], score
+        if best is None:
+            return None, f"devices: {req.name}"
+        gid = best.id()
+        taken.setdefault(gid, set()).update(best_ids)
+        staged.append((gid, task_name, best_ids))
+        assigned.append(AllocatedDeviceResource(
+            task=task_name, vendor=best.vendor, type=best.type,
+            name=best.name, device_ids=list(best_ids)))
+    for gid, _task, ids in staged:
+        in_use.add(node.id, gid, ids)
+    return assigned, ""

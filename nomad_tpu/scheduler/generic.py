@@ -226,6 +226,12 @@ class GenericScheduler(Scheduler):
                                    evaluation, results)
             return
 
+        # concrete device-instance assignment for groups that ask for
+        # devices (reference: scheduler/device.go AllocateDevice); may
+        # re-place a subset when a node's instances run out mid-plan
+        dev_assign = self._assign_devices(job, tgs, places, reqs,
+                                          decisions, stopped)
+
         # host-side port assignment per chosen node (reference: AllocsFit's
         # NetworkIndex, kept off-device per SURVEY §7 P1).  Preemption
         # victims' ports are freed: exclude them from the index.
@@ -243,7 +249,7 @@ class GenericScheduler(Scheduler):
         # the 40-field dataclass constructor per placement.
         alloc_templates: Dict[str, Allocation] = {}
 
-        for p, d in zip(places, decisions):
+        for i, (p, d) in enumerate(zip(places, decisions)):
             tg = p.tg
             if d.node_id is None:
                 self._record_failure(tg.name, d.metric)
@@ -295,6 +301,7 @@ class GenericScheduler(Scheduler):
             ad["node_id"] = d.node_id
             ad["resources"] = ask
             ad["allocated_ports"] = ports or {}
+            ad["allocated_devices"] = dev_assign.get(i, [])
             ad["metrics"] = d.metric
             # per-alloc mutable state: runners write task_states in place
             ad["task_states"] = {}
@@ -315,6 +322,83 @@ class GenericScheduler(Scheduler):
                     append_reschedule_tracker(alloc, p.previous_alloc, self.now)
                     alloc.desired_description = ALLOC_RESCHEDULED
             plan.append_alloc(alloc)
+
+    def _assign_devices(self, job, tgs, places, reqs, decisions, stopped):
+        """Pick concrete device instances for every placement whose task
+        group requests devices (reference: scheduler/device.go
+        AllocateDevice called from BinPackIterator).
+
+        The kernel's [G, N] device mask was computed against the snapshot,
+        so a node can run out of instances mid-plan (several placements
+        landing on it).  Failed assignments are re-placed through the
+        engine with the in-plan usage overlay visible (up to 3 rounds —
+        the host-side twin of the kernel's sequential-capacity scan);
+        still-failing placements become normal placement failures with the
+        exhausted dimension recorded.  Mutates `decisions` in place and
+        returns {placement_index: [AllocatedDeviceResource]}."""
+        from .device import InUseIndex, assign_devices, tg_device_requests
+
+        tg_has_dev = {tg.name: bool(tg_device_requests(tg)) for tg in tgs}
+        if not any(tg_has_dev.values()):
+            return {}
+        dev_assign: Dict[int, list] = {}
+        stopped_ids = {a.id for a in stopped}
+        dev_index = InUseIndex()
+        seeded = set()
+
+        def seed(node_id: str) -> None:
+            # preemption victims are conservatively NOT excluded: their
+            # instances stay unavailable within this plan
+            if node_id in seeded:
+                return
+            seeded.add(node_id)
+            for a in self.state.allocs_by_node(node_id):
+                if a.terminal_status() or a.id in stopped_ids:
+                    continue
+                dev_index.add_alloc(node_id, a)
+
+        pending = [i for i, p in enumerate(places)
+                   if tg_has_dev[p.tg.name]]
+        for round_no in range(3):
+            failed = []
+            for i in pending:
+                d = decisions[i]
+                if d.node_id is None:
+                    continue
+                node = self.state.node_by_id(d.node_id)
+                if node is None:
+                    failed.append(i)
+                    continue
+                seed(d.node_id)
+                assigned, why = assign_devices(node, places[i].tg, dev_index)
+                if assigned is None:
+                    failed.append(i)
+                else:
+                    dev_assign[i] = assigned
+            if not failed:
+                return dev_assign
+            if round_no == 2:
+                break
+            redo = self.engine.place(
+                self.state, job, tgs, [reqs[i] for i in failed],
+                stopped_allocs=stopped, seed=getattr(self, "_seed", 0),
+                device_in_use=dev_index)
+            for i, d_new in zip(failed, redo):
+                if d_new.node_id is None:
+                    # the first pass found a device node; the re-place
+                    # lost it to in-plan instance consumption — that is
+                    # exhaustion, not filtering (reference: AllocMetric
+                    # DimensionExhausted["devices"])
+                    d_new.metric.exhausted_node("devices")
+                decisions[i] = d_new
+            pending = failed
+        for i in failed:
+            d = decisions[i]
+            if d.node_id is not None:
+                d.metric.exhausted_node("devices")
+                d.node_id = None
+                d.evictions = []
+        return dev_assign
 
     def _materialize_bulk(self, plan: Plan, job: Job,
                           places: List[RPlace], bd,

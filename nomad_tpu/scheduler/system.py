@@ -170,6 +170,19 @@ class SystemScheduler(Scheduler):
                 if not ok:
                     metric.exhausted_node(dim)
                     continue
+                # device instance assignment (scheduler/device.py): the
+                # proposed view's assignments are visible via the index
+                assigned = []
+                from .device import (InUseIndex, assign_devices,
+                                     tg_device_requests)
+                if tg_device_requests(tg):
+                    idx = InUseIndex()
+                    for a in proposed.values():
+                        idx.add_alloc(n.id, a)
+                    assigned, _why = assign_devices(n, tg, idx)
+                    if assigned is None:
+                        metric.exhausted_node("devices")
+                        continue
                 alloc = Allocation(
                     namespace=job.namespace,
                     eval_id=evaluation.id,
@@ -179,6 +192,7 @@ class SystemScheduler(Scheduler):
                     job=job,
                     task_group=tg.name,
                     resources=ask,
+                    allocated_devices=assigned,
                     desired_status="run",
                     client_status="pending",
                     job_version=job.version,

@@ -152,6 +152,14 @@ def allocs_fit(node: Node, allocs: List[Allocation],
         ni.set_node(node)
 
     seen_ports: Set[int] = set(ni.used_ports)
+    # device instance bookkeeping (reference: structs.AllocsFit's
+    # devicesFit path): every assigned instance must exist in the node's
+    # inventory and be assigned at most once across the alloc set
+    seen_devs: Set[Tuple[str, str]] = set()
+    inventory: Dict[str, Set[str]] = {}
+    if check_devices:
+        for d in node.resources.devices:
+            inventory.setdefault(d.id(), set()).update(d.instance_ids)
     for a in allocs:
         if a.terminal_status():
             continue
@@ -165,6 +173,16 @@ def allocs_fit(node: Node, allocs: List[Allocation],
             if port in seen_ports:
                 return False, "network: port collision", used
             seen_ports.add(port)
+        if check_devices:
+            for ad in getattr(a, "allocated_devices", ()) or ():
+                gid = ad.group_id()
+                have = inventory.get(gid, set())
+                for iid in ad.device_ids:
+                    if iid not in have:
+                        return False, f"devices: unknown instance {gid}[{iid}]", used
+                    if (gid, iid) in seen_devs:
+                        return False, f"devices: instance oversubscribed {gid}[{iid}]", used
+                    seen_devs.add((gid, iid))
 
     cap_cpu = node.resources.cpu - node.reserved.cpu
     cap_mem = node.resources.memory_mb - node.reserved.memory_mb

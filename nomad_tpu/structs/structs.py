@@ -212,6 +212,20 @@ class NodeDeviceResource:
 
 
 @dataclass
+class AllocatedDeviceResource:
+    """Concrete device instances assigned to one task of an allocation
+    (reference: structs.AllocatedDeviceResource)."""
+    task: str = ""
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    device_ids: List[str] = field(default_factory=list)
+
+    def group_id(self) -> str:
+        return f"{self.vendor}/{self.type}/{self.name}"
+
+
+@dataclass
 class NodeResources:
     """Node capacity (reference: structs.NodeResources + legacy Resources)."""
 
@@ -659,6 +673,7 @@ class Allocation:
     task_group: str = ""
     resources: Resources = field(default_factory=Resources)
     allocated_ports: Dict[str, int] = field(default_factory=dict)
+    allocated_devices: List[AllocatedDeviceResource] = field(default_factory=list)
     desired_status: str = ALLOC_DESIRED_RUN
     desired_description: str = ""
     desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
@@ -1116,13 +1131,18 @@ class CSIVolume:
     write_allocs: Dict[str, bool] = field(default_factory=dict)
     schedulable: bool = True
 
-    def claim_ok(self, read_only: bool) -> bool:
+    def claim_ok(self, read_only: bool, releasing=()) -> bool:
+        """`releasing`: alloc ids whose claims are being released by the
+        same plan (stops / preemptions / same-id replacements) — without
+        the exemption a single-node-writer volume livelocks on job update:
+        the replacement is refuted by its predecessor's claim, and the
+        refute also withholds the stop that would release it."""
         if not self.schedulable:
             return False
         if read_only:
             return True
         if self.access_mode.startswith("single-node-writer"):
-            return not self.write_allocs
+            return not (set(self.write_allocs) - set(releasing))
         return True
 
 

@@ -1,0 +1,232 @@
+"""Device scheduling tests (reference scenarios: scheduler/device_test.go,
+scheduler/feasible_test.go TestDeviceChecker, plan_apply device re-check)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.device import (
+    InUseIndex,
+    assign_devices,
+    group_affinity_score,
+    id_matches,
+    node_feasible,
+)
+from nomad_tpu.structs import (
+    AllocatedDeviceResource,
+    Affinity,
+    Allocation,
+    Constraint,
+    NodeDeviceResource,
+    RequestedDevice,
+    Resources,
+    allocs_fit,
+)
+
+NOW = 1_700_000_000.0
+
+
+def gpu_group(vendor="nvidia", typ="gpu", name="1080ti", count=2, **attrs):
+    return NodeDeviceResource(
+        vendor=vendor, type=typ, name=name,
+        instance_ids=[f"{name}-{i}" for i in range(count)],
+        attributes={k: str(v) for k, v in attrs.items()})
+
+
+def gpu_node(groups=None, **overrides):
+    n = mock.node(**overrides)
+    n.resources.devices = groups if groups is not None \
+        else [gpu_group()]
+    return n
+
+
+def gpu_job(name="gpu", count=1, dev_count=1, constraints=(),
+            affinities=(), **overrides):
+    j = mock.job(**overrides)
+    j.task_groups[0].count = count
+    j.task_groups[0].tasks[0].resources.devices = [RequestedDevice(
+        name=name, count=dev_count,
+        constraints=list(constraints), affinities=list(affinities))]
+    return j
+
+
+class TestMatching:
+    def test_id_matches_hierarchy(self):
+        d = gpu_group()
+        assert id_matches("gpu", d)
+        assert id_matches("nvidia/gpu", d)
+        assert id_matches("nvidia/gpu/1080ti", d)
+        assert not id_matches("fpga", d)
+        assert not id_matches("amd/gpu", d)
+        assert not id_matches("nvidia/gpu/2080", d)
+
+    def test_node_feasible_counts(self):
+        n = gpu_node([gpu_group(count=2)])
+        tg = gpu_job(dev_count=2).task_groups[0]
+        assert node_feasible(n, tg, InUseIndex())
+        idx = InUseIndex()
+        idx.add(n.id, "nvidia/gpu/1080ti", ["1080ti-0"])
+        assert not node_feasible(n, tg, idx)
+
+    def test_constraint_on_device_attr(self):
+        small = gpu_group(name="k80", memory="8192")
+        big = gpu_group(name="a100", memory="40960")
+        tg = gpu_job(constraints=[
+            Constraint("${device.attr.memory}", ">=", "16000")]
+        ).task_groups[0]
+        assert not node_feasible(gpu_node([small]), tg, InUseIndex())
+        assert node_feasible(gpu_node([big]), tg, InUseIndex())
+
+    def test_affinity_prefers_group(self):
+        req = RequestedDevice(name="gpu", count=1, affinities=[
+            Affinity("${device.model}", "=", "a100", weight=50)])
+        assert group_affinity_score(gpu_group(name="a100"), req) == 1.0
+        assert group_affinity_score(gpu_group(name="k80"), req) == 0.0
+
+    def test_assign_picks_best_group(self):
+        n = gpu_node([gpu_group(name="k80"), gpu_group(name="a100")])
+        j = gpu_job(affinities=[
+            Affinity("${device.model}", "=", "a100", weight=50)])
+        assigned, why = assign_devices(n, j.task_groups[0], InUseIndex())
+        assert why == ""
+        assert assigned[0].name == "a100"
+        assert len(assigned[0].device_ids) == 1
+
+    def test_assign_consumes_instances(self):
+        n = gpu_node([gpu_group(count=2)])
+        tg = gpu_job().task_groups[0]
+        idx = InUseIndex()
+        a1, _ = assign_devices(n, tg, idx)
+        a2, _ = assign_devices(n, tg, idx)
+        assert a1[0].device_ids != a2[0].device_ids
+        a3, why = assign_devices(n, tg, idx)
+        assert a3 is None and "devices" in why
+
+
+class TestSchedulerIntegration:
+    def _harness(self, nodes):
+        h = Harness()
+        for n in nodes:
+            h.state.upsert_node(n)
+        return h
+
+    def _placed(self, h):
+        return [a for allocs in h.plans[-1].node_allocation.values()
+                for a in allocs]
+
+    def test_filters_deviceless_nodes(self):
+        plain = [mock.node() for _ in range(4)]
+        gn = gpu_node()
+        h = self._harness(plain + [gn])
+        job = gpu_job(count=2)
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id)
+        assert h.process("service", e, now=NOW) is None
+        placed = self._placed(h)
+        assert len(placed) == 2
+        assert all(a.node_id == gn.id for a in placed)
+        ids = [tuple(a.allocated_devices[0].device_ids) for a in placed]
+        assert len(set(ids)) == 2      # distinct instances
+        assert all(a.allocated_devices[0].vendor == "nvidia" for a in placed)
+
+    def test_spills_to_second_node_when_exhausted(self):
+        g1, g2 = gpu_node(), gpu_node()
+        h = self._harness([g1, g2] + [mock.node() for _ in range(3)])
+        job = gpu_job(count=4)       # 4 allocs x 1 instance, 2 per node max
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id)
+        assert h.process("service", e, now=NOW) is None
+        placed = self._placed(h)
+        assert len(placed) == 4
+        by_node = {}
+        seen = set()
+        for a in placed:
+            by_node[a.node_id] = by_node.get(a.node_id, 0) + 1
+            key = (a.node_id, tuple(a.allocated_devices[0].device_ids))
+            assert key not in seen
+            seen.add(key)
+        assert by_node == {g1.id: 2, g2.id: 2}
+
+    def test_exhaustion_reports_devices_dimension(self):
+        gn = gpu_node([gpu_group(count=1)])
+        h = self._harness([gn, mock.node()])
+        job = gpu_job(count=2)
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id)
+        assert h.process("service", e, now=NOW) is None
+        placed = self._placed(h)
+        assert len(placed) == 1
+        # second placement failed on devices; blocked eval created
+        ev = h.evals[-1]
+        assert ev.failed_tg_allocs
+        m = ev.failed_tg_allocs["web"]
+        assert m.nodes_exhausted >= 1
+
+    def test_existing_allocs_block_instances(self):
+        gn = gpu_node([gpu_group(count=2)])
+        h = self._harness([gn])
+        prior = mock.alloc(node_id=gn.id)
+        prior.allocated_devices = [AllocatedDeviceResource(
+            task="web", vendor="nvidia", type="gpu", name="1080ti",
+            device_ids=["1080ti-0"])]
+        h.state.upsert_allocs([prior])
+        job = gpu_job(count=1)
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id)
+        assert h.process("service", e, now=NOW) is None
+        placed = self._placed(h)
+        assert len(placed) == 1
+        assert placed[0].allocated_devices[0].device_ids == ["1080ti-1"]
+
+    def test_system_scheduler_assigns_devices(self):
+        gn = gpu_node()
+        plain = mock.node()
+        h = self._harness([gn, plain])
+        job = mock.system_job()
+        job.task_groups[0].tasks[0].resources.devices = [
+            RequestedDevice(name="gpu", count=1)]
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id, type="system")
+        assert h.process("system", e, now=NOW) is None
+        placed = self._placed(h)
+        assert [a.node_id for a in placed] == [gn.id]
+        assert placed[0].allocated_devices[0].device_ids
+
+
+class TestAllocsFitDevices:
+    def test_double_booking_refused(self):
+        n = gpu_node([gpu_group(count=2)])
+        mk = lambda iid: Allocation(
+            resources=Resources(cpu=100, memory_mb=64),
+            allocated_devices=[AllocatedDeviceResource(
+                vendor="nvidia", type="gpu", name="1080ti",
+                device_ids=[iid])])
+        ok, why, _ = allocs_fit(
+            n, [mk("1080ti-0"), mk("1080ti-0")], check_devices=True)
+        assert not ok and "oversubscribed" in why
+        ok, _, _ = allocs_fit(
+            n, [mk("1080ti-0"), mk("1080ti-1")], check_devices=True)
+        assert ok
+
+    def test_unknown_instance_refused(self):
+        n = gpu_node([gpu_group(count=1)])
+        a = Allocation(
+            resources=Resources(cpu=100, memory_mb=64),
+            allocated_devices=[AllocatedDeviceResource(
+                vendor="nvidia", type="gpu", name="1080ti",
+                device_ids=["bogus"])])
+        ok, why, _ = allocs_fit(n, [a], check_devices=True)
+        assert not ok and "unknown instance" in why
+
+
+class TestTaskEnv:
+    def test_device_env_exposed(self):
+        from nomad_tpu.client.taskenv import build_task_env
+        job = gpu_job()
+        alloc = mock.alloc(job=job, task_group="web")
+        alloc.allocated_devices = [AllocatedDeviceResource(
+            task="web", vendor="nvidia", type="gpu", name="1080ti",
+            device_ids=["1080ti-1"])]
+        env = build_task_env(alloc, job.task_groups[0].tasks[0],
+                             mock.node())
+        assert env["NOMAD_DEVICE_NVIDIA_GPU_1080TI"] == "1080ti-1"
