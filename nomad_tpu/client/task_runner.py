@@ -131,6 +131,7 @@ class TaskRunner:
         self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
         self._kill = threading.Event()
         self._restart_requested = False
+        self._skip_delay = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.dead = threading.Event()
 
@@ -231,7 +232,7 @@ class TaskRunner:
                 except DriverError as e:
                     self._event(TASK_DRIVER_FAILURE, message=str(e))
                     decision, delay = self.restart_tracker.next(-1, True)
-                    if decision == KILL or self._kill.wait(delay):
+                    if decision == KILL or self._delay_wait(delay):
                         self._set_state(TASK_STATE_DEAD, failed=True)
                         return
                     self._event(TASK_RESTARTING, restart_reason=str(e))
@@ -285,7 +286,7 @@ class TaskRunner:
             self._set_state(TASK_STATE_PENDING)
             self._event(TASK_RESTARTING,
                         restart_reason="Restart within policy")
-            if decision in (RESTART, WAIT) and self._kill.wait(delay):
+            if decision in (RESTART, WAIT) and self._delay_wait(delay):
                 break
 
         # killed
@@ -311,14 +312,33 @@ class TaskRunner:
         """Operator-requested restart (reference: Allocations.Restart RPC →
         task runner Restart): stop the live instance and start a fresh one
         unconditionally — bypasses the RestartTracker so it never burns the
-        policy's attempt budget or kills the task."""
-        self._restart_requested = True
+        policy's attempt budget or kills the task.  With no live instance
+        (runner sleeping out a restart-policy delay) it skips the delay and
+        starts now — the flag is NOT left set, or a much later natural exit
+        would wrongly restart against policy."""
         h = self.handle
         if h is not None:
+            self._restart_requested = True
             try:
                 self.driver.stop_task(h, self.task.kill_timeout_s)
             except Exception:  # noqa: BLE001 - the wait loop handles exit
                 pass
+        else:
+            self._skip_delay.set()
+
+    def _delay_wait(self, delay: float) -> bool:
+        """Sleep out a restart delay; True = killed.  An operator restart
+        (skip_delay) ends the sleep early without killing."""
+        end = time.time() + delay
+        while True:
+            remaining = end - time.time()
+            if remaining <= 0:
+                return False
+            if self._kill.wait(min(remaining, 0.1)):
+                return True
+            if self._skip_delay.is_set():
+                self._skip_delay.clear()
+                return False
 
     def kill(self, wait: bool = True, timeout: float = 10.0,
              reason: str = "") -> None:

@@ -36,14 +36,17 @@ def build_task_env(alloc, task, node, task_dir: str = "",
         env[f"NOMAD_HOST_PORT_{label}"] = str(port)
     # assigned device instances (reference: device_hook.go — drivers map
     # these onto isolation primitives; exec-class drivers get env vars)
+    # key carries the full vendor/type/name id (nvidia/gpu vs amd/gpu must
+    # not collide); two requests landing on the SAME group merge their ids
+    dev_ids: Dict[str, list] = {}
     for ad in getattr(alloc, "allocated_devices", ()) or ():
         if ad.task and ad.task != task.name:
             continue
-        # key carries the full vendor/type/name id: two groups of the same
-        # type (nvidia/gpu + amd/gpu) must not overwrite each other
         key = "_".join(p for p in (ad.vendor, ad.type, ad.name) if p)
         key = key.upper().replace("-", "_").replace(".", "_")
-        env[f"NOMAD_DEVICE_{key}"] = ",".join(ad.device_ids)
+        dev_ids.setdefault(key, []).extend(ad.device_ids)
+    for key, ids in dev_ids.items():
+        env[f"NOMAD_DEVICE_{key}"] = ",".join(ids)
     for k, v in (task.env or {}).items():
         env[k] = interpolate(v, env, node)
     return env

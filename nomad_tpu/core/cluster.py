@@ -1,0 +1,395 @@
+"""Multi-server clustering: Raft-replicated state + gossip membership +
+RPC with leader forwarding + autopilot
+(reference: nomad/server.go setupRaft/setupSerf, nomad/rpc.go forward,
+nomad/autopilot.go, nomad/fsm.go).
+
+The single-server `core.Server` mutates its StateStore directly.  In
+cluster mode the store is wrapped in a `ReplicatedState` proxy: every
+mutating method becomes a Raft log command `(method, args, kwargs)`;
+the FSM applies committed commands to the LOCAL store on every server in
+log order, so all servers converge on identical state (the reference's
+nomadFSM.Apply dispatch, with the method name playing MessageType).
+Reads pass straight through to the local store — possibly stale on
+followers, exactly like the reference's default-consistency reads.
+
+`ClusterServer` composes:
+  - core.Server        (brokers, workers, plan applier, watchers)
+  - raft.RaftNode      (election + replication; leadership drives
+                        establish_leadership/revoke_leadership)
+  - membership.Gossip  (server discovery + failure detection; feeds the
+                        Raft peer set)
+  - RPCServer          (client/server RPC; writes forward to the leader —
+                        reference: rpcHandler.forward)
+  - autopilot          (leader reaps servers dead past the grace window)
+
+Clients connect to ANY server with `RemoteRPC` (same interface as
+client.InProcessRPC): blocking alloc watches are served locally (state
+replication fires the local watch), writes are forwarded.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.state import StateStore
+
+from .logging import log
+from .membership import Gossip, Member
+from .raft import NotLeaderError, RaftNode, recv_msg, reply, send_msg
+from .server import Server
+
+# Every StateStore mutation that must replicate.  A name here turns the
+# proxy method into a Raft command; everything else is a local read.
+MUTATIONS = frozenset({
+    "upsert_node", "upsert_nodes", "delete_node", "update_node_status",
+    "update_node_eligibility", "update_node_drain",
+    "upsert_job", "delete_job",
+    "upsert_evals", "delete_evals",
+    "upsert_allocs", "update_allocs_from_client",
+    "update_alloc_desired_transition",
+    "upsert_deployment", "delete_deployment", "upsert_plan_results",
+    "upsert_csi_volume", "delete_csi_volume",
+    "set_scheduler_config",
+    "upsert_namespace", "delete_namespace",
+    "upsert_node_pool", "delete_node_pool",
+    "upsert_acl_policy", "delete_acl_policy",
+    "upsert_acl_token", "delete_acl_token", "bootstrap_acl_token",
+    "upsert_service_registrations", "delete_service_registrations_by_alloc",
+    "upsert_variable", "delete_variable",
+    "snapshot_restore",
+})
+
+# Server-level methods a follower's RPC endpoint forwards to the leader.
+FORWARDED = frozenset({
+    "register_job", "deregister_job", "dispatch_job", "revert_job",
+    "force_gc", "bootstrap_acl",
+    "register_node", "heartbeat_node", "update_node_status", "drain_node",
+    "set_node_eligibility", "update_alloc_desired_transition",
+    "update_allocs_from_client", "apply_eval_update",
+    "upsert_service_registrations", "delete_service_registrations_by_alloc",
+})
+
+
+class ReplicatedState:
+    """StateStore facade: mutations go through Raft, reads go local."""
+
+    def __init__(self, local: StateStore,
+                 raft: Optional[RaftNode] = None) -> None:
+        self._local = local
+        self.raft = raft
+
+    def __getattr__(self, name):
+        local_attr = getattr(self._local, name)
+        if name not in MUTATIONS:
+            return local_attr
+        proxy = self
+
+        def replicated(*args, **kwargs):
+            raft = proxy.raft
+            if raft is None:
+                return local_attr(*args, **kwargs)
+            cmd = pickle.dumps((name, args, kwargs),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+            return raft.apply(cmd)
+
+        return replicated
+
+
+class RPCServer:
+    """TCP endpoint exposing the Server's public methods to clients and
+    peer servers (reference: nomad/rpc.go).  Writes on a follower are
+    forwarded to the leader transparently."""
+
+    def __init__(self, cluster: "ClusterServer",
+                 bind: Tuple[str, int] = ("127.0.0.1", 0)) -> None:
+        self.cluster = cluster
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(bind)
+        self._sock.listen(128)
+        self.addr = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rpc-listen")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, daemon=True,
+                             args=(conn,)).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            msg = recv_msg(conn, timeout=30.0)
+            if msg is None:
+                return
+            method = msg.get("method", "")
+            args = msg.get("args", ())
+            kwargs = msg.get("kwargs", {})
+            try:
+                result = self.cluster.rpc_call(method, args, kwargs)
+                reply(conn, {"ok": True, "result": result})
+            except NotLeaderError as e:
+                reply(conn, {"ok": False, "not_leader": True,
+                             "leader_rpc": self.cluster.leader_rpc_addr()})
+            except Exception as e:  # noqa: BLE001 - surface to the caller
+                reply(conn, {"ok": False, "error": repr(e)})
+
+
+class RemoteRPC:
+    """Client-side transport matching client.InProcessRPC's surface, over
+    TCP to any server with automatic leader-redirect and server failover
+    (reference: client/rpc.go + client/servers pool)."""
+
+    def __init__(self, servers: List[Tuple[str, int]]) -> None:
+        self.servers = list(servers)
+        self._preferred = 0
+
+    def call(self, method: str, *args, timeout: float = 35.0,
+             retries: int = 8, **kwargs):
+        last_err: Optional[str] = None
+        for attempt in range(retries):
+            order = (self.servers[self._preferred:]
+                     + self.servers[:self._preferred])
+            for i, addr in enumerate(order):
+                r = send_msg(tuple(addr), {"method": method, "args": args,
+                                           "kwargs": kwargs},
+                             timeout=timeout)
+                if r is None:
+                    last_err = f"no response from {addr}"
+                    continue
+                if r.get("ok"):
+                    self._preferred = \
+                        (self._preferred + i) % len(self.servers)
+                    return r.get("result")
+                if r.get("not_leader"):
+                    hint = r.get("leader_rpc")
+                    if hint and tuple(hint) not in map(tuple, self.servers):
+                        self.servers.append(tuple(hint))
+                    last_err = "not leader"
+                    continue
+                raise RuntimeError(r.get("error", "rpc failed"))
+            # no server answered / leadership in flux: back off and retry
+            # (reference: client/rpc.go retries through its server pool)
+            if attempt < retries - 1:
+                time.sleep(min(0.25 * (attempt + 1), 1.0))
+        raise ConnectionError(f"no server available: {last_err}")
+
+    # --- InProcessRPC surface ---
+
+    def register_node(self, node) -> None:
+        self.call("register_node", node)
+
+    def heartbeat_node(self, node_id: str) -> None:
+        self.call("heartbeat_node", node_id)
+
+    def update_node_status(self, node_id: str, status: str) -> None:
+        self.call("update_node_status", node_id, status)
+
+    def get_client_allocs(self, node_id: str, min_index: int,
+                          timeout: float = 5.0):
+        return self.call("get_client_allocs", node_id, min_index, timeout,
+                         timeout=timeout + 30.0)
+
+    def update_allocs(self, allocs) -> None:
+        self.call("update_allocs_from_client", allocs)
+
+    def update_service_registrations(self, regs) -> None:
+        self.call("upsert_service_registrations", regs)
+
+    def remove_service_registrations(self, alloc_id: str) -> None:
+        self.call("delete_service_registrations_by_alloc", alloc_id)
+
+
+class ClusterServer(Server):
+    """A core.Server participating in a Raft/gossip cluster."""
+
+    def __init__(self, name: str,
+                 host: str = "127.0.0.1",
+                 rpc_port: int = 0, raft_port: int = 0, serf_port: int = 0,
+                 join: Optional[List[Tuple[str, int]]] = None,
+                 data_dir: Optional[str] = None,
+                 autopilot_grace: float = 10.0,
+                 bootstrap_expect: int = 1,
+                 heartbeat_interval: Optional[float] = None,
+                 election_timeout: Optional[Tuple[float, float]] = None,
+                 **server_kwargs) -> None:
+        self.name = name
+        self._local_state = StateStore()
+        proxy = ReplicatedState(self._local_state)
+        super().__init__(dev_mode=False, state=proxy, **server_kwargs)
+        self.autopilot_grace = autopilot_grace
+
+        raft_kwargs = {}
+        if heartbeat_interval is not None:
+            raft_kwargs["heartbeat_interval"] = heartbeat_interval
+        if election_timeout is not None:
+            raft_kwargs["election_timeout"] = election_timeout
+        self.raft = RaftNode(
+            name, (host, raft_port),
+            fsm_apply=self._fsm_apply,
+            fsm_snapshot=self._fsm_snapshot,
+            fsm_restore=self._fsm_restore,
+            on_leader=self._on_raft_leader,
+            on_follower=self.revoke_leadership,
+            data_dir=data_dir,
+            bootstrap_expect=bootstrap_expect,
+            **raft_kwargs)
+        proxy.raft = self.raft
+
+        self.rpc = RPCServer(self, (host, rpc_port))
+        self.gossip = Gossip(
+            name, (host, serf_port),
+            meta={"raft": self.raft.addr, "rpc": self.rpc.addr},
+            on_change=self._on_members_changed)
+        self._join_seeds = list(join or [])
+        self._autopilot_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, tick_interval: float = 1.0, **_ignored) -> None:
+        super().start(tick_interval=tick_interval, establish=False)
+        self.raft.start()
+        self.rpc.start()
+        self.gossip.start()
+        for seed in self._join_seeds:
+            self.gossip.join(tuple(seed))
+        self._autopilot_thread = threading.Thread(
+            target=self._autopilot_loop, daemon=True,
+            name=f"autopilot-{self.name}")
+        self._autopilot_thread.start()
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self.gossip.leave()
+        self.gossip.stop()
+        self.rpc.stop()
+        self.raft.stop()
+        super().shutdown()
+        if self._autopilot_thread:
+            self._autopilot_thread.join(timeout=2)
+
+    # ------------------------------------------------------------ raft glue
+
+    def _fsm_apply(self, cmd: bytes):
+        name, args, kwargs = pickle.loads(cmd)
+        if name not in MUTATIONS:
+            raise ValueError(f"unknown FSM command {name!r}")
+        return getattr(self._local_state, name)(*args, **kwargs)
+
+    def _fsm_snapshot(self) -> bytes:
+        return pickle.dumps(self._local_state.snapshot_save(),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _fsm_restore(self, data: bytes) -> None:
+        self._local_state.snapshot_restore(pickle.loads(data))
+
+    def _on_raft_leader(self) -> None:
+        self.establish_leadership()
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def leader_rpc_addr(self) -> Optional[Tuple[str, int]]:
+        hint = self.raft.leader_hint()
+        if hint is None:
+            return None
+        if hint == self.name:
+            return self.rpc.addr
+        m = self.gossip.members.get(hint)
+        if m is not None:
+            return tuple(m.meta.get("rpc") or ()) or None
+        return None
+
+    # ------------------------------------------------------------ rpc glue
+
+    def rpc_call(self, method: str, args, kwargs):
+        """Dispatch one RPC.  Writes on a follower forward to the leader
+        (one hop — the leader serves or raises its own NotLeader)."""
+        if method in FORWARDED and not self.is_leader():
+            return self._forward(method, args, kwargs)
+        if method in ("upsert_service_registrations",
+                      "delete_service_registrations_by_alloc"):
+            target = getattr(self.state, method)
+        elif hasattr(self, method):
+            target = getattr(self, method)
+        else:
+            raise AttributeError(f"unknown RPC method {method!r}")
+        try:
+            return target(*args, **kwargs)
+        except NotLeaderError:
+            # lost leadership mid-call; let the client retry elsewhere
+            raise
+
+    def _forward(self, method: str, args, kwargs):
+        addr = self.leader_rpc_addr()
+        if addr is None:
+            raise NotLeaderError(None)
+        r = send_msg(tuple(addr), {"method": method, "args": args,
+                                   "kwargs": kwargs}, timeout=35.0)
+        if r is None:
+            raise ConnectionError(f"leader {addr} unreachable")
+        if r.get("ok"):
+            return r.get("result")
+        if r.get("not_leader"):
+            raise NotLeaderError(None)
+        raise RuntimeError(r.get("error", "forwarded rpc failed"))
+
+    # ----------------------------------------------------------- membership
+
+    def _on_members_changed(self, alive: Dict[str, Member]) -> None:
+        peers = {}
+        for m in alive.values():
+            raft_addr = m.meta.get("raft")
+            if raft_addr:
+                peers[m.name] = tuple(raft_addr)
+        self.raft.set_peers(peers)
+
+    def _autopilot_loop(self) -> None:
+        """Dead-server cleanup (reference: nomad/autopilot.go).  The
+        reference's autopilot is leader-only because its removals are
+        replicated Raft configuration changes; ours are symmetric-local
+        (see raft.py docstring), so every server reaps for itself behind
+        the same quorum guard — membership converges without tombstone
+        gossip."""
+        while not self._stopping.wait(1.0):
+            now = time.monotonic()
+            with self.gossip._lock:
+                members = list(self.gossip.members.values())
+                alive = sum(1 for m in members if m.status == "alive")
+                total = len(members)
+                # quorum guard: a leader that can't see a majority of the
+                # member set must NOT reap — reaping while partitioned
+                # would shrink its quorum denominator until it could
+                # "commit" alone (split brain)
+                if alive <= total // 2:
+                    continue
+                dead = [m.name for m in members
+                        if m.status in ("dead", "left")
+                        and now - m.status_time > self.autopilot_grace]
+                for nm in dead:
+                    self.gossip.members.pop(nm, None)
+            for nm in dead:
+                log("autopilot", "info", "reaping dead server", server=nm)
+                self.raft.remove_peer(nm)

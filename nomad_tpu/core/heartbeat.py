@@ -34,6 +34,10 @@ class HeartbeatTimers:
         with self._lock:
             self._deadlines.pop(node_id, None)
 
+    def has(self, node_id: str) -> bool:
+        with self._lock:
+            return node_id in self._deadlines
+
     def expired(self, now: float) -> List[str]:
         with self._lock:
             out = [nid for nid, dl in self._deadlines.items() if dl <= now]

@@ -1,0 +1,239 @@
+"""Gossip membership — the Serf/memberlist analog
+(reference: nomad/serf.go + hashicorp/serf/memberlist).
+
+Servers discover each other and detect failures without any central
+registry: each member keeps a member table (name → addr, meta,
+incarnation, status) and periodically pings a random peer, piggybacking
+its full table; tables merge by (incarnation, status-precedence).  A
+missed ack marks the peer suspect; a suspect that stays silent becomes
+dead (and the leave callback fires — feeding the Raft peer set and
+autopilot).  A member that hears itself called suspect/dead refutes by
+bumping its incarnation — straight SWIM, minus the indirect-probe round
+(loopback/LAN links don't partition one-way often enough to pay for it;
+the reference's memberlist does implement it).
+
+Transport: the same length-prefixed pickle framing as raft.py, TCP.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from .logging import log
+from .raft import recv_msg, reply, send_msg
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+LEFT = "left"
+
+_PRECEDENCE = {ALIVE: 0, SUSPECT: 1, DEAD: 2, LEFT: 2}
+
+PROBE_INTERVAL = 0.3
+SUSPECT_TIMEOUT = 1.5
+
+
+@dataclass
+class Member:
+    name: str
+    addr: Tuple[str, int]                  # gossip addr
+    meta: Dict[str, object] = field(default_factory=dict)
+    incarnation: int = 0
+    status: str = ALIVE
+    status_time: float = field(default_factory=time.monotonic)
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "addr": tuple(self.addr),
+                "meta": self.meta, "inc": self.incarnation,
+                "status": self.status}
+
+
+class Gossip:
+    """One member of the gossip pool."""
+
+    def __init__(self, name: str, bind: Tuple[str, int],
+                 meta: Optional[Dict[str, object]] = None,
+                 on_change: Optional[Callable[[Dict[str, Member]], None]] = None,
+                 probe_interval: float = PROBE_INTERVAL,
+                 suspect_timeout: float = SUSPECT_TIMEOUT) -> None:
+        self.name = name
+        self.meta = meta or {}
+        self.on_change = on_change
+        self.probe_interval = probe_interval
+        self.suspect_timeout = suspect_timeout
+        self._incarnation = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._threads = []
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(bind)
+        self._sock.listen(16)
+        self.addr = self._sock.getsockname()
+        self.members: Dict[str, Member] = {
+            name: Member(name=name, addr=self.addr, meta=self.meta)}
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> None:
+        for nm, fn in (("gossip-listen", self._listen_loop),
+                       ("gossip-probe", self._probe_loop)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"{nm}-{self.name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def join(self, seed: Tuple[str, int]) -> bool:
+        """Push-pull state sync with any existing member."""
+        r = send_msg(seed, {"type": "sync", "members": self._wire_members()},
+                     timeout=2.0)
+        if r is None:
+            return False
+        self._merge(r.get("members", []))
+        return True
+
+    def leave(self) -> None:
+        """Graceful leave: tell peers before going silent."""
+        with self._lock:
+            me = self.members[self.name]
+            me.status = LEFT
+            me.incarnation += 1
+            wire = self._wire_members()
+            peers = [m for m in self.members.values()
+                     if m.name != self.name and m.status == ALIVE]
+        for m in peers:
+            send_msg(m.addr, {"type": "sync", "members": wire}, timeout=0.5)
+
+    def alive_members(self) -> Dict[str, Member]:
+        with self._lock:
+            return {n: m for n, m in self.members.items()
+                    if m.status == ALIVE}
+
+    # ----------------------------------------------------------- internals
+
+    def _wire_members(self) -> list:
+        with self._lock:
+            return [m.to_wire() for m in self.members.values()]
+
+    def _merge(self, wire_members: list) -> None:
+        changed = False
+        with self._lock:
+            for w in wire_members:
+                nm = w["name"]
+                if nm == self.name:
+                    # refutation: bump incarnation past any rumor of death
+                    if w["status"] != ALIVE \
+                            and w["inc"] >= self._incarnation:
+                        self._incarnation = w["inc"] + 1
+                        self.members[self.name].incarnation = self._incarnation
+                        changed = True
+                    continue
+                cur = self.members.get(nm)
+                if cur is None:
+                    self.members[nm] = Member(
+                        name=nm, addr=tuple(w["addr"]), meta=w["meta"],
+                        incarnation=w["inc"], status=w["status"])
+                    changed = True
+                    continue
+                newer = (w["inc"], _PRECEDENCE[w["status"]]) > \
+                    (cur.incarnation, _PRECEDENCE[cur.status])
+                if newer:
+                    if cur.status != w["status"]:
+                        changed = True
+                    cur.incarnation = w["inc"]
+                    cur.status = w["status"]
+                    cur.meta = w["meta"]
+                    cur.addr = tuple(w["addr"])
+                    cur.status_time = time.monotonic()
+        if changed:
+            self._notify()
+
+    def _notify(self) -> None:
+        if self.on_change:
+            try:
+                self.on_change(self.alive_members())
+            except Exception as exc:  # noqa: BLE001
+                log("gossip", "error", "on_change failed", error=str(exc))
+
+    def _listen_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, daemon=True,
+                             args=(conn,)).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        with conn:
+            msg = recv_msg(conn, timeout=2.0)
+            if msg is None:
+                return
+            if msg.get("type") in ("ping", "sync"):
+                self._merge(msg.get("members", []))
+                reply(conn, {"type": "ack",
+                             "members": self._wire_members()})
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            with self._lock:
+                candidates = [m for m in self.members.values()
+                              if m.name != self.name
+                              and m.status in (ALIVE, SUSPECT)]
+            if not candidates:
+                continue
+            target = random.choice(candidates)
+            r = send_msg(target.addr,
+                         {"type": "ping", "members": self._wire_members()},
+                         timeout=0.5)
+            now = time.monotonic()
+            if r is not None:
+                self._merge(r.get("members", []))
+                revived = False
+                with self._lock:
+                    m = self.members.get(target.name)
+                    if m is not None and m.status == SUSPECT:
+                        m.status = ALIVE
+                        m.status_time = now
+                        revived = True
+                if revived:
+                    self._notify()
+            else:
+                changed = False
+                with self._lock:
+                    m = self.members.get(target.name)
+                    if m is not None and m.status == ALIVE:
+                        m.status = SUSPECT
+                        m.status_time = now
+                        changed = True
+                if changed:
+                    log("gossip", "warn", "member suspect",
+                        member=target.name)
+            # suspects past the timeout are dead
+            dead = []
+            with self._lock:
+                for m in self.members.values():
+                    if m.status == SUSPECT \
+                            and now - m.status_time > self.suspect_timeout:
+                        m.status = DEAD
+                        m.status_time = now
+                        dead.append(m.name)
+            if dead:
+                for nm in dead:
+                    log("gossip", "warn", "member dead", member=nm)
+                self._notify()

@@ -1,0 +1,723 @@
+"""Raft consensus for multi-server state replication
+(reference: hashicorp/raft + nomad/raft_rpc.go + nomad/fsm.go wiring).
+
+The reference replicates every cluster mutation through a Raft log applied
+to the FSM on 3/5 servers; this module is the same protocol re-implemented
+for the TPU framework's Python server plane: leader election with
+randomized timeouts, log replication with per-follower progress tracking,
+commit on majority match, FSM apply in log order, and snapshot
+install for lagging followers (log compaction via the state store's
+snapshot_save/snapshot_restore).
+
+Transport is length-prefixed pickle over loopback/LAN TCP — the cluster
+peers are mutually trusted (the reference likewise runs msgpack-RPC
+between servers with optional mTLS; TLS termination would wrap the
+sockets here).  One short-lived connection per message keeps the failure
+model trivial: any socket error is a lost message, and Raft is built on
+lost messages.
+
+Simplification vs the reference (documented, deliberate): peer-set
+changes (autopilot add/remove) take effect via the membership layer on
+every server symmetrically rather than through joint-consensus
+configuration entries.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .logging import log
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+HEARTBEAT_INTERVAL = 0.075
+ELECTION_TIMEOUT = (0.3, 0.6)
+MAX_APPEND_ENTRIES = 256
+
+
+class NotLeaderError(Exception):
+    """Raised by apply() on a non-leader; carries the leader hint."""
+
+    def __init__(self, leader: Optional[str]) -> None:
+        super().__init__(f"not the leader (leader={leader})")
+        self.leader = leader
+
+
+@dataclass
+class Entry:
+    term: int
+    index: int
+    cmd: bytes
+
+
+def send_msg(addr: Tuple[str, int], msg: dict, timeout: float = 1.0,
+             ) -> Optional[dict]:
+    """One-shot request/response; None on any failure."""
+    try:
+        with socket.create_connection(addr, timeout=timeout) as s:
+            payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+            s.sendall(struct.pack(">I", len(payload)) + payload)
+            return recv_msg(s, timeout)
+    except (OSError, pickle.PickleError, EOFError):
+        return None
+
+
+def recv_msg(sock: socket.socket, timeout: float = 5.0) -> Optional[dict]:
+    sock.settimeout(timeout)
+    try:
+        hdr = _recv_exact(sock, 4)
+        if hdr is None:
+            return None
+        (n,) = struct.unpack(">I", hdr)
+        body = _recv_exact(sock, n)
+        if body is None:
+            return None
+        return pickle.loads(body)
+    except (OSError, pickle.PickleError, EOFError):
+        return None
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def reply(sock: socket.socket, msg: dict) -> None:
+    try:
+        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+    except OSError:
+        pass
+
+
+class RaftNode:
+    """One Raft participant.
+
+    fsm_apply(cmd: bytes) -> result   applied exactly once, in log order
+    fsm_snapshot() -> bytes           full-state snapshot for compaction
+    fsm_restore(data: bytes)          replace state from a snapshot
+    on_leader() / on_follower()       leadership transition callbacks
+    """
+
+    def __init__(self, name: str, bind: Tuple[str, int],
+                 fsm_apply: Callable[[bytes], object],
+                 fsm_snapshot: Optional[Callable[[], bytes]] = None,
+                 fsm_restore: Optional[Callable[[bytes], None]] = None,
+                 on_leader: Optional[Callable[[], None]] = None,
+                 on_follower: Optional[Callable[[], None]] = None,
+                 data_dir: Optional[str] = None,
+                 max_log_entries: int = 8192,
+                 heartbeat_interval: float = HEARTBEAT_INTERVAL,
+                 election_timeout: Tuple[float, float] = ELECTION_TIMEOUT,
+                 bootstrap_expect: int = 1,
+                 ) -> None:
+        self.name = name
+        self.fsm_apply = fsm_apply
+        self.fsm_snapshot = fsm_snapshot
+        self.fsm_restore = fsm_restore
+        self.on_leader = on_leader
+        self.on_follower = on_follower
+        self.data_dir = data_dir
+        self.max_log_entries = max_log_entries
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        # no elections until this many servers are known (reference:
+        # server config bootstrap_expect) — a server that starts before
+        # membership converges must not win a singleton "quorum"
+        self.bootstrap_expect = max(1, bootstrap_expect)
+
+        # persistent state (term/vote/log; durable when data_dir given)
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[Entry] = []
+        # log prefix replaced by a snapshot; _snap_data holds the bytes of
+        # the last compaction for lagging-follower installs
+        self.snap_index = 0
+        self.snap_term = 0
+        self._snap_data: Optional[bytes] = None
+
+        # volatile
+        self.role = FOLLOWER
+        self.leader_name: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.peers: Dict[str, Tuple[str, int]] = {}   # name -> raft addr
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._apply_cv = threading.Condition(self._lock)
+        self._waiters: Dict[int, list] = {}   # index -> [event, result, term]
+        self._last_contact = time.monotonic()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # one long-lived replicator thread per peer, kicked by an event on
+        # apply() and by the heartbeat timeout — not a thread per message
+        self._peer_kick: Dict[str, threading.Event] = {}
+        self._peer_threads: Dict[str, threading.Thread] = {}
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(bind)
+        self._sock.listen(64)
+        self.addr = self._sock.getsockname()
+
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._restore_durable()
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> None:
+        for name, fn in (("raft-listen", self._listen_loop),
+                         ("raft-tick", self._tick_loop),
+                         ("raft-apply", self._apply_loop)):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"{name}-{self.name}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._apply_cv:
+            self._apply_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def set_peers(self, peers: Dict[str, Tuple[str, int]]) -> None:
+        """Merge in peers (membership layer callback).  ADD-ONLY by
+        design: a server that merely *looks* dead must keep counting
+        toward quorum, or a fully-partitioned node would shrink its peer
+        set to nothing and elect itself (split brain).  Removal happens
+        only through `remove_peer` — driven by the leader's autopilot
+        after the grace window, and only while the leader still has
+        quorum contact."""
+        with self._lock:
+            for n, a in peers.items():
+                if n == self.name:
+                    continue
+                self.peers[n] = tuple(a)
+                self.next_index.setdefault(n, self._last_index() + 1)
+                self.match_index.setdefault(n, 0)
+                if n not in self._peer_threads and not self._stop.is_set():
+                    self._peer_kick[n] = threading.Event()
+                    t = threading.Thread(
+                        target=self._replicator_loop, args=(n,),
+                        daemon=True, name=f"raft-repl-{self.name}->{n}")
+                    self._peer_threads[n] = t
+                    t.start()
+
+    def remove_peer(self, name: str) -> None:
+        with self._lock:
+            self.peers.pop(name, None)
+            self.next_index.pop(name, None)
+            self.match_index.pop(name, None)
+            self._peer_threads.pop(name, None)   # loop exits on its own
+            kick = self._peer_kick.pop(name, None)
+        if kick is not None:
+            kick.set()
+
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def leader_hint(self) -> Optional[str]:
+        return self.leader_name if self.role != LEADER else self.name
+
+    # ------------------------------------------------------------- client
+
+    def apply(self, cmd: bytes, timeout: float = 10.0):
+        """Replicate one command; returns the local FSM result after the
+        entry commits.  Raises NotLeaderError on non-leaders."""
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_name)
+            index = self._last_index() + 1
+            entry = Entry(term=self.term, index=index, cmd=cmd)
+            self.log.append(entry)
+            self._persist_entry(entry)
+            waiter = [threading.Event(), None, self.term]
+            self._waiters[index] = waiter
+            single = not self.peers
+            if single:
+                self.commit_index = index
+                self._apply_cv.notify_all()
+        if not single:
+            self._replicate_once()
+        if not waiter[0].wait(timeout):
+            with self._lock:
+                self._waiters.pop(index, None)
+            raise TimeoutError(f"raft apply timed out at index {index}")
+        if isinstance(waiter[1], _Dropped):
+            raise NotLeaderError(self.leader_name)
+        if isinstance(waiter[1], Exception):
+            raise waiter[1]
+        return waiter[1]
+
+    # ------------------------------------------------------------ internals
+
+    def _last_index(self) -> int:
+        return self.log[-1].index if self.log else self.snap_index
+
+    def _last_term(self) -> int:
+        return self.log[-1].term if self.log else self.snap_term
+
+    def _entry_at(self, index: int) -> Optional[Entry]:
+        i = index - (self.snap_index + 1)
+        if 0 <= i < len(self.log):
+            return self.log[i]
+        return None
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == self.snap_index:
+            return self.snap_term
+        e = self._entry_at(index)
+        return e.term if e is not None else None
+
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        was_leader = self.role == LEADER
+        self.role = FOLLOWER
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_meta()
+        if leader is not None:
+            self.leader_name = leader
+        if was_leader:
+            for idx, waiter in list(self._waiters.items()):
+                if idx > self.commit_index:
+                    waiter[1] = _Dropped()
+                    waiter[0].set()
+                    self._waiters.pop(idx, None)
+            if self.on_follower:
+                cb = self.on_follower
+                threading.Thread(target=cb, daemon=True).start()
+
+    def _tick_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.role == LEADER:
+                self._replicate_once()
+                self._stop.wait(self.heartbeat_interval)
+                continue
+            timeout = random.uniform(*self.election_timeout)
+            self._stop.wait(0.02)
+            if (time.monotonic() - self._last_contact) >= timeout:
+                self._run_election()
+
+    def _run_election(self) -> None:
+        with self._lock:
+            if self.role == LEADER or self._stop.is_set():
+                return
+            # bootstrap gate: only before the cluster has EVER formed
+            # (empty log, term 0).  After that, elections must proceed
+            # with whatever peer set remains — autopilot legitimately
+            # shrinks it below the original bootstrap_expect.
+            if (self.term == 0 and self._last_index() == 0
+                    and len(self.peers) + 1 < self.bootstrap_expect):
+                self._last_contact = time.monotonic()
+                return
+            self.role = CANDIDATE
+            self.term += 1
+            self.voted_for = self.name
+            self._persist_meta()
+            term = self.term
+            last_idx, last_term = self._last_index(), self._last_term()
+            peers = dict(self.peers)
+            self._last_contact = time.monotonic()
+        votes = 1
+        needed = (len(peers) + 1) // 2 + 1
+        results = []
+        threads = []
+
+        def ask(addr):
+            results.append(send_msg(addr, {
+                "type": "vote_req", "term": term, "cand": self.name,
+                "last_idx": last_idx, "last_term": last_term},
+                timeout=0.5))
+
+        for addr in peers.values():
+            t = threading.Thread(target=ask, daemon=True, args=(addr,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=0.6)
+        for r in results:
+            if r is None:
+                continue
+            if r.get("term", 0) > term:
+                with self._lock:
+                    self._become_follower(r["term"], None)
+                return
+            if r.get("granted"):
+                votes += 1
+        with self._lock:
+            if self.role != CANDIDATE or self.term != term:
+                return
+            if votes >= needed:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_name = self.name
+        nxt = self._last_index() + 1
+        for n in self.peers:
+            self.next_index[n] = nxt
+            self.match_index[n] = 0
+        # no-op barrier entry: prior-term entries may only commit via a
+        # committed entry of the CURRENT term (Raft §5.4.2); without it a
+        # restarted/new leader never commits its replayed log
+        noop = Entry(term=self.term, index=nxt, cmd=b"")
+        self.log.append(noop)
+        self._persist_entry(noop)
+        if not self.peers:
+            self.commit_index = noop.index
+            self._apply_cv.notify_all()
+        log("raft", "info", "leadership won", name=self.name, term=self.term)
+        if self.on_leader:
+            cb = self.on_leader
+            threading.Thread(target=cb, daemon=True).start()
+
+    def _replicate_once(self) -> None:
+        """Kick every per-peer replicator."""
+        with self._lock:
+            kicks = list(self._peer_kick.values())
+        for k in kicks:
+            k.set()
+
+    def _replicator_loop(self, name: str) -> None:
+        """Long-lived replication pump for one peer: sends on apply-kick
+        or heartbeat timeout, exits when the peer is removed."""
+        while not self._stop.is_set():
+            with self._lock:
+                if name not in self.peers:
+                    return
+                addr = self.peers[name]
+                kick = self._peer_kick.get(name)
+                is_leader = self.role == LEADER
+            if is_leader:
+                self._replicate_to(name, addr)
+            if kick is None:
+                return
+            kick.wait(self.heartbeat_interval)
+            kick.clear()
+
+    def _replicate_to(self, name: str, addr: Tuple[str, int]) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            nxt = self.next_index.get(name, self._last_index() + 1)
+            if nxt <= self.snap_index:
+                # follower is behind the compacted prefix: ship a snapshot
+                msg = self._snapshot_msg()
+            else:
+                prev_idx = nxt - 1
+                prev_term = self._term_at(prev_idx)
+                if prev_term is None:
+                    msg = self._snapshot_msg()
+                else:
+                    ents = [(e.term, e.index, e.cmd) for e in
+                            self.log[nxt - self.snap_index - 1:
+                                     nxt - self.snap_index - 1
+                                     + MAX_APPEND_ENTRIES]]
+                    msg = {"type": "append", "term": self.term,
+                           "leader": self.name, "prev_idx": prev_idx,
+                           "prev_term": prev_term, "entries": ents,
+                           "commit": self.commit_index}
+        if msg is None:
+            return
+        r = send_msg(addr, msg, timeout=1.0)
+        if r is None:
+            return
+        with self._lock:
+            if r.get("term", 0) > self.term:
+                self._become_follower(r["term"], None)
+                return
+            if self.role != LEADER:
+                return
+            if msg["type"] == "snap":
+                self.next_index[name] = msg["last_idx"] + 1
+                self.match_index[name] = msg["last_idx"]
+            elif r.get("ok"):
+                m = r.get("match", 0)
+                self.match_index[name] = max(self.match_index.get(name, 0), m)
+                self.next_index[name] = self.match_index[name] + 1
+                self._advance_commit()
+            else:
+                hint = r.get("hint")
+                self.next_index[name] = max(
+                    1, hint if hint else self.next_index.get(name, 2) - 1)
+
+    def _snapshot_msg(self) -> Optional[dict]:
+        """Ship the snapshot taken at the last compaction.  NEVER snapshot
+        the live FSM here: this runs in a replication thread while the
+        apply loop may have advanced last_applied past what it has
+        actually applied — a fresh snapshot stamped with last_applied
+        could omit committed commands forever.  Compaction snapshots are
+        taken by the apply thread itself between batches, where
+        fsm-applied == snap_index exactly."""
+        if self._snap_data is None:
+            return None
+        return {"type": "snap", "term": self.term, "leader": self.name,
+                "last_idx": self.snap_index,
+                "last_term": self.snap_term,
+                "data": self._snap_data}
+
+    def _advance_commit(self) -> None:
+        matches = sorted([self._last_index()]
+                         + [self.match_index.get(n, 0) for n in self.peers],
+                         reverse=True)
+        majority = matches[len(matches) // 2]
+        if majority > self.commit_index \
+                and self._term_at(majority) == self.term:
+            self.commit_index = majority
+            self._apply_cv.notify_all()
+
+    # ------------------------------------------------------------- serving
+
+    def _listen_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, daemon=True,
+                             args=(conn,)).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            msg = recv_msg(conn, timeout=2.0)
+            if msg is None:
+                return
+            handler = {"vote_req": self._on_vote_req,
+                       "append": self._on_append,
+                       "snap": self._on_snap}.get(msg.get("type"))
+            if handler is None:
+                return
+            resp = handler(msg)
+            if resp is not None:
+                reply(conn, resp)
+
+    def _on_vote_req(self, m: dict) -> dict:
+        with self._lock:
+            if m["term"] > self.term:
+                self._become_follower(m["term"], None)
+            granted = False
+            if m["term"] == self.term \
+                    and self.voted_for in (None, m["cand"]):
+                up_to_date = (m["last_term"], m["last_idx"]) >= \
+                    (self._last_term(), self._last_index())
+                if up_to_date:
+                    granted = True
+                    self.voted_for = m["cand"]
+                    self._persist_meta()
+                    self._last_contact = time.monotonic()
+            return {"term": self.term, "granted": granted}
+
+    def _on_append(self, m: dict) -> dict:
+        with self._lock:
+            if m["term"] < self.term:
+                return {"term": self.term, "ok": False}
+            self._last_contact = time.monotonic()
+            if m["term"] > self.term or self.role != FOLLOWER:
+                self._become_follower(m["term"], m["leader"])
+            self.leader_name = m["leader"]
+            prev_idx, prev_term = m["prev_idx"], m["prev_term"]
+            if prev_idx > 0:
+                t = self._term_at(prev_idx)
+                if t is None:
+                    return {"term": self.term, "ok": False,
+                            "hint": self._last_index() + 1}
+                if t != prev_term:
+                    # conflict: drop the conflicting suffix
+                    self.log = self.log[:prev_idx - self.snap_index - 1]
+                    self._persist_log()
+                    return {"term": self.term, "ok": False,
+                            "hint": max(1, prev_idx)}
+            appended = False
+            for term, index, cmd in m["entries"]:
+                existing = self._entry_at(index)
+                if existing is not None:
+                    if existing.term == term:
+                        continue
+                    self.log = self.log[:index - self.snap_index - 1]
+                    appended = True
+                if index == self._last_index() + 1:
+                    self.log.append(Entry(term=term, index=index, cmd=cmd))
+                    appended = True
+            if appended:
+                self._persist_log()
+            match = self._last_index()
+            if m["commit"] > self.commit_index:
+                self.commit_index = min(m["commit"], match)
+                self._apply_cv.notify_all()
+            return {"term": self.term, "ok": True, "match": match}
+
+    def _on_snap(self, m: dict) -> dict:
+        with self._lock:
+            if m["term"] < self.term:
+                return {"term": self.term}
+            self._last_contact = time.monotonic()
+            self._become_follower(m["term"], m["leader"])
+            if m["last_idx"] <= self.last_applied:
+                return {"term": self.term}
+            if self.fsm_restore is not None:
+                self.fsm_restore(m["data"])
+            self._snap_data = m["data"]
+            self.snap_index = m["last_idx"]
+            self.snap_term = m["last_term"]
+            self.log = []
+            self.commit_index = max(self.commit_index, m["last_idx"])
+            self.last_applied = m["last_idx"]
+            self._persist_log()
+            return {"term": self.term}
+
+    # --------------------------------------------------------------- apply
+
+    def _apply_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._apply_cv:
+                while (self.last_applied >= self.commit_index
+                       and not self._stop.is_set()):
+                    self._apply_cv.wait(timeout=0.5)
+                if self._stop.is_set():
+                    return
+                batch = []
+                while self.last_applied < self.commit_index:
+                    idx = self.last_applied + 1
+                    e = self._entry_at(idx)
+                    if e is None:
+                        break
+                    batch.append(e)
+                    self.last_applied = idx
+            for e in batch:
+                if not e.cmd:          # leadership no-op barrier
+                    continue
+                try:
+                    result = self.fsm_apply(e.cmd)
+                    err = None
+                except Exception as exc:  # noqa: BLE001 - FSM must not kill raft
+                    result, err = None, exc
+                    log("raft", "error", "fsm apply failed",
+                        index=e.index, error=str(exc))
+                with self._lock:
+                    waiter = self._waiters.pop(e.index, None)
+                if waiter is not None:
+                    waiter[1] = err if err is not None else result
+                    waiter[0].set()
+            with self._lock:
+                self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        if self.fsm_snapshot is None \
+                or len(self.log) <= self.max_log_entries:
+            return
+        # keep a tail of entries so slightly-lagging followers don't need
+        # a full snapshot transfer
+        keep = self.max_log_entries // 2
+        new_snap_idx = self.last_applied
+        tail = [e for e in self.log if e.index > new_snap_idx][-keep:]
+        cut = [e for e in self.log if e.index <= new_snap_idx]
+        if not cut:
+            return
+        self._snap_data = self.fsm_snapshot()
+        self.snap_term = self._term_at(new_snap_idx) or self.term
+        self.snap_index = new_snap_idx
+        self.log = [e for e in self.log if e.index > new_snap_idx]
+        self._persist_log(snapshot=self._snap_data)
+
+    # ---------------------------------------------------------- durability
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.data_dir, f"raft-{self.name}.meta")
+
+    def _log_path(self) -> str:
+        return os.path.join(self.data_dir, f"raft-{self.name}.log")
+
+    def _persist_meta(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"term": self.term, "voted_for": self.voted_for}, f)
+        os.replace(tmp, self._meta_path())
+
+    def _persist_entry(self, entry: Entry) -> None:
+        if not self.data_dir:
+            return
+        with open(self._log_path(), "ab") as f:
+            payload = pickle.dumps(entry)
+            f.write(struct.pack(">I", len(payload)) + payload)
+
+    def _persist_log(self, snapshot: Optional[bytes] = None) -> None:
+        """Rewrite the durable log (suffix truncation / compaction)."""
+        if not self.data_dir:
+            return
+        tmp = self._log_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            hdr = pickle.dumps({"snap_index": self.snap_index,
+                                "snap_term": self.snap_term,
+                                "snapshot": snapshot})
+            f.write(struct.pack(">I", len(hdr)) + hdr)
+            for e in self.log:
+                payload = pickle.dumps(e)
+                f.write(struct.pack(">I", len(payload)) + payload)
+        os.replace(tmp, self._log_path())
+
+    def _restore_durable(self) -> None:
+        try:
+            with open(self._meta_path(), "rb") as f:
+                meta = pickle.load(f)
+                self.term = meta["term"]
+                self.voted_for = meta["voted_for"]
+        except (OSError, pickle.PickleError, EOFError, KeyError):
+            pass
+        try:
+            with open(self._log_path(), "rb") as f:
+                first = True
+                while True:
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        break
+                    (n,) = struct.unpack(">I", hdr)
+                    body = f.read(n)
+                    if len(body) < n:
+                        break
+                    obj = pickle.loads(body)
+                    if first and isinstance(obj, dict):
+                        self.snap_index = obj.get("snap_index", 0)
+                        self.snap_term = obj.get("snap_term", 0)
+                        snap = obj.get("snapshot")
+                        if snap is not None and self.fsm_restore is not None:
+                            self.fsm_restore(snap)
+                            self._snap_data = snap
+                            self.last_applied = self.snap_index
+                            self.commit_index = self.snap_index
+                        first = False
+                        continue
+                    first = False
+                    if isinstance(obj, Entry):
+                        self.log.append(obj)
+        except (OSError, pickle.PickleError, EOFError):
+            pass
+
+
+class _Dropped:
+    """Sentinel result for entries lost to leadership loss before commit."""

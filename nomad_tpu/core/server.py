@@ -54,8 +54,11 @@ class Server:
     def __init__(self, num_workers: int = 1, dev_mode: bool = True,
                  heartbeat_ttl: float = 30.0,
                  failed_follow_up_delay: tuple = (60.0, 240.0),
-                 acl_enabled: bool = False) -> None:
-        self.state = StateStore()
+                 acl_enabled: bool = False,
+                 state: Optional[StateStore] = None) -> None:
+        # `state` may be a ReplicatedState proxy (cluster.py): every
+        # component below then routes mutations through Raft transparently
+        self.state = state if state is not None else StateStore()
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
@@ -103,11 +106,33 @@ class Server:
         for j in snap.jobs():
             if j.periodic is not None:
                 self.periodic.add(j, now=now)
+        # fresh TTL grace for EVERY ready node: after (re)gaining
+        # leadership any pre-existing deadline is stale — the node has
+        # been heartbeating some other leader meanwhile, and an old
+        # frozen deadline would expire a live node on the first tick
+        # (reference: initializeHeartbeatTimers)
+        for n in snap.nodes():
+            if n.status == "ready":
+                self.heartbeats.reset(n.id, now)
 
-    def start(self, tick_interval: float = 1.0) -> None:
-        """Threaded mode: start applier + workers + the tick loop that
-        drives heartbeat expiry and broker timeouts."""
+    def revoke_leadership(self) -> None:
+        """reference: revokeLeadership — disable the leader-only machinery
+        when Raft moves the leadership elsewhere (cluster mode)."""
         if not self._leader:
+            return
+        self._leader = False
+        log("server", "info", "leadership revoked")
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+
+    def start(self, tick_interval: float = 1.0,
+              establish: bool = True) -> None:
+        """Threaded mode: start applier + workers + the tick loop that
+        drives heartbeat expiry and broker timeouts.  `establish=False`
+        (cluster mode): leadership comes from the Raft election callback
+        instead of being assumed."""
+        if establish and not self._leader:
             self.establish_leadership()
         self.dev_mode = False
         self.plan_applier.start()
@@ -462,6 +487,10 @@ class Server:
         """Periodic leader duties: broker delayed-eval promotion + nack
         timeouts, heartbeat expiry."""
         t = now if now is not None else time.time()
+        if not self._leader:
+            # followers carry no timers/queues; their copies of these
+            # duties belong to the leader (reference: leaderLoop)
+            return
         self.eval_broker.tick(t)
         # delivery-limit failures: mark failed in state (apply_eval_update
         # then creates the delayed follow-up)

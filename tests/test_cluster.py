@@ -1,0 +1,283 @@
+"""Multi-server cluster tests: raft election/replication/failover, gossip
+membership, RPC leader forwarding, autopilot
+(reference scenarios: nomad/leader_test.go, raft integration via
+TestServer(t, cb) + WaitForLeader — multi-node without a real cluster =
+in-process instances on loopback, SURVEY.md §5)."""
+
+import pickle
+import tempfile
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.cluster import ClusterServer, RemoteRPC
+from nomad_tpu.core.membership import Gossip
+from nomad_tpu.core.raft import NotLeaderError, RaftNode
+
+FAST = dict(heartbeat_interval=0.04, election_timeout=(0.15, 0.3))
+
+
+def wait_for(fn, timeout=8.0, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# --------------------------------------------------------------------- raft
+
+
+class KVFSM:
+    """Tiny deterministic FSM for raft unit tests."""
+
+    def __init__(self):
+        self.data = {}
+        self.applied = []
+
+    def apply(self, cmd: bytes):
+        k, v = pickle.loads(cmd)
+        self.data[k] = v
+        self.applied.append((k, v))
+        return len(self.applied)
+
+    def snapshot(self) -> bytes:
+        return pickle.dumps((self.data, self.applied))
+
+    def restore(self, data: bytes) -> None:
+        self.data, self.applied = pickle.loads(data)
+
+
+def make_raft_trio(**kw):
+    fsms = [KVFSM() for _ in range(3)]
+    nodes = [RaftNode(f"s{i}", ("127.0.0.1", 0),
+                      fsm_apply=fsms[i].apply,
+                      fsm_snapshot=fsms[i].snapshot,
+                      fsm_restore=fsms[i].restore,
+                      **{**FAST, **kw})
+             for i in range(3)]
+    addrs = {n.name: n.addr for n in nodes}
+    for n in nodes:
+        n.set_peers(addrs)
+        n.start()
+    return nodes, fsms
+
+
+def leader_of(nodes):
+    leaders = [n for n in nodes if n.is_leader()]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+class TestRaft:
+    def test_election_and_replication(self):
+        nodes, fsms = make_raft_trio()
+        try:
+            leader = wait_for(lambda: leader_of(nodes), msg="leader")
+            for i in range(5):
+                leader.apply(pickle.dumps((f"k{i}", i)))
+            wait_for(lambda: all(len(f.applied) == 5 for f in fsms),
+                     msg="replication")
+            assert all(f.data == fsms[0].data for f in fsms)
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_follower_rejects_apply(self):
+        nodes, _ = make_raft_trio()
+        try:
+            leader = wait_for(lambda: leader_of(nodes), msg="leader")
+            follower = next(n for n in nodes if n is not leader)
+            with pytest.raises(NotLeaderError):
+                follower.apply(b"nope")
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_leader_failover_preserves_log(self):
+        nodes, fsms = make_raft_trio()
+        try:
+            leader = wait_for(lambda: leader_of(nodes), msg="leader")
+            for i in range(3):
+                leader.apply(pickle.dumps((f"k{i}", i)))
+            leader.stop()
+            rest = [n for n in nodes if n is not leader]
+            new_leader = wait_for(lambda: leader_of(rest),
+                                  msg="new leader")
+            assert new_leader is not leader
+            new_leader.apply(pickle.dumps(("post", 1)))
+            live_fsms = [fsms[nodes.index(n)] for n in rest]
+            wait_for(lambda: all(f.data.get("post") == 1
+                                 and len(f.applied) == 4
+                                 for f in live_fsms),
+                     msg="post-failover replication")
+            assert all(f.data.get("k2") == 2 for f in live_fsms)
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_lagging_follower_catches_up_via_snapshot(self):
+        nodes, fsms = make_raft_trio(max_log_entries=8)
+        try:
+            leader = wait_for(lambda: leader_of(nodes), msg="leader")
+            follower = next(n for n in nodes if n is not leader)
+            follower.stop()
+            for i in range(40):    # force compaction past the dead follower
+                leader.apply(pickle.dumps((f"k{i}", i)))
+            wait_for(lambda: leader.snap_index > 0, msg="compaction")
+            # a fresh node with the same identity rejoins
+            fsm = KVFSM()
+            reborn = RaftNode(follower.name, ("127.0.0.1", 0),
+                              fsm_apply=fsm.apply, fsm_snapshot=fsm.snapshot,
+                              fsm_restore=fsm.restore, **FAST)
+            addrs = {n.name: n.addr for n in nodes if n is not follower}
+            addrs[reborn.name] = reborn.addr
+            reborn.set_peers(addrs)
+            reborn.start()
+            for n in nodes:
+                if n is not follower:
+                    n.set_peers(addrs)
+            wait_for(lambda: fsm.data.get("k39") == 39,
+                     msg="snapshot install + catch-up")
+            reborn.stop()
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_durable_restart_replays_log(self):
+        with tempfile.TemporaryDirectory() as d:
+            fsm = KVFSM()
+            n = RaftNode("solo", ("127.0.0.1", 0), fsm_apply=fsm.apply,
+                         fsm_snapshot=fsm.snapshot, fsm_restore=fsm.restore,
+                         data_dir=d, **FAST)
+            n.start()
+            wait_for(lambda: n.is_leader(), msg="solo leader")
+            for i in range(5):
+                n.apply(pickle.dumps((f"k{i}", i)))
+            term = n.term
+            n.stop()
+
+            fsm2 = KVFSM()
+            n2 = RaftNode("solo", ("127.0.0.1", 0), fsm_apply=fsm2.apply,
+                          fsm_snapshot=fsm2.snapshot,
+                          fsm_restore=fsm2.restore, data_dir=d, **FAST)
+            assert n2.term >= term
+            assert len([e for e in n2.log if e.cmd]) == 5
+            n2.start()
+            wait_for(lambda: fsm2.data.get("k4") == 4, msg="log replay")
+            n2.stop()
+
+
+# ------------------------------------------------------------------- gossip
+
+
+class TestGossip:
+    def test_join_and_failure_detection(self):
+        g1 = Gossip("a", ("127.0.0.1", 0), probe_interval=0.1,
+                    suspect_timeout=0.4)
+        g2 = Gossip("b", ("127.0.0.1", 0), probe_interval=0.1,
+                    suspect_timeout=0.4)
+        g3 = Gossip("c", ("127.0.0.1", 0), probe_interval=0.1,
+                    suspect_timeout=0.4)
+        for g in (g1, g2, g3):
+            g.start()
+        assert g2.join(g1.addr)
+        assert g3.join(g1.addr)
+        try:
+            wait_for(lambda: len(g1.alive_members()) == 3
+                     and len(g3.alive_members()) == 3, msg="convergence")
+            g2.stop()
+            wait_for(lambda: "b" not in g1.alive_members(),
+                     msg="failure detection")
+        finally:
+            for g in (g1, g3):
+                g.stop()
+
+
+# ------------------------------------------------------------ full cluster
+
+
+@pytest.fixture
+def trio():
+    s1 = ClusterServer("s1", autopilot_grace=1.0, bootstrap_expect=3,
+                       **FAST)
+    s2 = ClusterServer("s2", autopilot_grace=1.0, bootstrap_expect=3,
+                       **FAST)
+    s3 = ClusterServer("s3", autopilot_grace=1.0, bootstrap_expect=3,
+                       **FAST)
+    s1.start(tick_interval=0.2)
+    s2._join_seeds = [s1.gossip.addr]
+    s3._join_seeds = [s1.gossip.addr]
+    s2.start(tick_interval=0.2)
+    s3.start(tick_interval=0.2)
+    servers = [s1, s2, s3]
+    yield servers
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+def cluster_leader(servers):
+    leaders = [s for s in servers if s.is_leader()]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+class TestClusterServer:
+    def test_replicated_scheduling_with_forwarding(self, trio):
+        leader = wait_for(lambda: cluster_leader(trio), msg="leader")
+        follower = next(s for s in trio if s is not leader)
+
+        # node + job registered THROUGH A FOLLOWER (forwarded to leader)
+        rpc = RemoteRPC([follower.rpc.addr])
+        node = mock.node()
+        rpc.register_node(node)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        rpc.call("register_job", job)
+
+        # the leader schedules; state replicates to every server
+        def placed_everywhere():
+            return all(
+                len([a for a in s.state.allocs_by_job("default", job.id)
+                     if not a.terminal_status()]) == 3
+                for s in trio)
+        wait_for(placed_everywhere, msg="replicated placement")
+
+        # follower reads agree with leader reads
+        f_allocs = follower.state.allocs_by_job("default", job.id)
+        l_allocs = leader.state.allocs_by_job("default", job.id)
+        assert {a.id for a in f_allocs} == {a.id for a in l_allocs}
+
+    def test_leader_failover_keeps_scheduling(self, trio):
+        leader = wait_for(lambda: cluster_leader(trio), msg="leader")
+        rpc = RemoteRPC([s.rpc.addr for s in trio])
+        node = mock.node()
+        rpc.register_node(node)
+        job1 = mock.job()
+        job1.task_groups[0].count = 2
+        rpc.call("register_job", job1)
+        wait_for(lambda: len(leader.state.allocs_by_job(
+            "default", job1.id)) == 2, msg="initial placement")
+
+        leader.shutdown()
+        rest = [s for s in trio if s is not leader]
+        new_leader = wait_for(lambda: cluster_leader(rest),
+                              msg="failover leader")
+
+        # autopilot reaps the dead server once grace passes
+        wait_for(lambda: leader.name not in new_leader.raft.peers,
+                 timeout=10.0, msg="autopilot reap")
+
+        job2 = mock.job()
+        job2.task_groups[0].count = 2
+        rpc.call("register_job", job2)
+        wait_for(lambda: all(
+            len(s.state.allocs_by_job("default", job2.id)) == 2
+            for s in rest), msg="post-failover placement")
+        # pre-failover state survived
+        assert all(len(s.state.allocs_by_job("default", job1.id)) == 2
+                   for s in rest)
