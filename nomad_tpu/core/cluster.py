@@ -122,6 +122,14 @@ class RPCServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # shutdown() BEFORE close(): close() does not wake a thread
+        # already blocked in accept() — the in-flight syscall keeps the
+        # file description alive and would accept (and serve!) one more
+        # connection after "close"
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -135,6 +143,12 @@ class RPCServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            if self._stop.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(target=self._serve, daemon=True,
                              args=(conn,)).start()
 
@@ -144,6 +158,12 @@ class RPCServer:
             if msg is None:
                 return
             method = msg.get("method", "")
+            if self.cluster._stopping.is_set():
+                # shutting down: refuse with a retryable redirect rather
+                # than executing against a dying server
+                reply(conn, {"ok": False, "not_leader": True,
+                             "leader_rpc": None})
+                return
             args = msg.get("args", ())
             kwargs = msg.get("kwargs", {})
             try:
@@ -153,7 +173,8 @@ class RPCServer:
                 reply(conn, {"ok": False, "not_leader": True,
                              "leader_rpc": self.cluster.leader_rpc_addr()})
             except Exception as e:  # noqa: BLE001 - surface to the caller
-                reply(conn, {"ok": False, "error": repr(e)})
+                reply(conn, {"ok": False,
+                             "error": f"[{self.cluster.name}] {e!r}"})
 
 
 class RemoteRPC:
@@ -188,7 +209,8 @@ class RemoteRPC:
                         self.servers.append(tuple(hint))
                     last_err = "not leader"
                     continue
-                raise RuntimeError(r.get("error", "rpc failed"))
+                raise RuntimeError(f"{r.get('error', 'rpc failed')} "
+                                   f"(via {addr})")
             # no server answered / leadership in flux: back off and retry
             # (reference: client/rpc.go retries through its server pool)
             if attempt < retries - 1:

@@ -91,6 +91,12 @@ class Gossip:
 
     def stop(self) -> None:
         self._stop.set()
+        # shutdown() BEFORE close(): close() does not wake a thread
+        # already blocked in accept() (see cluster.RPCServer.stop)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

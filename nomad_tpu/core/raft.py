@@ -170,6 +170,8 @@ class RaftNode:
         # apply() and by the heartbeat timeout — not a thread per message
         self._peer_kick: Dict[str, threading.Event] = {}
         self._peer_threads: Dict[str, threading.Thread] = {}
+        self._peer_ack: Dict[str, float] = {}   # last response, any kind
+        self._lease_start = 0.0
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -193,7 +195,20 @@ class RaftNode:
             self._threads.append(t)
 
     def stop(self) -> None:
-        self._stop.set()
+        with self._lock:
+            self._stop.set()
+            # a stopped node must not linger as an apparent leader —
+            # apply() checks role, and the step-down drops pending
+            # waiters with NotLeaderError so callers retry elsewhere
+            if self.role == LEADER:
+                self._become_follower(self.term, None)
+            self.role = FOLLOWER
+        # shutdown() BEFORE close(): close() does not wake a thread
+        # already blocked in accept() (see cluster.RPCServer.stop)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -248,7 +263,7 @@ class RaftNode:
         """Replicate one command; returns the local FSM result after the
         entry commits.  Raises NotLeaderError on non-leaders."""
         with self._lock:
-            if self.role != LEADER:
+            if self.role != LEADER or self._stop.is_set():
                 raise NotLeaderError(self.leader_name)
             index = self._last_index() + 1
             entry = Entry(term=self.term, index=index, cmd=cmd)
@@ -265,7 +280,24 @@ class RaftNode:
         if not waiter[0].wait(timeout):
             with self._lock:
                 self._waiters.pop(index, None)
-            raise TimeoutError(f"raft apply timed out at index {index}")
+                e = self._entry_at(index)
+                now_m = time.monotonic()
+                acks = {n: round(now_m - self._peer_ack.get(n, 0.0), 2)
+                        for n in self.peers}
+                detail = (f"index {index}: node={self.name}"
+                          f" role={self.role} term={self.term}"
+                          f" commit={self.commit_index}"
+                          f" applied={self.last_applied}"
+                          f" last={self._last_index()}"
+                          f" entry_term={e.term if e else None}"
+                          f" entry_is_noop={e is not None and not e.cmd}"
+                          f" waiter_term={waiter[2]}"
+                          f" next={dict(self.next_index)}"
+                          f" match={dict(self.match_index)}"
+                          f" ack_age={acks}"
+                          f" repl_alive="
+                          f"{ {n: t.is_alive() for n, t in self._peer_threads.items()} }")
+            raise TimeoutError(f"raft apply timed out at {detail}")
         if isinstance(waiter[1], _Dropped):
             raise NotLeaderError(self.leader_name)
         if isinstance(waiter[1], Exception):
@@ -314,6 +346,7 @@ class RaftNode:
     def _tick_loop(self) -> None:
         while not self._stop.is_set():
             if self.role == LEADER:
+                self._check_lease()
                 self._replicate_once()
                 self._stop.wait(self.heartbeat_interval)
                 continue
@@ -321,6 +354,27 @@ class RaftNode:
             self._stop.wait(0.02)
             if (time.monotonic() - self._last_contact) >= timeout:
                 self._run_election()
+
+    def _check_lease(self) -> None:
+        """Leader lease: a leader that hasn't heard from a majority for a
+        multiple of the election timeout steps down rather than lingering
+        as a stale leader (its applies would only time out anyway, and a
+        deaf-but-alive node must rejoin via a fresh election)."""
+        lease = self.election_timeout[1] * 4
+        now = time.monotonic()
+        with self._lock:
+            if self.role != LEADER or not self.peers:
+                return
+            if now - self._lease_start < lease:
+                return
+            fresh = sum(1 for n in self.peers
+                        if now - self._peer_ack.get(n, 0.0) < lease)
+            needed = (len(self.peers) + 1) // 2 + 1
+            if fresh + 1 < needed:
+                log("raft", "warn", "leader lease lost; stepping down",
+                    name=self.name, term=self.term)
+                self._become_follower(self.term, None)
+                self._last_contact = time.monotonic()
 
     def _run_election(self) -> None:
         with self._lock:
@@ -377,6 +431,7 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader_name = self.name
+        self._lease_start = time.monotonic()
         nxt = self._last_index() + 1
         for n in self.peers:
             self.next_index[n] = nxt
@@ -404,25 +459,78 @@ class RaftNode:
 
     def _replicator_loop(self, name: str) -> None:
         """Long-lived replication pump for one peer: sends on apply-kick
-        or heartbeat timeout, exits when the peer is removed."""
-        while not self._stop.is_set():
-            with self._lock:
-                if name not in self.peers:
+        or heartbeat timeout over ONE persistent connection (reconnect on
+        error), exits when the peer is removed."""
+        sock: Optional[socket.socket] = None
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    if name not in self.peers:
+                        return
+                    addr = self.peers[name]
+                    kick = self._peer_kick.get(name)
+                    is_leader = self.role == LEADER
+                if is_leader:
+                    try:
+                        sock = self._replicate_to(name, addr, sock)
+                    except Exception as exc:  # noqa: BLE001 - pump must live
+                        log("raft", "error", "replicate failed",
+                            peer=name, error=str(exc))
+                        try:
+                            if sock is not None:
+                                sock.close()
+                        except OSError:
+                            pass
+                        sock = None
+                if kick is None:
                     return
-                addr = self.peers[name]
-                kick = self._peer_kick.get(name)
-                is_leader = self.role == LEADER
-            if is_leader:
-                self._replicate_to(name, addr)
-            if kick is None:
-                return
-            kick.wait(self.heartbeat_interval)
-            kick.clear()
+                kick.wait(self.heartbeat_interval)
+                kick.clear()
+        except BaseException as exc:  # noqa: BLE001 - must never die silent
+            log("raft", "error", "replicator died",
+                peer=name, error=repr(exc))
+            raise
+        finally:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
-    def _replicate_to(self, name: str, addr: Tuple[str, int]) -> None:
+    def _peer_roundtrip(self, sock: Optional[socket.socket],
+                        addr: Tuple[str, int], msg: dict,
+                        ) -> Tuple[Optional[socket.socket], Optional[dict]]:
+        """Send one framed message over the persistent peer connection,
+        reconnecting once on failure.  Returns (socket, response)."""
+        import struct as _struct
+        for attempt in range(2):
+            if sock is None:
+                try:
+                    sock = socket.create_connection(addr, timeout=1.0)
+                except OSError:
+                    return None, None
+            try:
+                payload = pickle.dumps(msg,
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+                sock.sendall(_struct.pack(">I", len(payload)) + payload)
+                r = recv_msg(sock, timeout=2.0)
+                if r is not None:
+                    return sock, r
+            except (OSError, pickle.PickleError):
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            sock = None
+        return None, None
+
+    def _replicate_to(self, name: str, addr: Tuple[str, int],
+                      sock: Optional[socket.socket] = None,
+                      ) -> Optional[socket.socket]:
         with self._lock:
             if self.role != LEADER:
-                return
+                return sock
             nxt = self.next_index.get(name, self._last_index() + 1)
             if nxt <= self.snap_index:
                 # follower is behind the compacted prefix: ship a snapshot
@@ -430,6 +538,14 @@ class RaftNode:
             else:
                 prev_idx = nxt - 1
                 prev_term = self._term_at(prev_idx)
+                if prev_term is None and prev_idx > self._last_index():
+                    # defensive: next_index drifted past our log (stale
+                    # match bookkeeping); resync from the top instead of
+                    # stalling on a snapshot we may not have
+                    self.next_index[name] = self._last_index() + 1
+                    nxt = self.next_index[name]
+                    prev_idx = nxt - 1
+                    prev_term = self._term_at(prev_idx)
                 if prev_term is None:
                     msg = self._snapshot_msg()
                 else:
@@ -442,16 +558,17 @@ class RaftNode:
                            "prev_term": prev_term, "entries": ents,
                            "commit": self.commit_index}
         if msg is None:
-            return
-        r = send_msg(addr, msg, timeout=1.0)
+            return sock
+        sock, r = self._peer_roundtrip(sock, addr, msg)
         if r is None:
-            return
+            return sock
+        self._peer_ack[name] = time.monotonic()
         with self._lock:
             if r.get("term", 0) > self.term:
                 self._become_follower(r["term"], None)
-                return
+                return sock
             if self.role != LEADER:
-                return
+                return sock
             if msg["type"] == "snap":
                 self.next_index[name] = msg["last_idx"] + 1
                 self.match_index[name] = msg["last_idx"]
@@ -464,6 +581,7 @@ class RaftNode:
                 hint = r.get("hint")
                 self.next_index[name] = max(
                     1, hint if hint else self.next_index.get(name, 2) - 1)
+        return sock
 
     def _snapshot_msg(self) -> Optional[dict]:
         """Ship the snapshot taken at the last compaction.  NEVER snapshot
@@ -497,22 +615,38 @@ class RaftNode:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
+                # transient failure (e.g. EMFILE) must NOT make the node
+                # deaf — a deaf node never hears higher terms and lingers
+                # as a stale leader forever
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+                continue
+            if self._stop.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
                 return
             threading.Thread(target=self._serve_conn, daemon=True,
                              args=(conn,)).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        """Serve a connection until the peer closes it: replicators hold
+        one persistent connection and pump many messages through it."""
         with conn:
-            msg = recv_msg(conn, timeout=2.0)
-            if msg is None:
-                return
-            handler = {"vote_req": self._on_vote_req,
-                       "append": self._on_append,
-                       "snap": self._on_snap}.get(msg.get("type"))
-            if handler is None:
-                return
-            resp = handler(msg)
-            if resp is not None:
+            while not self._stop.is_set():
+                msg = recv_msg(conn, timeout=10.0)
+                if msg is None:
+                    return
+                handler = {"vote_req": self._on_vote_req,
+                           "append": self._on_append,
+                           "snap": self._on_snap}.get(msg.get("type"))
+                if handler is None:
+                    return
+                resp = handler(msg)
+                if resp is None:
+                    return
                 reply(conn, resp)
 
     def _on_vote_req(self, m: dict) -> dict:
@@ -564,7 +698,13 @@ class RaftNode:
                     appended = True
             if appended:
                 self._persist_log()
-            match = self._last_index()
+            # match = the last index KNOWN to agree with the leader — NOT
+            # our raw last_index: a longer stale suffix from a deposed
+            # leader would inflate the leader's next_index past its own
+            # log and stall replication forever (the leader would try to
+            # ship a snapshot it does not have)
+            ents = m["entries"]
+            match = (ents[-1][1] if ents else prev_idx)
             if m["commit"] > self.commit_index:
                 self.commit_index = min(m["commit"], match)
                 self._apply_cv.notify_all()
