@@ -22,17 +22,53 @@ class Agent:
                  http_port: int = 0,
                  heartbeat_ttl: float = 30.0,
                  acl_enabled: bool = False,
-                 nodes: Optional[List[Node]] = None) -> None:
+                 nodes: Optional[List[Node]] = None,
+                 server_name: str = "",
+                 bootstrap_expect: int = 1,
+                 join: Optional[List] = None,
+                 rpc_port: int = 0, raft_port: int = 0, serf_port: int = 0,
+                 data_dir: Optional[str] = None) -> None:
         if not server_enabled:
             raise NotImplementedError(
                 "client-only agents need a remote RPC transport; "
                 "in-process agents always embed the server")
-        self.server = Server(num_workers=num_workers, dev_mode=False,
-                             heartbeat_ttl=heartbeat_ttl,
-                             acl_enabled=acl_enabled)
+        cluster_mode = bool(server_name or join or bootstrap_expect > 1)
+        if cluster_mode:
+            # multi-server: raft-replicated state + gossip membership
+            # (reference: server { bootstrap_expect, server_join })
+            from .core.cluster import ClusterServer
+            import uuid
+            seeds = []
+            for s in (join or []):
+                if not isinstance(s, str):
+                    seeds.append((str(s[0]), int(s[1])))
+                    continue
+                host, sep, port = s.rpartition(":")
+                if not sep or not port.isdigit():
+                    raise ValueError(
+                        f"-join expects host:port, got {s!r}")
+                seeds.append((host, int(port)))
+            self.server = ClusterServer(
+                server_name or f"server-{uuid.uuid4().hex[:8]}",
+                rpc_port=rpc_port, raft_port=raft_port, serf_port=serf_port,
+                join=seeds, data_dir=data_dir,
+                bootstrap_expect=bootstrap_expect,
+                num_workers=num_workers, heartbeat_ttl=heartbeat_ttl,
+                acl_enabled=acl_enabled)
+        else:
+            self.server = Server(num_workers=num_workers, dev_mode=False,
+                                 heartbeat_ttl=heartbeat_ttl,
+                                 acl_enabled=acl_enabled)
         self.clients: List[Client] = []
         if client_enabled:
-            rpc = InProcessRPC(self.server)
+            if cluster_mode:
+                # in cluster mode the local server may be a follower (or
+                # mid-election): clients go through the TCP RPC, which
+                # forwards writes to the leader and retries transitions
+                from .core.cluster import RemoteRPC
+                rpc = RemoteRPC([self.server.rpc.addr])
+            else:
+                rpc = InProcessRPC(self.server)
             for i in range(num_clients):
                 node = nodes[i] if nodes and i < len(nodes) else None
                 self.clients.append(Client(rpc, node=node))

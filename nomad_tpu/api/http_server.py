@@ -903,7 +903,23 @@ class HTTPAPIServer:
                 except APIError as e:
                     self._respond(e.status, {"Error": str(e)})
                 except Exception as e:  # noqa: BLE001 - endpoint isolation
-                    self._respond(500, {"Error": f"{type(e).__name__}: {e}"})
+                    from nomad_tpu.core.raft import NotLeaderError
+                    if isinstance(e, NotLeaderError):
+                        # cluster mode: leadership in flux (normally the
+                        # server forwards writes itself; this surfaces
+                        # only when no leader is known).  Resolve the hint
+                        # to an RPC address if the server can.
+                        srv = router.agent.server
+                        addr = None
+                        if hasattr(srv, "leader_rpc_addr"):
+                            addr = srv.leader_rpc_addr()
+                        self._respond(500, {
+                            "Error": "rpc error: no cluster leader",
+                            "LeaderRPCAddr":
+                                f"{addr[0]}:{addr[1]}" if addr else ""})
+                    else:
+                        self._respond(
+                            500, {"Error": f"{type(e).__name__}: {e}"})
 
             def _chunked_loop(self, pull, cleanup) -> None:
                 """Shared chunked-streaming scaffold for the event and

@@ -85,9 +85,20 @@ def cmd_agent(args) -> int:
                   http_port=cfg.http_port,
                   heartbeat_ttl=cfg.heartbeat_ttl,
                   acl_enabled=cfg.acl_enabled,
-                  nodes=nodes)
+                  nodes=nodes,
+                  server_name=getattr(args, "server_name", ""),
+                  bootstrap_expect=getattr(args, "bootstrap_expect", 1),
+                  join=getattr(args, "join", []) or [],
+                  rpc_port=getattr(args, "rpc_port", 0),
+                  raft_port=getattr(args, "raft_port", 0),
+                  serf_port=getattr(args, "serf_port", 0),
+                  data_dir=getattr(args, "data_dir", "") or None)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address}")
+    srv = agent.server
+    if hasattr(srv, "gossip"):
+        print(f"==> cluster server {srv.name}: rpc={srv.rpc.addr} "
+              f"raft={srv.raft.addr} serf={srv.gossip.addr}")
     print(f"==> {len(agent.clients)} in-process client node(s)"
           + ("  [ACL enabled]" if cfg.acl_enabled else ""))
     stop = []
@@ -538,6 +549,17 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-bind", default="")
     ag.add_argument("-clients", type=int, default=None)
     ag.add_argument("-workers", type=int, default=None)
+    # multi-server cluster mode (reference: -server, -bootstrap-expect,
+    # -join / server_join)
+    ag.add_argument("-server-name", dest="server_name", default="")
+    ag.add_argument("-bootstrap-expect", dest="bootstrap_expect",
+                    type=int, default=1)
+    ag.add_argument("-join", action="append", default=[],
+                    help="host:port of an existing server's serf endpoint")
+    ag.add_argument("-rpc-port", dest="rpc_port", type=int, default=0)
+    ag.add_argument("-raft-port", dest="raft_port", type=int, default=0)
+    ag.add_argument("-serf-port", dest="serf_port", type=int, default=0)
+    ag.add_argument("-data-dir", dest="data_dir", default="")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(

@@ -75,12 +75,16 @@ FORWARDED = frozenset({
 
 
 class ReplicatedState:
-    """StateStore facade: mutations go through Raft, reads go local."""
+    """StateStore facade: mutations go through Raft, reads go local.
+    On a follower, a mutation is forwarded to the leader via the
+    `forward` callback (set by ClusterServer) — so HTTP/endpoint code can
+    run against any server, like the reference's RPC forwarding."""
 
     def __init__(self, local: StateStore,
                  raft: Optional[RaftNode] = None) -> None:
         self._local = local
         self.raft = raft
+        self.forward = None     # (method, args, kwargs) -> result
 
     def __getattr__(self, name):
         local_attr = getattr(self._local, name)
@@ -92,9 +96,15 @@ class ReplicatedState:
             raft = proxy.raft
             if raft is None:
                 return local_attr(*args, **kwargs)
-            cmd = pickle.dumps((name, args, kwargs),
-                               protocol=pickle.HIGHEST_PROTOCOL)
-            return raft.apply(cmd)
+            try:
+                cmd = pickle.dumps((name, args, kwargs),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+                return raft.apply(cmd)
+            except NotLeaderError:
+                if proxy.forward is None:
+                    raise
+                return proxy.forward("_state_mutation", (name,) + args,
+                                     kwargs)
 
         return replicated
 
@@ -142,7 +152,11 @@ class RPCServer:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
-                return
+                # transient (e.g. EMFILE) must not kill RPC serving
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+                continue
             if self._stop.is_set():
                 try:
                     conn.close()
@@ -167,6 +181,13 @@ class RPCServer:
             args = msg.get("args", ())
             kwargs = msg.get("kwargs", {})
             try:
+                if msg.get("fwd") and not self.cluster.is_leader():
+                    # one-hop rule: a forwarded request landing on another
+                    # non-leader bounces back instead of chaining hops
+                    reply(conn, {"ok": False, "not_leader": True,
+                                 "leader_rpc":
+                                     self.cluster.leader_rpc_addr()})
+                    return
                 result = self.cluster.rpc_call(method, args, kwargs)
                 reply(conn, {"ok": True, "result": result})
             except NotLeaderError as e:
@@ -187,7 +208,7 @@ class RemoteRPC:
         self._preferred = 0
 
     def call(self, method: str, *args, timeout: float = 35.0,
-             retries: int = 8, **kwargs):
+             retries: int = 20, **kwargs):
         last_err: Optional[str] = None
         for attempt in range(retries):
             order = (self.servers[self._preferred:]
@@ -212,9 +233,10 @@ class RemoteRPC:
                 raise RuntimeError(f"{r.get('error', 'rpc failed')} "
                                    f"(via {addr})")
             # no server answered / leadership in flux: back off and retry
-            # (reference: client/rpc.go retries through its server pool)
+            # (reference: client/rpc.go retries through its server pool;
+            # generous budget covers bootstrap waiting on quorum)
             if attempt < retries - 1:
-                time.sleep(min(0.25 * (attempt + 1), 1.0))
+                time.sleep(min(0.25 * (attempt + 1), 1.5))
         raise ConnectionError(f"no server available: {last_err}")
 
     # --- InProcessRPC surface ---
@@ -278,8 +300,12 @@ class ClusterServer(Server):
             bootstrap_expect=bootstrap_expect,
             **raft_kwargs)
         proxy.raft = self.raft
+        proxy.forward = self._forward
 
         self.rpc = RPCServer(self, (host, rpc_port))
+        # server-level endpoint methods forward to the leader when called
+        # on a follower (HTTP API / local CLI against any server)
+        self._wrap_forwarding()
         self.gossip = Gossip(
             name, (host, serf_port),
             meta={"raft": self.raft.addr, "rpc": self.rpc.addr},
@@ -351,8 +377,14 @@ class ClusterServer(Server):
         (one hop — the leader serves or raises its own NotLeader)."""
         if method in FORWARDED and not self.is_leader():
             return self._forward(method, args, kwargs)
-        if method in ("upsert_service_registrations",
-                      "delete_service_registrations_by_alloc"):
+        if method == "_state_mutation":
+            # forwarded raw state mutation from a follower's proxy
+            name, args = args[0], args[1:]
+            if name not in MUTATIONS:
+                raise AttributeError(f"unknown state mutation {name!r}")
+            target = getattr(self.state, name)
+        elif method in ("upsert_service_registrations",
+                        "delete_service_registrations_by_alloc"):
             target = getattr(self.state, method)
         elif hasattr(self, method):
             target = getattr(self, method)
@@ -364,12 +396,31 @@ class ClusterServer(Server):
             # lost leadership mid-call; let the client retry elsewhere
             raise
 
+    def _wrap_forwarding(self) -> None:
+        """Bind follower→leader forwarding onto every write endpoint
+        (reference: rpcHandler.forward): the HTTP layer and in-process
+        callers can then hit ANY server."""
+        for m in FORWARDED:
+            orig = getattr(self, m, None)
+            if orig is None or not callable(orig):
+                continue
+
+            def make(m=m, orig=orig):
+                def fwd(*a, **k):
+                    if not self.is_leader():
+                        return self._forward(m, a, k)
+                    return orig(*a, **k)
+                return fwd
+
+            setattr(self, m, make())
+
     def _forward(self, method: str, args, kwargs):
         addr = self.leader_rpc_addr()
         if addr is None:
             raise NotLeaderError(None)
         r = send_msg(tuple(addr), {"method": method, "args": args,
-                                   "kwargs": kwargs}, timeout=35.0)
+                                   "kwargs": kwargs, "fwd": True},
+                     timeout=35.0)
         if r is None:
             raise ConnectionError(f"leader {addr} unreachable")
         if r.get("ok"):

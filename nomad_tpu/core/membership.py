@@ -181,6 +181,17 @@ class Gossip:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
+                # transient (e.g. EMFILE) must not silence the member
+                # permanently — it would be declared dead while healthy
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+                continue
+            if self._stop.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
                 return
             threading.Thread(target=self._serve, daemon=True,
                              args=(conn,)).start()

@@ -255,7 +255,10 @@ class RaftNode:
         return self.role == LEADER
 
     def leader_hint(self) -> Optional[str]:
-        return self.leader_name if self.role != LEADER else self.name
+        if self.role == LEADER:
+            return self.name
+        # never advertise ourselves while not leading
+        return self.leader_name if self.leader_name != self.name else None
 
     # ------------------------------------------------------------- client
 
@@ -333,6 +336,11 @@ class RaftNode:
             self._persist_meta()
         if leader is not None:
             self.leader_name = leader
+        elif self.leader_name == self.name:
+            # stepping down with no successor known: clearing the stale
+            # self-hint matters — forwarding would otherwise loop back to
+            # this non-leader for the whole partition
+            self.leader_name = None
         if was_leader:
             for idx, waiter in list(self._waiters.items()):
                 if idx > self.commit_index:
@@ -502,7 +510,6 @@ class RaftNode:
                         ) -> Tuple[Optional[socket.socket], Optional[dict]]:
         """Send one framed message over the persistent peer connection,
         reconnecting once on failure.  Returns (socket, response)."""
-        import struct as _struct
         for attempt in range(2):
             if sock is None:
                 try:
@@ -510,9 +517,7 @@ class RaftNode:
                 except OSError:
                     return None, None
             try:
-                payload = pickle.dumps(msg,
-                                       protocol=pickle.HIGHEST_PROTOCOL)
-                sock.sendall(_struct.pack(">I", len(payload)) + payload)
+                reply(sock, msg)
                 r = recv_msg(sock, timeout=2.0)
                 if r is not None:
                     return sock, r
