@@ -27,7 +27,8 @@ class Agent:
                  bootstrap_expect: int = 1,
                  join: Optional[List] = None,
                  rpc_port: int = 0, raft_port: int = 0, serf_port: int = 0,
-                 data_dir: Optional[str] = None) -> None:
+                 data_dir: Optional[str] = None,
+                 plugin_dir: str = "") -> None:
         if not server_enabled:
             raise NotImplementedError(
                 "client-only agents need a remote RPC transport; "
@@ -71,7 +72,8 @@ class Agent:
                 rpc = InProcessRPC(self.server)
             for i in range(num_clients):
                 node = nodes[i] if nodes and i < len(nodes) else None
-                self.clients.append(Client(rpc, node=node))
+                self.clients.append(Client(rpc, node=node,
+                                           plugin_dir=plugin_dir))
         self.http = HTTPAPIServer(self, host=http_host, port=http_port)
         self._started_at = time.time()
 

@@ -92,7 +92,8 @@ def cmd_agent(args) -> int:
                   rpc_port=getattr(args, "rpc_port", 0),
                   raft_port=getattr(args, "raft_port", 0),
                   serf_port=getattr(args, "serf_port", 0),
-                  data_dir=getattr(args, "data_dir", "") or None)
+                  data_dir=getattr(args, "data_dir", "") or None,
+                  plugin_dir=getattr(args, "plugin_dir", ""))
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address}")
     srv = agent.server
@@ -560,6 +561,8 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-raft-port", dest="raft_port", type=int, default=0)
     ag.add_argument("-serf-port", dest="serf_port", type=int, default=0)
     ag.add_argument("-data-dir", dest="data_dir", default="")
+    ag.add_argument("-plugin-dir", dest="plugin_dir", default="",
+                    help="directory of external driver/device plugins")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(

@@ -63,11 +63,26 @@ class Client:
                  data_dir: str = "", drivers: Optional[Dict] = None,
                  heartbeat_interval: float = 10.0,
                  sync_interval: float = 0.2,
-                 devices=None) -> None:
+                 devices=None,
+                 plugin_dir: str = "") -> None:
         self.rpc = rpc
         self.data_dir = data_dir
         self.drivers = drivers if drivers is not None \
             else new_driver_registry()
+        # external plugins (reference: client plugin_dir): discovered
+        # driver plugins join the registry; device plugins extend the
+        # fingerprinted device groups
+        self.plugin_manager = None
+        if plugin_dir:
+            from nomad_tpu.plugins import PluginManager
+            self.plugin_manager = PluginManager(plugin_dir)
+            self.plugin_manager.scan()
+            self.plugin_manager.start_supervisor()
+            # the dispensed shims are stable objects (relaunch swaps the
+            # connection inside them), so copying refs here stays live
+            self.drivers.update(self.plugin_manager.drivers)
+            devices = list(devices or [])
+            devices.extend(self.plugin_manager.fingerprint_devices())
         self.node = node or Node()
         self.heartbeat_interval = heartbeat_interval
         self.sync_interval = sync_interval
@@ -111,6 +126,8 @@ class Client:
         # wait them out before closing the state db they write to
         self.wait_until_idle(timeout=10.0)
         self.state_db.close()
+        if self.plugin_manager is not None:
+            self.plugin_manager.shutdown()
 
     # ------------------------------------------------------------- loops
 

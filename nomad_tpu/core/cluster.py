@@ -204,7 +204,7 @@ class RemoteRPC:
     (reference: client/rpc.go + client/servers pool)."""
 
     def __init__(self, servers: List[Tuple[str, int]]) -> None:
-        self.servers = list(servers)
+        self.servers = [tuple(a) for a in servers]
         self._preferred = 0
 
     def call(self, method: str, *args, timeout: float = 35.0,
@@ -221,8 +221,12 @@ class RemoteRPC:
                     last_err = f"no response from {addr}"
                     continue
                 if r.get("ok"):
-                    self._preferred = \
-                        (self._preferred + i) % len(self.servers)
+                    # index of the addr that answered (the list may have
+                    # grown mid-iteration from leader hints)
+                    try:
+                        self._preferred = self.servers.index(tuple(addr))
+                    except ValueError:
+                        self._preferred = 0
                     return r.get("result")
                 if r.get("not_leader"):
                     hint = r.get("leader_rpc")
@@ -375,8 +379,8 @@ class ClusterServer(Server):
     def rpc_call(self, method: str, args, kwargs):
         """Dispatch one RPC.  Writes on a follower forward to the leader
         (one hop — the leader serves or raises its own NotLeader)."""
-        if method in FORWARDED and not self.is_leader():
-            return self._forward(method, args, kwargs)
+        # FORWARDED methods are wrapped by _wrap_forwarding, which does
+        # the is_leader/forward dance — no separate check here
         if method == "_state_mutation":
             # forwarded raw state mutation from a follower's proxy
             name, args = args[0], args[1:]

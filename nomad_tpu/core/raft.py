@@ -517,7 +517,13 @@ class RaftNode:
                 except OSError:
                     return None, None
             try:
-                reply(sock, msg)
+                # raising send (NOT reply(), which swallows OSError):
+                # a failed send must trigger the immediate reconnect
+                # below, not a silent 2s recv timeout on a request that
+                # never left
+                payload = pickle.dumps(msg,
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+                sock.sendall(struct.pack(">I", len(payload)) + payload)
                 r = recv_msg(sock, timeout=2.0)
                 if r is not None:
                     return sock, r

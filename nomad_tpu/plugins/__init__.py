@@ -1,0 +1,17 @@
+"""External plugin framework (reference: plugins/ + go-plugin)."""
+
+from .base import (  # noqa: F401
+    MAGIC_COOKIE_KEY,
+    MAGIC_COOKIE_VALUE,
+    PluginClient,
+    PluginError,
+    launch_plugin,
+    serve,
+)
+from .device import (  # noqa: F401
+    DevicePlugin,
+    ExternalDevicePlugin,
+    serve_device,
+)
+from .driver import ExternalDriver, serve_driver  # noqa: F401
+from .manager import PluginManager  # noqa: F401
