@@ -35,7 +35,8 @@ class AllocRunner:
                  on_update: Optional[Callable] = None,
                  checks_healthy: Optional[Callable] = None,
                  restore_handles: Optional[Dict] = None,
-                 on_handle: Optional[Callable] = None) -> None:
+                 on_handle: Optional[Callable] = None,
+                 device_reserver: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.node = node
         self.drivers = drivers
@@ -44,6 +45,7 @@ class AllocRunner:
         self.checks_healthy = checks_healthy
         self.restore_handles = restore_handles or {}
         self._persist_handle = on_handle
+        self.device_reserver = device_reserver
         self.task_runners: List[TaskRunner] = []
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -84,7 +86,8 @@ class AllocRunner:
                 self.alloc, task, driver, self.node, task_dir=tdir,
                 is_batch=is_batch, on_state_change=self._on_task_change,
                 restore_handle=self.restore_handles.get(task.name),
-                on_handle=self._on_task_handle))
+                on_handle=self._on_task_handle,
+                device_reserver=self.device_reserver))
 
     # ------------------------------------------------------------ status
 

@@ -170,7 +170,10 @@ class Client:
                                  checks_healthy=self.services.checks_healthy,
                                  restore_handles=self.state_db
                                  .get_task_handles(alloc.id),
-                                 on_handle=self.state_db.put_task_handle)
+                                 on_handle=self.state_db.put_task_handle,
+                                 device_reserver=(
+                                     self.plugin_manager.reserve
+                                     if self.plugin_manager else None))
                 with self._lock:
                     self.alloc_runners[alloc.id] = ar
                     self.state_db.put_allocation(alloc)

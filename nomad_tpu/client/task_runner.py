@@ -112,7 +112,8 @@ class TaskRunner:
                  on_state_change: Optional[Callable] = None,
                  update_interval: float = 0.0,
                  restore_handle: Optional[TaskHandle] = None,
-                 on_handle: Optional[Callable] = None) -> None:
+                 on_handle: Optional[Callable] = None,
+                 device_reserver: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -126,6 +127,7 @@ class TaskRunner:
         # starting a fresh task (reference: task runner handle reattach)
         self.restore_handle = restore_handle
         self.on_handle = on_handle
+        self.device_reserver = device_reserver
         self.handle: Optional[TaskHandle] = None
         self.env: Dict[str, str] = {}
         self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
@@ -184,6 +186,12 @@ class TaskRunner:
                 os.makedirs(self.task_dir, exist_ok=True)
             self.env = build_task_env(self.alloc, self.task, self.node,
                                       self.task_dir)
+            if self.device_reserver and self.alloc.allocated_devices:
+                # device plugin reserve(): plugin-specific env (e.g.
+                # ACME_VISIBLE_DEVICES) layered over the generic
+                # NOMAD_DEVICE_* exposure (reference: device_hook.go)
+                self.env.update(self.device_reserver(
+                    self.alloc.allocated_devices, self.task.name))
             self._event(TASK_SETUP)
             for hook in self.hooks:
                 hook.prestart(self)

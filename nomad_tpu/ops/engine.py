@@ -292,9 +292,17 @@ class PlacementEngine:
               bulk_api: bool = False,
               seed: int = 0,
               device_in_use=None,
+              block=None,
               ):
         """Score + select nodes for `requests` (placements of `tgs`).
         Returns one decision per request, in order.
+
+        `block`: compact alternative to `requests` — a (tg_name, count)
+        pair describing `count` fresh placements of one task group with
+        no per-placement state (reconcile.PlaceBlock).  The bulk kernel
+        needs nothing more; if the job shape forces the exact scan
+        (spread/distinct/devices), equivalent per-placement requests are
+        synthesized here.
 
         `stopped_allocs`: allocs the in-flight plan is stopping/evicting —
         their usage (and job-count, for this job) is subtracted before
@@ -306,12 +314,18 @@ class PlacementEngine:
         it concurrent workers pick identical nodes and the plan applier
         refutes all but the first (see select._tiebreak_noise).
         """
-        if not requests:
+        if block is not None:
+            block_tg, block_count = block
+            if block_count <= 0:
+                return []
+        elif not requests:
             return []
         t0 = time.perf_counter_ns()
         t = tensors if tensors is not None else self.packer.update(snapshot)
         n = t.n
         if n == 0:
+            if block is not None:
+                requests = [PlacementRequest(tg_name=block_tg)] * block_count
             return [self._no_nodes_decision(r, snapshot, job) for r in requests]
 
         tg_tensors: TGTensors = self.packer.lower_task_groups(
@@ -319,7 +333,7 @@ class PlacementEngine:
         ctx: JobContext = self.packer.job_context(job, snapshot, t)
 
         name_to_g = {name: i for i, name in enumerate(tg_tensors.names)}
-        p_real = len(requests)
+        p_real = block_count if block is not None else len(requests)
         p_pad = _pad_pow2(p_real)
 
         desired = np.array([tg.count for tg in tgs], np.int32)
@@ -365,21 +379,32 @@ class PlacementEngine:
 
         has_spread = bool(job.spreads) or any(tg.spreads for tg in tgs)
         has_distinct = any(tg_tensors.distinct)
-        bulk_ok = (
-            p_real >= BULK_THRESHOLD
-            and len({r.tg_name for r in requests}) == 1
-            and not has_spread and not has_distinct
-            # device asks cap per-node intake by discrete instance counts,
-            # which the water-fill rounds can't see — exact scan only
-            and dev_mask is None
-            and all(not r.prev_node_id for r in requests))
+        if block is not None:
+            bulk_ok = (p_real >= BULK_THRESHOLD
+                       and not has_spread and not has_distinct
+                       and dev_mask is None)
+            if not bulk_ok or not bulk_api:
+                # rare fallback: the exact scan / per-placement decision
+                # paths need request rows
+                requests = [PlacementRequest(tg_name=block_tg)] * p_real
+        else:
+            bulk_ok = (
+                p_real >= BULK_THRESHOLD
+                and len({r.tg_name for r in requests}) == 1
+                and not has_spread and not has_distinct
+                # device asks cap per-node intake by discrete instance
+                # counts, which the water-fill rounds can't see — exact
+                # scan only
+                and dev_mask is None
+                and all(not r.prev_node_id for r in requests))
 
         # ONE packed device->host transfer: the chip sits behind a network
         # transport with a large fixed cost per array fetch, so the kernels
         # bitcast every output into a single int32 buffer.  used/job_count
         # stay on device, fetched only on the preemption fallback path.
         if bulk_ok:
-            g_idx = name_to_g[requests[0].tg_name]
+            g_idx = name_to_g[block_tg if block is not None
+                              else requests[0].tg_name]
             round_size = min(BULK_ROUND, p_pad)
             n_rounds = p_pad // round_size
             binp = BulkInputs(
@@ -404,7 +429,8 @@ class PlacementEngine:
                 picks, _, meta = _unpack_bulk_compact(
                     np.asarray(buf), round_size, p_real)
                 return self._bulk_decisions(
-                    requests[0].tg_name, picks, meta, round_size, t, ctx,
+                    block_tg if block is not None else requests[0].tg_name,
+                    picks, meta, round_size, t, ctx,
                     snapshot, job, binp, tg_tensors, tg_idx, used_dev,
                     job_count_dev, p_real, n, t0)
             (picks, scores, topk_rows, topk_scores,

@@ -218,6 +218,9 @@ class ClusterPacker:
         alloc_node = self._alloc_node
         counted = self._counted
         id_to_row = t.id_to_row
+        # bulk plans share ONE resources object across a whole round:
+        # build its usage tuple once, not per alloc
+        res_cache: Dict[int, Tuple[int, int, int]] = {}
         for a in allocs:
             aid = a.id
             old_node = alloc_node.get(aid)
@@ -232,7 +235,9 @@ class ClusterPacker:
             nid = a.node_id
             if nid and not a.terminal_status():
                 r = a.resources
-                res = (r.cpu, r.memory_mb, r.disk_mb)
+                res = res_cache.get(id(r))
+                if res is None:
+                    res_cache[id(r)] = res = (r.cpu, r.memory_mb, r.disk_mb)
                 c = counted.get(nid)
                 if c is None:
                     counted[nid] = c = {}

@@ -86,8 +86,14 @@ class PluginClient:
                                         name=f"plugin-{info.get('name')}")
         self._reader.start()
 
-    def call(self, method: str, timeout: Optional[float] = None,
+    _DEFAULT_TIMEOUT = 60.0
+
+    def call(self, method: str, timeout: Any = "__default__",
              **params) -> Any:
+        """`timeout=None` blocks until the plugin answers (wait_task on a
+        long-running task); omitted -> 60s."""
+        if timeout == "__default__":
+            timeout = self._DEFAULT_TIMEOUT
         with self._lock:
             if self._closed:
                 raise PluginError("plugin connection closed")
@@ -107,7 +113,7 @@ class PluginClient:
             with self._lock:
                 self._pending.pop(rid, None)
             raise PluginError(f"plugin send failed: {e}") from e
-        if not waiter[0].wait(timeout if timeout is not None else 60.0):
+        if not waiter[0].wait(timeout):
             with self._lock:
                 self._pending.pop(rid, None)
             raise PluginError(f"plugin call {method} timed out")

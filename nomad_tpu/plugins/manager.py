@@ -35,6 +35,7 @@ class PluginManager:
         self._clients: Dict[str, PluginClient] = {}
         self.drivers: Dict[str, ExternalDriver] = {}
         self.devices: Dict[str, ExternalDevicePlugin] = {}
+        self._group_plugin: Dict[str, str] = {}    # group id -> plugin name
         self._stop = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
 
@@ -59,29 +60,46 @@ class PluginManager:
     # ------------------------------------------------------------ discovery
 
     def scan(self) -> None:
-        """Discover + launch plugins (idempotent; relaunches dead ones)."""
+        """Discover + launch plugins (idempotent; relaunches dead ones,
+        drops plugins whose files were removed, launches in parallel so
+        one slow plugin doesn't serialize client startup)."""
         if not os.path.isdir(self.plugin_dir):
             return
+        cmds: Dict[str, List[str]] = {}
         for entry in sorted(os.listdir(self.plugin_dir)):
             path = os.path.join(self.plugin_dir, entry)
             if not os.path.isfile(path):
                 continue
             if entry.endswith(".py"):
-                cmd = [sys.executable, path]
+                cmds[path] = [sys.executable, path]
             elif os.access(path, os.X_OK):
-                cmd = [path]
-            else:
-                continue
-            self._cmds[path] = cmd
+                cmds[path] = [path]
+        to_launch = []
         with self._lock:
-            for path, cmd in list(self._cmds.items()):
+            # prune plugins whose files disappeared (drop their shims)
+            for path in list(self._clients):
+                if path not in cmds:
+                    self._forget(path, self._clients[path])
+            self._cmds = cmds
+            for path, cmd in cmds.items():
                 client = self._clients.get(path)
                 if client is not None and client.alive():
                     continue
                 if client is not None:
                     # keep the dispensed shim: _launch swaps its client
                     self._forget(path, client, drop_dispensed=False)
-                self._launch(path, cmd)
+                to_launch.append((path, cmd))
+        if not to_launch:
+            return
+        if len(to_launch) == 1:
+            self._launch(*to_launch[0])
+            return
+        threads = [threading.Thread(target=self._launch, args=(p, c),
+                                    daemon=True) for p, c in to_launch]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     def _launch(self, path: str, cmd: List[str]) -> None:
         client = None
@@ -95,6 +113,10 @@ class PluginManager:
         if client is None:
             return
         info = client.info
+        with self._lock:
+            self._register(path, client, info)
+
+    def _register(self, path: str, client: PluginClient, info) -> None:
         self._clients[path] = client
         name = info.get("name", path)
         if info.get("type") == "driver":
@@ -136,15 +158,43 @@ class PluginManager:
     # ------------------------------------------------------------- queries
 
     def fingerprint_devices(self):
-        """All device groups reported by live device plugins."""
+        """All device groups reported by live device plugins; records
+        which plugin owns each group id for reserve() routing."""
         groups = []
         for p in list(self.devices.values()):
             try:
-                groups.extend(p.fingerprint())
+                mine = p.fingerprint()
             except Exception as e:  # noqa: BLE001 - a dead plugin is not fatal
                 log("plugins", "warn", "device fingerprint failed",
                     plugin=p.name, error=str(e))
+                continue
+            for g in mine:
+                self._group_plugin[g.id()] = p.name
+            groups.extend(mine)
         return groups
+
+    def reserve(self, allocated_devices, task_name: str = ""):
+        """Map assigned device instances onto env vars via the owning
+        device plugin's reserve() (reference: device_hook.go calling
+        DevicePlugin.Reserve).  Returns merged env vars; plugin failures
+        degrade to the generic NOMAD_DEVICE_* exposure."""
+        envs: Dict[str, str] = {}
+        for ad in allocated_devices or ():
+            if task_name and ad.task and ad.task != task_name:
+                continue
+            pname = self._group_plugin.get(ad.group_id())
+            plug = self.devices.get(pname) if pname else None
+            if plug is None:
+                continue
+            try:
+                r = plug.reserve(ad.device_ids) or {}
+            except Exception as e:  # noqa: BLE001
+                log("plugins", "warn", "device reserve failed",
+                    plugin=pname, error=str(e))
+                continue
+            for k, v in (r.get("envs") or {}).items():
+                envs[str(k)] = str(v)
+        return envs
 
     def shutdown(self) -> None:
         self._stop.set()

@@ -181,9 +181,23 @@ class GenericScheduler(Scheduler):
 
         # ---- placements: one batched device call for the whole eval ----
         all_places = results.place + destructive_places
+        blocks = results.place_blocks
+        if blocks and (all_places or len(blocks) > 1):
+            # mixed placement kinds in one eval: expand the compact blocks
+            # so capacity stays coupled in a SINGLE engine call (two calls
+            # would each see only state usage, not each other's picks)
+            from .reconcile import _name
+            for b in blocks:
+                all_places.extend(
+                    RPlace(tg=b.tg, name=_name(job, b.tg, ix), index=ix)
+                    for ix in b.indexes)
+            blocks = []
         if all_places and job is not None:
             self._compute_placements(plan, job, all_places, evaluation,
                                      results)
+        elif blocks and job is not None:
+            self._compute_placements_block(plan, job, blocks[0],
+                                           evaluation, results)
 
         plan.deployment = results.deployment
         plan.deployment_updates = results.deployment_updates
@@ -225,7 +239,18 @@ class GenericScheduler(Scheduler):
             self._materialize_bulk(plan, job, places, decisions,
                                    evaluation, results)
             return
+        self._materialize_decisions(plan, job, places, reqs, decisions,
+                                    evaluation, results, stopped)
 
+    def _materialize_decisions(self, plan: Plan, job: Job,
+                               places: List[RPlace], reqs,
+                               decisions, evaluation: Evaluation,
+                               results: ReconcileResults,
+                               stopped) -> None:
+        """Per-decision alloc construction (ports, devices, reschedule
+        trackers) — the tail of `_compute_placements`, shared with the
+        block fallback path."""
+        tgs = job.task_groups
         # concrete device-instance assignment for groups that ask for
         # devices (reference: scheduler/device.go AllocateDevice); may
         # re-place a subset when a node's instances run out mid-plan
@@ -323,6 +348,33 @@ class GenericScheduler(Scheduler):
                     alloc.desired_description = ALLOC_RESCHEDULED
             plan.append_alloc(alloc)
 
+    def _compute_placements_block(self, plan: Plan, job: Job, block,
+                                  evaluation: Evaluation,
+                                  results: ReconcileResults) -> None:
+        """Compact twin of `_compute_placements` for one PlaceBlock: no
+        per-placement request objects anywhere — the engine gets
+        (task group, count) and the bulk decisions materialize with names
+        derived from the block's index list."""
+        stopped = [a for allocs in plan.node_update.values() for a in allocs]
+        decisions = self.engine.place(
+            self.state, job, job.task_groups, None,
+            stopped_allocs=stopped, bulk_api=True,
+            seed=getattr(self, "_seed", 0),
+            block=(block.tg.name, len(block.indexes)))
+        if isinstance(decisions, BulkDecisions):
+            self._materialize_bulk(plan, job, None, decisions,
+                                   evaluation, results, block=block)
+            return
+        # engine fell back (spread/devices/small count): expand and run
+        # the general path with the decisions it already computed
+        from .reconcile import _name
+        places = [RPlace(tg=block.tg, name=_name(job, block.tg, ix),
+                         index=ix) for ix in block.indexes]
+        from nomad_tpu.ops import PlacementRequest
+        reqs = [PlacementRequest(tg_name=block.tg.name)] * len(places)
+        self._materialize_decisions(plan, job, places, reqs, decisions,
+                                    evaluation, results, stopped)
+
     def _assign_devices(self, job, tgs, places, reqs, decisions, stopped):
         """Pick concrete device instances for every placement whose task
         group requests devices (reference: scheduler/device.go
@@ -401,15 +453,17 @@ class GenericScheduler(Scheduler):
         return dev_assign
 
     def _materialize_bulk(self, plan: Plan, job: Job,
-                          places: List[RPlace], bd,
+                          places: Optional[List[RPlace]], bd,
                           evaluation: Evaluation,
-                          results: ReconcileResults) -> None:
+                          results: ReconcileResults,
+                          block=None) -> None:
         """Materialize allocations straight from a BulkDecisions array —
         the per-placement twin loop of `_compute_placements`, with every
         per-alloc object cost stripped: template-dict clones, batched ids,
         a shared per-round AllocMetric, and a shared resources object when
-        the group asks for no ports."""
-        tg = places[0].tg
+        the group asks for no ports.  With `block` (compact path) names
+        come straight from the index list — no RPlace objects exist."""
+        tg = block.tg if block is not None else places[0].tg
         ask = tg.combined_resources()
         has_net = bool(ask.networks)
         tmpl = Allocation(
@@ -428,7 +482,8 @@ class GenericScheduler(Scheduler):
         if results.deployment is not None:
             tmpl.deployment_id = results.deployment.id
         tmpl_d = tmpl.__dict__
-        ids = new_ids(len(places))
+        count = len(block.indexes) if block is not None else len(places)
+        ids = new_ids(count)
         picks_l = bd.picks.tolist()
         node_ids = bd.node_ids
         metrics = bd.metrics
@@ -438,8 +493,48 @@ class GenericScheduler(Scheduler):
         net_idx: Dict[str, NetworkIndex] = {}
         last_nid = None
         last_list = None
+        if block is not None:
+            prefix = f"{job.id}.{tg.name}["     # matches reconcile._name
+            indexes = block.indexes
 
-        for i, p in enumerate(places):
+        if (block is not None and not has_net and not bd.evictions
+                and results.deployment is None):
+            # hottest shape (the bench/batch pattern): fresh block, no
+            # ports, no preemptions — a minimal clone loop, iterated per
+            # round so the shared metric and failure accounting hoist out
+            alloc_new = Allocation.__new__
+            tg_name = tg.name
+            i = 0
+            for m in metrics:
+                end = min(i + rs, count)
+                while i < end:
+                    pick = picks_l[i]
+                    if pick < 0:
+                        self._record_failure_shared(tg_name, m)
+                        i += 1
+                        continue
+                    nid = node_ids[pick]
+                    alloc = alloc_new(Allocation)
+                    d2 = dict(tmpl_d)
+                    alloc.__dict__ = d2
+                    d2["id"] = ids[i]
+                    d2["name"] = prefix + str(indexes[i]) + "]"
+                    d2["node_id"] = nid
+                    d2["metrics"] = m
+                    d2["task_states"] = {}
+                    if nid is last_nid:
+                        last_list.append(alloc)
+                    else:
+                        last_nid = nid
+                        last_list = node_alloc.get(nid)
+                        if last_list is None:
+                            node_alloc[nid] = last_list = []
+                        last_list.append(alloc)
+                    i += 1
+            return
+
+        for i in range(count):
+            p = places[i] if block is None else None
             pick = picks_l[i]
             m = metrics[i // rs]
             if pick < 0:
@@ -450,7 +545,8 @@ class GenericScheduler(Scheduler):
             d2 = dict(tmpl_d)
             alloc.__dict__ = d2
             d2["id"] = ids[i]
-            d2["name"] = p.name
+            d2["name"] = (prefix + str(indexes[i]) + "]"
+                          if block is not None else p.name)
             d2["node_id"] = nid
             d2["metrics"] = m
             d2["task_states"] = {}
@@ -482,11 +578,11 @@ class GenericScheduler(Scheduler):
                 for victim in ev:
                     plan.append_preempted_alloc(victim, alloc.id)
                 d2["preempted_allocations"] = [v.id for v in ev]
-            if p.canary and results.deployment is not None:
+            if p is not None and p.canary and results.deployment is not None:
                 dstate = results.deployment.task_groups.get(tg.name)
                 if dstate is not None:
                     dstate.placed_canaries.append(alloc.id)
-            if p.previous_alloc is not None:
+            if p is not None and p.previous_alloc is not None:
                 d2["previous_allocation"] = p.previous_alloc.id
                 if p.reschedule:
                     from .util import append_reschedule_tracker

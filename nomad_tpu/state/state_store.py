@@ -424,9 +424,20 @@ class StateStore:
             # Released when the alloc goes terminal.  Changed volumes
             # accumulate and merge ONCE, not per alloc.
             changed_vols: Dict[Tuple[str, str], CSIVolume] = {}
+            # hoist the volumes-exist check per (job, group) — a 100k-alloc
+            # plan of a volumeless group must not pay a tg lookup per alloc
+            vol_tg: Dict[Tuple[int, str], bool] = {}
             for node_allocs in result.node_allocation.values():
                 for a in node_allocs:
-                    self._claim_csi_volumes_locked(a, changed_vols)
+                    key = (id(a.job), a.task_group)
+                    has = vol_tg.get(key)
+                    if has is None:
+                        tg = a.job.lookup_task_group(a.task_group) \
+                            if a.job else None
+                        has = bool(tg is not None and tg.volumes)
+                        vol_tg[key] = has
+                    if has:
+                        self._claim_csi_volumes_locked(a, changed_vols)
             if changed_vols:
                 self._csi_volumes = {**self._csi_volumes, **changed_vols}
             if result.deployment is not None:

@@ -154,6 +154,8 @@ class TestClientIntegration:
             t = job.task_groups[0].tasks[0]
             t.driver = "hello"
             t.config = {"message": "external", "run_for_s": 60}
+            from nomad_tpu.structs import RequestedDevice
+            t.resources.devices = [RequestedDevice(name="gpu", count=1)]
             srv.register_job(job)
             deadline = time.time() + 20
             runner = None
@@ -168,6 +170,13 @@ class TestClientIntegration:
             tr = runner.task_runners[0]
             assert tr.handle.driver == "hello"
             assert tr.handle.pid > 0
+            # device plugin reserve() mapped the assigned instance into
+            # the task env (plus the generic NOMAD_DEVICE_* exposure)
+            alloc = runner.alloc
+            assert alloc.allocated_devices
+            iid = alloc.allocated_devices[0].device_ids[0]
+            assert tr.env["ACME_VISIBLE_DEVICES"] == iid
+            assert tr.env["NOMAD_DEVICE_ACME_GPU_FAKE100"] == iid
         finally:
             cl.shutdown()
             srv.shutdown()
