@@ -26,4 +26,4 @@ from .policy import (  # noqa: F401
     Policy,
     parse_policy,
 )
-from .acl import ACL, compile_acl, management_acl  # noqa: F401
+from .acl import ACL, compile_acl, management_acl, workload_acl  # noqa: F401

@@ -24,6 +24,10 @@ class ACL:
         self.agent = ""
         self.operator = ""
         self.quota = ""
+        # workload identity: read-only variable access restricted to
+        # these (namespace, path-prefix) pairs (reference: the implicit
+        # workload-identity policy over nomad/jobs/<job_id>)
+        self.var_prefixes: Optional[List[tuple]] = None
 
     # --------------------------------------------------------- namespaces
 
@@ -51,6 +55,22 @@ class ACL:
             return True
         caps = self._ns_caps(ns)
         return bool(caps) and CAP_DENY not in caps
+
+    def allow_variable(self, ns: str, path: str, write: bool) -> bool:
+        """Path-aware variable check for ONE exact path: path-restricted
+        ACLs (workload identities) may only READ at/under their prefixes;
+        everything else falls back to the namespace capability.  List
+        endpoints filter each candidate through this."""
+        if self.management:
+            return True
+        if self.var_prefixes is not None:
+            if write:
+                return False
+            return any(ns == pns
+                       and (path == pre or path.startswith(pre + "/"))
+                       for pns, pre in self.var_prefixes)
+        cap = "variables-write" if write else "variables-read"
+        return self.allow_namespace_operation(ns, cap)
 
     # ------------------------------------------------------------- coarse
 
@@ -110,3 +130,13 @@ def compile_acl(policies: Iterable[Policy]) -> ACL:
 
 def management_acl() -> ACL:
     return ACL(management=True)
+
+
+def workload_acl(namespace: str, var_prefix: str) -> ACL:
+    """The implicit workload-identity policy: read/list variables at and
+    under `var_prefix` in `namespace`, nothing else (reference: the
+    auto-generated workload identity policy)."""
+    acl = ACL()
+    acl._ns[namespace] = {"variables-read", "variables-list", "read-job"}
+    acl.var_prefixes = [(namespace, var_prefix)]
+    return acl

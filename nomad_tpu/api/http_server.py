@@ -415,9 +415,11 @@ class Router:
             if method == "GET":
                 prefix = (qs.get("prefix") or [""])[0]
                 return [codec.encode(v)
-                        for v in s.state.variables(ns, prefix)]
+                        for v in s.state.variables(ns, prefix)
+                        if acl is None
+                        or acl.allow_variable(ns, v.path, write=False)]
         elif head == "var":
-            return self._var(method, p[1:], ns, body)
+            return self._var(method, p[1:], ns, body, acl)
         elif head == "system":
             if p[1:2] == ["gc"] and method in ("PUT", "POST"):
                 s.force_gc()
@@ -709,12 +711,17 @@ class Router:
         raise APIError(404, "bad node pool request")
 
     def _var(self, method: str, p: List[str], ns: str,
-             body: Optional[Dict]) -> Any:
+             body: Optional[Dict], acl=None) -> Any:
         from nomad_tpu.structs import VariableItem
         s = self.server
         path = "/".join(p)
         if not path:
             raise APIError(400, "variable path required")
+        # path-level enforcement: workload identities only read their own
+        # job's subtree (reference: the implicit workload policy)
+        if acl is not None and not acl.allow_variable(
+                ns, path, write=method != "GET"):
+            raise APIError(403, f"permission denied for variable {path!r}")
         if method == "GET":
             v = s.state.variable_by_path(ns, path)
             if v is None:

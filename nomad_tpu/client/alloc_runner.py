@@ -36,7 +36,8 @@ class AllocRunner:
                  checks_healthy: Optional[Callable] = None,
                  restore_handles: Optional[Dict] = None,
                  on_handle: Optional[Callable] = None,
-                 device_reserver: Optional[Callable] = None) -> None:
+                 device_reserver: Optional[Callable] = None,
+                 identity_fetcher: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.node = node
         self.drivers = drivers
@@ -46,6 +47,7 @@ class AllocRunner:
         self.restore_handles = restore_handles or {}
         self._persist_handle = on_handle
         self.device_reserver = device_reserver
+        self.identity_fetcher = identity_fetcher
         self.task_runners: List[TaskRunner] = []
         self._lock = threading.Lock()
         self._done = threading.Event()
@@ -87,7 +89,8 @@ class AllocRunner:
                 is_batch=is_batch, on_state_change=self._on_task_change,
                 restore_handle=self.restore_handles.get(task.name),
                 on_handle=self._on_task_handle,
-                device_reserver=self.device_reserver))
+                device_reserver=self.device_reserver,
+                identity_fetcher=self.identity_fetcher))
 
     # ------------------------------------------------------------ status
 

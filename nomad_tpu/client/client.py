@@ -57,6 +57,12 @@ class InProcessRPC:
     def remove_service_registrations(self, alloc_id: str) -> None:
         self.server.state.delete_service_registrations_by_alloc(alloc_id)
 
+    def derive_identity_tokens(self, alloc_id: str):
+        tokens, err = self.server.derive_identity_tokens(alloc_id)
+        if err:
+            return {}
+        return tokens
+
 
 class Client:
     def __init__(self, rpc, node: Optional[Node] = None,
@@ -173,7 +179,10 @@ class Client:
                                  on_handle=self.state_db.put_task_handle,
                                  device_reserver=(
                                      self.plugin_manager.reserve
-                                     if self.plugin_manager else None))
+                                     if self.plugin_manager else None),
+                                 identity_fetcher=getattr(
+                                     self.rpc, "derive_identity_tokens",
+                                     None))
                 with self._lock:
                     self.alloc_runners[alloc.id] = ar
                     self.state_db.put_allocation(alloc)

@@ -113,7 +113,8 @@ class TaskRunner:
                  update_interval: float = 0.0,
                  restore_handle: Optional[TaskHandle] = None,
                  on_handle: Optional[Callable] = None,
-                 device_reserver: Optional[Callable] = None) -> None:
+                 device_reserver: Optional[Callable] = None,
+                 identity_fetcher: Optional[Callable] = None) -> None:
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -128,6 +129,7 @@ class TaskRunner:
         self.restore_handle = restore_handle
         self.on_handle = on_handle
         self.device_reserver = device_reserver
+        self.identity_fetcher = identity_fetcher
         self.handle: Optional[TaskHandle] = None
         self.env: Dict[str, str] = {}
         self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
@@ -186,6 +188,17 @@ class TaskRunner:
                 os.makedirs(self.task_dir, exist_ok=True)
             self.env = build_task_env(self.alloc, self.task, self.node,
                                       self.task_dir)
+            if self.identity_fetcher is not None:
+                # workload identity (reference: identity_hook.go): the
+                # task's signed identity rides NOMAD_TOKEN; failures
+                # degrade to no token, never a dead task
+                try:
+                    tok = self.identity_fetcher(
+                        self.alloc.id).get(self.task.name)
+                    if tok:
+                        self.env["NOMAD_TOKEN"] = tok
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
             if self.device_reserver and self.alloc.allocated_devices:
                 # device plugin reserve(): plugin-specific env (e.g.
                 # ACME_VISIBLE_DEVICES) layered over the generic

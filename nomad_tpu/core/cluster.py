@@ -53,7 +53,7 @@ MUTATIONS = frozenset({
     "update_alloc_desired_transition",
     "upsert_deployment", "delete_deployment", "upsert_plan_results",
     "upsert_csi_volume", "delete_csi_volume",
-    "set_scheduler_config",
+    "set_scheduler_config", "set_identity_secret",
     "upsert_namespace", "delete_namespace",
     "upsert_node_pool", "delete_node_pool",
     "upsert_acl_policy", "delete_acl_policy",
@@ -267,6 +267,10 @@ class RemoteRPC:
 
     def remove_service_registrations(self, alloc_id: str) -> None:
         self.call("delete_service_registrations_by_alloc", alloc_id)
+
+    def derive_identity_tokens(self, alloc_id: str):
+        tokens, err = self.call("derive_identity_tokens", alloc_id)
+        return {} if err else tokens
 
 
 class ClusterServer(Server):

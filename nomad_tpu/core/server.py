@@ -91,6 +91,10 @@ class Server:
         queue, blocked evals; restore pending evals from state."""
         self._leader = True
         log("server", "info", "leadership established")
+        # workload-identity signing secret: minted once per cluster
+        # (first-writer-wins in the store; replicated + snapshotted)
+        if not self.state.identity_secret():
+            self.state.set_identity_secret(new_id() + new_id())
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.plan_queue.set_enabled(True)
@@ -240,10 +244,53 @@ class Server:
             return None, "ACL bootstrap already done"
         return token, ""
 
+    def derive_identity_tokens(self, alloc_id: str):
+        """Mint one workload identity per task of a live alloc
+        (reference: Alloc.SignIdentities RPC / identity_hook).
+        Returns ({task_name: token}, error)."""
+        from .identity import mint
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None, "alloc not found"
+        if alloc.terminal_status():
+            return None, "alloc is terminal"
+        secret = self.state.identity_secret()
+        if not secret:
+            return None, "identity keyring not initialized"
+        job = alloc.job or self.state.job_by_id(alloc.namespace,
+                                                alloc.job_id)
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        tasks = [t.name for t in tg.tasks] if tg else []
+        return {t: mint(secret, namespace=alloc.namespace,
+                        job_id=alloc.job_id, alloc_id=alloc_id, task=t)
+                for t in tasks}, ""
+
     def resolve_token(self, secret_id: str):
         """secret -> compiled ACL; (None, error) when unknown
-        (reference: Server.ResolveToken + its ACL cache)."""
+        (reference: Server.ResolveToken + its ACL cache).  Workload
+        identity tokens resolve to the implicit read-only policy over
+        the job's variable subtree."""
         from nomad_tpu.acl import compile_acl, management_acl, parse_policy
+        from .identity import IDENTITY_PREFIX, variable_prefix, verify
+        if secret_id.startswith(IDENTITY_PREFIX):
+            secret = self.state.identity_secret()
+            if not secret:
+                # NEVER verify against a fallback value — an empty
+                # keyring means no identity can possibly be valid
+                return None, "identity keyring not initialized"
+            claims = verify(secret, secret_id)
+            if claims is None:
+                return None, "invalid workload identity"
+            ns = claims.get("nomad_namespace")
+            job_id = claims.get("nomad_job_id")
+            if not ns or not job_id:
+                return None, "invalid workload identity claims"
+            alloc = self.state.alloc_by_id(
+                claims.get("nomad_allocation_id", ""))
+            if alloc is None or alloc.terminal_status():
+                return None, "workload identity alloc not active"
+            from nomad_tpu.acl import workload_acl
+            return workload_acl(ns, variable_prefix(job_id)), ""
         if not self.acl_enabled:
             return management_acl(), ""
         if not secret_id:

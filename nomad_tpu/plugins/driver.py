@@ -100,7 +100,9 @@ class ExternalDriver(Driver):
         self.client = client
         self.name = client.info.get("name", "external")
 
-    def _call(self, method: str, timeout: Optional[float] = None, **params):
+    def _call(self, method: str, timeout="__default__", **params):
+        """timeout omitted -> the client's 60s default; timeout=None ->
+        block until the plugin answers (wait_task only)."""
         try:
             return self.client.call(method, timeout=timeout, **params)
         except Exception as e:  # noqa: BLE001 - uniform driver errors

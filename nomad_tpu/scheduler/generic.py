@@ -35,7 +35,7 @@ from nomad_tpu.structs import (
 
 from .base import Planner, Scheduler
 from .reconcile import PlaceRequest as RPlace
-from .reconcile import ReconcileResults, reconcile
+from .reconcile import ReconcileResults, _name, reconcile
 from .util import ALLOC_RESCHEDULED, tainted_nodes
 
 # reference: maxServiceScheduleAttempts / maxBatchScheduleAttempts
@@ -186,7 +186,6 @@ class GenericScheduler(Scheduler):
             # mixed placement kinds in one eval: expand the compact blocks
             # so capacity stays coupled in a SINGLE engine call (two calls
             # would each see only state usage, not each other's picks)
-            from .reconcile import _name
             for b in blocks:
                 all_places.extend(
                     RPlace(tg=b.tg, name=_name(job, b.tg, ix), index=ix)
@@ -367,10 +366,8 @@ class GenericScheduler(Scheduler):
             return
         # engine fell back (spread/devices/small count): expand and run
         # the general path with the decisions it already computed
-        from .reconcile import _name
         places = [RPlace(tg=block.tg, name=_name(job, block.tg, ix),
                          index=ix) for ix in block.indexes]
-        from nomad_tpu.ops import PlacementRequest
         reqs = [PlacementRequest(tg_name=block.tg.name)] * len(places)
         self._materialize_decisions(plan, job, places, reqs, decisions,
                                     evaluation, results, stopped)

@@ -69,6 +69,10 @@ class StateStore:
         self._variables: Dict[Tuple[str, str], VariableItem] = {}
         self._services: Dict[str, ServiceRegistration] = {}
         self._scheduler_config = SchedulerConfiguration()
+        # cluster-wide workload-identity signing secret (reference: the
+        # keyring backing workload identities); set once by the leader,
+        # replicated + snapshotted like all state
+        self._identity_secret = ""
         # secondary indexes (bucket dicts are copy-on-write)
         self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], Dict[str, Allocation]] = {}
@@ -550,6 +554,19 @@ class StateStore:
             self._scheduler_config = cfg
             return idx
 
+    def set_identity_secret(self, secret: str) -> int:
+        """First writer wins: concurrent leaders racing at bootstrap must
+        not rotate an already-established signing secret."""
+        with self._lock:
+            if self._identity_secret:
+                return self._index
+            idx = self._bump()
+            self._identity_secret = secret
+            return idx
+
+    def identity_secret(self) -> str:
+        return self._identity_secret
+
     def upsert_namespace(self, ns: Namespace) -> int:
         with self._lock:
             idx = self._bump()
@@ -771,6 +788,7 @@ class StateStore:
                 "Services": [codec.encode(r)
                              for r in self._services.values()],
                 "SchedulerConfig": codec.encode(self._scheduler_config),
+                "IdentitySecret": self._identity_secret,
             }
 
     def snapshot_restore(self, doc: Dict) -> None:
@@ -839,6 +857,7 @@ class StateStore:
                  for d in doc.get("Services", []))}
             self._scheduler_config = codec.decode(
                 SC, doc.get("SchedulerConfig") or {})
+            self._identity_secret = doc.get("IdentitySecret", "") or ""
             self._index = max(int(doc.get("Index", 0)), self._index) + 1
             self._index_cv.notify_all()
             self._emit("Restore", self._index, None)

@@ -1,0 +1,184 @@
+"""Workload identity tests (reference scenarios: workload identity +
+the implicit variables policy, identity_hook, Alloc.SignIdentities)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.identity import mint, variable_prefix, verify
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import VariableItem
+
+SECRET = "test-secret"
+
+
+class TestTokenFormat:
+    def test_mint_verify_roundtrip(self):
+        tok = mint(SECRET, namespace="default", job_id="web",
+                   alloc_id="a1", task="t1")
+        claims = verify(SECRET, tok)
+        assert claims["nomad_job_id"] == "web"
+        assert claims["nomad_allocation_id"] == "a1"
+        assert claims["nomad_task"] == "t1"
+
+    def test_forged_signature_rejected(self):
+        tok = mint(SECRET, namespace="default", job_id="web",
+                   alloc_id="a1", task="t1")
+        assert verify("other-secret", tok) is None
+        # flipping claim bytes breaks the signature
+        body = tok[len("nomad-wi."):]
+        h, c, s = body.split(".")
+        tampered = f"nomad-wi.{h}.{c[:-2] + ('AA' if c[-2:] != 'AA' else 'BB')}.{s}"
+        assert verify(SECRET, tampered) is None
+
+    def test_expiry(self):
+        tok = mint(SECRET, namespace="default", job_id="web",
+                   alloc_id="a1", task="t1", ttl_s=60, now=1000.0)
+        assert verify(SECRET, tok, now=1030.0) is not None
+        assert verify(SECRET, tok, now=1100.0) is None
+
+    def test_garbage_rejected(self):
+        assert verify(SECRET, "nope") is None
+        assert verify(SECRET, "nomad-wi.x.y") is None
+        assert verify(SECRET, "nomad-wi.a.b.c") is None
+
+
+class TestServerIdentity:
+    def _server_with_alloc(self):
+        srv = Server(dev_mode=True, acl_enabled=True)
+        srv.establish_leadership()
+        node = mock.node()
+        srv.state.upsert_node(node)
+        job = mock.job()
+        srv.state.upsert_job(job)
+        alloc = mock.alloc(job=job, job_id=job.id, node_id=node.id)
+        srv.state.upsert_allocs([alloc])
+        return srv, job, alloc
+
+    def test_secret_minted_on_leadership(self):
+        srv, _, _ = self._server_with_alloc()
+        assert srv.state.identity_secret()
+
+    def test_derive_tokens_per_task(self):
+        srv, job, alloc = self._server_with_alloc()
+        tokens, err = srv.derive_identity_tokens(alloc.id)
+        assert err == ""
+        assert set(tokens) == {t.name for t in job.task_groups[0].tasks}
+
+    def test_derive_rejects_unknown_and_terminal(self):
+        srv, job, alloc = self._server_with_alloc()
+        _, err = srv.derive_identity_tokens("nope")
+        assert err
+        dead = alloc.copy_skip_job()
+        dead.client_status = "failed"
+        srv.state.upsert_allocs([dead])
+        _, err = srv.derive_identity_tokens(alloc.id)
+        assert err
+
+    def test_resolve_token_scopes_variables(self):
+        srv, job, alloc = self._server_with_alloc()
+        tokens, _ = srv.derive_identity_tokens(alloc.id)
+        tok = next(iter(tokens.values()))
+        acl, err = srv.resolve_token(tok)
+        assert err == ""
+        pre = variable_prefix(job.id)
+        assert acl.allow_variable("default", f"{pre}/db", write=False)
+        assert acl.allow_variable("default", pre, write=False)
+        assert not acl.allow_variable("default", "nomad/jobs/other",
+                                      write=False)
+        assert not acl.allow_variable("default", f"{pre}/db", write=True)
+
+    def test_resolve_rejects_identity_of_dead_alloc(self):
+        srv, job, alloc = self._server_with_alloc()
+        tokens, _ = srv.derive_identity_tokens(alloc.id)
+        tok = next(iter(tokens.values()))
+        dead = alloc.copy_skip_job()
+        dead.desired_status = "stop"
+        srv.state.upsert_allocs([dead])
+        acl, err = srv.resolve_token(tok)
+        assert acl is None and err
+
+
+class TestHTTPVariableScoping:
+    def test_workload_token_reads_only_its_subtree(self):
+        import json
+        import urllib.request
+        import urllib.error
+        from nomad_tpu.agent import Agent
+
+        agent = Agent(num_clients=1, http_port=0, acl_enabled=True)
+        agent.start()
+        try:
+            srv = agent.server
+            node_ids = [c.node.id for c in agent.clients]
+            job = mock.job()
+            job.id = "webjob"
+            srv.state.upsert_job(job)
+            alloc = mock.alloc(job=job, job_id=job.id,
+                               node_id=node_ids[0])
+            srv.state.upsert_allocs([alloc])
+            srv.state.upsert_variable(VariableItem(
+                path=f"nomad/jobs/{job.id}/db", namespace="default",
+                items={"password": "hunter2"}))
+            srv.state.upsert_variable(VariableItem(
+                path="nomad/jobs/otherjob/db", namespace="default",
+                items={"password": "secret"}))
+            tokens, _ = srv.derive_identity_tokens(alloc.id)
+            tok = next(iter(tokens.values()))
+
+            def req(path):
+                r = urllib.request.Request(
+                    agent.address + path,
+                    headers={"X-Nomad-Token": tok})
+                try:
+                    with urllib.request.urlopen(r, timeout=10) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, None
+
+            st, v = req(f"/v1/var/nomad/jobs/{job.id}/db")
+            assert st == 200 and v["Items"]["password"] == "hunter2"
+            st, _ = req("/v1/var/nomad/jobs/otherjob/db")
+            assert st == 403
+            # listing filters to the granted subtree
+            st, vs = req("/v1/vars")
+            assert st == 200
+            assert {x["Path"] for x in vs} == {f"nomad/jobs/{job.id}/db"}
+        finally:
+            agent.shutdown()
+
+
+class TestTaskEnvToken:
+    def test_task_gets_nomad_token(self, tmp_path):
+        from nomad_tpu.client.client import Client, InProcessRPC
+
+        srv = Server(dev_mode=False, heartbeat_ttl=3600)
+        srv.start()
+        cl = Client(InProcessRPC(srv), node=mock.node(),
+                    data_dir=str(tmp_path))
+        cl.start()
+        try:
+            job = mock.job()
+            job.id = "tokjob"
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "mock"
+            t.config = {"run_for_s": 60}
+            srv.register_job(job)
+            deadline = time.time() + 15
+            tr = None
+            while time.time() < deadline:
+                rs = list(cl.alloc_runners.values())
+                if rs and rs[0].task_runners[0].state.state == "running":
+                    tr = rs[0].task_runners[0]
+                    break
+                time.sleep(0.2)
+            assert tr is not None
+            tok = tr.env.get("NOMAD_TOKEN", "")
+            assert tok.startswith("nomad-wi.")
+            claims = verify(srv.state.identity_secret(), tok)
+            assert claims["nomad_job_id"] == "tokjob"
+        finally:
+            cl.shutdown()
+            srv.shutdown()
