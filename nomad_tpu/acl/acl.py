@@ -137,6 +137,8 @@ def workload_acl(namespace: str, var_prefix: str) -> ACL:
     under `var_prefix` in `namespace`, nothing else (reference: the
     auto-generated workload identity policy)."""
     acl = ACL()
-    acl._ns[namespace] = {"variables-read", "variables-list", "read-job"}
+    # variables ONLY — no read-job: it would expose every job spec and
+    # (via /v1/client/fs) every sibling alloc's filesystem and logs
+    acl._ns[namespace] = {"variables-read", "variables-list"}
     acl.var_prefixes = [(namespace, var_prefix)]
     return acl

@@ -33,6 +33,14 @@ class Agent:
             raise NotImplementedError(
                 "client-only agents need a remote RPC transport; "
                 "in-process agents always embed the server")
+        # every client needs a writable sandbox for task dirs, logs, and
+        # the restart-survival state db (reference: agent data_dir,
+        # defaulting instead of silently running sandboxless)
+        self._owns_data_dir = not data_dir
+        if not data_dir:
+            import tempfile
+            data_dir = tempfile.mkdtemp(prefix="nomad-tpu-agent-")
+        self.data_dir = data_dir
         cluster_mode = bool(server_name or join or bootstrap_expect > 1)
         if cluster_mode:
             # multi-server: raft-replicated state + gossip membership
@@ -70,9 +78,12 @@ class Agent:
                 rpc = RemoteRPC([self.server.rpc.addr])
             else:
                 rpc = InProcessRPC(self.server)
+            import os
             for i in range(num_clients):
                 node = nodes[i] if nodes and i < len(nodes) else None
-                self.clients.append(Client(rpc, node=node,
+                cdir = os.path.join(data_dir, f"client{i}")
+                os.makedirs(cdir, exist_ok=True)
+                self.clients.append(Client(rpc, node=node, data_dir=cdir,
                                            plugin_dir=plugin_dir))
         self.http = HTTPAPIServer(self, host=http_host, port=http_port)
         self._started_at = time.time()
@@ -91,6 +102,11 @@ class Agent:
         for c in self.clients:
             c.shutdown()
         self.server.shutdown()
+        if self._owns_data_dir:
+            # the default sandbox was ours to provision, so it is ours to
+            # clean (task dirs can hold secret-bearing files)
+            import shutil
+            shutil.rmtree(self.data_dir, ignore_errors=True)
 
     @property
     def address(self) -> str:

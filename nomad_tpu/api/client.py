@@ -184,6 +184,30 @@ class Allocations(_Endpoint):
     def stop(self, alloc_id: str) -> Dict:
         return self.c.put(f"/v1/allocation/{alloc_id}/stop")
 
+    def restart(self, alloc_id: str) -> Dict:
+        return self.c.put(f"/v1/allocation/{alloc_id}/restart")
+
+    def signal(self, alloc_id: str, signal: str) -> Dict:
+        return self.c.put(f"/v1/allocation/{alloc_id}/signal",
+                          body={"Signal": signal})
+
+    def logs(self, alloc_id: str, task: str = "", type: str = "stdout",
+             offset: int = 0, limit: int = 1 << 20) -> Dict:
+        return self.c.get(
+            f"/v1/client/fs/logs/{alloc_id}", task=task, type=type,
+            offset=str(offset), limit=str(limit))
+
+    def fs_ls(self, alloc_id: str, path: str = "") -> List[Dict]:
+        return self.c.request("GET", f"/v1/client/fs/ls/{alloc_id}",
+                              params={"path": path})
+
+    def fs_cat(self, alloc_id: str, path: str) -> str:
+        return self.c.request("GET", f"/v1/client/fs/cat/{alloc_id}",
+                              params={"path": path})
+
+    def stats(self, alloc_id: str) -> Dict:
+        return self.c.get(f"/v1/client/allocation/{alloc_id}/stats")
+
 
 class Evaluations(_Endpoint):
     def list(self) -> List[Dict]:

@@ -174,6 +174,12 @@ class Router:
             if not acl.allow_agent_read():
                 raise APIError(403, "permission denied: agent policy")
             return acl
+        if head == "client":
+            # alloc fs/logs/stats need read-job in the alloc's namespace;
+            # the handler re-checks against the alloc's actual namespace
+            if not acl.allow_namespace_operation(ns, "read-job"):
+                raise APIError(403, "permission denied: needs read-job")
+            return acl
         return acl
 
     def _dispatch(self, method: str, p: List[str], ns: str,
@@ -424,6 +430,8 @@ class Router:
             if p[1:2] == ["gc"] and method in ("PUT", "POST"):
                 s.force_gc()
                 return {}
+        elif head == "client":
+            return self._client_fs(method, p[1:], ns, qs, acl)
         elif head == "status":
             if p[1:2] == ["leader"]:
                 return "local"           # single in-process server
@@ -709,6 +717,137 @@ class Router:
                 raise APIError(400, err)
             return {}
         raise APIError(404, "bad node pool request")
+
+    def _client_fs(self, method: str, p: List[str], ns: str,
+                   qs: Dict[str, List[str]], acl=None) -> Any:
+        """/v1/client/* — alloc filesystem, task logs, alloc stats,
+        served by the agent's in-process clients (reference:
+        client/fs_endpoint.go + alloc stats, proxied by the HTTP agent).
+
+        Shapes:
+          GET /v1/client/fs/logs/<alloc>?task=T&type=stdout|stderr
+              &offset=N&limit=N     -> {"Data": ..., "Offset": end}
+          GET /v1/client/fs/ls/<alloc>?path=sub/dir  -> [entries]
+          GET /v1/client/fs/cat/<alloc>?path=file    -> raw text
+          GET /v1/client/allocation/<alloc>/stats    -> resource usage
+        """
+        import os
+        s = self.server
+        if method != "GET" or len(p) < 2:
+            raise APIError(404, "bad client request")
+
+        def find_runner(alloc_id):
+            for c in self.agent.clients:
+                ar = c.alloc_runners.get(alloc_id)
+                if ar is not None:
+                    return c, ar
+            raise APIError(404, "alloc not running on this agent")
+
+        def check_alloc_ns(alloc_id):
+            a = s.state.alloc_by_id(alloc_id)
+            if a is None:
+                # fail CLOSED: a runner may outlive the server-side alloc
+                # (GC), and serving its files on the caller-chosen
+                # namespace's grant would leak across namespaces
+                raise APIError(404, "alloc not found")
+            self._check_ns(acl, a.namespace, "read-job")
+
+        if p[0] == "allocation" and p[2:3] == ["stats"]:
+            alloc_id = p[1]
+            check_alloc_ns(alloc_id)
+            _, ar = find_runner(alloc_id)
+            tasks = {}
+            for tr in ar.task_runners:
+                pid = tr.handle.pid if tr.handle else 0
+                cpu_ticks = rss_kb = 0
+                if pid:
+                    try:
+                        with open(f"/proc/{pid}/stat", "rb") as f:
+                            st = f.read()
+                        fl = st[st.rfind(b")") + 2:].split()
+                        cpu_ticks = int(fl[11]) + int(fl[12])
+                        with open(f"/proc/{pid}/statm") as f:
+                            rss_kb = int(f.read().split()[1]) \
+                                * (os.sysconf("SC_PAGE_SIZE") // 1024)
+                    except (OSError, IndexError, ValueError):
+                        pass
+                tasks[tr.task.name] = {
+                    "Pid": pid,
+                    "State": tr.state.state,
+                    "CPUTicks": cpu_ticks,
+                    "MemoryRSSKB": rss_kb,
+                    "Restarts": tr.state.restarts,
+                }
+            return {"AllocID": alloc_id, "Tasks": tasks}
+
+        if p[0] != "fs" or len(p) < 3:
+            raise APIError(404, "bad client request")
+        op, alloc_id = p[1], p[2]
+        check_alloc_ns(alloc_id)
+        c, ar = find_runner(alloc_id)
+        base = os.path.realpath(os.path.join(c.data_dir, alloc_id))
+        if not os.path.isdir(base):
+            raise APIError(404, "alloc filesystem not found")
+
+        def safe(rel: str) -> str:
+            # confine to the alloc sandbox (reference: fs_endpoint path
+            # validation) — symlinks and .. must not escape
+            full = os.path.realpath(os.path.join(base, rel.lstrip("/")))
+            if full != base and not full.startswith(base + os.sep):
+                raise APIError(403, "path escapes allocation directory")
+            return full
+
+        if op == "logs":
+            task = (qs.get("task") or [""])[0]
+            if not task and ar.task_runners:
+                task = ar.task_runners[0].task.name
+            kind = (qs.get("type") or ["stdout"])[0]
+            if kind not in ("stdout", "stderr"):
+                raise APIError(400, "type must be stdout|stderr")
+            try:
+                offset = int((qs.get("offset") or ["0"])[0])
+                limit = min(int((qs.get("limit") or [str(1 << 20)])[0]),
+                            1 << 22)
+            except ValueError:
+                raise APIError(400, "offset/limit must be integers")
+            path = safe(os.path.join(task, f"{task}.{kind}"))
+            try:
+                size = os.path.getsize(path)
+                if offset < 0:           # tail semantics
+                    offset = max(0, size + offset)
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(limit)
+            except OSError:
+                return {"Data": "", "Offset": 0, "Size": 0}
+            return {"Data": data.decode(errors="replace"),
+                    "Offset": offset + len(data), "Size": size}
+        if op == "ls":
+            rel = (qs.get("path") or [""])[0]
+            full = safe(rel)
+            try:
+                out = []
+                for name in sorted(os.listdir(full)):
+                    fp = os.path.join(full, name)
+                    st = os.stat(fp, follow_symlinks=False)
+                    out.append({"Name": name,
+                                "IsDir": os.path.isdir(fp),
+                                "Size": st.st_size,
+                                "ModTime": st.st_mtime})
+                return out
+            except OSError as e:
+                raise APIError(404, f"ls: {e}")
+        if op == "cat":
+            rel = (qs.get("path") or [""])[0]
+            if not rel:
+                raise APIError(400, "path required")
+            full = safe(rel)
+            try:
+                with open(full, "rb") as f:
+                    return f.read(1 << 22).decode(errors="replace")
+            except OSError as e:
+                raise APIError(404, f"cat: {e}")
+        raise APIError(404, "bad client fs request")
 
     def _var(self, method: str, p: List[str], ns: str,
              body: Optional[Dict], acl=None) -> Any:

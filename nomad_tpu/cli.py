@@ -253,6 +253,39 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    c = _client(args)
+    kind = "stderr" if args.stderr else "stdout"
+    r = c.allocations.logs(args.alloc_id, task=args.task, type=kind,
+                           offset=-args.tail if args.tail else 0)
+    sys.stdout.write(r.get("Data", ""))
+    return 0
+
+
+def cmd_alloc_fs(args) -> int:
+    c = _client(args)
+    path = args.path or ""
+    if args.cat:
+        sys.stdout.write(c.allocations.fs_cat(args.alloc_id, path))
+        return 0
+    for e in c.allocations.fs_ls(args.alloc_id, path):
+        kind = "d" if e.get("IsDir") else "-"
+        print(f"{kind} {e.get('Size', 0):>10}  {e.get('Name')}")
+    return 0
+
+
+def cmd_alloc_restart(args) -> int:
+    _client(args).allocations.restart(args.alloc_id)
+    print(f"restarted tasks of allocation {args.alloc_id}")
+    return 0
+
+
+def cmd_alloc_signal(args) -> int:
+    _client(args).allocations.signal(args.alloc_id, args.signal)
+    print(f"sent {args.signal} to allocation {args.alloc_id}")
+    return 0
+
+
 def cmd_alloc_stop(args) -> int:
     resp = _client(args).allocations.stop(args.alloc_id)
     print(f"stopping; eval {resp.get('EvalID', '')}")
@@ -628,6 +661,26 @@ def build_parser() -> argparse.ArgumentParser:
     alst = alloc.add_parser("stop")
     alst.add_argument("alloc_id")
     alst.set_defaults(fn=cmd_alloc_stop)
+    allg = alloc.add_parser("logs")
+    allg.add_argument("alloc_id")
+    allg.add_argument("task", nargs="?", default="")
+    allg.add_argument("-stderr", action="store_true")
+    allg.add_argument("-tail", type=int, default=0,
+                      help="show the last N bytes")
+    allg.set_defaults(fn=cmd_alloc_logs)
+    alfs = alloc.add_parser("fs")
+    alfs.add_argument("alloc_id")
+    alfs.add_argument("path", nargs="?", default="")
+    alfs.add_argument("-cat", action="store_true",
+                      help="print the file instead of listing")
+    alfs.set_defaults(fn=cmd_alloc_fs)
+    alrs = alloc.add_parser("restart")
+    alrs.add_argument("alloc_id")
+    alrs.set_defaults(fn=cmd_alloc_restart)
+    alsg = alloc.add_parser("signal")
+    alsg.add_argument("alloc_id")
+    alsg.add_argument("signal", nargs="?", default="SIGUSR1")
+    alsg.set_defaults(fn=cmd_alloc_signal)
 
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(
         dest="eval_cmd", required=True)

@@ -47,7 +47,17 @@ class AllocRunner:
         self.restore_handles = restore_handles or {}
         self._persist_handle = on_handle
         self.device_reserver = device_reserver
-        self.identity_fetcher = identity_fetcher
+        # one derive RPC per ALLOC, shared by every task runner (the
+        # server mints all task tokens in one call)
+        self._identity_raw = identity_fetcher
+        self._identity_cache: Optional[Dict] = None
+        self.identity_fetcher = (self._fetch_identities
+                                 if identity_fetcher else None)
+
+    def _fetch_identities(self, alloc_id: str) -> Dict:
+        if self._identity_cache is None:
+            self._identity_cache = self._identity_raw(alloc_id) or {}
+        return self._identity_cache
         self.task_runners: List[TaskRunner] = []
         self._lock = threading.Lock()
         self._done = threading.Event()

@@ -1,0 +1,139 @@
+"""Alloc filesystem / logs / stats endpoints
+(reference scenarios: client/fs_endpoint.go tests, command/alloc_logs)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+
+
+@pytest.fixture(scope="module")
+def agent_with_job(tmp_path_factory):
+    agent = Agent(num_clients=1, http_port=0)
+    # clients need a writable data_dir for task sandboxes + logs
+    for i, c in enumerate(agent.clients):
+        c.data_dir = str(tmp_path_factory.mktemp(f"alloc{i}"))
+    agent.start()
+    job = mock.job()
+    job.id = "logjob"
+    job.task_groups[0].count = 1
+    t = job.task_groups[0].tasks[0]
+    t.name = "speaker"
+    t.driver = "raw_exec"
+    t.config = {"command": "/bin/sh",
+                "args": ["-c",
+                         "echo hello-stdout; echo hello-stderr 1>&2; "
+                         "echo data > artifact.txt; sleep 300"]}
+    agent.server.register_job(job)
+    deadline = time.time() + 20
+    alloc_id = None
+    while time.time() < deadline:
+        runners = list(agent.clients[0].alloc_runners.values())
+        if runners and runners[0].task_runners \
+                and runners[0].task_runners[0].state.state == "running":
+            alloc_id = runners[0].alloc.id
+            break
+        time.sleep(0.2)
+    assert alloc_id, "task never started"
+    time.sleep(0.5)              # let the echos land on disk
+    yield agent, alloc_id
+    agent.shutdown()
+
+
+def get(agent, path):
+    with urllib.request.urlopen(agent.address + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestLogs:
+    def test_stdout(self, agent_with_job):
+        agent, aid = agent_with_job
+        r = get(agent, f"/v1/client/fs/logs/{aid}?task=speaker")
+        assert "hello-stdout" in r["Data"]
+        assert r["Offset"] > 0
+
+    def test_stderr(self, agent_with_job):
+        agent, aid = agent_with_job
+        r = get(agent, f"/v1/client/fs/logs/{aid}?task=speaker&type=stderr")
+        assert "hello-stderr" in r["Data"]
+
+    def test_offset_pagination(self, agent_with_job):
+        agent, aid = agent_with_job
+        r1 = get(agent, f"/v1/client/fs/logs/{aid}?task=speaker&limit=5")
+        assert len(r1["Data"]) == 5
+        r2 = get(agent, f"/v1/client/fs/logs/{aid}?task=speaker"
+                        f"&offset={r1['Offset']}")
+        assert (r1["Data"] + r2["Data"]).startswith("hello-stdout")
+
+    def test_negative_offset_tails(self, agent_with_job):
+        agent, aid = agent_with_job
+        r = get(agent, f"/v1/client/fs/logs/{aid}?task=speaker&offset=-3")
+        assert len(r["Data"]) == 3
+
+    def test_default_task(self, agent_with_job):
+        agent, aid = agent_with_job
+        r = get(agent, f"/v1/client/fs/logs/{aid}")
+        assert "hello-stdout" in r["Data"]
+
+
+class TestFS:
+    def test_ls_and_cat(self, agent_with_job):
+        agent, aid = agent_with_job
+        top = get(agent, f"/v1/client/fs/ls/{aid}")
+        assert any(e["Name"] == "speaker" and e["IsDir"] for e in top)
+        files = get(agent, f"/v1/client/fs/ls/{aid}?path=speaker")
+        names = {e["Name"] for e in files}
+        assert {"speaker.stdout", "speaker.stderr",
+                "artifact.txt"} <= names
+        body = get(agent,
+                   f"/v1/client/fs/cat/{aid}?path=speaker/artifact.txt")
+        assert body.strip() == "data"
+
+    def test_path_traversal_blocked(self, agent_with_job):
+        agent, aid = agent_with_job
+        for bad in ("../../etc/passwd", "..%2F..%2Fetc%2Fpasswd"):
+            try:
+                get(agent, f"/v1/client/fs/cat/{aid}?path={bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code in (403, 404)
+            else:
+                raise AssertionError("traversal not blocked")
+
+    def test_unknown_alloc_404(self, agent_with_job):
+        agent, _ = agent_with_job
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(agent, "/v1/client/fs/ls/deadbeef")
+        assert ei.value.code == 404
+
+
+class TestStats:
+    def test_alloc_stats(self, agent_with_job):
+        agent, aid = agent_with_job
+        r = get(agent, f"/v1/client/allocation/{aid}/stats")
+        t = r["Tasks"]["speaker"]
+        assert t["Pid"] > 0
+        assert t["State"] == "running"
+        assert t["MemoryRSSKB"] > 0
+
+
+class TestCLI:
+    def test_alloc_logs_command(self, agent_with_job, capsys):
+        agent, aid = agent_with_job
+        from nomad_tpu.cli import main
+        rc = main(["-address", agent.address, "alloc", "logs", aid,
+                   "speaker"])
+        assert rc == 0
+        assert "hello-stdout" in capsys.readouterr().out
+
+    def test_alloc_fs_command(self, agent_with_job, capsys):
+        agent, aid = agent_with_job
+        from nomad_tpu.cli import main
+        rc = main(["-address", agent.address, "alloc", "fs", aid,
+                   "speaker"])
+        assert rc == 0
+        assert "artifact.txt" in capsys.readouterr().out
