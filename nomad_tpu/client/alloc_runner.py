@@ -51,19 +51,28 @@ class AllocRunner:
         # server mints all task tokens in one call)
         self._identity_raw = identity_fetcher
         self._identity_cache: Optional[Dict] = None
+        self._identity_lock = threading.Lock()
         self.identity_fetcher = (self._fetch_identities
                                  if identity_fetcher else None)
-
-    def _fetch_identities(self, alloc_id: str) -> Dict:
-        if self._identity_cache is None:
-            self._identity_cache = self._identity_raw(alloc_id) or {}
-        return self._identity_cache
         self.task_runners: List[TaskRunner] = []
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._destroyed = False
         self.health: Optional[bool] = None
         self._build_runners()
+
+    def _fetch_identities(self, alloc_id: str) -> Dict:
+        # dedicated lock: the derive RPC can block for the socket timeout
+        # and must not stall status sync / supervision on self._lock
+        with self._identity_lock:
+            if self._identity_cache is None:
+                fetched = self._identity_raw(alloc_id)
+                if not fetched:
+                    # transient failure (leader election, server down):
+                    # leave the cache unset so a task restart retries
+                    return {}
+                self._identity_cache = fetched
+            return self._identity_cache
 
     # ------------------------------------------------------------- build
 
