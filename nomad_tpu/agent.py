@@ -28,7 +28,13 @@ class Agent:
                  join: Optional[List] = None,
                  rpc_port: int = 0, raft_port: int = 0, serf_port: int = 0,
                  data_dir: Optional[str] = None,
-                 plugin_dir: str = "") -> None:
+                 plugin_dir: str = "",
+                 encrypt: str = "") -> None:
+        if encrypt:
+            # cluster shared secret: encrypt + authenticate every
+            # server-plane wire frame (raft/gossip/RPC) — core/wire.py
+            from nomad_tpu.core import wire
+            wire.set_key(encrypt)
         if not server_enabled:
             raise NotImplementedError(
                 "client-only agents need a remote RPC transport; "

@@ -44,6 +44,10 @@ class AgentConfig:
     datacenter: str = "dc1"
     client_meta: Dict[str, str] = field(default_factory=dict)
     acl_enabled: bool = False
+    # cluster shared secret: AES-256-GCM encryption + authentication of
+    # every server-plane wire frame (reference: the serf `encrypt`
+    # gossip key); empty = plaintext (dev)
+    encrypt: str = ""
 
     def merge(self, other: "AgentConfig",
               set_fields: set) -> "AgentConfig":
@@ -83,6 +87,8 @@ def parse_agent_config(src: str):
                 put("bind_addr", str(v))
             elif node.name == "log_level":
                 put("log_level", str(v).lower())
+            elif node.name == "encrypt":
+                put("encrypt", str(v))
             else:
                 raise ValueError(f"unknown agent setting {node.name!r}")
         elif isinstance(node, Block):

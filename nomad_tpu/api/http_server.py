@@ -202,9 +202,22 @@ class Router:
                 if job.namespace != ns:
                     self._check_ns(acl, job.namespace, "submit-job")
                 ev = s.register_job(job)
-                return {"EvalID": ev.id if ev else "",
-                        "JobModifyIndex": s.state.job_by_id(
-                            job.namespace, job.id).job_modify_index}
+                if ev is not None:
+                    # the eval carries the LEADER's stored modify index —
+                    # exact even when this server's local replica hasn't
+                    # applied the write yet
+                    return {"EvalID": ev.id,
+                            "JobModifyIndex": ev.job_modify_index}
+                # periodic/parameterized parents get no eval; poll the
+                # local store for the replicated write (first sight only
+                # — an update racing replication may briefly report the
+                # prior index)
+                stored = self._read_local(
+                    lambda: s.state.job_by_id(job.namespace, job.id))
+                if stored is None:
+                    raise APIError(500, "registered job not yet visible")
+                return {"EvalID": "",
+                        "JobModifyIndex": stored.job_modify_index}
         elif head == "job":
             return self._job(method, p[1:], ns, qs, body, acl)
         elif head == "nodes":
@@ -434,8 +447,15 @@ class Router:
             return self._client_fs(method, p[1:], ns, qs, acl)
         elif head == "status":
             if p[1:2] == ["leader"]:
+                if hasattr(s, "leader_rpc_addr"):   # cluster mode
+                    addr = s.leader_rpc_addr()
+                    return f"{addr[0]}:{addr[1]}" if addr else ""
                 return "local"           # single in-process server
             if p[1:2] == ["peers"]:
+                if hasattr(s, "gossip"):
+                    return [f"{m.meta['rpc'][0]}:{m.meta['rpc'][1]}"
+                            for m in s.gossip.alive_members().values()
+                            if m.meta.get("rpc")]
                 return ["local"]
         elif head == "agent":
             if p[1:2] == ["self"]:
@@ -444,6 +464,11 @@ class Router:
                                        "Enabled": bool(self.agent.clients)}},
                         "stats": self.agent.stats()}
             if p[1:2] == ["members"]:
+                if hasattr(s, "gossip"):
+                    return {"Members": [
+                        {"Name": m.name, "Status": m.status,
+                         "Addr": list(m.addr)}
+                        for m in s.gossip.members_snapshot().values()]}
                 return {"Members": [{"Name": "local", "Status": "alive"}]}
         elif head == "metrics":
             return self.agent.metrics()
@@ -881,6 +906,21 @@ class Router:
         raise APIError(404, "bad variable request")
 
     # ------------------------------------------------------------ helpers
+
+    def _read_local(self, read, timeout: float = 5.0):
+        """Read-your-writes after a possibly-forwarded mutation: on a
+        cluster follower the raft apply lands asynchronously, so a read
+        issued right after a write can miss it — poll briefly for the
+        local store to catch up (the reference achieves this with the
+        write's raft index + blocking query; the forwarded result here
+        doesn't carry the index)."""
+        import time as _time
+        deadline = _time.time() + timeout
+        while True:
+            v = read()
+            if v is not None or _time.time() >= deadline:
+                return v
+            _time.sleep(0.02)
 
     def _block(self, qs: Dict[str, List[str]]) -> None:
         """Minimal blocking-query support (reference: blockingRPC)."""

@@ -93,7 +93,8 @@ def cmd_agent(args) -> int:
                   raft_port=getattr(args, "raft_port", 0),
                   serf_port=getattr(args, "serf_port", 0),
                   data_dir=getattr(args, "data_dir", "") or None,
-                  plugin_dir=getattr(args, "plugin_dir", ""))
+                  plugin_dir=getattr(args, "plugin_dir", ""),
+                  encrypt=cfg.encrypt)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address}")
     srv = agent.server

@@ -112,7 +112,19 @@ class Client:
 
     def start(self) -> None:
         """register + heartbeat + watch_allocations + alloc_sync loops."""
-        self.rpc.register_node(self.node)
+        try:
+            self.rpc.register_node(self.node)
+        except Exception as exc:
+            # likely no leader yet (cluster still electing at boot):
+            # register from a background retry loop instead of failing
+            # the agent (reference: client retryRegisterNode)
+            from nomad_tpu.core.logging import log
+            log("client", "warn", "node registration deferred",
+                node=self.node.id, error=str(exc))
+            t = threading.Thread(target=self._register_retry_loop,
+                                 daemon=True, name="client-register")
+            t.start()
+            self._threads.append(t)
         for name, fn in (("heartbeat", self._heartbeat_loop),
                          ("watch-allocs", self._watch_loop),
                          ("alloc-sync", self._sync_loop)):
@@ -136,6 +148,25 @@ class Client:
             self.plugin_manager.shutdown()
 
     # ------------------------------------------------------------- loops
+
+    def _register_retry_loop(self) -> None:
+        from nomad_tpu.core.logging import log
+        last_err = ""
+        while not self._stop.wait(1.0):
+            try:
+                self.rpc.register_node(self.node)
+                log("client", "info", "node registered",
+                    node=self.node.id)
+                return
+            except Exception as exc:
+                # log each DISTINCT error once — a permanent failure
+                # (bad payload, server-side error) must stay diagnosable,
+                # not drown as an eternal silent retry
+                if str(exc) != last_err:
+                    last_err = str(exc)
+                    log("client", "warn", "node registration retry failing",
+                        node=self.node.id, error=last_err)
+                continue
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):

@@ -29,7 +29,6 @@ replication fires the local watch), writes are forwarded.
 
 from __future__ import annotations
 
-import pickle
 import socket
 import threading
 import time
@@ -37,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.state import StateStore
 
+from . import wire
 from .logging import log
 from .membership import Gossip, Member
 from .raft import NotLeaderError, RaftNode, recv_msg, reply, send_msg
@@ -73,6 +73,14 @@ FORWARDED = frozenset({
     "upsert_service_registrations", "delete_service_registrations_by_alloc",
 })
 
+# Full RPC surface the TCP endpoint will dispatch (reference: the fixed
+# endpoint set registered in nomad/server.go setupRpcServer).  Everything
+# else on the wire is rejected — the endpoint must never expose arbitrary
+# server attributes.
+RPC_METHODS = FORWARDED | {
+    "get_client_allocs", "derive_identity_tokens",
+}
+
 
 class ReplicatedState:
     """StateStore facade: mutations go through Raft, reads go local.
@@ -97,8 +105,7 @@ class ReplicatedState:
             if raft is None:
                 return local_attr(*args, **kwargs)
             try:
-                cmd = pickle.dumps((name, args, kwargs),
-                                   protocol=pickle.HIGHEST_PROTOCOL)
+                cmd = wire.packb((name, args, kwargs))
                 return raft.apply(cmd)
             except NotLeaderError:
                 if proxy.forward is None:
@@ -349,17 +356,18 @@ class ClusterServer(Server):
     # ------------------------------------------------------------ raft glue
 
     def _fsm_apply(self, cmd: bytes):
-        name, args, kwargs = pickle.loads(cmd)
+        # data-only decode: cmd bytes replicate over the network, so they
+        # must never be able to construct anything outside the registry
+        name, args, kwargs = wire.unpackb(cmd)
         if name not in MUTATIONS:
             raise ValueError(f"unknown FSM command {name!r}")
         return getattr(self._local_state, name)(*args, **kwargs)
 
     def _fsm_snapshot(self) -> bytes:
-        return pickle.dumps(self._local_state.snapshot_save(),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        return wire.packb(self._local_state.snapshot_save())
 
     def _fsm_restore(self, data: bytes) -> None:
-        self._local_state.snapshot_restore(pickle.loads(data))
+        self._local_state.snapshot_restore(wire.unpackb(data))
 
     def _on_raft_leader(self) -> None:
         self.establish_leadership()
@@ -394,9 +402,12 @@ class ClusterServer(Server):
         elif method in ("upsert_service_registrations",
                         "delete_service_registrations_by_alloc"):
             target = getattr(self.state, method)
-        elif hasattr(self, method):
+        elif method in RPC_METHODS:
             target = getattr(self, method)
         else:
+            # explicit allowlist — the endpoint must not dispatch
+            # arbitrary attribute names from the wire (stop(), private
+            # helpers, ...)
             raise AttributeError(f"unknown RPC method {method!r}")
         try:
             return target(*args, **kwargs)

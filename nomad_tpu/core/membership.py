@@ -12,7 +12,8 @@ bumping its incarnation — straight SWIM, minus the indirect-probe round
 (loopback/LAN links don't partition one-way often enough to pay for it;
 the reference's memberlist does implement it).
 
-Transport: the same length-prefixed pickle framing as raft.py, TCP.
+Transport: the same length-prefixed msgpack framing as raft.py (see
+core.wire — data-only, optional HMAC frame auth), TCP.
 """
 
 from __future__ import annotations
@@ -129,6 +130,12 @@ class Gossip:
         with self._lock:
             return {n: m for n, m in self.members.items()
                     if m.status == ALIVE}
+
+    def members_snapshot(self) -> Dict[str, Member]:
+        """All members (any status) — keeps the table's locking inside
+        this module for external readers like the HTTP API."""
+        with self._lock:
+            return dict(self.members)
 
     # ----------------------------------------------------------- internals
 

@@ -9,12 +9,16 @@ commit on majority match, FSM apply in log order, and snapshot
 install for lagging followers (log compaction via the state store's
 snapshot_save/snapshot_restore).
 
-Transport is length-prefixed pickle over loopback/LAN TCP — the cluster
-peers are mutually trusted (the reference likewise runs msgpack-RPC
-between servers with optional mTLS; TLS termination would wrap the
-sockets here).  One short-lived connection per message keeps the failure
-model trivial: any socket error is a lost message, and Raft is built on
-lost messages.
+Transport is length-prefixed msgpack over loopback/LAN TCP via
+core.wire — DATA ONLY (no pickle on any socket: a reachable port must
+never yield code execution), with optional AES-GCM frame encryption
+from the cluster shared secret (`encrypt` agent option; the reference
+likewise runs msgpack-RPC between servers with optional mTLS).  One
+short-lived connection per message keeps the failure model trivial: any
+socket error is a lost message, and Raft is built on lost messages.
+
+Durable files (log/meta on local disk) use pickle — the trust boundary
+is the socket, not the node's own data_dir.
 
 Simplification vs the reference (documented, deliberate): peer-set
 changes (autopilot add/remove) take effect via the membership layer on
@@ -34,6 +38,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import wire
 from .logging import log
 
 FOLLOWER = "follower"
@@ -62,13 +67,16 @@ class Entry:
 
 def send_msg(addr: Tuple[str, int], msg: dict, timeout: float = 1.0,
              ) -> Optional[dict]:
-    """One-shot request/response; None on any failure."""
+    """One-shot request/response; None on any failure.
+    Encoding happens OUTSIDE the net of swallowed errors: an
+    unencodable payload is a local programming error and must raise,
+    not masquerade as a dead server."""
+    frame = wire.encode_frame(msg)
     try:
         with socket.create_connection(addr, timeout=timeout) as s:
-            payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-            s.sendall(struct.pack(">I", len(payload)) + payload)
+            s.sendall(frame)
             return recv_msg(s, timeout)
-    except (OSError, pickle.PickleError, EOFError):
+    except (OSError, ValueError, EOFError):
         return None
 
 
@@ -82,8 +90,8 @@ def recv_msg(sock: socket.socket, timeout: float = 5.0) -> Optional[dict]:
         body = _recv_exact(sock, n)
         if body is None:
             return None
-        return pickle.loads(body)
-    except (OSError, pickle.PickleError, EOFError):
+        return wire.decode_body(body)
+    except (OSError, ValueError, TypeError, EOFError):
         return None
 
 
@@ -99,8 +107,7 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 def reply(sock: socket.socket, msg: dict) -> None:
     try:
-        payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        sock.sendall(wire.encode_frame(msg))
     except OSError:
         pass
 
@@ -150,6 +157,9 @@ class RaftNode:
         self.snap_index = 0
         self.snap_term = 0
         self._snap_data: Optional[bytes] = None
+        # in-memory replication-only tail of already-compacted entries
+        # (index <= snap_index); see _maybe_compact
+        self._tail: List[Entry] = []
 
         # volatile
         self.role = FOLLOWER
@@ -516,18 +526,21 @@ class RaftNode:
                     sock = socket.create_connection(addr, timeout=1.0)
                 except OSError:
                     return None, None
+            # encode per attempt (fresh nonce — a byte-identical resend
+            # would trip the receiver's replay guard), and OUTSIDE the
+            # try: an unencodable payload must raise, not look like a
+            # dead peer
+            frame = wire.encode_frame(msg)
             try:
                 # raising send (NOT reply(), which swallows OSError):
                 # a failed send must trigger the immediate reconnect
                 # below, not a silent 2s recv timeout on a request that
                 # never left
-                payload = pickle.dumps(msg,
-                                       protocol=pickle.HIGHEST_PROTOCOL)
-                sock.sendall(struct.pack(">I", len(payload)) + payload)
+                sock.sendall(frame)
                 r = recv_msg(sock, timeout=2.0)
                 if r is not None:
                     return sock, r
-            except (OSError, pickle.PickleError):
+            except (OSError, ValueError):
                 pass
             try:
                 sock.close()
@@ -544,8 +557,9 @@ class RaftNode:
                 return sock
             nxt = self.next_index.get(name, self._last_index() + 1)
             if nxt <= self.snap_index:
-                # follower is behind the compacted prefix: ship a snapshot
-                msg = self._snapshot_msg()
+                # follower is behind the compacted prefix: serve from the
+                # retained tail if it still covers nxt, else snapshot
+                msg = self._tail_append_msg(nxt) or self._snapshot_msg()
             else:
                 prev_idx = nxt - 1
                 prev_term = self._term_at(prev_idx)
@@ -593,6 +607,23 @@ class RaftNode:
                 self.next_index[name] = max(
                     1, hint if hint else self.next_index.get(name, 2) - 1)
         return sock
+
+    def _tail_append_msg(self, nxt: int) -> Optional[dict]:
+        """Append msg for a follower behind the compaction point, built
+        from the replication tail (entries with index <= snap_index kept
+        at compaction).  None when the tail doesn't cover nxt-1 — the
+        prev entry's term must be known for the consistency check."""
+        if not self._tail or nxt <= self._tail[0].index:
+            return None
+        base = self._tail[0].index
+        prev_idx = nxt - 1
+        prev_term = self._tail[prev_idx - base].term
+        ents = [(e.term, e.index, e.cmd)
+                for e in (self._tail[nxt - base:]
+                          + self.log)[:MAX_APPEND_ENTRIES]]
+        return {"type": "append", "term": self.term, "leader": self.name,
+                "prev_idx": prev_idx, "prev_term": prev_term,
+                "entries": ents, "commit": self.commit_index}
 
     def _snapshot_msg(self) -> Optional[dict]:
         """Ship the snapshot taken at the last compaction.  NEVER snapshot
@@ -735,6 +766,7 @@ class RaftNode:
             self.snap_index = m["last_idx"]
             self.snap_term = m["last_term"]
             self.log = []
+            self._tail = []
             self.commit_index = max(self.commit_index, m["last_idx"])
             self.last_applied = m["last_idx"]
             self._persist_log()
@@ -780,11 +812,11 @@ class RaftNode:
         if self.fsm_snapshot is None \
                 or len(self.log) <= self.max_log_entries:
             return
-        # keep a tail of entries so slightly-lagging followers don't need
-        # a full snapshot transfer
-        keep = self.max_log_entries // 2
+        # the snapshot must be taken at EXACTLY the FSM's applied index
+        # (fsm_apply is not idempotent), so compaction always cuts at
+        # last_applied; slightly-lagging followers are instead served
+        # from the in-memory replication tail kept below
         new_snap_idx = self.last_applied
-        tail = [e for e in self.log if e.index > new_snap_idx][-keep:]
         cut = [e for e in self.log if e.index <= new_snap_idx]
         if not cut:
             return
@@ -792,7 +824,16 @@ class RaftNode:
         self.snap_term = self._term_at(new_snap_idx) or self.term
         self.snap_index = new_snap_idx
         self.log = [e for e in self.log if e.index > new_snap_idx]
-        self._persist_log(snapshot=self._snap_data)
+        # replication-only tail: the most recent compacted entries, kept
+        # in memory so a follower just behind the compaction point gets a
+        # normal append instead of a full snapshot transfer.  Never used
+        # for local replay (the durable snapshot covers these indexes)
+        # and not persisted — losing it merely costs a laggard a
+        # snapshot.  Contiguity holds: cut starts where the previous
+        # tail ended (the old snap_index), and [-keep:] keeps a suffix.
+        keep = max(1, self.max_log_entries // 2)   # [-0:] keeps ALL
+        self._tail = (self._tail + cut)[-keep:]
+        self._persist_log()
 
     # ---------------------------------------------------------- durability
 
@@ -817,15 +858,20 @@ class RaftNode:
             payload = pickle.dumps(entry)
             f.write(struct.pack(">I", len(payload)) + payload)
 
-    def _persist_log(self, snapshot: Optional[bytes] = None) -> None:
-        """Rewrite the durable log (suffix truncation / compaction)."""
+    def _persist_log(self) -> None:
+        """Rewrite the durable log (suffix truncation / compaction).
+        ALWAYS embeds the current compaction snapshot: this header is the
+        snapshot's only durable home, so a rewrite that dropped it would
+        leave a restarted node with snap_index > 0 but no bytes to
+        restore — last_applied stuck at 0 behind a prefix that no longer
+        exists in the log."""
         if not self.data_dir:
             return
         tmp = self._log_path() + ".tmp"
         with open(tmp, "wb") as f:
             hdr = pickle.dumps({"snap_index": self.snap_index,
                                 "snap_term": self.snap_term,
-                                "snapshot": snapshot})
+                                "snapshot": self._snap_data})
             f.write(struct.pack(">I", len(hdr)) + hdr)
             for e in self.log:
                 payload = pickle.dumps(e)

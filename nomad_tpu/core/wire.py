@@ -1,0 +1,179 @@
+"""Data-only wire codec + authenticated framing for the server plane.
+
+The reference runs msgpack-RPC between servers with optional mTLS
+(reference: nomad/rpc.go, helper/codec); the important property is that
+the wire format is DATA ONLY — a peer (or an attacker who can reach the
+port) can inject garbage state, but never code.  This module gives the
+Python server plane the same property:
+
+  - msgpack framing (never pickle) for every TCP message: raft, gossip,
+    and the server RPC endpoint, plus the raft FSM command encoding.
+  - dataclass payloads ride as a msgpack ext type carrying
+    (class-name, field-dict); decode only constructs classes from an
+    explicit registry (the nomad_tpu.structs dataclasses), so arbitrary
+    types are not reachable from the wire.
+  - optional shared-secret frame encryption (AES-256-GCM, the `encrypt`
+    agent option — the analog of Nomad's serf encrypt key): when a key
+    is set, every frame is encrypted and authenticated, and frames
+    whose timestamp falls outside a freshness window — or whose nonce
+    was already seen inside it — are dropped (bounded replay
+    protection; peers' clocks must agree within the window, like the
+    reference's ACL-token expiry handling assumes).
+
+Durable files (raft log/meta on local disk) are NOT wire and keep their
+own encoding — the trust boundary is the socket, not the local disk.
+
+Tuples become lists on the wire (msgpack semantics); all consumers
+tolerate that (the membership/cluster code already re-tuples addresses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import msgpack
+
+_EXT_DATACLASS = 1
+_EXT_SET = 2
+
+_NONCE_LEN = 12
+_TS_LEN = 8
+# |sender clock - receiver clock| + network latency must fit here
+REPLAY_WINDOW_S = 120.0
+
+_KEY: Optional[bytes] = None
+_aead = None
+_seen_nonces: Dict[bytes, float] = {}
+_seen_lock = threading.Lock()
+
+_REGISTRY: Dict[str, type] = {}
+_registered_modules: set = set()
+
+
+def set_key(secret: Optional[str]) -> None:
+    """Install the cluster shared secret (agent `encrypt` option).
+    None/empty disables frame encryption (loopback/dev clusters)."""
+    global _KEY, _aead
+    if not secret:
+        _KEY, _aead = None, None
+    else:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        _KEY = hashlib.sha256(secret.encode("utf-8")).digest()
+        _aead = AESGCM(_KEY)
+    with _seen_lock:
+        _seen_nonces.clear()
+
+
+def has_key() -> bool:
+    return _KEY is not None
+
+
+def register_module(module) -> None:
+    """Add every dataclass defined in `module` to the decode registry."""
+    if module in _registered_modules:
+        return
+    _registered_modules.add(module)
+    for name in dir(module):
+        obj = getattr(module, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            existing = _REGISTRY.get(obj.__name__)
+            if existing is not None and existing is not obj:
+                raise TypeError(
+                    f"wire registry name collision: {obj.__name__} in "
+                    f"{obj.__module__} vs {existing.__module__}")
+            _REGISTRY[obj.__name__] = obj
+
+
+def _ensure_registry() -> None:
+    if not _REGISTRY:
+        import nomad_tpu.structs as structs
+        import nomad_tpu.structs.structs as structs_impl
+        register_module(structs)
+        register_module(structs_impl)
+
+
+def _default(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        _ensure_registry()
+        cls = type(obj).__name__
+        if _REGISTRY.get(cls) is not type(obj):
+            raise TypeError(
+                f"wire codec: dataclass {type(obj).__module__}.{cls} is "
+                "not registered (register_module its module first)")
+        fields = {f.name: getattr(obj, f.name)
+                  for f in dataclasses.fields(obj)}
+        return msgpack.ExtType(_EXT_DATACLASS, packb([cls, fields]))
+    if isinstance(obj, (set, frozenset)):
+        return msgpack.ExtType(_EXT_SET, packb(sorted(obj)))
+    raise TypeError(
+        f"wire codec cannot encode {type(obj).__name__} (data-only wire; "
+        "no arbitrary objects)")
+
+
+def _ext_hook(code: int, data: bytes) -> Any:
+    if code == _EXT_DATACLASS:
+        _ensure_registry()
+        cls_name, fields = unpackb(data)
+        cls = _REGISTRY.get(cls_name)
+        if cls is None:
+            raise ValueError(f"wire codec: unknown dataclass {cls_name!r}")
+        return cls(**fields)
+    if code == _EXT_SET:
+        return set(unpackb(data))
+    return msgpack.ExtType(code, data)
+
+
+def packb(obj: Any) -> bytes:
+    return msgpack.packb(obj, default=_default, use_bin_type=True)
+
+
+def unpackb(data: bytes) -> Any:
+    return msgpack.unpackb(data, ext_hook=_ext_hook, raw=False,
+                           strict_map_key=False)
+
+
+def encode_frame(msg: Any) -> bytes:
+    """msg -> length-prefixed (optionally encrypted) frame bytes."""
+    body = packb(msg)
+    if _aead is not None:
+        ts = struct.pack(">d", time.time())
+        nonce = os.urandom(_NONCE_LEN)
+        body = ts + nonce + _aead.encrypt(nonce, body, ts)
+    return struct.pack(">I", len(body)) + body
+
+
+def _check_replay(nonce: bytes, now: float) -> None:
+    with _seen_lock:
+        if nonce in _seen_nonces:
+            raise ValueError("replayed frame")
+        _seen_nonces[nonce] = now + REPLAY_WINDOW_S
+        if len(_seen_nonces) > 65536:
+            for k in [k for k, exp in _seen_nonces.items() if exp < now]:
+                del _seen_nonces[k]
+
+
+def decode_body(body: bytes) -> Any:
+    """Frame body (after the length prefix) -> msg.
+    Raises ValueError on an unauthenticated/stale/replayed frame when a
+    key is set."""
+    if _aead is not None:
+        if len(body) < _TS_LEN + _NONCE_LEN + 16:
+            raise ValueError("unauthenticated frame")
+        ts_raw = body[:_TS_LEN]
+        nonce = body[_TS_LEN:_TS_LEN + _NONCE_LEN]
+        (ts,) = struct.unpack(">d", ts_raw)
+        now = time.time()
+        if abs(now - ts) > REPLAY_WINDOW_S:
+            raise ValueError("stale frame")
+        _check_replay(nonce, now)
+        try:
+            body = _aead.decrypt(nonce, body[_TS_LEN + _NONCE_LEN:], ts_raw)
+        except Exception:
+            raise ValueError("frame authentication failed")
+    return unpackb(body)
