@@ -119,58 +119,94 @@ class PlacementOutputs(NamedTuple):
     job_count: jnp.ndarray    # [N] final job counts
 
 
-def place(inp: PlacementInputs) -> PlacementOutputs:
-    n = inp.attrs.shape[0]
-    top_k = min(TOP_K, n)
+class StepStatics(NamedTuple):
+    """Loop-invariant per-eval tensors, computed once before the scan.
+    `rows` are GLOBAL node row ids for the slice being scored — a plain
+    arange on one device, offset by the shard index under shard_map — so
+    the scoring core below is byte-identical in both deployments."""
+    static: jnp.ndarray   # [G, N] feasibility
+    aff_sc: jnp.ndarray   # [G, N]
+    aff_any: jnp.ndarray  # [G]
+    sp_any: jnp.ndarray   # []
+    capf: jnp.ndarray     # [N, 3] float32
+    noise: jnp.ndarray    # [N]
+    rows: jnp.ndarray     # [N] global row ids
+
+
+def scan_statics(inp: PlacementInputs, rows) -> StepStatics:
     static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
                            inp.con, inp.luts)              # [G, N]
     if inp.extra_mask is not None:
         static = static & inp.extra_mask
-    aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)  # [G, N]
-    aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)        # [G]
-    sp_any = jnp.any(inp.sp_weight > 0)
-    capf = inp.cap.astype(jnp.float32)
-    noise = tiebreak_noise(inp.seed, jnp.arange(n))
+    return StepStatics(
+        static=static,
+        aff_sc=affinity_score(inp.attrs, inp.aff, inp.luts),  # [G, N]
+        aff_any=jnp.any(inp.aff[..., 3] != 0, axis=1),        # [G]
+        sp_any=jnp.any(inp.sp_weight > 0),
+        capf=inp.cap.astype(jnp.float32),
+        noise=tiebreak_noise(inp.seed, rows),
+        rows=rows)
+
+
+def step_scores(inp: PlacementInputs, st: StepStatics, carry, g, prev):
+    """Scoring core of ONE placement step — shared verbatim by the
+    single-device scan (`place`) and the sharded per-shard body
+    (parallel/mesh._place_local), so the two deployments cannot drift.
+    Returns (feas, final, stat_g, fit, dh_ok): the feasibility verdicts
+    and the normalized rank-chain score for every (local) node."""
+    used, job_count, sp_counts, pd_counts = carry
+    n = st.rows.shape[0]
+    req_g = inp.req[g]
+    stat_g = st.static[g]
+    fit = capacity_fit(inp.cap, used, req_g)
+    dh_ok = jnp.where(inp.dh_limit[g] > 0,
+                      job_count < inp.dh_limit[g], True)
+    # distinct_property: node's per-value count must stay under the limit
+    kd = pd_counts.shape[1]
+    pd_val = jnp.clip(inp.pd_nodeval, 0, kd - 1)             # [D, N]
+    pd_cnt = jnp.take_along_axis(pd_counts, pd_val, axis=1)  # [D, N]
+    pd_row_ok = (pd_cnt < inp.pd_limit[:, None]) & (inp.pd_nodeval >= 0)
+    pd_applies = inp.pd_apply[g] & (inp.pd_limit > 0)        # [D]
+    pd_ok = jnp.all(jnp.where(pd_applies[:, None], pd_row_ok, True),
+                    axis=0)                                  # [N]
+    feas = stat_g & fit & dh_ok & pd_ok
+
+    # ---- rank chain ----
+    # normalized to [0,1] like the reference (rank.go: fit/maxFitScore)
+    # so binpack is comparable with the ±1-bounded affinity/spread boosts
+    bp = binpack_score(st.capf, used.astype(jnp.float32),
+                       req_g.astype(jnp.float32),
+                       inp.spread_algo) / 18.0
+    aa = job_anti_affinity(job_count, inp.desired[g])
+    rp = jnp.where(st.rows == prev, -1.0, 0.0)
+    af = st.aff_sc[g]
+    sp = spread_boost(inp.sp_nodeval, inp.sp_weight,
+                      inp.sp_expected, sp_counts)
+    comps = jnp.stack([bp, aa, rp, af, sp])            # [5, N]
+    act_mask = jnp.stack([
+        jnp.ones(n, bool),
+        job_count > 0,
+        st.rows == prev,
+        jnp.broadcast_to(st.aff_any[g], (n,)),
+        jnp.broadcast_to(st.sp_any, (n,)),
+    ])
+    final = normalize_scores(comps, act_mask)
+    return feas, final, stat_g, fit, dh_ok
+
+
+def place(inp: PlacementInputs) -> PlacementOutputs:
+    n = inp.attrs.shape[0]
+    top_k = min(TOP_K, n)
+    st = scan_statics(inp, jnp.arange(n))
+    static, noise = st.static, st.noise
 
     def step(carry, xs):
         used, job_count, sp_counts, pd_counts = carry
         g, prev, act = xs
         req_g = inp.req[g]
         stat_g = static[g]
-        fit = capacity_fit(inp.cap, used, req_g)
-        dh_ok = jnp.where(inp.dh_limit[g] > 0,
-                          job_count < inp.dh_limit[g], True)
-        # distinct_property: node's per-value count must stay under the limit
-        kd = pd_counts.shape[1]
-        pd_val = jnp.clip(inp.pd_nodeval, 0, kd - 1)             # [D, N]
-        pd_cnt = jnp.take_along_axis(pd_counts, pd_val, axis=1)  # [D, N]
-        pd_row_ok = (pd_cnt < inp.pd_limit[:, None]) & (inp.pd_nodeval >= 0)
-        pd_applies = inp.pd_apply[g] & (inp.pd_limit > 0)        # [D]
-        pd_ok = jnp.all(jnp.where(pd_applies[:, None], pd_row_ok, True),
-                        axis=0)                                  # [N]
-        feas = stat_g & fit & dh_ok & pd_ok
-
-        # ---- rank chain ----
-        # normalized to [0,1] like the reference (rank.go: fit/maxFitScore)
-        # so binpack is comparable with the ±1-bounded affinity/spread boosts
-        bp = binpack_score(capf, used.astype(jnp.float32),
-                           req_g.astype(jnp.float32),
-                           inp.spread_algo) / 18.0
-        aa = job_anti_affinity(job_count, inp.desired[g])
-        rows = jnp.arange(n)
-        rp = jnp.where(rows == prev, -1.0, 0.0)
-        af = aff_sc[g]
-        sp = spread_boost(inp.sp_nodeval, inp.sp_weight,
-                          inp.sp_expected, sp_counts)
-        comps = jnp.stack([bp, aa, rp, af, sp])            # [5, N]
-        act_mask = jnp.stack([
-            jnp.ones(n, bool),
-            job_count > 0,
-            rows == prev,
-            jnp.broadcast_to(aff_any[g], (n,)),
-            jnp.broadcast_to(sp_any, (n,)),
-        ])
-        final = normalize_scores(comps, act_mask)
+        feas, final, _, fit, dh_ok = step_scores(inp, st, carry, g, prev)
+        rows = st.rows
 
         # selection order gets the tie-break noise; reported scores do not
         masked = jnp.where(feas, final, NEG_INF)
@@ -193,6 +229,7 @@ def place(inp: PlacementInputs) -> PlacementOutputs:
                   * ((val_p >= 0) & ok)[..., None])
         sp_counts = sp_counts + sp_hot
         # distinct_property counts bump only for rows applying to this TG
+        kd = pd_counts.shape[1]
         pd_val_p = jnp.where(pick >= 0,
                              inp.pd_nodeval[:, jnp.maximum(pick, 0)],
                              -1)                            # [D]
@@ -231,19 +268,18 @@ def place(inp: PlacementInputs) -> PlacementOutputs:
 place_jit = jax.jit(place)
 
 
-def place_packed(inp: PlacementInputs):
-    """`place` with every per-placement output packed into ONE int32 buffer
-    `[P, 14]` (floats bitcast) so the host pays a single device→host
-    round trip — the PJRT transport here is a network tunnel with a
-    ~30-100ms fixed cost per array fetch, which dominated eval latency
-    when the engine fetched ten arrays per batch.
+def pack_outputs(out: PlacementOutputs):
+    """Pack per-placement outputs into ONE int32 buffer `[P, 14]` (floats
+    bitcast) so the host pays a single device→host round trip — the PJRT
+    transport here is a network tunnel with a ~30-100ms fixed cost per
+    array fetch, which dominated eval latency when the engine fetched ten
+    arrays per batch.
 
     Column layout: 0 pick | 1 score | 2-4 topk_rows | 5-7 topk_scores |
     8 n_feasible | 9 n_filtered | 10 n_exhausted | 11-13 dim_exhausted.
     Returns (buf, used, job_count); used/job_count are fetched lazily by
     the engine only on the preemption fallback path.
     """
-    out = place(inp)
     f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
     p, top_k = out.topk_rows.shape
     pad_k = jnp.full((p, 3 - top_k), -1, jnp.int32)
@@ -256,6 +292,11 @@ def place_packed(inp: PlacementInputs):
         out.n_exhausted[:, None], out.dim_exhausted,
     ], axis=1)
     return buf, out.used, out.job_count
+
+
+def place_packed(inp: PlacementInputs):
+    """`place` + pack_outputs (see there for the layout)."""
+    return pack_outputs(place(inp))
 
 
 place_packed_jit = jax.jit(place_packed)
@@ -298,26 +339,19 @@ def _to_bulk_inputs(inp: PlacementInputs) -> BulkInputs:
         seed=inp.seed, extra_mask=inp.extra_mask)
 
 
-def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
-               carry, want):
-    """One water-fill round of the bulk kernel.  Returns compact per-round
-    outputs: the sorted fill prefix (node rows + per-node fill counts +
-    scores, length `round_size`) and shared round metrics — everything the
-    host needs, at O(round_size) not O(N) per round.
-
-    `static_t` is the loop-invariant (feasibility mask, affinity scores)
-    triple, computed once in _bulk_scan and closed over — recomputing it
-    per round would multiply the gather/reduce chain by the round count.
-    """
+def bulk_round_scores(inp: BulkInputs, static_t, used, job_count,
+                      round_size: int):
+    """Per-node intake capacity (k_i) and rank-chain score for one
+    water-fill round at the current proposed state — shared verbatim by
+    the single-device bulk kernel and the sharded variant
+    (parallel/mesh._bulk_local), so the two cannot drift."""
     n = inp.attrs.shape[0]
     g = inp.g
     req = inp.req[g]
     capf = inp.cap.astype(jnp.float32)
     big = jnp.int32(round_size)
+    static, aff_sc, aff_any, _ = static_t
 
-    static, aff_sc, aff_any, noise = static_t
-
-    used, job_count = carry
     free = inp.cap - used
     per_dim = jnp.where(req[None, :] > 0,
                         free // jnp.maximum(req[None, :], 1), big)
@@ -343,6 +377,46 @@ def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
         jnp.broadcast_to(aff_any, (n,)),
     ])
     score = normalize_scores(comps, act_mask)
+    return k_i, score
+
+
+def bulk_round_metrics(inp: BulkInputs, static, used, job_count):
+    """Post-commit exhaustion metrics for one water-fill round (shared by
+    the single-device and sharded bulk kernels; the sharded caller psums
+    the returned local sums)."""
+    req = inp.req[inp.g]
+    free2 = inp.cap - used
+    fit2 = jnp.all(free2 >= req[None, :], axis=1) & jnp.all(
+        free2 >= 0, axis=1)
+    dh_ok2 = jnp.where(inp.dh_limit[inp.g] > 0,
+                       job_count < inp.dh_limit[inp.g], True)
+    exhausted2 = static & ~(fit2 & dh_ok2)
+    n_exh = jnp.sum(exhausted2)
+    dim_ex = jnp.sum(exhausted2[:, None] & (free2 < req[None, :]), axis=0)
+    return n_exh, dim_ex
+
+
+def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
+               carry, want):
+    """One water-fill round of the bulk kernel.  Returns compact per-round
+    outputs: the sorted fill prefix (node rows + per-node fill counts +
+    scores, length `round_size`) and shared round metrics — everything the
+    host needs, at O(round_size) not O(N) per round.
+
+    `static_t` is the loop-invariant (feasibility mask, affinity scores)
+    triple, computed once in _bulk_scan and closed over — recomputing it
+    per round would multiply the gather/reduce chain by the round count.
+    """
+    n = inp.attrs.shape[0]
+    g = inp.g
+    req = inp.req[g]
+    big = jnp.int32(round_size)
+
+    static, aff_sc, aff_any, noise = static_t
+
+    used, job_count = carry
+    k_i, score = bulk_round_scores(inp, static_t, used, job_count,
+                                   round_size)
 
     # spread algorithm: cap per-node intake so a round fans out
     viable = jnp.maximum(jnp.sum(k_i > 0), 1)
@@ -394,16 +468,9 @@ def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
     # this round failed against capacity already consumed by the round's
     # earlier fills (sequential semantics), and for successful rounds the
     # stock metric likewise counts nodes filled by earlier placements
-    free2 = inp.cap - used
-    fit2 = jnp.all(free2 >= req[None, :], axis=1) & jnp.all(
-        free2 >= 0, axis=1)
-    dh_ok2 = jnp.where(inp.dh_limit[g] > 0,
-                       job_count < inp.dh_limit[g], True)
-    exhausted2 = static & ~(fit2 & dh_ok2)
-    n_exh = jnp.sum(exhausted2).astype(jnp.int32)
-    dim_ex = jnp.sum(
-        exhausted2[:, None] & (free2 < req[None, :]),
-        axis=0).astype(jnp.int32)
+    n_exh, dim_ex = bulk_round_metrics(inp, static, used, job_count)
+    n_exh = n_exh.astype(jnp.int32)
+    dim_ex = dim_ex.astype(jnp.int32)
 
     out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
            n_feas, n_filt, n_exh, dim_ex,

@@ -24,20 +24,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from nomad_tpu.ops.feasibility import feasible_mask
-from nomad_tpu.ops.scoring import (
-    affinity_score,
-    binpack_score,
-    capacity_fit,
-    job_anti_affinity,
-    normalize_scores,
-    spread_boost,
-)
 from nomad_tpu.ops.select import (
     NEG_INF,
     TOP_K,
+    BulkInputs,
     PlacementInputs,
     PlacementOutputs,
+    _bulk_static,
+    bulk_round_metrics,
+    bulk_round_scores,
+    pack_outputs,
+    scan_statics,
+    step_scores,
     tiebreak_noise,
 )
 
@@ -57,60 +55,28 @@ def pad_nodes(n: int, ndev: int) -> int:
 
 
 def _place_local(inp: PlacementInputs) -> PlacementOutputs:
-    """Per-shard body (runs under shard_map).  Mirrors ops.select.place but
-    with global winner selection and replicated count-state updates."""
+    """Per-shard body (runs under shard_map).  The scoring core is
+    ops.select.step_scores — literally the same function the single-device
+    scan runs, fed global row ids — so the two deployments cannot drift;
+    only winner selection (two-stage top-k over ICI) and count-state
+    updates (owner-shard psum broadcast) differ."""
     n_loc = inp.attrs.shape[0]
-    ndev = jax.lax.axis_size(AXIS)
     offset = jax.lax.axis_index(AXIS) * n_loc
     global_rows = offset + jnp.arange(n_loc)
     k_loc = min(TOP_K, n_loc)
 
-    static = feasible_mask(inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
-                           inp.con, inp.luts)              # [G, N_loc]
-    if inp.extra_mask is not None:
-        static = static & inp.extra_mask
-    aff_sc = affinity_score(inp.attrs, inp.aff, inp.luts)  # [G, N_loc]
-    aff_any = jnp.any(inp.aff[..., 3] != 0, axis=1)
-    sp_any = jnp.any(inp.sp_weight > 0)
-    capf = inp.cap.astype(jnp.float32)
-    # global-row-keyed tie-break: identical for a given global row on every
-    # shard, so the two-stage top-k stays consistent across the mesh
-    noise = tiebreak_noise(inp.seed, global_rows)
+    # global-row-keyed statics: tie-break noise is identical for a given
+    # GLOBAL row on every shard, so the two-stage top-k stays consistent
+    st = scan_statics(inp, global_rows)
+    static, noise = st.static, st.noise
 
     def step(carry, xs):
         used, job_count, sp_counts, pd_counts = carry
         g, prev, act = xs
         req_g = inp.req[g]
         stat_g = static[g]
-        fit = capacity_fit(inp.cap, used, req_g)
-        dh_ok = jnp.where(inp.dh_limit[g] > 0,
-                          job_count < inp.dh_limit[g], True)
+        feas, final, _, fit, dh_ok = step_scores(inp, st, carry, g, prev)
         kd = pd_counts.shape[1]
-        pd_val = jnp.clip(inp.pd_nodeval, 0, kd - 1)
-        pd_cnt = jnp.take_along_axis(pd_counts, pd_val, axis=1)
-        pd_row_ok = (pd_cnt < inp.pd_limit[:, None]) & (inp.pd_nodeval >= 0)
-        pd_applies = inp.pd_apply[g] & (inp.pd_limit > 0)
-        pd_ok = jnp.all(jnp.where(pd_applies[:, None], pd_row_ok, True),
-                        axis=0)
-        feas = stat_g & fit & dh_ok & pd_ok
-
-        bp = binpack_score(capf, used.astype(jnp.float32),
-                           req_g.astype(jnp.float32),
-                           inp.spread_algo) / 18.0
-        aa = job_anti_affinity(job_count, inp.desired[g])
-        rp = jnp.where(global_rows == prev, -1.0, 0.0)
-        af = aff_sc[g]
-        sp = spread_boost(inp.sp_nodeval, inp.sp_weight,
-                          inp.sp_expected, sp_counts)
-        comps = jnp.stack([bp, aa, rp, af, sp])
-        act_mask = jnp.stack([
-            jnp.ones(n_loc, bool),
-            job_count > 0,
-            global_rows == prev,
-            jnp.broadcast_to(aff_any[g], (n_loc,)),
-            jnp.broadcast_to(sp_any, (n_loc,)),
-        ])
-        final = normalize_scores(comps, act_mask)
         # selection order gets the tie-break noise; reported scores recover
         # the true value by re-hashing the chosen global rows
         masked = jnp.where(feas, final, NEG_INF) + noise
@@ -153,9 +119,11 @@ def _place_local(inp: PlacementInputs) -> PlacementOutputs:
                   * ((pval >= 0) & inp.pd_apply[g] & ok)[..., None])
         pd_counts = pd_counts + pd_hot
 
-        # ---- metrics (global) ----
+        # ---- metrics (global; same classification as select.place:
+        # distinct_property misses count as neither filtered nor
+        # exhausted there, so not here either) ----
         n_filtered = jax.lax.psum(jnp.sum(~stat_g), AXIS)
-        exhausted = stat_g & (~fit | ~dh_ok | ~pd_ok)
+        exhausted = stat_g & (~fit | ~dh_ok)
         n_exhausted = jax.lax.psum(jnp.sum(exhausted), AXIS)
         n_feas = jax.lax.psum(jnp.sum(feas), AXIS)
         pre_used = used - onehot[:, None].astype(jnp.int32) * req_g[None, :]
@@ -215,4 +183,187 @@ def place_sharded_fn(mesh: Mesh):
     f = jax.shard_map(_place_local, mesh=mesh,
                       in_specs=(in_specs,), out_specs=out_specs,
                       check_vma=False)
+    return jax.jit(f)
+
+
+def place_sharded_packed_fn(mesh: Mesh):
+    """Sharded placement + ops.select.pack_outputs in one jit: the packed
+    [P, 14] buffer is what PlacementEngine fetches (single device→host
+    transfer); used/job_count stay sharded on the mesh."""
+    spec_n = P(AXIS)
+    in_specs = PlacementInputs(
+        attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n,
+        dc_mask=spec_n, pool_mask=spec_n, luts=P(),
+        con=P(), aff=P(), req=P(), desired=P(), dh_limit=P(),
+        sp_nodeval=P(None, AXIS), sp_weight=P(), sp_expected=P(),
+        sp_counts0=P(),
+        pd_nodeval=P(None, AXIS), pd_limit=P(), pd_apply=P(), pd_counts0=P(),
+        tg_idx=P(), prev_row=P(), active=P(), job_count0=spec_n,
+        spread_algo=P(), seed=P(),
+        extra_mask=P(None, AXIS),
+    )
+    out_specs = PlacementOutputs(
+        picks=P(), scores=P(), topk_rows=P(), topk_scores=P(),
+        n_feasible=P(), n_filtered=P(), n_exhausted=P(), dim_exhausted=P(),
+        used=spec_n, job_count=spec_n,
+    )
+    inner = jax.shard_map(_place_local, mesh=mesh,
+                          in_specs=(in_specs,), out_specs=out_specs,
+                          check_vma=False)
+
+    def f(inp):
+        return pack_outputs(inner(inp))
+
+    return jax.jit(f)
+
+
+# ------------------------------------------------------------ bulk kernel
+
+
+def _bulk_local(inp: BulkInputs, round_size: int, n_rounds: int,
+                top_k: int):
+    """Per-shard body of the sharded bulk (water-fill rounds) kernel.
+    The round's intake/score math is ops.select.bulk_round_scores — the
+    same function the single-device kernel runs — on the local node
+    shard; the fill is decided globally from an all-gather of each
+    shard's top candidates, then committed by the owning shards."""
+    n_loc = inp.attrs.shape[0]
+    offset = jax.lax.axis_index(AXIS) * n_loc
+    global_rows = offset + jnp.arange(n_loc)
+    big = jnp.int32(round_size)
+
+    static, aff_sc, aff_any, _ = _bulk_static(inp, inp.g)
+    noise = tiebreak_noise(inp.seed, global_rows)
+    static_t = (static, aff_sc, aff_any, noise)
+
+    def round_step(carry, want):
+        used, job_count = carry
+        k_i, score = bulk_round_scores(inp, static_t, used, job_count,
+                                       round_size)
+
+        # spread algorithm: cap per-node intake so a round fans out
+        # (viable counted over the WHOLE mesh)
+        viable = jnp.maximum(jax.lax.psum(jnp.sum(k_i > 0), AXIS), 1)
+        cap_round = jnp.where(
+            inp.spread_algo,
+            jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
+        k_round = jnp.minimum(k_i, cap_round)
+
+        # two-stage candidate selection: each shard contributes its local
+        # top min(round_size, n_loc) nodes; the union is a superset of
+        # the global top round_size because every global winner is a
+        # local winner on its shard
+        kk_loc = min(round_size, n_loc)
+        masked = jnp.where(k_round > 0, score, NEG_INF)
+        loc_nsc, loc_order = jax.lax.top_k(masked + noise, kk_loc)
+        loc_pack = jnp.stack([
+            loc_nsc,
+            jnp.where(loc_nsc > NEG_INF / 2, score[loc_order], NEG_INF),
+            k_round[loc_order].astype(jnp.float32),
+            global_rows[loc_order].astype(jnp.float32),
+        ])                                                   # [4, kk_loc]
+        allp = jax.lax.all_gather(loc_pack, AXIS, axis=1).reshape(4, -1)
+        kk_glob = min(round_size, allp.shape[1])
+        g_nsc, g_idx = jax.lax.top_k(allp[0], kk_glob)
+        sc_k = jnp.where(g_nsc > NEG_INF / 2, allp[1][g_idx], NEG_INF)
+        k_sorted = jnp.where(sc_k > NEG_INF / 2,
+                             allp[2][g_idx].astype(jnp.int32), 0)
+        rows_k = allp[3][g_idx].astype(jnp.int32)
+
+        # water-fill the sorted candidates up to `want` (replicated math)
+        csum = jnp.cumsum(k_sorted)
+        c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
+        placed_total = jnp.sum(c_sorted)
+
+        # commit: each shard applies the fills for rows it owns
+        mine = (rows_k >= offset) & (rows_k < offset + n_loc)
+        loc_rows = jnp.clip(rows_k - offset, 0, n_loc - 1)
+        c_i = (jnp.zeros(n_loc, jnp.int32)
+               .at[loc_rows].add(
+                   jnp.where(mine, c_sorted, 0).astype(jnp.int32),
+                   mode="drop"))
+        req = inp.req[inp.g]
+        used = used + c_i[:, None] * req[None, :]
+        job_count = job_count + c_i
+
+        # compact fill prefix (pad when the whole cluster is smaller
+        # than a round)
+        pad = round_size - kk_glob
+        if pad:
+            rows_p = jnp.concatenate([rows_k, jnp.zeros(pad, rows_k.dtype)])
+            cnt_p = jnp.concatenate(
+                [c_sorted.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
+            sc_p = jnp.concatenate([sc_k, jnp.full(pad, NEG_INF, sc_k.dtype)])
+        else:
+            rows_p, cnt_p, sc_p = rows_k, c_sorted.astype(jnp.int32), sc_k
+
+        # round metrics (global, same classification as the single-device
+        # kernel: POST-commit exhaustion)
+        tk = min(top_k, kk_glob)
+        top_sc = sc_p[:tk]
+        top_rows = jnp.where(top_sc > NEG_INF / 2, rows_p[:tk], -1)
+        top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
+        n_feas = jax.lax.psum(jnp.sum(k_round > 0), AXIS).astype(jnp.int32)
+        n_filt = jax.lax.psum(jnp.sum(~static), AXIS).astype(jnp.int32)
+        n_exh_l, dim_ex_l = bulk_round_metrics(inp, static, used, job_count)
+        n_exh = jax.lax.psum(n_exh_l, AXIS).astype(jnp.int32)
+        dim_ex = jax.lax.psum(dim_ex_l, AXIS).astype(jnp.int32)
+
+        out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
+               n_feas, n_filt, n_exh, dim_ex,
+               placed_total.astype(jnp.int32))
+        return (used, job_count), out
+
+    want_r = jnp.clip(
+        inp.p_real - jnp.arange(n_rounds, dtype=jnp.int32) * round_size,
+        0, round_size)
+    carry0 = (inp.used0, inp.job_count0)
+    (used, job_count), outs = jax.lax.scan(round_step, carry0, want_r)
+    return outs + (used, job_count)
+
+
+def place_bulk_sharded_packed_fn(mesh: Mesh, round_size: int,
+                                 n_rounds: int):
+    """Sharded bulk kernel with the same compact packed buffer layout as
+    ops.select.place_bulk_packed (with_scores variant included via the
+    `with_scores` call arg being fixed False — the engine's BulkDecisions
+    path never reads per-placement scores)."""
+    import jax.numpy as jnp  # noqa: F811 (local clarity)
+
+    spec_n = P(AXIS)
+    in_specs = BulkInputs(
+        attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n,
+        dc_mask=spec_n, pool_mask=spec_n, luts=P(),
+        con=P(), aff=P(), req=P(), desired=P(), dh_limit=P(),
+        job_count0=spec_n, spread_algo=P(), g=P(), p_real=P(), seed=P(),
+        extra_mask=P(None, AXIS),
+    )
+    out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                 spec_n, spec_n)
+    top_k = TOP_K
+    inner = jax.shard_map(
+        partial(_bulk_local, round_size=round_size, n_rounds=n_rounds,
+                top_k=top_k),
+        mesh=mesh, in_specs=(in_specs,), out_specs=out_specs,
+        check_vma=False)
+
+    def f(inp: BulkInputs):
+        (rows_p, cnt_p, sc_p, top_rows, top_sc,
+         n_feas, n_filt, n_exh, dim_ex, placed, used, job_count) = inner(inp)
+        f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+        fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
+        r = top_rows.shape[0]
+        tk = top_rows.shape[1]
+        meta = jnp.concatenate([
+            jnp.concatenate([top_rows,
+                             jnp.full((r, 3 - tk), -1, jnp.int32)], axis=1),
+            jnp.concatenate([f2i(top_sc),
+                             jnp.zeros((r, 3 - tk), jnp.int32)], axis=1),
+            n_feas[:, None], n_filt[:, None], n_exh[:, None],
+            dim_ex, placed[:, None],
+            jnp.zeros((r, 3), jnp.int32),
+        ], axis=1)
+        buf = jnp.concatenate([fills, meta], axis=1)
+        return buf, used, job_count
+
     return jax.jit(f)
