@@ -30,11 +30,21 @@ class Agent:
                  data_dir: Optional[str] = None,
                  plugin_dir: str = "",
                  encrypt: str = "") -> None:
+        # cluster shared secret: encrypt + authenticate every server-plane
+        # wire frame (raft/gossip/RPC) — core/wire.py.  The key is
+        # process-global (one cluster per process): set_key raises on a
+        # conflicting non-empty key, and a plaintext agent in a keyed
+        # process is a loud config error — neither silent inheritance of
+        # the old key nor a silent downgrade that would strip encryption
+        # out from under the running cluster.
+        from nomad_tpu.core import wire
         if encrypt:
-            # cluster shared secret: encrypt + authenticate every
-            # server-plane wire frame (raft/gossip/RPC) — core/wire.py
-            from nomad_tpu.core import wire
             wire.set_key(encrypt)
+        elif wire.has_key():
+            raise ValueError(
+                "this process already has a cluster encrypt key installed; "
+                "in-process agents must share it (pass the same encrypt "
+                "value, or reset deliberately with wire.set_key(None))")
         if not server_enabled:
             raise NotImplementedError(
                 "client-only agents need a remote RPC transport; "

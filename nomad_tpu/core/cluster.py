@@ -174,8 +174,11 @@ class RPCServer:
                              args=(conn,)).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from . import wire
+        req_tag = wire.channel_tag("rpc", "req", self.addr)
+        rep_tag = wire.channel_tag("rpc", "rep", self.addr)
         with conn:
-            msg = recv_msg(conn, timeout=30.0)
+            msg = recv_msg(conn, timeout=30.0, tag=req_tag)
             if msg is None:
                 return
             method = msg.get("method", "")
@@ -183,7 +186,7 @@ class RPCServer:
                 # shutting down: refuse with a retryable redirect rather
                 # than executing against a dying server
                 reply(conn, {"ok": False, "not_leader": True,
-                             "leader_rpc": None})
+                             "leader_rpc": None}, tag=rep_tag)
                 return
             args = msg.get("args", ())
             kwargs = msg.get("kwargs", {})
@@ -193,16 +196,19 @@ class RPCServer:
                     # non-leader bounces back instead of chaining hops
                     reply(conn, {"ok": False, "not_leader": True,
                                  "leader_rpc":
-                                     self.cluster.leader_rpc_addr()})
+                                     self.cluster.leader_rpc_addr()},
+                          tag=rep_tag)
                     return
                 result = self.cluster.rpc_call(method, args, kwargs)
-                reply(conn, {"ok": True, "result": result})
+                reply(conn, {"ok": True, "result": result}, tag=rep_tag)
             except NotLeaderError as e:
                 reply(conn, {"ok": False, "not_leader": True,
-                             "leader_rpc": self.cluster.leader_rpc_addr()})
+                             "leader_rpc": self.cluster.leader_rpc_addr()},
+                      tag=rep_tag)
             except Exception as e:  # noqa: BLE001 - surface to the caller
                 reply(conn, {"ok": False,
-                             "error": f"[{self.cluster.name}] {e!r}"})
+                             "error": f"[{self.cluster.name}] {e!r}"},
+                      tag=rep_tag)
 
 
 class RemoteRPC:

@@ -108,7 +108,7 @@ class Gossip:
     def join(self, seed: Tuple[str, int]) -> bool:
         """Push-pull state sync with any existing member."""
         r = send_msg(seed, {"type": "sync", "members": self._wire_members()},
-                     timeout=2.0)
+                     timeout=2.0, channel="serf")
         if r is None:
             return False
         self._merge(r.get("members", []))
@@ -124,7 +124,8 @@ class Gossip:
             peers = [m for m in self.members.values()
                      if m.name != self.name and m.status == ALIVE]
         for m in peers:
-            send_msg(m.addr, {"type": "sync", "members": wire}, timeout=0.5)
+            send_msg(m.addr, {"type": "sync", "members": wire}, timeout=0.5,
+                     channel="serf")
 
     def alive_members(self) -> Dict[str, Member]:
         with self._lock:
@@ -204,14 +205,17 @@ class Gossip:
                              args=(conn,)).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from . import wire
         with conn:
-            msg = recv_msg(conn, timeout=2.0)
+            msg = recv_msg(conn, timeout=2.0,
+                           tag=wire.channel_tag("serf", "req", self.addr))
             if msg is None:
                 return
             if msg.get("type") in ("ping", "sync"):
                 self._merge(msg.get("members", []))
                 reply(conn, {"type": "ack",
-                             "members": self._wire_members()})
+                             "members": self._wire_members()},
+                      tag=wire.channel_tag("serf", "rep", self.addr))
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval):
@@ -224,7 +228,7 @@ class Gossip:
             target = random.choice(candidates)
             r = send_msg(target.addr,
                          {"type": "ping", "members": self._wire_members()},
-                         timeout=0.5)
+                         timeout=0.5, channel="serf")
             now = time.monotonic()
             if r is not None:
                 self._merge(r.get("members", []))

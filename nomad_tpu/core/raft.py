@@ -66,21 +66,24 @@ class Entry:
 
 
 def send_msg(addr: Tuple[str, int], msg: dict, timeout: float = 1.0,
-             ) -> Optional[dict]:
+             channel: str = "rpc") -> Optional[dict]:
     """One-shot request/response; None on any failure.
     Encoding happens OUTSIDE the net of swallowed errors: an
     unencodable payload is a local programming error and must raise,
-    not masquerade as a dead server."""
-    frame = wire.encode_frame(msg)
+    not masquerade as a dead server.  `channel` binds the encrypted
+    frame to the destination plane+listener (wire.channel_tag)."""
+    frame = wire.encode_frame(msg, tag=wire.channel_tag(channel, "req", addr))
     try:
         with socket.create_connection(addr, timeout=timeout) as s:
             s.sendall(frame)
-            return recv_msg(s, timeout)
+            return recv_msg(s, timeout,
+                            tag=wire.channel_tag(channel, "rep", addr))
     except (OSError, ValueError, EOFError):
         return None
 
 
-def recv_msg(sock: socket.socket, timeout: float = 5.0) -> Optional[dict]:
+def recv_msg(sock: socket.socket, timeout: float = 5.0,
+             tag: bytes = b"") -> Optional[dict]:
     sock.settimeout(timeout)
     try:
         hdr = _recv_exact(sock, 4)
@@ -90,7 +93,7 @@ def recv_msg(sock: socket.socket, timeout: float = 5.0) -> Optional[dict]:
         body = _recv_exact(sock, n)
         if body is None:
             return None
-        return wire.decode_body(body)
+        return wire.decode_body(body, tag=tag)
     except (OSError, ValueError, TypeError, EOFError):
         return None
 
@@ -105,9 +108,9 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def reply(sock: socket.socket, msg: dict) -> None:
+def reply(sock: socket.socket, msg: dict, tag: bytes = b"") -> None:
     try:
-        sock.sendall(wire.encode_frame(msg))
+        sock.sendall(wire.encode_frame(msg, tag=tag))
     except OSError:
         pass
 
@@ -423,7 +426,7 @@ class RaftNode:
             results.append(send_msg(addr, {
                 "type": "vote_req", "term": term, "cand": self.name,
                 "last_idx": last_idx, "last_term": last_term},
-                timeout=0.5))
+                timeout=0.5, channel="raft"))
 
         for addr in peers.values():
             t = threading.Thread(target=ask, daemon=True, args=(addr,))
@@ -530,14 +533,16 @@ class RaftNode:
             # would trip the receiver's replay guard), and OUTSIDE the
             # try: an unencodable payload must raise, not look like a
             # dead peer
-            frame = wire.encode_frame(msg)
+            frame = wire.encode_frame(
+                msg, tag=wire.channel_tag("raft", "req", addr))
             try:
                 # raising send (NOT reply(), which swallows OSError):
                 # a failed send must trigger the immediate reconnect
                 # below, not a silent 2s recv timeout on a request that
                 # never left
                 sock.sendall(frame)
-                r = recv_msg(sock, timeout=2.0)
+                r = recv_msg(sock, timeout=2.0,
+                             tag=wire.channel_tag("raft", "rep", addr))
                 if r is not None:
                     return sock, r
             except (OSError, ValueError):
@@ -676,9 +681,11 @@ class RaftNode:
     def _serve_conn(self, conn: socket.socket) -> None:
         """Serve a connection until the peer closes it: replicators hold
         one persistent connection and pump many messages through it."""
+        req_tag = wire.channel_tag("raft", "req", self.addr)
+        rep_tag = wire.channel_tag("raft", "rep", self.addr)
         with conn:
             while not self._stop.is_set():
-                msg = recv_msg(conn, timeout=10.0)
+                msg = recv_msg(conn, timeout=10.0, tag=req_tag)
                 if msg is None:
                     return
                 handler = {"vote_req": self._on_vote_req,
@@ -689,7 +696,7 @@ class RaftNode:
                 resp = handler(msg)
                 if resp is None:
                     return
-                reply(conn, resp)
+                reply(conn, resp, tag=rep_tag)
 
     def _on_vote_req(self, m: dict) -> dict:
         with self._lock:

@@ -147,7 +147,14 @@ class Server:
 
         def tick_loop():
             while not self._tick_stop.wait(tick_interval):
-                self.tick()
+                # a tick must never kill the loop: leadership can move
+                # between tick()'s _leader check and a forwarded write
+                # (NotLeaderError), and any other transient failure will
+                # be retried next tick anyway
+                try:
+                    self.tick()
+                except Exception as exc:  # noqa: BLE001
+                    log("server", "warn", "tick failed", error=repr(exc))
 
         self._tick_thread = threading.Thread(target=tick_loop,
                                              name="server-tick", daemon=True)

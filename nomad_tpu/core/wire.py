@@ -18,7 +18,20 @@ Python server plane the same property:
     whose timestamp falls outside a freshness window — or whose nonce
     was already seen inside it — are dropped (bounded replay
     protection; peers' clocks must agree within the window, like the
-    reference's ACL-token expiry handling assumes).
+    reference's ACL-token expiry handling assumes).  Frames are bound
+    to their destination via AAD: the transport passes a
+    (channel, direction, listener-address) tag (`channel_tag`) so a
+    frame captured en route to one listener cannot be replayed to a
+    different node, port, or plane (raft/gossip/rpc), and a request
+    cannot be reflected as a reply.  Replay-cache entries are recorded
+    only AFTER successful authentication (forged floods cannot grow the
+    cache or poison legitimate nonces) and the cache is hard-capped
+    with oldest-first eviction.
+
+The key is process-global: one cluster secret per process.  `set_key`
+raises if a DIFFERENT non-empty key is already installed (in-process
+multi-agent setups must share one cluster); an empty value explicitly
+resets to plaintext.
 
 Durable files (raft log/meta on local disk) are NOT wire and keep their
 own encoding — the trust boundary is the socket, not the local disk.
@@ -31,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import itertools
 import os
 import struct
 import threading
@@ -46,6 +60,9 @@ _NONCE_LEN = 12
 _TS_LEN = 8
 # |sender clock - receiver clock| + network latency must fit here
 REPLAY_WINDOW_S = 120.0
+# hard cap on the replay cache: beyond this, oldest entries are evicted
+# (dict insertion order == expiry order, expiries are now + constant)
+MAX_SEEN_NONCES = 65536
 
 _KEY: Optional[bytes] = None
 _aead = None
@@ -56,15 +73,29 @@ _REGISTRY: Dict[str, type] = {}
 _registered_modules: set = set()
 
 
-def set_key(secret: Optional[str]) -> None:
+def set_key(secret: Optional[str], force: bool = False) -> None:
     """Install the cluster shared secret (agent `encrypt` option).
-    None/empty disables frame encryption (loopback/dev clusters)."""
+    None/empty disables frame encryption (loopback/dev clusters) —
+    an explicit reset, never silent inheritance of a previous key.
+    Raises ValueError when a DIFFERENT non-empty key is already
+    installed (the key is process-global: one cluster per process);
+    `force=True` overrides (tests / deliberate re-keying)."""
     global _KEY, _aead
     if not secret:
+        if _KEY is None:
+            return                     # idempotent: nothing to reset
         _KEY, _aead = None, None
     else:
         from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-        _KEY = hashlib.sha256(secret.encode("utf-8")).digest()
+        new_key = hashlib.sha256(secret.encode("utf-8")).digest()
+        if _KEY == new_key:
+            return                     # idempotent: keep the replay cache
+        if _KEY is not None and not force:
+            raise ValueError(
+                "a different cluster encrypt key is already installed in "
+                "this process (the wire key is process-global: one cluster "
+                "per process; pass force=True to re-key deliberately)")
+        _KEY = new_key
         _aead = AESGCM(_KEY)
     with _seen_lock:
         _seen_nonces.clear()
@@ -138,30 +169,64 @@ def unpackb(data: bytes) -> Any:
                            strict_map_key=False)
 
 
-def encode_frame(msg: Any) -> bytes:
-    """msg -> length-prefixed (optionally encrypted) frame bytes."""
+def channel_tag(channel: str, direction: str, addr) -> bytes:
+    """AAD binding a frame to its destination: the plane
+    (raft/serf/rpc), the direction (req = toward the listener,
+    rep = the listener's reply on that connection), and the listener's
+    advertised host:port.  Senders derive it from the address they
+    dial; the listener from its own advertised address — the two are
+    the same tuple in this codebase (listeners bind concrete addresses,
+    default 127.0.0.1, and gossip propagates the bound tuples).
+    CONSTRAINT: the dialed and advertised strings must match textually —
+    a wildcard bind (0.0.0.0) or hostname seed would make every frame
+    fail auth; an advertise-address knob must be added before either is
+    supported."""
+    host, port = addr
+    return f"{channel}|{direction}|{host}:{port}".encode("utf-8")
+
+
+def encode_frame(msg: Any, tag: bytes = b"") -> bytes:
+    """msg -> length-prefixed (optionally encrypted) frame bytes.
+    `tag` (see channel_tag) rides as additional authenticated data —
+    the receiver must present the identical tag to decode."""
     body = packb(msg)
     if _aead is not None:
         ts = struct.pack(">d", time.time())
         nonce = os.urandom(_NONCE_LEN)
-        body = ts + nonce + _aead.encrypt(nonce, body, ts)
+        body = ts + nonce + _aead.encrypt(nonce, body, ts + tag)
     return struct.pack(">I", len(body)) + body
 
 
-def _check_replay(nonce: bytes, now: float) -> None:
+def _register_nonce(nonce: bytes, ts: float, now: float) -> None:
+    """Record an AUTHENTICATED frame's nonce; raises on a duplicate.
+    Called only after the GCM tag verified — unauthenticated traffic can
+    neither grow this cache nor pre-poison a legitimate frame's nonce.
+    The entry expires at ts + REPLAY_WINDOW_S — the instant the FRAME
+    itself goes stale — so a replay can never slip through an expired
+    entry while the frame is still inside the freshness window (any
+    nonce found present is therefore an unconditional reject)."""
     with _seen_lock:
         if nonce in _seen_nonces:
             raise ValueError("replayed frame")
-        _seen_nonces[nonce] = now + REPLAY_WINDOW_S
-        if len(_seen_nonces) > 65536:
-            for k in [k for k, exp in _seen_nonces.items() if exp < now]:
+        _seen_nonces[nonce] = ts + REPLAY_WINDOW_S
+        # expiries are ts + constant and frames arrive roughly in ts
+        # order (bounded clock skew), so insertion order tracks expiry
+        # order: drop the expired front, then hard-cap oldest-first —
+        # only eviction fairness depends on the ordering, never the
+        # duplicate check above
+        for k in list(itertools.islice(iter(_seen_nonces), 64)):
+            if _seen_nonces[k] < now:
                 del _seen_nonces[k]
+            else:
+                break
+        while len(_seen_nonces) > MAX_SEEN_NONCES:
+            del _seen_nonces[next(iter(_seen_nonces))]
 
 
-def decode_body(body: bytes) -> Any:
+def decode_body(body: bytes, tag: bytes = b"") -> Any:
     """Frame body (after the length prefix) -> msg.
     Raises ValueError on an unauthenticated/stale/replayed frame when a
-    key is set."""
+    key is set.  `tag` must match the sender's (channel binding)."""
     if _aead is not None:
         if len(body) < _TS_LEN + _NONCE_LEN + 16:
             raise ValueError("unauthenticated frame")
@@ -171,9 +236,10 @@ def decode_body(body: bytes) -> Any:
         now = time.time()
         if abs(now - ts) > REPLAY_WINDOW_S:
             raise ValueError("stale frame")
-        _check_replay(nonce, now)
         try:
-            body = _aead.decrypt(nonce, body[_TS_LEN + _NONCE_LEN:], ts_raw)
+            body = _aead.decrypt(nonce, body[_TS_LEN + _NONCE_LEN:],
+                                 ts_raw + tag)
         except Exception:
             raise ValueError("frame authentication failed")
+        _register_nonce(nonce, ts, now)
     return unpackb(body)

@@ -348,6 +348,13 @@ def place_bulk_sharded_packed_fn(mesh: Mesh, round_size: int,
         check_vma=False)
 
     def f(inp: BulkInputs):
+        # same guards as the single-device place_bulk_packed: the fill
+        # encoding (row*2048+count) needs n < 2^20 and counts < 2048, and
+        # n < 2^20 also keeps the float32 row/count transit through
+        # _bulk_local's all_gather exact (float32 is exact below 2^24)
+        n = inp.attrs.shape[0]
+        assert n < (1 << 20), "packed fill rows support < 2^20 nodes"
+        assert round_size <= 1024, "packed fill counts support rounds <= 1024"
         (rows_p, cnt_p, sc_p, top_rows, top_sc,
          n_feas, n_filt, n_exh, dim_ex, placed, used, job_count) = inner(inp)
         f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)

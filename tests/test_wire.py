@@ -92,9 +92,88 @@ class TestFrameAuth:
     def test_wrong_key_rejected(self):
         wire.set_key("key-a")
         frame = wire.encode_frame({"a": 1})
-        wire.set_key("key-b")
+        wire.set_key("key-b", force=True)
         with pytest.raises(ValueError):
             wire.decode_body(frame[4:])
+
+    def test_conflicting_key_raises(self):
+        """The key is process-global: silently swapping clusters is a
+        bug, not a feature (one cluster per process)."""
+        wire.set_key("key-a")
+        with pytest.raises(ValueError):
+            wire.set_key("key-b")
+        # same key: idempotent AND keeps the replay cache (a second
+        # same-key Agent must not reopen the replay window)
+        body = wire.encode_frame({"x": 1})[4:]
+        wire.decode_body(body)
+        wire.set_key("key-a")
+        with pytest.raises(ValueError):
+            wire.decode_body(body)     # still a replay after re-set
+        wire.set_key(None)             # explicit reset allowed
+        wire.set_key("key-b")          # fresh install after reset
+
+    def test_plaintext_agent_in_keyed_process_rejected(self):
+        from nomad_tpu.agent import Agent
+
+        wire.set_key("cluster-secret")
+        with pytest.raises(ValueError):
+            Agent(client_enabled=False)   # default encrypt="" must not
+                                          # silently strip the key
+
+    def test_channel_binding(self):
+        """A frame bound to one (channel, direction, listener) must not
+        authenticate anywhere else — no cross-plane or reflected replay."""
+        wire.set_key("cluster-secret")
+        addr_a = ("127.0.0.1", 4646)
+        addr_b = ("127.0.0.1", 4647)
+        tag = wire.channel_tag("raft", "req", addr_a)
+        body = wire.encode_frame({"type": "append"}, tag=tag)[4:]
+        # wrong plane, wrong listener, reflected direction: all rejected
+        for bad in (wire.channel_tag("serf", "req", addr_a),
+                    wire.channel_tag("raft", "req", addr_b),
+                    wire.channel_tag("raft", "rep", addr_a),
+                    b""):
+            with pytest.raises(ValueError):
+                wire.decode_body(body, tag=bad)
+        assert wire.decode_body(body, tag=tag) == {"type": "append"}
+
+    def test_forged_flood_does_not_grow_replay_cache(self):
+        """Unauthenticated frames must neither grow _seen_nonces nor
+        pre-poison a legitimate frame's nonce (nonce registration happens
+        only after the GCM tag verifies)."""
+        import os
+        import struct as _struct
+        import time as _time
+
+        wire.set_key("cluster-secret")
+        real = wire.encode_frame({"op": "x"})[4:]
+        ts, nonce = real[:8], real[8:20]
+        with wire._seen_lock:
+            base = len(wire._seen_nonces)
+        # flood: garbage ciphertexts with fresh timestamps + the REAL
+        # frame's ts+nonce with a forged body (the pre-poison attack)
+        for _ in range(50):
+            forged = (_struct.pack(">d", _time.time()) + os.urandom(12)
+                      + os.urandom(48))
+            with pytest.raises(ValueError):
+                wire.decode_body(forged)
+        with pytest.raises(ValueError):
+            wire.decode_body(ts + nonce + os.urandom(48))
+        with wire._seen_lock:
+            assert len(wire._seen_nonces) == base   # nothing registered
+        # the authentic frame still decodes (nonce was never poisoned)
+        assert wire.decode_body(real) == {"op": "x"}
+        # ... and only now is its nonce live
+        with pytest.raises(ValueError):
+            wire.decode_body(real)
+
+    def test_replay_cache_hard_cap(self, monkeypatch):
+        wire.set_key("cluster-secret")
+        monkeypatch.setattr(wire, "MAX_SEEN_NONCES", 64)
+        for i in range(200):
+            wire.decode_body(wire.encode_frame({"i": i})[4:])
+        with wire._seen_lock:
+            assert len(wire._seen_nonces) <= 64
 
     def test_confidentiality(self):
         wire.set_key("cluster-secret")
