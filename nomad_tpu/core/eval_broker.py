@@ -119,6 +119,33 @@ class EvalBroker:
                 else:
                     now += 0.001
 
+    def dequeue_batch(self, schedulers: List[str], max_n: int, now: float,
+                      timeout: Optional[float] = None,
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Pop up to `max_n` ready evals (each with its own token) for a
+        single batched worker pass.  Blocks like dequeue() for the FIRST
+        eval; the rest are taken only if immediately ready — a batch
+        never waits for stragglers.  Per-job serialization holds across
+        the batch (distinct jobs by construction)."""
+        out: List[Tuple[Evaluation, str]] = []
+        ev, token = self.dequeue(schedulers, now, timeout)
+        if ev is None:
+            return out
+        out.append((ev, token))
+        with self._cv:
+            while len(out) < max_n and self._enabled:
+                nxt = self._pop_ready_locked(schedulers)
+                if nxt is None:
+                    break
+                tok = new_id()
+                self._outstanding[nxt.id] = (
+                    tok, now + self.nack_timeout, nxt)
+                self._dequeues[nxt.id] = self._dequeues.get(nxt.id, 0) + 1
+                self._in_flight_jobs.add((nxt.namespace, nxt.job_id))
+                self.stats["dequeued"] += 1
+                out.append((nxt, tok))
+        return out
+
     def _pop_ready_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
         """Pop the best ready eval whose job has no eval in flight; evals
         for busy jobs are stashed in the per-job waiting list."""

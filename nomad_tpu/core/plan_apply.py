@@ -15,6 +15,8 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -35,11 +37,18 @@ class PendingPlan:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[PlanResult] = None
     error: Optional[Exception] = None
+    # plan-queue latency (enqueue -> responded), the north-star's second
+    # metric (BASELINE.json: p99 plan-queue latency; reference telemetry:
+    # nomad.plan.queue_depth / nomad.plan.submit)
+    enqueue_t: float = 0.0
+    queue: Optional["PlanQueue"] = None
 
     def respond(self, result: Optional[PlanResult],
                 error: Optional[Exception]) -> None:
         self.result = result
         self.error = error
+        if self.queue is not None and self.enqueue_t:
+            self.queue.record_latency(time.perf_counter() - self.enqueue_t)
         self.done.set()
 
     def wait(self, timeout: float = 30.0
@@ -58,7 +67,22 @@ class PlanQueue:
         self._enabled = False
         self._seq = itertools.count()
         self._heap: List[Tuple[int, int, PendingPlan]] = []
-        self.stats = {"depth_peak": 0}
+        self.stats = {"depth_peak": 0, "submitted": 0}
+        # ring of recent enqueue->respond latencies (seconds); feeds the
+        # /v1/metrics p50/p99 gauges and the bench's p99 measurement
+        self.latencies: deque = deque(maxlen=16384)
+
+    def record_latency(self, seconds: float) -> None:
+        self.latencies.append(seconds)
+
+    def latency_quantiles(self, qs=(0.5, 0.99)) -> Dict[str, float]:
+        """Quantiles (seconds) over the recent-latency ring."""
+        lat = sorted(self.latencies)
+        if not lat:
+            return {f"p{int(q * 100)}": 0.0 for q in qs}
+        return {f"p{int(q * 100)}":
+                lat[min(int(q * (len(lat) - 1) + 0.5), len(lat) - 1)]
+                for q in qs}
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -75,11 +99,13 @@ class PlanQueue:
                 p = PendingPlan(plan)
                 p.respond(None, RuntimeError("plan queue disabled"))
                 return p
-            pending = PendingPlan(plan)
+            pending = PendingPlan(plan, enqueue_t=time.perf_counter(),
+                                  queue=self)
             heapq.heappush(self._heap,
                            (-plan.priority, next(self._seq), pending))
             self.stats["depth_peak"] = max(self.stats["depth_peak"],
                                            len(self._heap))
+            self.stats["submitted"] += 1
             self._cv.notify()
             return pending
 
@@ -104,6 +130,17 @@ class PlanApplier:
         self.queue = queue
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # coupled-batch fast path: (batch_id, expected placement_seq).
+        # Plans of one multi-eval batch were computed against shared
+        # proposed capacity on device — they cannot oversubscribe a node
+        # collectively — so while the store's placement_seq shows ONLY
+        # this chain's own commits since the batch's snapshot, the
+        # per-node AllocsFit re-check is provably redundant and skipped.
+        # Any foreign placement-relevant write breaks the seq fence and
+        # restores the full re-check (optimistic-concurrency safety
+        # exactly as the reference's evaluatePlan).
+        self._chain: Optional[Tuple[str, int]] = None
+        self.stats = {"fast_path": 0, "full_check": 0}
 
     # ------------------------------------------------------------ running
 
@@ -129,22 +166,55 @@ class PlanApplier:
     # ------------------------------------------------------------- apply
 
     def apply_one(self, pending: PendingPlan) -> None:
+        plan = pending.plan
         try:
-            result = self.evaluate_plan(pending.plan)
+            # coupled-batch fast path: decide against the CURRENT fence.
+            # The commit itself re-verifies the fence under the store lock
+            # (upsert_plan_results returns -1 on a slipped-in foreign
+            # write) and the chain advances ONLY on fast commits — after
+            # any full-checked commit the remaining batch plans were
+            # computed against a snapshot that never saw the foreign
+            # write, so they must full-check too.
+            seq_now = self.state.placement_seq()
+            fast = False
+            if plan.coupled_batch is not None:
+                bid, seq0 = plan.coupled_batch
+                if self._chain is None or self._chain[0] != bid:
+                    self._chain = (bid, seq0)
+                fast = seq_now == self._chain[1]
+            result = self.evaluate_plan(plan, skip_fit=fast)
+            idx = self.state.upsert_plan_results(
+                plan, result, expected_placement_seq=seq_now if fast
+                else None)
+            if idx == -1:
+                # a foreign write landed between the fence read and the
+                # commit: redo with the full optimistic re-check
+                self._chain = (self._chain[0], -1)
+                fast = False
+                result = self.evaluate_plan(plan, skip_fit=False)
+                self.state.upsert_plan_results(plan, result)
             if result.refuted_nodes:
                 log("plan", "warn", "plan partially refuted",
-                    eval_id=pending.plan.eval_id,
+                    eval_id=plan.eval_id,
                     refuted=len(result.refuted_nodes))
-            self.state.upsert_plan_results(pending.plan, result)
+            if plan.coupled_batch is not None:
+                self._chain = (self._chain[0],
+                               seq_now + 1 if fast else -1)
             result.alloc_index = self.state.latest_index()
             pending.respond(result, None)
         except Exception as e:  # noqa: BLE001
+            # no (or unknown) commit: the chain's arithmetic no longer
+            # holds — drop it so the rest of the batch full-checks
+            self._chain = None
             pending.respond(None, e)
 
-    def evaluate_plan(self, plan: Plan) -> PlanResult:
+    def evaluate_plan(self, plan: Plan, skip_fit: bool = False
+                      ) -> PlanResult:
         """Re-check each touched node against the latest snapshot; refuted
         nodes are dropped from the result (partial commit).
-        reference: evaluatePlan / evaluateNodePlan."""
+        reference: evaluatePlan / evaluateNodePlan.  `skip_fit` is the
+        coupled-batch fast path (see apply_one): node existence/status and
+        CSI claims are still checked, only AllocsFit is skipped."""
         snap = self.state.snapshot()
         result = PlanResult(
             node_update=dict(plan.node_update),
@@ -152,8 +222,10 @@ class PlanApplier:
             deployment=plan.deployment,
             deployment_updates=plan.deployment_updates,
         )
+        self.stats["fast_path" if skip_fit else "full_check"] += 1
         for node_id, new_allocs in plan.node_allocation.items():
-            if self._node_plan_ok(snap, plan, node_id, new_allocs):
+            if self._node_plan_ok(snap, plan, node_id, new_allocs,
+                                  skip_fit=skip_fit):
                 result.node_allocation[node_id] = new_allocs
             else:
                 result.refuted_nodes.append(node_id)
@@ -163,28 +235,30 @@ class PlanApplier:
         return result
 
     def _node_plan_ok(self, snap, plan: Plan, node_id: str,
-                      new_allocs: List[Allocation]) -> bool:
+                      new_allocs: List[Allocation],
+                      skip_fit: bool = False) -> bool:
         node = snap.node_by_id(node_id)
         if node is None:
             return False
         if node.status == "down":
             # only stops are allowed on down nodes
             return False
-        existing = {a.id: a for a in snap.allocs_by_node(node_id)
-                    if not a.terminal_status()}
-        for a in plan.node_update.get(node_id, []):
-            existing.pop(a.id, None)
-        for a in plan.node_preemptions.get(node_id, []):
-            existing.pop(a.id, None)
-        for a in new_allocs:
-            existing[a.id] = a   # same-id update replaces
-        # check_devices: a concurrent worker may have assigned the same
-        # device instances against its own stale snapshot — the refute
-        # here is what makes host-side device assignment race-safe
-        ok, _, _ = allocs_fit(node, list(existing.values()),
-                              check_devices=True)
-        if not ok:
-            return False
+        if not skip_fit:
+            existing = {a.id: a for a in snap.allocs_by_node(node_id)
+                        if not a.terminal_status()}
+            for a in plan.node_update.get(node_id, []):
+                existing.pop(a.id, None)
+            for a in plan.node_preemptions.get(node_id, []):
+                existing.pop(a.id, None)
+            for a in new_allocs:
+                existing[a.id] = a   # same-id update replaces
+            # check_devices: a concurrent worker may have assigned the same
+            # device instances against its own stale snapshot — the refute
+            # here is what makes host-side device assignment race-safe
+            ok, _, _ = allocs_fit(node, list(existing.values()),
+                                  check_devices=True)
+            if not ok:
+                return False
         # CSI claim re-check (reference: CSIVolumeChecker claim_ok at the
         # serialization point): access-mode limits and schedulable=false
         # refute here — the device mask only checks plugin presence.

@@ -55,7 +55,11 @@ class Server:
                  heartbeat_ttl: float = 30.0,
                  failed_follow_up_delay: tuple = (60.0, 240.0),
                  acl_enabled: bool = False,
-                 state: Optional[StateStore] = None) -> None:
+                 state: Optional[StateStore] = None,
+                 eval_batch: int = 64) -> None:
+        # max ready evals one worker pass batches into a single device
+        # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
+        self.eval_batch = eval_batch
         # `state` may be a ReplicatedState proxy (cluster.py): every
         # component below then routes mutations through Raft transparently
         self.state = state if state is not None else StateStore()
@@ -574,6 +578,9 @@ class Server:
         the number of evals processed."""
         t = now if now is not None else time.time()
         n = 0
-        while n < limit and self.workers[0].run_once(timeout=0.0, now=t):
-            n += 1
+        while n < limit:
+            handled = self.workers[0].run_once(timeout=0.0, now=t)
+            if not handled:
+                break
+            n += handled
         return n

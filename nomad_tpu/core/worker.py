@@ -15,10 +15,14 @@ from typing import List, Optional, Tuple
 from nomad_tpu.core.logging import log
 from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.scheduler import new_scheduler
-from nomad_tpu.structs import Evaluation, Plan, PlanResult
+from nomad_tpu.structs import Evaluation, Plan, PlanResult, new_id
 
 SCHEDULERS_SERVED = ["service", "batch", "system", "sysbatch",
                      "service-tpu", "batch-tpu", "_core"]
+
+# eval types whose scheduler supports the multi-eval batched device
+# launch (GenericScheduler.prepare_batch / process_batched)
+BATCHABLE_TYPES = {"service", "batch", "service-tpu", "batch-tpu"}
 
 
 class Worker:
@@ -59,20 +63,30 @@ class Worker:
     # ------------------------------------------------------------- steps
 
     def run_once(self, timeout: float = 0.0, now: Optional[float] = None
-                 ) -> bool:
-        """Dequeue + process one eval.  Returns True when an eval was
-        handled (used by tests and by the drain loop)."""
+                 ) -> int:
+        """Dequeue + process one batch of evals (batch size 1 when the
+        server's eval batching is off).  Returns the number of evals
+        handled (0 = nothing ready; used by tests and the drain loop)."""
+        batch_n = getattr(self.server, "eval_batch", 0)
+        if batch_n and batch_n > 1:
+            return self.run_batch(batch_n, timeout=timeout, now=now)
         broker = self.server.eval_broker
         t = now if now is not None else time.time()
         evaluation, token = broker.dequeue(SCHEDULERS_SERVED, now=t,
                                            timeout=timeout)
         if evaluation is None:
-            return False
+            return 0
         self._eval_token = token
         try:
             err = self._invoke(evaluation, t)
         except Exception as e:  # noqa: BLE001 - a scheduler bug must nack,
             err = e             # not kill the worker thread
+        self._settle(evaluation, token, err, t)
+        return 1
+
+    def _settle(self, evaluation: Evaluation, token: str,
+                err: Optional[Exception], t: float) -> None:
+        broker = self.server.eval_broker
         if err is None:
             broker.ack(evaluation.id, token)
             self.stats["acked"] += 1
@@ -85,7 +99,121 @@ class Worker:
             log("worker", "warn", "eval nacked", worker=self.id,
                 eval_id=evaluation.id, job_id=evaluation.job_id,
                 error=str(err))
-        return True
+
+    def run_batch(self, max_n: int, timeout: float = 0.0,
+                  now: Optional[float] = None) -> int:
+        """Dequeue up to `max_n` ready evals and process them as ONE
+        batch: the reconcile phase runs per eval on a shared snapshot,
+        every batch-eligible eval's placement block goes to the device in
+        a single multi-eval launch (engine.place_batch), and the
+        resulting plans — mutually consistent by construction — submit
+        through the plan queue individually.  Ineligible evals (system,
+        core GC, spread/device jobs, updates/stops) process through the
+        normal per-eval path in dequeue order."""
+        broker = self.server.eval_broker
+        t = now if now is not None else time.time()
+        batch = broker.dequeue_batch(SCHEDULERS_SERVED, max_n, now=t,
+                                     timeout=timeout)
+        if not batch:
+            return 0
+        settled: set = set()
+        try:
+            return self._run_batch_inner(batch, t, settled)
+        except Exception as e:  # noqa: BLE001 - the solo path nacks on
+            # any failure; the batched path must give every dequeued
+            # eval the same guarantee or a single bad snapshot kills the
+            # worker thread with the whole batch's tokens outstanding
+            log("worker", "error", "batch pass failed; nacking remainder",
+                worker=self.id, error=repr(e))
+            for ev, token in batch:
+                if ev.id not in settled:
+                    self._settle(ev, token, e, t)
+            return len(batch)
+
+    def _run_batch_inner(self, batch, t: float, settled: set) -> int:
+        import zlib
+
+        from nomad_tpu.ops.engine import BatchItem
+        from nomad_tpu.scheduler.generic import GenericScheduler
+
+        state = self.server.state
+        max_idx = max((ev.modify_index or 0) for ev, _ in batch)
+        if max_idx:
+            state.wait_for_index(max_idx, timeout=5.0)
+        # placement-write fence read ATOMICALLY with the snapshot: a
+        # foreign write between separate reads would be invisible to the
+        # fence yet missing from the snapshot (the applier would then
+        # skip the fit re-check against state the scheduler never saw)
+        self._snapshot, batch_seq0 = state.snapshot_and_placement_seq()
+
+        # phase 1: build schedulers, reconcile batch-eligible evals
+        work = []          # (ev, token, sched_or_None, prep_or_err)
+        for ev, token in batch:
+            self.stats["invoked"] += 1
+            if ev.type == "_core":
+                kwargs = {"now": t, "store": state}
+            else:
+                kwargs = {"now": t, "engine": self.server.engine}
+            try:
+                sched = new_scheduler(ev.type, self._snapshot, self,
+                                      **kwargs)
+            except Exception as e:  # noqa: BLE001 - factory/init error
+                work.append((ev, token, None, e))
+                continue
+            prep = None
+            if (len(batch) > 1 and ev.type in BATCHABLE_TYPES
+                    and isinstance(sched, GenericScheduler)):
+                try:
+                    prep = sched.prepare_batch(ev)
+                except Exception:  # noqa: BLE001 - fall back to solo
+                    prep = None
+            work.append((ev, token, sched, prep))
+
+        # phase 2: ONE device launch for all eligible placement blocks
+        prepared = [(i, w) for i, w in enumerate(work)
+                    if w[2] is not None
+                    and isinstance(w[3], GenericScheduler.BatchPrep)]
+        bds = {}
+        batch_id = ""
+        if len(prepared) >= 2:
+            batch_id = new_id()
+            items = [BatchItem(job=w[3].job, tg=w[3].tg, count=w[3].count)
+                     for _, w in prepared]
+            seed = (zlib.crc32(prepared[0][1][0].id.encode())
+                    & 0xFFFFFFFF) or 1
+            try:
+                decisions = self.server.engine.place_batch(
+                    self._snapshot, items, seed=seed)
+                bds = {i: d for (i, _), d in zip(prepared, decisions)}
+            except Exception as e:  # noqa: BLE001 - solo fallback
+                log("worker", "warn", "batch launch failed; going solo",
+                    worker=self.id, error=str(e))
+                bds = {}
+
+        # phase 3: coupled plans FIRST — a solo eval's commit is a
+        # placement write the batch snapshot never saw, which would break
+        # the applier's fence and force full re-checks for the whole
+        # chain — then everything else in dequeue order
+        order = ([i for i in range(len(work)) if i in bds]
+                 + [i for i in range(len(work)) if i not in bds])
+        for i in order:
+            ev, token, sched, prep = work[i]
+            if sched is None:
+                self._settle(ev, token, prep, t)      # factory error
+                settled.add(ev.id)
+                continue
+            try:
+                if i in bds:
+                    err = sched.process_batched(
+                        ev, prep, bds[i],
+                        coupled_batch=(batch_id, batch_seq0))
+                else:
+                    err = sched.process(ev)
+            except Exception as e:  # noqa: BLE001 - nack, don't die
+                err = e
+            self._settle(ev, token, err, t)
+            settled.add(ev.id)
+        return len(work)
 
     def _invoke(self, evaluation: Evaluation, now: float) -> Optional[Exception]:
         state = self.server.state

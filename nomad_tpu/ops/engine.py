@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -31,8 +32,8 @@ from nomad_tpu.structs import (
 from .feasibility import feasible_mask_jit
 from .preempt import Preemptor, preemption_enabled
 from .select import (
-    BulkInputs, PlacementInputs, PlacementOutputs, place_bulk_packed_jit,
-    place_packed_jit)
+    BulkInputs, MultiEvalInputs, PlacementInputs, PlacementOutputs,
+    place_bulk_packed_jit, place_multi_packed_jit, place_packed_jit)
 
 # Minimum homogeneous batch size before the rounds-based bulk kernel beats
 # the per-placement scan (scan is exact sequential semantics; bulk commits
@@ -47,11 +48,79 @@ SCATTER_CHUNK = 16384
 _scatter_add_jit = jax.jit(lambda u, r, v: u.at[r].add(v))
 
 
+# Process-wide mesh + sharded-kernel caches.  Critically NOT per-engine:
+# every Server builds its own PlacementEngine, and a fresh jit closure per
+# engine would recompile the sharded kernels (tens of seconds over a TPU
+# tunnel) on every server start.  Keyed by the mesh's device ids so two
+# equivalent meshes share compilations.
+_MESH_SINGLETON = None
+_SHARDED_FN_CACHE: Dict[tuple, object] = {}
+
+
+def _default_mesh():
+    global _MESH_SINGLETON
+    if _MESH_SINGLETON is None:
+        from nomad_tpu.parallel.mesh import make_mesh
+        _MESH_SINGLETON = make_mesh()
+    return _MESH_SINGLETON
+
+
+def _sharded_fn(mesh, kind: str, *shape_args):
+    key = (kind, tuple(d.id for d in mesh.devices.flat)) + shape_args
+    fn = _SHARDED_FN_CACHE.get(key)
+    if fn is None:
+        if kind == "scatter":
+            from jax.sharding import NamedSharding, PartitionSpec
+            fn = jax.jit(
+                lambda u, r, v: u.at[r].add(v),
+                out_shardings=NamedSharding(mesh,
+                                            PartitionSpec("nodes", None)))
+        else:
+            from nomad_tpu.parallel import mesh as pmesh
+            builder = {"scan": pmesh.place_sharded_packed_fn,
+                       "bulk": pmesh.place_bulk_sharded_packed_fn,
+                       "multi": pmesh.place_multi_sharded_packed_fn}[kind]
+            fn = builder(mesh, *shape_args)
+        _SHARDED_FN_CACHE[key] = fn
+    return fn
+
+
+def _pad_rows(a: np.ndarray, n_pad: int, fill=0) -> np.ndarray:
+    """Pad a host array's leading (node) axis to n_pad rows."""
+    n = a.shape[0]
+    if n == n_pad:
+        return a
+    out = np.full((n_pad,) + a.shape[1:], fill, a.dtype)
+    out[:n] = a
+    return out
+
+
+def _pad_cols(a: np.ndarray, n_pad: int, fill=0) -> np.ndarray:
+    """Pad a host array's trailing (node) axis to n_pad columns."""
+    n = a.shape[-1]
+    if n == n_pad:
+        return a
+    out = np.full(a.shape[:-1] + (n_pad,), fill, a.dtype)
+    out[..., :n] = a
+    return out
+
+
 @dataclass
 class PlacementRequest:
     """One placement the reconciler asked for."""
     tg_name: str
     prev_node_id: str = ""       # reschedule penalty target
+
+
+@dataclass
+class BatchItem:
+    """One eval's placement block inside a multi-eval batch: `count`
+    fresh placements of `tg` for `job` (the batch-eligible shape the
+    worker's batched path prepares — reconcile produced exactly one
+    PlaceBlock and nothing else)."""
+    job: Job
+    tg: TaskGroup
+    count: int
 
 
 @dataclass
@@ -130,10 +199,31 @@ class BulkDecisions:
 
 
 class PlacementEngine:
-    """Owns a ClusterPacker + device caches for one scheduling session."""
+    """Owns a ClusterPacker + device caches for one scheduling session.
 
-    def __init__(self, packer: Optional[ClusterPacker] = None) -> None:
+    Multi-device: when the runtime exposes more than one device (a real
+    TPU slice, or the virtual CPU mesh in tests), the engine AUTOMATICALLY
+    shards the node axis over a `jax.sharding.Mesh` and routes every
+    kernel launch through the parallel/mesh sharded variants (two-stage
+    top-k over ICI) — SURVEY §6.7/§7 P7.  Node tensors are padded to a
+    multiple of the mesh size (padded rows are ineligible) and cached
+    device-side with NamedSharding."""
+
+    def __init__(self, packer: Optional[ClusterPacker] = None,
+                 mesh=None) -> None:
+        """`mesh`: None = auto (shard when >1 device), False = force
+        single-device, or an explicit jax.sharding.Mesh."""
         self.packer = packer or ClusterPacker()
+        if mesh is None and jax.device_count() > 1:
+            mesh = _default_mesh()
+        self.mesh = mesh = mesh or None
+        self._ndev = 1 if mesh is None else mesh.devices.size
+        self._node_sharding = None
+        self._scatter_fn = _scatter_add_jit
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._node_sharding = NamedSharding(mesh, PartitionSpec("nodes"))
+            self._scatter_fn = _sharded_fn(mesh, "scatter")
         self._dev_cache: Dict[str, object] = {}
         self._cache_version: Tuple[int, int] = (-1, -1)
         self._used_version: int = -1
@@ -141,12 +231,21 @@ class PlacementEngine:
         self._const_cache: Dict[tuple, object] = {}
         self._dc_cache: Optional[Tuple[int, Dict[str, int]]] = None
 
+    def _padded_n(self, n: int) -> int:
+        """Node count padded to a mesh multiple (identity single-device)."""
+        return ((n + self._ndev - 1) // self._ndev) * self._ndev
+
+    def _sharded(self, kind: str, *shape_args):
+        return _sharded_fn(self.mesh, kind, *shape_args)
+
     # ------------------------------------------------------------ devices
 
     def _node_arrays(self, t: NodeTensors):
         """Upload node tensors once per (version, vocab, width) — the
         incremental HBM sync point.  Width matters: ensure_column can widen
-        attrs after a build without bumping the row version."""
+        attrs after a build without bumping the row version.  On a mesh the
+        node axis is padded to a device multiple (padded rows ineligible)
+        and placed with NamedSharding."""
         key = (t.version, len(self.packer.interner), t.attrs.shape[1])
         if self._cache_version != key:
             # packer.lock: a concurrent update()/_on_allocs in another
@@ -156,11 +255,21 @@ class PlacementEngine:
             # jnp.asarray zero-copies the numpy buffer, and the packer
             # mutates it after the copy too.
             with self.packer.lock:
-                self._dev_cache = {
-                    "attrs": jnp.array(t.attrs),
-                    "cap": jnp.array(t.cap),
-                    "elig": jnp.array(t.elig),
-                }
+                npad = self._padded_n(t.n)
+                if self.mesh is None:
+                    self._dev_cache = {
+                        "attrs": jnp.array(t.attrs),
+                        "cap": jnp.array(t.cap),
+                        "elig": jnp.array(t.elig),
+                    }
+                else:
+                    put = partial(jax.device_put,
+                                  device=self._node_sharding)
+                    self._dev_cache = {
+                        "attrs": put(_pad_rows(t.attrs, npad, UNSET)),
+                        "cap": put(_pad_rows(t.cap, npad)),
+                        "elig": put(_pad_rows(t.elig, npad, False)),
+                    }
                 self._cache_version = key
                 self._used_version = -1
                 self._used_dev = None
@@ -212,14 +321,23 @@ class PlacementEngine:
                             [r_c, np.zeros(pad - n_c, r_c.dtype)])
                         v_c = np.concatenate(
                             [v_c, np.zeros((pad - n_c, 3), v_c.dtype)])
-                    dev = _scatter_add_jit(
+                    dev = self._scatter_fn(
                         dev, jnp.asarray(r_c), jnp.asarray(v_c))
                 self._used_dev = dev
             else:
                 # copy=True: t.used is mutated in place by the packer's
                 # delta accounting; an aliased upload double-applies
                 # future deltas
-                self._used_dev = jnp.array(t.used)
+                used_h = t.used
+                if self.mesh is None:
+                    self._used_dev = jnp.array(used_h)
+                else:
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    self._used_dev = jax.device_put(
+                        _pad_rows(np.array(used_h),
+                                  self._padded_n(t.n)),
+                        NamedSharding(self.mesh,
+                                      PartitionSpec("nodes", None)))
             self._used_version = ver
             return self._used_dev
 
@@ -335,6 +453,7 @@ class PlacementEngine:
         name_to_g = {name: i for i, name in enumerate(tg_tensors.names)}
         p_real = block_count if block is not None else len(requests)
         p_pad = _pad_pow2(p_real)
+        npad = self._padded_n(n)
 
         desired = np.array([tg.count for tg in tgs], np.int32)
         algo = snapshot.scheduler_config().scheduler_algorithm
@@ -342,7 +461,7 @@ class PlacementEngine:
         used0 = self._used_device(t)
         job_count = ctx.job_count
         if stopped_allocs:
-            delta = np.zeros((n, 3), np.int32)
+            delta = np.zeros((npad, 3), np.int32)
             job_count = job_count.copy()
             for a in stopped_allocs:
                 row = t.id_to_row.get(a.node_id)
@@ -358,24 +477,27 @@ class PlacementEngine:
         # cached per-eval device constants (the tunnel moves ~3MB/s; every
         # [N]-sized upload that repeats across evals must be cached)
         dcm = self._dev_const(
-            ("dc", t.version, tuple(job.datacenters)),
-            lambda: ctx.dc_mask)
+            ("dc", t.version, npad, tuple(job.datacenters)),
+            lambda: _pad_rows(ctx.dc_mask, npad, False))
         pm = self._dev_const(
-            ("pool", t.version, job.node_pool), lambda: ctx.pool_mask)
+            ("pool", t.version, npad, job.node_pool),
+            lambda: _pad_rows(ctx.pool_mask, npad, False))
         luts_dev = self._dev_const(
             ("luts", self.packer.lut_epoch, tg_tensors.luts.shape),
             lambda: tg_tensors.luts)
         if job_count.any():
-            jc_dev = jnp.asarray(job_count)
+            jc_dev = jnp.asarray(_pad_rows(job_count, npad))
         else:
-            jc_dev = self._dev_const(("zjc", n), lambda: np.zeros(n, np.int32))
+            jc_dev = self._dev_const(("zjc", npad),
+                                     lambda: np.zeros(npad, np.int32))
 
         # device (GPU/...) feasibility: host-computed per-TG node mask
         # (kernel capacity dims stay cpu/mem/disk; discrete instance
         # matching is host work — scheduler/device.py)
         dev_mask = self._device_mask(
             tgs, t, snapshot, {a.id for a in stopped_allocs}, device_in_use)
-        extra_mask = None if dev_mask is None else jnp.asarray(dev_mask)
+        extra_mask = (None if dev_mask is None
+                      else jnp.asarray(_pad_cols(dev_mask, npad, False)))
 
         has_spread = bool(job.spreads) or any(tg.spreads for tg in tgs)
         has_distinct = any(tg_tensors.distinct)
@@ -397,6 +519,12 @@ class PlacementEngine:
                 # scan only
                 and dev_mask is None
                 and all(not r.prev_node_id for r in requests))
+        # the sharded bulk kernel has no with_scores variant; the
+        # expanded-API bulk path needs per-placement scores, so on a mesh
+        # it routes through the exact scan instead (tests/diagnostics only
+        # — production callers use bulk_api)
+        if self.mesh is not None and not bulk_api:
+            bulk_ok = False
 
         # ONE packed device->host transfer: the chip sits behind a network
         # transport with a large fixed cost per array fetch, so the kernels
@@ -422,12 +550,21 @@ class PlacementEngine:
                 seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
                 extra_mask=extra_mask,
             )
-            buf, used_dev, job_count_dev = place_bulk_packed_jit(
-                binp, round_size, n_rounds, not bulk_api)
+            if self.mesh is not None:
+                buf, used_dev, job_count_dev = self._sharded(
+                    "bulk", round_size, n_rounds)(binp)
+            else:
+                buf, used_dev, job_count_dev = place_bulk_packed_jit(
+                    binp, round_size, n_rounds, not bulk_api)
             tg_idx = np.full(p_real, g_idx, np.int32)
             if bulk_api:
                 picks, _, meta = _unpack_bulk_compact(
                     np.asarray(buf), round_size, p_real)
+                if npad != n:
+                    # mesh padding rows are statically infeasible; they
+                    # must not read as real filtered nodes
+                    meta = meta.copy()
+                    meta[:, 7] -= npad - n
                 return self._bulk_decisions(
                     block_tg if block is not None else requests[0].tg_name,
                     picks, meta, round_size, t, ctx,
@@ -436,6 +573,7 @@ class PlacementEngine:
             (picks, scores, topk_rows, topk_scores,
              n_feas, n_filt, n_exh, dim_exh) = _unpack_bulk(
                 np.asarray(buf), round_size, p_real, n)
+            n_filt = n_filt - (npad - n)
             inp = binp      # _preempt_fallback field source
         else:
             sp: SpreadTensors = lower_spreads(self.packer, job, t, snapshot)
@@ -459,11 +597,11 @@ class PlacementEngine:
                 req=jnp.asarray(tg_tensors.req),
                 desired=jnp.asarray(desired),
                 dh_limit=jnp.asarray(tg_tensors.dh_limit),
-                sp_nodeval=jnp.asarray(sp.sp_nodeval),
+                sp_nodeval=jnp.asarray(_pad_cols(sp.sp_nodeval, npad, -1)),
                 sp_weight=jnp.asarray(sp.sp_weight),
                 sp_expected=jnp.asarray(sp.sp_expected),
                 sp_counts0=jnp.asarray(sp.sp_counts0),
-                pd_nodeval=jnp.asarray(pd.pd_nodeval),
+                pd_nodeval=jnp.asarray(_pad_cols(pd.pd_nodeval, npad, -1)),
                 pd_limit=jnp.asarray(pd.pd_limit),
                 pd_apply=jnp.asarray(pd.pd_apply),
                 pd_counts0=jnp.asarray(pd.pd_counts0),
@@ -475,14 +613,17 @@ class PlacementEngine:
                 seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
                 extra_mask=extra_mask,
             )
-            buf, used_dev, job_count_dev = place_packed_jit(inp)
+            if self.mesh is not None:
+                buf, used_dev, job_count_dev = self._sharded("scan")(inp)
+            else:
+                buf, used_dev, job_count_dev = place_packed_jit(inp)
             b = np.asarray(buf)[:p_real]
             picks = b[:, 0].copy()
             scores = b[:, 1].view(np.float32)
             topk_rows = b[:, 2:5]
             topk_scores = b[:, 5:8].view(np.float32)
             n_feas = b[:, 8]
-            n_filt = b[:, 9]
+            n_filt = b[:, 9] - (npad - n)
             n_exh = b[:, 10]
             dim_exh = b[:, 11:14]
         elapsed = (time.perf_counter_ns() - t0) // max(p_real, 1)
@@ -554,12 +695,14 @@ class PlacementEngine:
                 or not preemption_enabled(snapshot.scheduler_config(),
                                           job.type)):
             return evictions_by_req
+        # slice off mesh padding rows: the preemptor works host-side over
+        # the REAL node rows
         static = np.asarray(feasible_mask_jit(
             inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
-            inp.con, inp.luts))
+            inp.con, inp.luts))[:, :t.n]
         preemptor = Preemptor(job, snapshot, t, static,
-                              np.asarray(used_dev),
-                              job_count=np.asarray(job_count_dev),
+                              np.asarray(used_dev)[:t.n],
+                              job_count=np.asarray(job_count_dev)[:t.n],
                               dh_limit=tg_tensors.dh_limit)
         for i in range(p_real):
             if picks[i] >= 0:
@@ -592,9 +735,19 @@ class PlacementEngine:
             picks, snapshot, job, inp, tg_tensors, tg_idx,
             t, used_dev, job_count_dev, p_real)
         elapsed = int(time.perf_counter_ns() - t0) // max(p_real, 1)
-        dc_counts = self._dc_counts(t)
-        n_in_pool = int(ctx.pool_mask.sum())
-        node_ids = t.node_ids
+        metrics = self._metrics_from_meta(
+            meta, n, int(ctx.pool_mask.sum()), self._dc_counts(t),
+            t.node_ids, elapsed)
+        return BulkDecisions(
+            tg_name=tg_name, picks=picks, node_ids=t.node_ids,
+            round_size=round_size, metrics=metrics, evictions=evictions,
+            nodes_evaluated=n)
+
+    @staticmethod
+    def _metrics_from_meta(meta, n, n_in_pool, dc_counts, node_ids,
+                           elapsed) -> List[AllocMetric]:
+        """Per-round AllocMetric objects from the bulk kernels' compact
+        meta block (shared by the single-eval bulk path and place_batch)."""
         dims = ("cpu", "memory", "disk")
         tsc = meta[:, 3:6].view(np.float32).tolist()
         metrics: List[AllocMetric] = []
@@ -616,10 +769,156 @@ class PlacementEngine:
                               scores={"final": ks}, norm_score=ks)
                 for kr, ks in zip(row[0:3], tsc[r]) if kr >= 0]
             metrics.append(metric)
-        return BulkDecisions(
-            tg_name=tg_name, picks=picks, node_ids=node_ids,
-            round_size=round_size, metrics=metrics, evictions=evictions,
-            nodes_evaluated=n)
+        return metrics
+
+    # -------------------------------------------------------- multi-eval
+
+    def place_batch(self, snapshot, items: Sequence[BatchItem],
+                    seed: int = 0) -> List[Optional[BulkDecisions]]:
+        """Score + select nodes for MANY evals' placement blocks in ONE
+        device launch (DP over evals — SURVEY §3.6 row 1; the reference
+        runs one eval per worker goroutine instead, nomad/worker.go).
+
+        Each item is one eval's (job, task group, count) block; rounds
+        run sequentially on device so the items' plans see each other's
+        proposed usage and cannot refute each other at the applier.
+        Returns one BulkDecisions per item (None when the cluster is
+        empty).  Preemption is NOT attempted here — a caller seeing
+        failed picks with preemption enabled should fall back to the
+        single-eval path, which carries the preemptor."""
+        if not items:
+            return []
+        t = self.packer.update(snapshot)
+        n = t.n
+        if n == 0:
+            return [None] * len(items)
+        t0 = time.perf_counter_ns()
+        npad = self._padded_n(n)
+        dev = self._node_arrays(t)
+        used0 = self._used_device(t)
+        algo = snapshot.scheduler_config().scheduler_algorithm
+
+        G = len(items)
+        g_pad = _pad_pow2(G, lo=1)
+        tgts = []
+        ctxs = []
+        for it in items:
+            tgts.append(self.packer.lower_task_groups(
+                it.job, [it.tg], snapshot=snapshot))
+            ctxs.append(self.packer.job_context(it.job, snapshot, t))
+        # pad the constraint/affinity row axes to a pow2 ladder so mixed
+        # batches land on a handful of compiled shapes
+        c_max = _pad_pow2(max(tt.con.shape[1] for tt in tgts), lo=1)
+        a_max = _pad_pow2(max(tt.aff.shape[1] for tt in tgts), lo=1)
+        con = np.zeros((g_pad, c_max, 3), np.int32)
+        aff = np.zeros((g_pad, a_max, 4), np.int32)
+        req = np.zeros((g_pad, 3), np.int32)
+        desired = np.ones(g_pad, np.int32)
+        dh_limit = np.zeros(g_pad, np.int32)
+        g_mask = np.zeros(g_pad, np.int32)
+        mask_keys: Dict[tuple, int] = {}
+        mask_rows: List[object] = []
+        jc_nz_idx: List[int] = []
+        jc_nz_rows: List[np.ndarray] = []
+        for gi, it in enumerate(items):
+            tt, ctx = tgts[gi], ctxs[gi]
+            con[gi, :tt.con.shape[1]] = tt.con[0]
+            aff[gi, :tt.aff.shape[1]] = tt.aff[0]
+            req[gi] = tt.req[0]
+            desired[gi] = max(it.tg.count, 1)
+            dh_limit[gi] = tt.dh_limit[0]
+            key = (tuple(it.job.datacenters), it.job.node_pool)
+            mi = mask_keys.get(key)
+            if mi is None:
+                mi = len(mask_rows)
+                mask_keys[key] = mi
+                mask_rows.append(self._dev_const(
+                    ("basemask", t.version, npad) + key,
+                    lambda ctx=ctx: _pad_rows(
+                        ctx.dc_mask & ctx.pool_mask, npad, False)))
+            g_mask[gi] = mi
+            if ctx.job_count.any():
+                jc_nz_idx.append(gi)
+                jc_nz_rows.append(ctx.job_count)
+        m_pad = _pad_pow2(len(mask_rows), lo=1)
+        zrow = self._dev_const(("zrow", npad),
+                               lambda: np.zeros(npad, bool))
+        mask_rows.extend([zrow] * (m_pad - len(mask_rows)))
+        base_mask = jnp.stack(mask_rows)
+
+        # per-job alloc-count rows: device zeros + a scatter of only the
+        # jobs that actually have live allocs (fresh jobs upload nothing)
+        jc0 = jnp.zeros((g_pad, npad), jnp.int32)
+        if jc_nz_idx:
+            jc0 = jc0.at[jnp.asarray(np.array(jc_nz_idx, np.int32))].set(
+                jnp.asarray(_pad_cols(np.stack(jc_nz_rows), npad)))
+
+        # round schedule: item gi -> ceil(count / rs) consecutive rounds
+        counts = [max(it.count, 0) for it in items]
+        biggest = max(counts) if counts else 0
+        rs = 1024 if biggest > 256 else (256 if biggest > 64 else 64)
+        round_g: List[int] = []
+        round_want: List[int] = []
+        spans: List[Tuple[int, int]] = []
+        for gi, c in enumerate(counts):
+            start = len(round_g)
+            left = c
+            while left > 0:
+                round_g.append(gi)
+                round_want.append(min(left, rs))
+                left -= rs
+            spans.append((start, len(round_g)))
+        r_pad = _pad_pow2(max(len(round_g), 1), lo=1)
+        pad_r = r_pad - len(round_g)
+        round_g.extend([0] * pad_r)
+        round_want.extend([0] * pad_r)
+
+        luts = tgts[-1].luts      # the most complete LUT matrix
+        luts_dev = self._dev_const(
+            ("luts", self.packer.lut_epoch, luts.shape), lambda: luts)
+
+        inp = MultiEvalInputs(
+            attrs=dev["attrs"], cap=dev["cap"], used0=used0,
+            elig=dev["elig"], luts=luts_dev, base_mask=base_mask,
+            con=jnp.asarray(con), aff=jnp.asarray(aff),
+            req=jnp.asarray(req), desired=jnp.asarray(desired),
+            dh_limit=jnp.asarray(dh_limit), g_mask=jnp.asarray(g_mask),
+            g_job=jnp.arange(g_pad, dtype=jnp.int32),
+            job_count0=jc0,
+            spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
+            round_g=jnp.asarray(np.array(round_g, np.int32)),
+            round_want=jnp.asarray(np.array(round_want, np.int32)),
+            seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
+        )
+        if self.mesh is not None:
+            buf, _, _ = self._sharded("multi", rs)(inp)
+        else:
+            buf, _, _ = place_multi_packed_jit(inp, rs)
+        buf_np = np.asarray(buf)
+
+        dc_counts = self._dc_counts(t)
+        elapsed = (time.perf_counter_ns() - t0) // max(sum(counts), 1)
+        decisions: List[Optional[BulkDecisions]] = []
+        for gi, it in enumerate(items):
+            lo, hi = spans[gi]
+            if hi == lo:
+                decisions.append(BulkDecisions(
+                    tg_name=it.tg.name, picks=np.empty(0, np.int32),
+                    node_ids=t.node_ids, round_size=rs, metrics=[],
+                    nodes_evaluated=n))
+                continue
+            picks, _, meta = _unpack_bulk_compact(
+                buf_np[lo:hi], rs, counts[gi])
+            if npad != n:
+                meta = meta.copy()
+                meta[:, 7] -= npad - n
+            metrics = self._metrics_from_meta(
+                meta, n, int(ctxs[gi].pool_mask.sum()), dc_counts,
+                t.node_ids, int(elapsed))
+            decisions.append(BulkDecisions(
+                tg_name=it.tg.name, picks=picks, node_ids=t.node_ids,
+                round_size=rs, metrics=metrics, nodes_evaluated=n))
+        return decisions
 
     def _no_nodes_decision(self, r: PlacementRequest, snapshot, job: Job
                            ) -> PlacementDecision:

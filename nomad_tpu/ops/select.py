@@ -26,7 +26,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .feasibility import feasible_mask
+from .feasibility import constraint_mask, feasible_mask
 from .scoring import (
     affinity_score,
     binpack_score,
@@ -339,20 +339,20 @@ def _to_bulk_inputs(inp: PlacementInputs) -> BulkInputs:
         seed=inp.seed, extra_mask=inp.extra_mask)
 
 
-def bulk_round_scores(inp: BulkInputs, static_t, used, job_count,
-                      round_size: int):
+def round_scores_g(cap, req, desired, dh_limit, static, aff_sc, aff_any,
+                   used, job_count, spread_algo, round_size: int):
     """Per-node intake capacity (k_i) and rank-chain score for one
-    water-fill round at the current proposed state — shared verbatim by
-    the single-device bulk kernel and the sharded variant
-    (parallel/mesh._bulk_local), so the two cannot drift."""
-    n = inp.attrs.shape[0]
-    g = inp.g
-    req = inp.req[g]
-    capf = inp.cap.astype(jnp.float32)
+    water-fill round at the current proposed state, parameterized on the
+    round's task group values — THE shared scoring core of every bulk
+    deployment: the single-device bulk kernel (fixed g via
+    bulk_round_scores), the sharded variant (parallel/mesh._bulk_local),
+    and the multi-eval batch kernel (dynamic g per round), so none of
+    the three can drift."""
+    n = cap.shape[0]
+    capf = cap.astype(jnp.float32)
     big = jnp.int32(round_size)
-    static, aff_sc, aff_any, _ = static_t
 
-    free = inp.cap - used
+    free = cap - used
     per_dim = jnp.where(req[None, :] > 0,
                         free // jnp.maximum(req[None, :], 1), big)
     k_i = jnp.clip(jnp.min(per_dim, axis=1), 0, big)
@@ -360,16 +360,15 @@ def bulk_round_scores(inp: BulkInputs, static_t, used, job_count,
     # is infeasible even if that dimension isn't requested — matches
     # capacity_fit's all-dims check in the exact scan kernel
     k_i = jnp.where(jnp.any(free < 0, axis=1), 0, k_i)
-    k_i = jnp.where(inp.dh_limit[g] > 0,
-                    jnp.minimum(k_i, jnp.clip(
-                        inp.dh_limit[g] - job_count, 0, big)),
+    k_i = jnp.where(dh_limit > 0,
+                    jnp.minimum(k_i, jnp.clip(dh_limit - job_count, 0, big)),
                     k_i)
     k_i = jnp.where(static, k_i, 0)
 
     # rank chain at the current proposed state
     bp = binpack_score(capf, used.astype(jnp.float32),
-                       req.astype(jnp.float32), inp.spread_algo) / 18.0
-    aa = job_anti_affinity(job_count, inp.desired[g])
+                       req.astype(jnp.float32), spread_algo) / 18.0
+    aa = job_anti_affinity(job_count, desired)
     comps = jnp.stack([bp, aa, aff_sc])
     act_mask = jnp.stack([
         jnp.ones(n, bool),
@@ -380,20 +379,82 @@ def bulk_round_scores(inp: BulkInputs, static_t, used, job_count,
     return k_i, score
 
 
-def bulk_round_metrics(inp: BulkInputs, static, used, job_count):
-    """Post-commit exhaustion metrics for one water-fill round (shared by
-    the single-device and sharded bulk kernels; the sharded caller psums
-    the returned local sums)."""
-    req = inp.req[inp.g]
-    free2 = inp.cap - used
+def bulk_round_scores(inp: BulkInputs, static_t, used, job_count,
+                      round_size: int):
+    """round_scores_g at the bulk kernel's fixed task group `inp.g`
+    (shared verbatim with parallel/mesh._bulk_local)."""
+    g = inp.g
+    static, aff_sc, aff_any, _ = static_t
+    return round_scores_g(inp.cap, inp.req[g], inp.desired[g],
+                          inp.dh_limit[g], static, aff_sc, aff_any,
+                          used, job_count, inp.spread_algo, round_size)
+
+
+def round_metrics_g(cap, req, dh_limit, static, used, job_count):
+    """Post-commit exhaustion metrics for one water-fill round,
+    parameterized on the round's task group values (shared core, see
+    round_scores_g; the sharded caller psums the returned local sums)."""
+    free2 = cap - used
     fit2 = jnp.all(free2 >= req[None, :], axis=1) & jnp.all(
         free2 >= 0, axis=1)
-    dh_ok2 = jnp.where(inp.dh_limit[inp.g] > 0,
-                       job_count < inp.dh_limit[inp.g], True)
+    dh_ok2 = jnp.where(dh_limit > 0, job_count < dh_limit, True)
     exhausted2 = static & ~(fit2 & dh_ok2)
     n_exh = jnp.sum(exhausted2)
     dim_ex = jnp.sum(exhausted2[:, None] & (free2 < req[None, :]), axis=0)
     return n_exh, dim_ex
+
+
+def bulk_round_metrics(inp: BulkInputs, static, used, job_count):
+    """round_metrics_g at the bulk kernel's fixed task group `inp.g`."""
+    return round_metrics_g(inp.cap, inp.req[inp.g], inp.dh_limit[inp.g],
+                           static, used, job_count)
+
+
+def waterfill_round(k_i, score, noise, want, spread_algo, round_size: int):
+    """Water-fill one round: pick the top-scored nodes and fill each up
+    to its intake k_i until `want` placements are assigned.  Returns the
+    compact fill prefix (rows/counts/scores, padded to round_size), the
+    per-node committed counts c_i, and the total placed — shared by the
+    single-device bulk kernel and the multi-eval batch kernel (the
+    sharded kernel's two-stage variant lives in parallel/mesh)."""
+    n = k_i.shape[0]
+    big = jnp.int32(round_size)
+    # spread algorithm: cap per-node intake so a round fans out
+    viable = jnp.maximum(jnp.sum(k_i > 0), 1)
+    cap_round = jnp.where(
+        spread_algo,
+        jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
+    k_round = jnp.minimum(k_i, cap_round)
+
+    # water-fill the top-K nodes up to `want`.  K = round_size suffices:
+    # every selected node absorbs >= 1 alloc, so at most `want` <= K nodes
+    # fill.  top_k over [N] then O(K) arithmetic beats a full [N] argsort
+    # per round by ~50x at 50k nodes.
+    # selection order gets the tie-break noise; reported scores do not
+    masked = jnp.where(k_round > 0, score, NEG_INF)
+    kk = min(round_size, n)
+    nsc_k, order_k = jax.lax.top_k(masked + noise, kk)
+    sc_k = jnp.where(nsc_k > NEG_INF / 2, score[order_k], NEG_INF)
+    k_sorted = jnp.where(sc_k > NEG_INF / 2, k_round[order_k], 0)
+    csum = jnp.cumsum(k_sorted)
+    c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
+    placed_total = jnp.sum(c_sorted)
+
+    c_i = (jnp.zeros(n, jnp.int32)
+           .at[order_k].add(c_sorted.astype(jnp.int32), mode="drop"))
+
+    # compact fill prefix (pad up to round_size when the cluster is small)
+    pad = round_size - kk
+    if pad:
+        rows_p = jnp.concatenate([order_k, jnp.zeros(pad, order_k.dtype)])
+        cnt_p = jnp.concatenate(
+            [c_sorted.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
+        sc_p = jnp.concatenate([sc_k, jnp.full(pad, NEG_INF, sc_k.dtype)])
+    else:
+        rows_p = order_k
+        cnt_p = c_sorted.astype(jnp.int32)
+        sc_p = sc_k
+    return rows_p, cnt_p, sc_p, c_i, placed_total, k_round
 
 
 def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
@@ -407,60 +468,23 @@ def _bulk_step(inp: BulkInputs, round_size: int, top_k: int, static_t,
     triple, computed once in _bulk_scan and closed over — recomputing it
     per round would multiply the gather/reduce chain by the round count.
     """
-    n = inp.attrs.shape[0]
     g = inp.g
     req = inp.req[g]
-    big = jnp.int32(round_size)
-
     static, aff_sc, aff_any, noise = static_t
 
     used, job_count = carry
     k_i, score = bulk_round_scores(inp, static_t, used, job_count,
                                    round_size)
-
-    # spread algorithm: cap per-node intake so a round fans out
-    viable = jnp.maximum(jnp.sum(k_i > 0), 1)
-    cap_round = jnp.where(
-        inp.spread_algo,
-        jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
-    k_round = jnp.minimum(k_i, cap_round)
-
-    # water-fill the top-K nodes up to `want`.  K = round_size suffices:
-    # every selected node absorbs >= 1 alloc, so at most `want` <= K nodes
-    # fill.  top_k over [N] then O(K) arithmetic beats a full [N] argsort
-    # per round (the old form) by ~50x at 50k nodes.
-    # selection order gets the tie-break noise; reported scores do not
-    masked = jnp.where(k_round > 0, score, NEG_INF)
-    kk = min(round_size, n)
-    nsc_k, order_k = jax.lax.top_k(masked + noise, kk)
-    sc_k = jnp.where(nsc_k > NEG_INF / 2, score[order_k], NEG_INF)
-    k_sorted = jnp.where(sc_k > NEG_INF / 2, k_round[order_k], 0)
-    csum = jnp.cumsum(k_sorted)
-    c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
-    placed_total = jnp.sum(c_sorted)
+    rows_p, cnt_p, sc_p, c_i, placed_total, k_round = waterfill_round(
+        k_i, score, noise, want, inp.spread_algo, round_size)
 
     # commit the round
-    c_i = (jnp.zeros(n, jnp.int32)
-           .at[order_k].add(c_sorted.astype(jnp.int32), mode="drop"))
     used = used + c_i[:, None] * req[None, :]
     job_count = job_count + c_i
 
-    # compact fill prefix (pad up to round_size when the cluster is small)
-    pad = round_size - kk
-    if pad:
-        rows_p = jnp.concatenate([order_k, jnp.zeros(pad, order_k.dtype)])
-        cnt_p = jnp.concatenate(
-            [c_sorted.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
-        sc_p = jnp.concatenate([sc_k, jnp.full(pad, NEG_INF, sc_k.dtype)])
-    else:
-        rows_p = order_k
-        cnt_p = c_sorted.astype(jnp.int32)
-        sc_p = sc_k
-
     # round metrics (shared by every placement of the round)
-    top_sc = sc_k[:top_k]
-    top_rows = order_k[:top_k]
-    top_rows = jnp.where(top_sc > NEG_INF / 2, top_rows, -1)
+    top_sc = sc_p[:top_k]
+    top_rows = jnp.where(top_sc > NEG_INF / 2, rows_p[:top_k], -1)
     top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
     n_feas = jnp.sum(k_round > 0).astype(jnp.int32)
     n_filt = jnp.sum(~static).astype(jnp.int32)
@@ -612,3 +636,118 @@ def place_bulk(inp: PlacementInputs, round_size: int) -> PlacementOutputs:
 
 
 place_bulk_jit = jax.jit(place_bulk, static_argnums=1)
+
+
+class MultiEvalInputs(NamedTuple):
+    """Device inputs for ONE batched multi-eval launch — the
+    data-parallel-over-evals axis (SURVEY.md §3.6 row 1): G task groups
+    drawn from up to J distinct jobs place in R water-fill rounds
+    against a single shared capacity state.  Rounds run sequentially in
+    a scan, so evals in one batch see each other's proposed usage — the
+    resulting plans are mutually consistent and cannot refute each other
+    at the serialized applier (the optimistic-concurrency conflicts the
+    reference resolves at plan_apply simply never happen inside a batch).
+
+    Per-job state that PlacementInputs holds as single vectors becomes
+    indexed here: `base_mask[g_mask[g]]` is the job's dc∧pool mask
+    (deduped across the batch — most jobs share one), and
+    `job_count0[g_job[g]]` is the job's per-node alloc count row for
+    anti-affinity / distinct_hosts."""
+    # node state (shared across the batch)
+    attrs: jnp.ndarray       # [N, A] int32
+    cap: jnp.ndarray         # [N, 3] int32
+    used0: jnp.ndarray       # [N, 3] int32
+    elig: jnp.ndarray        # [N] bool
+    luts: jnp.ndarray        # [L, V] bool
+    base_mask: jnp.ndarray   # [M, N] bool   deduped dc∧pool masks
+    # per-task-group statics (G spans all evals of the batch)
+    con: jnp.ndarray         # [G, C, 3] int32
+    aff: jnp.ndarray         # [G, Af, 4] int32
+    req: jnp.ndarray         # [G, 3] int32
+    desired: jnp.ndarray     # [G] int32
+    dh_limit: jnp.ndarray    # [G] int32
+    g_mask: jnp.ndarray      # [G] int32  -> base_mask row
+    g_job: jnp.ndarray       # [G] int32  -> job_count0 row
+    job_count0: jnp.ndarray  # [J, N] int32
+    spread_algo: jnp.ndarray  # [] bool
+    # round schedule (host-computed: eval e with count c contributes
+    # ceil(c / round_size) consecutive rounds; padding rounds want=0)
+    round_g: jnp.ndarray     # [R] int32
+    round_want: jnp.ndarray  # [R] int32
+    seed: jnp.ndarray = jnp.uint32(0)
+    extra_mask: jnp.ndarray = None       # [G, N] bool | None
+
+
+def place_multi_packed(inp: MultiEvalInputs, round_size: int):
+    """Batched multi-eval placement: every round's intake/score math is
+    the same round_scores_g / waterfill_round / round_metrics_g core the
+    single-eval bulk kernel runs — only the task group (and its job's
+    count row) varies per round.  Output is the compact per-round packed
+    buffer of place_bulk_packed, `[R, round_size + 16]`, one device→host
+    transfer for the WHOLE batch; the host slices rows per eval.
+    Returns (buf, used, job_count [J, N])."""
+    n = inp.attrs.shape[0]
+    assert n < (1 << 20), "packed fill rows support < 2^20 nodes"
+    assert round_size <= 1024, "packed fill counts support rounds <= 1024"
+    top_k = min(TOP_K, n)
+
+    # batch statics: one fused [G, N] feasibility + affinity evaluation
+    base = inp.elig[None, :] & inp.base_mask[inp.g_mask]        # [G, N]
+    static_all = constraint_mask(inp.attrs, inp.con, inp.luts) & base
+    if inp.extra_mask is not None:
+        static_all = static_all & inp.extra_mask
+    aff_all = affinity_score(inp.attrs, inp.aff, inp.luts)      # [G, N]
+    aff_any_all = jnp.any(inp.aff[..., 3] != 0, axis=1)         # [G]
+    noise = tiebreak_noise(inp.seed, jnp.arange(n))
+
+    def round_step(carry, xs):
+        used, jc = carry
+        g, want = xs
+        j = inp.g_job[g]
+        job_count = jc[j]
+        req = inp.req[g]
+        static = static_all[g]
+        k_i, score = round_scores_g(
+            inp.cap, req, inp.desired[g], inp.dh_limit[g], static,
+            aff_all[g], aff_any_all[g], used, job_count,
+            inp.spread_algo, round_size)
+        rows_p, cnt_p, sc_p, c_i, placed_total, k_round = waterfill_round(
+            k_i, score, noise, want, inp.spread_algo, round_size)
+
+        used = used + c_i[:, None] * req[None, :]
+        jc = jc.at[j].add(c_i)
+
+        top_sc = sc_p[:top_k]
+        top_rows = jnp.where(top_sc > NEG_INF / 2, rows_p[:top_k], -1)
+        top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
+        n_feas = jnp.sum(k_round > 0).astype(jnp.int32)
+        n_filt = jnp.sum(~static).astype(jnp.int32)
+        n_exh, dim_ex = round_metrics_g(
+            inp.cap, req, inp.dh_limit[g], static, used, jc[j])
+        out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
+               n_feas, n_filt, n_exh.astype(jnp.int32),
+               dim_ex.astype(jnp.int32), placed_total.astype(jnp.int32))
+        return (used, jc), out
+
+    carry0 = (inp.used0, inp.job_count0)
+    (used, jc), outs = jax.lax.scan(
+        round_step, carry0, (inp.round_g, inp.round_want))
+    (rows_p, cnt_p, sc_p, top_rows, top_sc,
+     n_feas, n_filt, n_exh, dim_ex, placed) = outs
+    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
+    r = top_rows.shape[0]
+    meta = jnp.concatenate([
+        jnp.concatenate([top_rows,
+                         jnp.full((r, 3 - top_k), -1, jnp.int32)], axis=1),
+        jnp.concatenate([f2i(top_sc),
+                         jnp.zeros((r, 3 - top_k), jnp.int32)], axis=1),
+        n_feas[:, None], n_filt[:, None], n_exh[:, None],
+        dim_ex, placed[:, None],
+        jnp.zeros((r, 3), jnp.int32),
+    ], axis=1)
+    buf = jnp.concatenate([fills, meta], axis=1)
+    return buf, used, jc
+
+
+place_multi_packed_jit = jax.jit(place_multi_packed, static_argnums=(1,))

@@ -24,16 +24,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from nomad_tpu.ops.feasibility import constraint_mask
+from nomad_tpu.ops.scoring import affinity_score
 from nomad_tpu.ops.select import (
     NEG_INF,
     TOP_K,
     BulkInputs,
+    MultiEvalInputs,
     PlacementInputs,
     PlacementOutputs,
     _bulk_static,
     bulk_round_metrics,
     bulk_round_scores,
     pack_outputs,
+    round_metrics_g,
+    round_scores_g,
     scan_statics,
     step_scores,
     tiebreak_noise,
@@ -220,17 +225,88 @@ def place_sharded_packed_fn(mesh: Mesh):
 # ------------------------------------------------------------ bulk kernel
 
 
+def _sharded_waterfill(k_i, score, noise, static, want, spread_algo,
+                       round_size: int, top_k: int, n_loc: int, offset,
+                       global_rows):
+    """One sharded water-fill round: local candidates -> two-stage top-k
+    over ICI -> replicated fill math -> owner-shard commit counts.
+    Shared by the sharded bulk kernel (fixed task group) and the sharded
+    multi-eval kernel (task group per round).  Returns the compact fill
+    prefix (global rows/counts/scores), local commit counts c_i, the
+    top-k metric slice, and global feasible/filter counts."""
+    big = jnp.int32(round_size)
+    # spread algorithm: cap per-node intake so a round fans out (viable
+    # counted over the WHOLE mesh)
+    viable = jnp.maximum(jax.lax.psum(jnp.sum(k_i > 0), AXIS), 1)
+    cap_round = jnp.where(
+        spread_algo,
+        jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
+    k_round = jnp.minimum(k_i, cap_round)
+
+    # two-stage candidate selection: each shard contributes its local
+    # top min(round_size, n_loc) nodes; the union is a superset of the
+    # global top round_size because every global winner is a local
+    # winner on its shard
+    kk_loc = min(round_size, n_loc)
+    masked = jnp.where(k_round > 0, score, NEG_INF)
+    loc_nsc, loc_order = jax.lax.top_k(masked + noise, kk_loc)
+    loc_pack = jnp.stack([
+        loc_nsc,
+        jnp.where(loc_nsc > NEG_INF / 2, score[loc_order], NEG_INF),
+        k_round[loc_order].astype(jnp.float32),
+        global_rows[loc_order].astype(jnp.float32),
+    ])                                                   # [4, kk_loc]
+    allp = jax.lax.all_gather(loc_pack, AXIS, axis=1).reshape(4, -1)
+    kk_glob = min(round_size, allp.shape[1])
+    g_nsc, g_idx = jax.lax.top_k(allp[0], kk_glob)
+    sc_k = jnp.where(g_nsc > NEG_INF / 2, allp[1][g_idx], NEG_INF)
+    k_sorted = jnp.where(sc_k > NEG_INF / 2,
+                         allp[2][g_idx].astype(jnp.int32), 0)
+    rows_k = allp[3][g_idx].astype(jnp.int32)
+
+    # water-fill the sorted candidates up to `want` (replicated math)
+    csum = jnp.cumsum(k_sorted)
+    c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
+    placed_total = jnp.sum(c_sorted)
+
+    # commit: each shard applies the fills for rows it owns
+    mine = (rows_k >= offset) & (rows_k < offset + n_loc)
+    loc_rows = jnp.clip(rows_k - offset, 0, n_loc - 1)
+    c_i = (jnp.zeros(n_loc, jnp.int32)
+           .at[loc_rows].add(
+               jnp.where(mine, c_sorted, 0).astype(jnp.int32),
+               mode="drop"))
+
+    # compact fill prefix (pad when the whole cluster is smaller than a
+    # round)
+    pad = round_size - kk_glob
+    if pad:
+        rows_p = jnp.concatenate([rows_k, jnp.zeros(pad, rows_k.dtype)])
+        cnt_p = jnp.concatenate(
+            [c_sorted.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
+        sc_p = jnp.concatenate([sc_k, jnp.full(pad, NEG_INF, sc_k.dtype)])
+    else:
+        rows_p, cnt_p, sc_p = rows_k, c_sorted.astype(jnp.int32), sc_k
+
+    tk = min(top_k, kk_glob)
+    top_sc = sc_p[:tk]
+    top_rows = jnp.where(top_sc > NEG_INF / 2, rows_p[:tk], -1)
+    top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
+    n_feas = jax.lax.psum(jnp.sum(k_round > 0), AXIS).astype(jnp.int32)
+    n_filt = jax.lax.psum(jnp.sum(~static), AXIS).astype(jnp.int32)
+    return (rows_p, cnt_p, sc_p, top_rows, top_sc, n_feas, n_filt,
+            c_i, placed_total.astype(jnp.int32))
+
+
 def _bulk_local(inp: BulkInputs, round_size: int, n_rounds: int,
                 top_k: int):
     """Per-shard body of the sharded bulk (water-fill rounds) kernel.
     The round's intake/score math is ops.select.bulk_round_scores — the
     same function the single-device kernel runs — on the local node
-    shard; the fill is decided globally from an all-gather of each
-    shard's top candidates, then committed by the owning shards."""
+    shard; the fill is decided globally via _sharded_waterfill."""
     n_loc = inp.attrs.shape[0]
     offset = jax.lax.axis_index(AXIS) * n_loc
     global_rows = offset + jnp.arange(n_loc)
-    big = jnp.int32(round_size)
 
     static, aff_sc, aff_any, _ = _bulk_static(inp, inp.g)
     noise = tiebreak_noise(inp.seed, global_rows)
@@ -240,78 +316,22 @@ def _bulk_local(inp: BulkInputs, round_size: int, n_rounds: int,
         used, job_count = carry
         k_i, score = bulk_round_scores(inp, static_t, used, job_count,
                                        round_size)
-
-        # spread algorithm: cap per-node intake so a round fans out
-        # (viable counted over the WHOLE mesh)
-        viable = jnp.maximum(jax.lax.psum(jnp.sum(k_i > 0), AXIS), 1)
-        cap_round = jnp.where(
-            inp.spread_algo,
-            jnp.maximum(want // viable + 1, 1).astype(k_i.dtype), big)
-        k_round = jnp.minimum(k_i, cap_round)
-
-        # two-stage candidate selection: each shard contributes its local
-        # top min(round_size, n_loc) nodes; the union is a superset of
-        # the global top round_size because every global winner is a
-        # local winner on its shard
-        kk_loc = min(round_size, n_loc)
-        masked = jnp.where(k_round > 0, score, NEG_INF)
-        loc_nsc, loc_order = jax.lax.top_k(masked + noise, kk_loc)
-        loc_pack = jnp.stack([
-            loc_nsc,
-            jnp.where(loc_nsc > NEG_INF / 2, score[loc_order], NEG_INF),
-            k_round[loc_order].astype(jnp.float32),
-            global_rows[loc_order].astype(jnp.float32),
-        ])                                                   # [4, kk_loc]
-        allp = jax.lax.all_gather(loc_pack, AXIS, axis=1).reshape(4, -1)
-        kk_glob = min(round_size, allp.shape[1])
-        g_nsc, g_idx = jax.lax.top_k(allp[0], kk_glob)
-        sc_k = jnp.where(g_nsc > NEG_INF / 2, allp[1][g_idx], NEG_INF)
-        k_sorted = jnp.where(sc_k > NEG_INF / 2,
-                             allp[2][g_idx].astype(jnp.int32), 0)
-        rows_k = allp[3][g_idx].astype(jnp.int32)
-
-        # water-fill the sorted candidates up to `want` (replicated math)
-        csum = jnp.cumsum(k_sorted)
-        c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
-        placed_total = jnp.sum(c_sorted)
-
-        # commit: each shard applies the fills for rows it owns
-        mine = (rows_k >= offset) & (rows_k < offset + n_loc)
-        loc_rows = jnp.clip(rows_k - offset, 0, n_loc - 1)
-        c_i = (jnp.zeros(n_loc, jnp.int32)
-               .at[loc_rows].add(
-                   jnp.where(mine, c_sorted, 0).astype(jnp.int32),
-                   mode="drop"))
+        (rows_p, cnt_p, sc_p, top_rows, top_sc, n_feas, n_filt,
+         c_i, placed) = _sharded_waterfill(
+            k_i, score, noise, static, want, inp.spread_algo, round_size,
+            top_k, n_loc, offset, global_rows)
         req = inp.req[inp.g]
         used = used + c_i[:, None] * req[None, :]
         job_count = job_count + c_i
 
-        # compact fill prefix (pad when the whole cluster is smaller
-        # than a round)
-        pad = round_size - kk_glob
-        if pad:
-            rows_p = jnp.concatenate([rows_k, jnp.zeros(pad, rows_k.dtype)])
-            cnt_p = jnp.concatenate(
-                [c_sorted.astype(jnp.int32), jnp.zeros(pad, jnp.int32)])
-            sc_p = jnp.concatenate([sc_k, jnp.full(pad, NEG_INF, sc_k.dtype)])
-        else:
-            rows_p, cnt_p, sc_p = rows_k, c_sorted.astype(jnp.int32), sc_k
-
         # round metrics (global, same classification as the single-device
         # kernel: POST-commit exhaustion)
-        tk = min(top_k, kk_glob)
-        top_sc = sc_p[:tk]
-        top_rows = jnp.where(top_sc > NEG_INF / 2, rows_p[:tk], -1)
-        top_sc = jnp.where(top_sc > NEG_INF / 2, top_sc, 0.0)
-        n_feas = jax.lax.psum(jnp.sum(k_round > 0), AXIS).astype(jnp.int32)
-        n_filt = jax.lax.psum(jnp.sum(~static), AXIS).astype(jnp.int32)
         n_exh_l, dim_ex_l = bulk_round_metrics(inp, static, used, job_count)
         n_exh = jax.lax.psum(n_exh_l, AXIS).astype(jnp.int32)
         dim_ex = jax.lax.psum(dim_ex_l, AXIS).astype(jnp.int32)
 
         out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
-               n_feas, n_filt, n_exh, dim_ex,
-               placed_total.astype(jnp.int32))
+               n_feas, n_filt, n_exh, dim_ex, placed)
         return (used, job_count), out
 
     want_r = jnp.clip(
@@ -320,6 +340,100 @@ def _bulk_local(inp: BulkInputs, round_size: int, n_rounds: int,
     carry0 = (inp.used0, inp.job_count0)
     (used, job_count), outs = jax.lax.scan(round_step, carry0, want_r)
     return outs + (used, job_count)
+
+
+def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
+    """Per-shard body of the sharded multi-eval batch kernel: the same
+    round_scores_g / round_metrics_g core as ops.select.place_multi_packed
+    on the local node shard, with _sharded_waterfill's two-stage top-k
+    fill decision.  job_count rows [J, n_loc] are sharded along the node
+    axis like `used`."""
+    n_loc = inp.attrs.shape[0]
+    offset = jax.lax.axis_index(AXIS) * n_loc
+    global_rows = offset + jnp.arange(n_loc)
+
+    base = inp.elig[None, :] & inp.base_mask[inp.g_mask]        # [G, n_loc]
+    static_all = constraint_mask(inp.attrs, inp.con, inp.luts) & base
+    if inp.extra_mask is not None:
+        static_all = static_all & inp.extra_mask
+    aff_all = affinity_score(inp.attrs, inp.aff, inp.luts)
+    aff_any_all = jnp.any(inp.aff[..., 3] != 0, axis=1)
+    noise = tiebreak_noise(inp.seed, global_rows)
+
+    def round_step(carry, xs):
+        used, jc = carry
+        g, want = xs
+        j = inp.g_job[g]
+        job_count = jc[j]
+        req = inp.req[g]
+        static = static_all[g]
+        k_i, score = round_scores_g(
+            inp.cap, req, inp.desired[g], inp.dh_limit[g], static,
+            aff_all[g], aff_any_all[g], used, job_count,
+            inp.spread_algo, round_size)
+        (rows_p, cnt_p, sc_p, top_rows, top_sc, n_feas, n_filt,
+         c_i, placed) = _sharded_waterfill(
+            k_i, score, noise, static, want, inp.spread_algo, round_size,
+            top_k, n_loc, offset, global_rows)
+        used = used + c_i[:, None] * req[None, :]
+        jc = jc.at[j].add(c_i)
+        n_exh_l, dim_ex_l = round_metrics_g(
+            inp.cap, req, inp.dh_limit[g], static, used, jc[j])
+        n_exh = jax.lax.psum(n_exh_l, AXIS).astype(jnp.int32)
+        dim_ex = jax.lax.psum(dim_ex_l, AXIS).astype(jnp.int32)
+        out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
+               n_feas, n_filt, n_exh, dim_ex, placed)
+        return (used, jc), out
+
+    carry0 = (inp.used0, inp.job_count0)
+    (used, jc), outs = jax.lax.scan(
+        round_step, carry0, (inp.round_g, inp.round_want))
+    return outs + (used, jc)
+
+
+def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
+    """Sharded multi-eval batch kernel with the same compact packed
+    buffer layout as ops.select.place_multi_packed."""
+    spec_n = P(AXIS)
+    in_specs = MultiEvalInputs(
+        attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n, luts=P(),
+        base_mask=P(None, AXIS),
+        con=P(), aff=P(), req=P(), desired=P(), dh_limit=P(),
+        g_mask=P(), g_job=P(), job_count0=P(None, AXIS),
+        spread_algo=P(), round_g=P(), round_want=P(), seed=P(),
+        extra_mask=P(None, AXIS),
+    )
+    out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                 spec_n, P(None, AXIS))
+    top_k = TOP_K
+    inner = jax.shard_map(
+        partial(_multi_local, round_size=round_size, top_k=top_k),
+        mesh=mesh, in_specs=(in_specs,), out_specs=out_specs,
+        check_vma=False)
+
+    def f(inp: MultiEvalInputs):
+        n = inp.attrs.shape[0]
+        assert n < (1 << 20), "packed fill rows support < 2^20 nodes"
+        assert round_size <= 1024, "packed fill counts support rounds <= 1024"
+        (rows_p, cnt_p, sc_p, top_rows, top_sc,
+         n_feas, n_filt, n_exh, dim_ex, placed, used, jc) = inner(inp)
+        f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+        fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
+        r = top_rows.shape[0]
+        tk = top_rows.shape[1]
+        meta = jnp.concatenate([
+            jnp.concatenate([top_rows,
+                             jnp.full((r, 3 - tk), -1, jnp.int32)], axis=1),
+            jnp.concatenate([f2i(top_sc),
+                             jnp.zeros((r, 3 - tk), jnp.int32)], axis=1),
+            n_feas[:, None], n_filt[:, None], n_exh[:, None],
+            dim_ex, placed[:, None],
+            jnp.zeros((r, 3), jnp.int32),
+        ], axis=1)
+        buf = jnp.concatenate([fills, meta], axis=1)
+        return buf, used, jc
+
+    return jax.jit(f)
 
 
 def place_bulk_sharded_packed_fn(mesh: Mesh, round_size: int,

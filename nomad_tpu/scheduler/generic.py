@@ -114,6 +114,120 @@ class GenericScheduler(Scheduler):
         e.failed_tg_allocs = dict(self.failed_tg_allocs)
         self.planner.update_eval(e)
 
+    # ------------------------------------------------------- batched path
+
+    class BatchPrep:
+        """One batch-eligible eval's reconcile output: `count` fresh
+        placements of `tg` — either a compact PlaceBlock (count >= 64)
+        or a list of fresh PlaceRequests (small evals, THE case the
+        multi-eval launch amortizes)."""
+        __slots__ = ("job", "tg", "count", "block", "places", "results")
+
+        def __init__(self, job, tg, count, block, places, results):
+            self.job = job
+            self.tg = tg
+            self.count = count
+            self.block = block
+            self.places = places
+            self.results = results
+
+    def prepare_batch(self, evaluation: Evaluation):
+        """Phase 1 of the multi-eval batched path (reference contrast:
+        nomad/worker.go runs one eval per goroutine; here compatible
+        evals share ONE device launch): run the reconcile phase only and
+        decide whether this eval is the batchable shape — ONLY fresh
+        placements of one task group and nothing else (no stops, updates,
+        reschedules, deployment activity), with no spread /
+        distinct_property / device asks (those need the exact scan
+        kernel's per-placement state).  Returns a BatchPrep or None
+        (caller processes the eval through the normal path)."""
+        if evaluation.annotate_plan:
+            return None          # dry-run diffs ride the normal path
+        state = self.state
+        job = state.job_by_id(evaluation.namespace, evaluation.job_id)
+        if job is None or job.stopped():
+            return None
+        allocs = state.allocs_by_job(evaluation.namespace, evaluation.job_id)
+        tainted = tainted_nodes(state, allocs)
+        deployment = state.latest_deployment_by_job(
+            evaluation.namespace, evaluation.job_id)
+        results = reconcile(job, False, allocs, tainted, self.now,
+                            existing_deployment=deployment)
+        if (results.stop or results.inplace_update
+                or results.destructive_update or results.reschedule_later
+                or results.deployment is not None
+                or results.deployment_updates):
+            return None
+        block = None
+        places = None
+        if len(results.place_blocks) == 1 and not results.place:
+            block = results.place_blocks[0]
+            tg = block.tg
+            count = len(block.indexes)
+        elif results.place and not results.place_blocks:
+            places = results.place
+            tg = places[0].tg
+            if any(p.tg is not tg or p.previous_alloc is not None
+                   or p.canary for p in places):
+                return None      # reschedules/canaries: exact path
+            count = len(places)
+        else:
+            return None
+        if count < 1:
+            return None
+        if job.spreads or tg.spreads:
+            return None
+        from nomad_tpu.structs import OP_DISTINCT_PROPERTY
+        cons = (list(job.constraints) + list(tg.constraints)
+                + [c for task in tg.tasks for c in task.constraints])
+        if any(c.operand == OP_DISTINCT_PROPERTY for c in cons):
+            return None
+        from .device import tg_device_requests
+        if tg_device_requests(tg):
+            return None
+        return self.BatchPrep(job, tg, count, block, places, results)
+
+    def process_batched(self, evaluation: Evaluation, prep, bd,
+                        coupled_batch=None) -> Optional[Exception]:
+        """Phase 2: complete an eval whose placements were computed in a
+        multi-eval batch launch — materialize + submit the plan, falling
+        back to the full process() retry loop on partial commit or when
+        preemption could still place failed picks (the batch kernel never
+        preempts).  `coupled_batch` tags the plan for the applier's
+        skip-refit fast path (core/plan_apply.PlanApplier)."""
+        from nomad_tpu.ops.preempt import preemption_enabled
+        job, results = prep.job, prep.results
+        if bd is None:
+            return self.process(evaluation)
+        if ((bd.picks < 0).any()
+                and preemption_enabled(self.state.scheduler_config(),
+                                       job.type)):
+            return self.process(evaluation)
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {tg.name: 0 for tg in job.task_groups}
+        plan = Plan(eval_id=evaluation.id, priority=evaluation.priority,
+                    job=job, coupled_batch=coupled_batch)
+        self._materialize_bulk(plan, job, prep.places, bd, evaluation,
+                               results, block=prep.block)
+        if plan.is_no_op():
+            self._finalize(evaluation)
+            return None
+        result, refreshed_state, err = self.planner.submit_plan(plan)
+        if err is not None:
+            self._update_eval_status(evaluation, "failed", str(err))
+            return err
+        if result is not None:
+            full, _, _ = result.full_commit(plan)
+            if not full:
+                # partial commit: some nodes were refuted against newer
+                # state — re-run the normal retry loop, which reconciles
+                # the committed remainder on a fresh snapshot
+                if refreshed_state is not None:
+                    self.state = refreshed_state
+                return self.process(evaluation)
+        self._finalize(evaluation)
+        return None
+
     # -------------------------------------------------------- single pass
 
     def _process_once(self, evaluation: Evaluation):

@@ -77,6 +77,21 @@ class StateStore:
         self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], Dict[str, Allocation]] = {}
         self._evals_by_job: Dict[Tuple[str, str], Dict[str, Evaluation]] = {}
+        # amortized COW for the alloc tables: snapshot() marks them shared;
+        # the NEXT write copies the outer dicts once and then mutates in
+        # place until another snapshot.  Without this every plan apply paid
+        # an O(cluster) outer-table copy (50k nodes -> milliseconds per
+        # plan, the pipeline bottleneck at bench scale).  Bucket dicts are
+        # tracked the same way: `_fresh_*` holds buckets copied since the
+        # last snapshot (private to the head, safe to mutate in place).
+        self._alloc_tables_shared = False
+        self._fresh_node_buckets: set = set()
+        self._fresh_job_buckets: set = set()
+        # monotonic counter of writes that can change placement validity
+        # (alloc inserts, node upserts/status, CSI volume changes) — the
+        # plan applier's coupled-batch fast path compares it to prove
+        # nothing placement-relevant changed since a plan's snapshot
+        self._placement_seq = 0
         # listeners for state-change events (event broker seam, SURVEY §6.5)
         self._listeners: List[Callable[[str, int, object], None]] = []
 
@@ -86,10 +101,21 @@ class StateStore:
         with self._lock:
             return self._index
 
+    def placement_seq(self) -> int:
+        """Counter of placement-relevant writes (see __init__)."""
+        with self._lock:
+            return self._placement_seq
+
     def _bump(self) -> int:
         self._index += 1
         self._index_cv.notify_all()
         return self._index
+
+    def _bump_placement(self) -> int:
+        """_bump for writes that can change placement validity (nodes,
+        allocs, CSI volumes) — advances the applier's fast-path fence."""
+        self._placement_seq += 1
+        return self._bump()
 
     def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
         """Block until the store has applied at least `index` (the eval
@@ -116,7 +142,7 @@ class StateStore:
 
     def upsert_node(self, node: Node) -> int:
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             prev = self._nodes.get(node.id)
             node = node.copy()
             node.create_index = prev.create_index if prev else idx
@@ -133,7 +159,7 @@ class StateStore:
         the whole batch (per-node upsert is O(cluster) per call, which makes
         seeding a 50k-node cluster quadratic)."""
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             table = dict(self._nodes)
             inserted = []
             for node in nodes:
@@ -151,7 +177,7 @@ class StateStore:
 
     def delete_node(self, node_id: str) -> int:
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             nodes = dict(self._nodes)
             nodes.pop(node_id, None)
             self._nodes = nodes
@@ -278,20 +304,31 @@ class StateStore:
 
     def upsert_allocs(self, allocs: Iterable[Allocation]) -> int:
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             self._insert_allocs(allocs, idx)
             return idx
 
     def _insert_allocs(self, allocs: Iterable[Allocation], idx: int,
                        copy: bool = True) -> None:
-        table = dict(self._allocs)
-        by_node = dict(self._allocs_by_node)
-        by_job = dict(self._allocs_by_job)
-        # Copy-on-first-touch per bucket: buckets shared with live snapshots
-        # are copied once per transaction, not once per alloc (a 10k-alloc
-        # plan for one job would otherwise copy the job bucket 10k times).
-        fresh_node: set = set()
-        fresh_job: set = set()
+        if self._alloc_tables_shared:
+            # a snapshot may hold the current tables: copy the outer dicts
+            # once, then mutate in place until the next snapshot
+            table = dict(self._allocs)
+            by_node = dict(self._allocs_by_node)
+            by_job = dict(self._allocs_by_job)
+            self._fresh_node_buckets = set()
+            self._fresh_job_buckets = set()
+            self._alloc_tables_shared = False
+        else:
+            table = self._allocs
+            by_node = self._allocs_by_node
+            by_job = self._allocs_by_job
+        # Copy-on-first-touch per bucket: buckets possibly shared with live
+        # snapshots are copied once per snapshot-write cycle, not once per
+        # alloc (a 10k-alloc plan for one job would otherwise copy the job
+        # bucket 10k times).
+        fresh_node: set = self._fresh_node_buckets
+        fresh_job: set = self._fresh_job_buckets
         fn_add = fresh_node.add
         fj_add = fresh_job.add
         table_get = table.get
@@ -347,7 +384,7 @@ class StateStore:
         """Client-side status updates (reference: FSM AllocClientUpdate):
         merges client_status into the stored alloc."""
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             merged = []
             for u in updates:
                 cur = self._allocs.get(u.id)
@@ -372,7 +409,7 @@ class StateStore:
         Alloc.UpdateDesiredTransition — the drainer's lever: the reconciler
         only migrates draining-node allocs the drainer has flagged)."""
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             merged = []
             for aid in alloc_ids:
                 cur = self._allocs.get(aid)
@@ -403,12 +440,26 @@ class StateStore:
 
     # ------------------------------------------------------- plan results
 
-    def upsert_plan_results(self, plan: Plan, result: PlanResult) -> int:
+    def upsert_plan_results(self, plan: Plan, result: PlanResult,
+                            expected_placement_seq: Optional[int] = None
+                            ) -> int:
         """Apply a committed plan (reference: FSM ApplyPlanResults →
         state.UpsertPlanResults): stops, preemption evictions, placements,
-        deployment upserts — one atomic index bump."""
+        deployment upserts — one atomic index bump.
+
+        `expected_placement_seq`: the applier's coupled-batch fast path
+        passes the fence value its skip-fit decision was based on; if a
+        foreign placement write slipped in since (the decision and the
+        commit are separate lock scopes), the commit is REFUSED by
+        returning -1 and the applier redoes the full re-check.  Checked
+        under the same lock as the commit, so the fast path is exactly as
+        safe as the full path.  Deterministic across Raft replicas: all
+        placement writes ride the log, so every replica's counter agrees."""
         with self._lock:
-            idx = self._bump()
+            if (expected_placement_seq is not None
+                    and self._placement_seq != expected_placement_seq):
+                return -1
+            idx = self._bump_placement()
             allocs: List[Allocation] = []
             for node_allocs in result.node_update.values():
                 allocs.extend(node_allocs)
@@ -473,7 +524,7 @@ class StateStore:
 
     def upsert_csi_volume(self, vol: CSIVolume) -> int:
         with self._lock:
-            idx = self._bump()
+            idx = self._bump_placement()
             key = (vol.namespace, vol.id)
             prev = self._csi_volumes.get(key)
             if prev is not None:
@@ -815,6 +866,9 @@ class StateStore:
             self._allocs = {}
             self._allocs_by_node = {}
             self._allocs_by_job = {}
+            self._alloc_tables_shared = False
+            self._fresh_node_buckets = set()
+            self._fresh_job_buckets = set()
             for d in doc["Allocs"]:
                 a = codec.decode(Allocation, d)
                 a.job = self._job_versions.get(
@@ -864,8 +918,22 @@ class StateStore:
 
     # ------------------------------------------------------------ snapshot
 
+    def snapshot_and_placement_seq(self):
+        """(snapshot, placement_seq) read atomically — the worker's
+        coupled-batch fence must be taken AT the snapshot: a write landing
+        between separate reads would be invisible to the fence while
+        missing from the snapshot (the applier would then skip the fit
+        re-check against state the scheduler never saw)."""
+        with self._lock:
+            return self.snapshot(), self._placement_seq
+
     def snapshot(self) -> "StateSnapshot":
         with self._lock:
+            # the handed-out tables are frozen from here on: the next
+            # alloc write copies before mutating (see _insert_allocs)
+            self._alloc_tables_shared = True
+            self._fresh_node_buckets = set()
+            self._fresh_job_buckets = set()
             return StateSnapshot(
                 store_id=self.store_id,
                 index=self._index,
@@ -885,7 +953,9 @@ class StateStore:
             )
 
     # convenience pass-throughs (read the live head; schedulers must use
-    # snapshot() for consistency)
+    # snapshot() for consistency).  dict.get is atomic under the GIL, but
+    # anything ITERATING a bucket must hold the lock: alloc buckets copied
+    # since the last snapshot are mutated in place by _insert_allocs.
     def node_by_id(self, node_id: str) -> Optional[Node]:
         return self._nodes.get(node_id)
 
@@ -899,7 +969,9 @@ class StateStore:
         return self._allocs.get(alloc_id)
 
     def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
-        return list(self._allocs_by_job.get((namespace, job_id), {}).values())
+        with self._lock:
+            return list(self._allocs_by_job.get((namespace, job_id),
+                                                {}).values())
 
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._deployments.get(dep_id)

@@ -933,6 +933,12 @@ class Plan:
     deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
     annotations: Optional["PlanAnnotations"] = None
     snapshot_index: int = 0
+    # (batch_id, placement_seq_at_snapshot) when this plan came from a
+    # multi-eval batched launch: plans of one batch were computed against
+    # shared proposed capacity and cannot refute each other, so the
+    # applier may skip the per-node AllocsFit re-check while the store's
+    # placement_seq proves no foreign write intervened (core/plan_apply)
+    coupled_batch: Optional[Tuple[str, int]] = None
 
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)

@@ -1,0 +1,336 @@
+"""Multi-eval batched scheduling (DP over evals — SURVEY §3.6 row 1).
+
+The reference processes one eval per worker goroutine (nomad/worker.go);
+here compatible pending evals share ONE device launch
+(ops.select.place_multi_packed via engine.place_batch) and their plans are
+mutually consistent by construction.  These tests pin:
+  - kernel parity: a batch of one == the single-eval bulk kernel
+  - capacity coupling: plans inside one batch never oversubscribe and
+    never refute each other at the serialized applier
+  - end-to-end: Server.process_all with eval_batch handles a mixed queue
+    (batchable + system + spread jobs) equivalently to solo processing
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.ops import PlacementEngine
+from nomad_tpu.ops.engine import BatchItem
+from nomad_tpu.scheduler import Harness
+
+NOW = 1.7e9
+
+
+def build_cluster(n_nodes=200, n_dcs=3, seed=0):
+    rng = random.Random(seed)
+    h = Harness()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.datacenter = f"dc{1 + i % n_dcs}"
+        n.resources.cpu = rng.choice([4000, 8000, 16000])
+        n.resources.memory_mb = rng.choice([8192, 16384, 32768])
+        nodes.append(n)
+    h.state.upsert_nodes(nodes)
+    return h, nodes
+
+
+def batch_jobs(h, counts, cpu=100, mem=64):
+    jobs = []
+    for c in counts:
+        job = mock.batch_job()
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = c
+        tg.tasks[0].resources.cpu = cpu
+        tg.tasks[0].resources.memory_mb = mem
+        h.state.upsert_job(job)
+        jobs.append(job)
+    return jobs
+
+
+class TestPlaceBatchKernel:
+    def test_single_item_matches_bulk_kernel(self):
+        h, _ = build_cluster(150)
+        (job,) = batch_jobs(h, [200])
+        snap = h.state.snapshot()
+        eng = PlacementEngine()
+        bd_batch = eng.place_batch(
+            snap, [BatchItem(job=job, tg=job.task_groups[0], count=200)],
+            seed=9)[0]
+        bd_bulk = eng.place(snap, job, job.task_groups, None, bulk_api=True,
+                            seed=9, block=(job.task_groups[0].name, 200))
+        assert np.array_equal(np.sort(bd_batch.picks),
+                              np.sort(bd_bulk.picks))
+        # metric parity for the first round
+        m_batch, m_bulk = bd_batch.metrics[0], bd_bulk.metrics[0]
+        assert m_batch.nodes_filtered == m_bulk.nodes_filtered
+        assert m_batch.nodes_exhausted == m_bulk.nodes_exhausted
+
+    def test_capacity_coupling_across_items(self):
+        """Items in one batch see each other's proposed usage: total
+        per-node commitment never exceeds capacity even when the batch
+        oversubscribes the cluster."""
+        h, nodes = build_cluster(20, seed=3)
+        for n in nodes:
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+        h.state.upsert_nodes(nodes)
+        jobs = batch_jobs(h, [30, 30, 30], cpu=1000, mem=512)
+        snap = h.state.snapshot()
+        eng = PlacementEngine()
+        items = [BatchItem(job=j, tg=j.task_groups[0],
+                           count=j.task_groups[0].count) for j in jobs]
+        decisions = eng.place_batch(snap, items, seed=5)
+        used = {}
+        placed = 0
+        for d in decisions:
+            for p in d.picks:
+                if p < 0:
+                    continue
+                used[int(p)] = used.get(int(p), 0) + 1000
+                placed += 1
+        # usable cpu is 4000 minus the node's reserved 100 -> 3 slots
+        # per node; 20 nodes x 3 = 60 total capacity for 90 asks
+        assert placed == 60, placed
+        for row, cpu in used.items():
+            assert cpu <= 3900, (row, cpu)
+        # failed picks report exhaustion, not filtering
+        failed_rounds = [m for d in decisions for m in d.metrics
+                         if m.dimension_exhausted]
+        assert failed_rounds
+
+    def test_job_anti_affinity_rows_isolated_per_job(self):
+        """Each item's anti-affinity sees only ITS job's allocs: two jobs
+        placing in one batch spread independently."""
+        h, _ = build_cluster(10)
+        jobs = batch_jobs(h, [4, 4], cpu=10, mem=10)
+        for j in jobs:
+            j.type = "service"
+            h.state.upsert_job(j)
+        snap = h.state.snapshot()
+        eng = PlacementEngine()
+        items = [BatchItem(job=j, tg=j.task_groups[0], count=4)
+                 for j in jobs]
+        d1, d2 = eng.place_batch(snap, items, seed=11)
+        assert (d1.picks >= 0).all() and (d2.picks >= 0).all()
+
+
+class TestBatchedWorkerPath:
+    def _run(self, eval_batch, n_jobs=6, count=25, system_too=True):
+        s = Server(dev_mode=True, eval_batch=eval_batch)
+        s.establish_leadership()
+        rng = random.Random(1)
+        for i in range(60):
+            n = mock.node()
+            n.datacenter = f"dc{1 + i % 3}"
+            n.resources.cpu = rng.choice([8000, 16000])
+            n.resources.memory_mb = 16384
+            s.register_node(n, now=NOW)
+        jobs = []
+        for _ in range(n_jobs):
+            job = mock.batch_job()
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            job.task_groups[0].count = count
+            # small asks: eval processing ORDER between concurrently
+            # pending evals is not a guarantee (coupled batches run
+            # before solos), so the fixture must not be capacity-tight
+            job.task_groups[0].tasks[0].resources.cpu = 10
+            job.task_groups[0].tasks[0].resources.memory_mb = 16
+            s.register_job(job, now=NOW)
+            jobs.append(job)
+        sysjob = None
+        if system_too:
+            sysjob = mock.system_job()
+            s.register_job(sysjob, now=NOW)
+        n = s.process_all(now=NOW)
+        return s, jobs, sysjob, n
+
+    def test_mixed_queue_batched_equals_solo(self):
+        s_b, jobs_b, sys_b, n_b = self._run(eval_batch=64)
+        s_s, jobs_s, sys_s, n_s = self._run(eval_batch=0)
+        assert n_b == n_s
+        for s, jobs, sysjob in ((s_b, jobs_b, sys_b), (s_s, jobs_s, sys_s)):
+            snap = s.state.snapshot()
+            for job in jobs:
+                live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                        if not a.terminal_status()]
+                assert len(live) == 25, (job.id, len(live))
+                evs = snap.evals_by_job(job.namespace, job.id)
+                assert any(e.status == "complete" for e in evs)
+            live = [a for a in snap.allocs_by_job(sysjob.namespace,
+                                                  sysjob.id)
+                    if not a.terminal_status()]
+            # system job defaults to dc1 only: a third of the nodes
+            assert len(live) == 20
+
+    def test_batched_plans_do_not_refute_each_other(self):
+        s, jobs, _, _ = self._run(eval_batch=64, n_jobs=8, count=40,
+                                  system_too=False)
+        # every plan committed in full: no worker retries happened
+        assert s.workers[0].stats["nacked"] == 0
+        snap = s.state.snapshot()
+        for job in jobs:
+            evs = snap.evals_by_job(job.namespace, job.id)
+            assert all(e.status in ("complete",) for e in evs), \
+                [(e.status, e.status_description) for e in evs]
+
+    def test_batch_oversubscription_creates_blocked_evals(self):
+        s = Server(dev_mode=True, eval_batch=64)
+        s.establish_leadership()
+        for _ in range(4):
+            n = mock.node()
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+            s.register_node(n, now=NOW)
+        jobs = []
+        for _ in range(3):
+            job = mock.batch_job()
+            job.task_groups[0].count = 3
+            job.task_groups[0].tasks[0].resources.cpu = 2000
+            job.task_groups[0].tasks[0].resources.memory_mb = 64
+            s.register_job(job, now=NOW)
+            jobs.append(job)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        placed = sum(
+            1 for job in jobs
+            for a in snap.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status())
+        # usable cpu 3900 fits ONE 2000-cpu alloc per node: 4 of 9 place
+        assert placed == 4
+        assert s.blocked_evals.num_blocked() >= 1
+        # capacity arrives -> blocked evals release and place the rest
+        big = mock.node()
+        big.resources.cpu = 16000
+        big.resources.memory_mb = 32768
+        s.register_node(big, now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        placed = sum(
+            1 for job in jobs
+            for a in snap.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status())
+        assert placed == 9
+
+    def test_spread_job_falls_back_to_exact_path_in_batch(self):
+        from nomad_tpu.structs import Spread, SpreadTarget
+        s = Server(dev_mode=True, eval_batch=64)
+        s.establish_leadership()
+        for i in range(30):
+            n = mock.node()
+            n.datacenter = f"dc{1 + i % 3}"
+            s.register_node(n, now=NOW)
+        plain = mock.batch_job()
+        plain.datacenters = ["dc1", "dc2", "dc3"]
+        plain.task_groups[0].count = 10
+        s.register_job(plain, now=NOW)
+        spread = mock.job()
+        spread.datacenters = ["dc1", "dc2", "dc3"]
+        spread.task_groups[0].count = 9
+        spread.spreads = [Spread(attribute="${node.datacenter}", weight=50,
+                                 targets=[SpreadTarget("dc1", 34),
+                                          SpreadTarget("dc2", 33),
+                                          SpreadTarget("dc3", 33)])]
+        s.register_job(spread, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        for job, want in ((plain, 10), (spread, 9)):
+            live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()]
+            assert len(live) == want
+        # the spread job actually spread across the three DCs
+        by_dc = {}
+        for a in snap.allocs_by_job(spread.namespace, spread.id):
+            node = snap.node_by_id(a.node_id)
+            by_dc[node.datacenter] = by_dc.get(node.datacenter, 0) + 1
+        assert sorted(by_dc.values()) == [3, 3, 3], by_dc
+
+    def test_applier_fast_path_and_fence(self):
+        """Coupled-batch plans skip the redundant AllocsFit re-check; a
+        foreign placement-relevant write mid-chain breaks the fence and
+        restores the full optimistic re-check (which refutes a plan the
+        fast path would have waved through)."""
+        from nomad_tpu.structs import Allocation, Plan
+
+        s, jobs, _, _ = self._run(eval_batch=64, n_jobs=6, count=20,
+                                  system_too=False)
+        stats = s.plan_applier.stats
+        assert stats["fast_path"] >= 5, stats
+
+        # hand-drive a coupled chain against the applier
+        snap = s.state.snapshot()
+        node = snap.nodes()[0]
+        job = jobs[0]
+
+        def mkplan(cpu, bid, seq0):
+            a = Allocation(namespace=job.namespace, job_id=job.id, job=job,
+                           task_group=job.task_groups[0].name,
+                           desired_status="run", client_status="pending")
+            a.resources = job.task_groups[0].combined_resources().copy()
+            a.resources.cpu = cpu
+            a.node_id = node.id
+            p = Plan(eval_id="manual", job=job,
+                     coupled_batch=(bid, seq0))
+            p.append_alloc(a)
+            return p
+
+        seq0 = s.state.placement_seq()
+        r1 = s.plan_applier.evaluate_plan(
+            mkplan(50, "bX", seq0), skip_fit=True)
+        assert not r1.refuted_nodes
+
+        # a plan that oversubscribes the node: with the fence intact it
+        # would slip through skip_fit; a foreign write breaks the chain
+        # arithmetic so apply_one full-checks and refutes it
+        big = mkplan(10 ** 9, "bX", seq0)
+        s.register_node(mock.node(), now=NOW + 1)    # foreign write
+        from nomad_tpu.core.plan_apply import PendingPlan
+        pending = PendingPlan(big)
+        s.plan_applier.apply_one(pending)
+        result, err = pending.wait(timeout=5)
+        assert err is None
+        assert result.refuted_nodes == [node.id]
+
+    def test_preemption_falls_back_to_solo(self):
+        from nomad_tpu.structs import (PreemptionConfig,
+                                       SchedulerConfiguration)
+        s = Server(dev_mode=True, eval_batch=64)
+        s.establish_leadership()
+        s.state.set_scheduler_config(SchedulerConfiguration(
+            preemption_config=PreemptionConfig(
+                service_scheduler_enabled=True,
+                batch_scheduler_enabled=True)))
+        for _ in range(5):
+            n = mock.node()
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+            s.register_node(n, now=NOW)
+        low = mock.batch_job()
+        low.priority = 20
+        low.task_groups[0].count = 5
+        low.task_groups[0].tasks[0].resources.cpu = 3000
+        s.register_job(low, now=NOW)
+        s.process_all(now=NOW)
+        # two high-pri jobs arrive together: each must preempt
+        highs = []
+        for _ in range(2):
+            hi = mock.job()
+            hi.priority = 80
+            hi.task_groups[0].count = 2
+            hi.task_groups[0].tasks[0].resources.cpu = 3000
+            s.register_job(hi, now=NOW + 1)
+            highs.append(hi)
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        for hi in highs:
+            live = [a for a in snap.allocs_by_job(hi.namespace, hi.id)
+                    if not a.terminal_status()]
+            assert len(live) == 2, (hi.id, len(live))
+        evicted = [a for a in snap.allocs_by_job(low.namespace, low.id)
+                   if a.desired_status == "evict"]
+        assert len(evicted) == 4
