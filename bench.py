@@ -455,9 +455,7 @@ def run_config_5(args):
             evals.append(ev)
             wave_jobs.append(job)
         t0 = time.perf_counter()
-        s.plan_applier.start()
-        for w in s.workers:
-            w.start()
+        s.start_scheduling()
         deadline = time.time() + 1200
         pending = {e.id for e in evals}
         while pending and time.time() < deadline:
@@ -473,10 +471,7 @@ def run_config_5(args):
             if pending:
                 time.sleep(0.05)
         dt = time.perf_counter() - t0
-        for w in s.workers:
-            w.stop()
-        s.plan_applier.stop()
-        s.plan_queue.set_enabled(True)    # re-arm for the next wave
+        s.stop_scheduling()
         snap = s.state.snapshot()
         statuses = [snap.eval_by_id(e.id).status for e in evals]
         assert all(st == "complete" for st in statuses), (

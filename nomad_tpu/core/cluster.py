@@ -52,7 +52,7 @@ MUTATIONS = frozenset({
     "upsert_allocs", "update_allocs_from_client",
     "update_alloc_desired_transition",
     "upsert_deployment", "delete_deployment", "upsert_plan_results",
-    "upsert_csi_volume", "delete_csi_volume",
+    "upsert_csi_volume", "delete_csi_volume", "release_csi_claim",
     "set_scheduler_config", "set_identity_secret",
     "upsert_namespace", "delete_namespace",
     "upsert_node_pool", "delete_node_pool",

@@ -105,13 +105,7 @@ class EvalBroker:
                 self._tick_locked(now)
                 ev = self._pop_ready_locked(schedulers)
                 if ev is not None:
-                    token = new_id()
-                    self._outstanding[ev.id] = (
-                        token, now + self.nack_timeout, ev)
-                    self._dequeues[ev.id] = self._dequeues.get(ev.id, 0) + 1
-                    self._in_flight_jobs.add((ev.namespace, ev.job_id))
-                    self.stats["dequeued"] += 1
-                    return ev, token
+                    return ev, self._issue_locked(ev, now)
                 if timeout == 0.0 or (deadline is not None and now >= deadline):
                     return None, ""
                 if not self._cv.wait(timeout=0.05):
@@ -133,18 +127,24 @@ class EvalBroker:
             return out
         out.append((ev, token))
         with self._cv:
+            self._tick_locked(now)     # expired redeliveries join the batch
             while len(out) < max_n and self._enabled:
                 nxt = self._pop_ready_locked(schedulers)
                 if nxt is None:
                     break
-                tok = new_id()
-                self._outstanding[nxt.id] = (
-                    tok, now + self.nack_timeout, nxt)
-                self._dequeues[nxt.id] = self._dequeues.get(nxt.id, 0) + 1
-                self._in_flight_jobs.add((nxt.namespace, nxt.job_id))
-                self.stats["dequeued"] += 1
-                out.append((nxt, tok))
+                out.append((nxt, self._issue_locked(nxt, now)))
         return out
+
+    def _issue_locked(self, ev: Evaluation, now: float) -> str:
+        """Mint a delivery token + outstanding/redelivery bookkeeping —
+        the single definition both dequeue paths share (nack/timeout
+        accounting must never diverge between them)."""
+        token = new_id()
+        self._outstanding[ev.id] = (token, now + self.nack_timeout, ev)
+        self._dequeues[ev.id] = self._dequeues.get(ev.id, 0) + 1
+        self._in_flight_jobs.add((ev.namespace, ev.job_id))
+        self.stats["dequeued"] += 1
+        return token
 
     def _pop_ready_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
         """Pop the best ready eval whose job has no eval in flight; evals

@@ -47,6 +47,7 @@ from .periodic import PeriodicDispatch, dispatch_job
 from .stream import EventBroker
 from .heartbeat import HeartbeatTimers, build_node_evals, invalidate_heartbeat
 from .plan_apply import PlanApplier, PlanQueue
+from .volume_watcher import VolumeWatcher
 from .worker import Worker
 
 
@@ -71,6 +72,7 @@ class Server:
         self.deployments = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatch(self)
+        self.volumes = VolumeWatcher(self)
         self.events = EventBroker()
         self.events.attach(self.state)
         self.engine = PlacementEngine()
@@ -182,6 +184,24 @@ class Server:
         (there is no applier thread)."""
         if not self._applier_running:
             self.plan_applier.apply_one(pending)
+
+    def start_scheduling(self) -> None:
+        """Start ONLY the applier + worker threads (no tick loop) — for
+        drivers like bench.py that enqueue everything first and control
+        time themselves.  Keeps _applier_running consistent: starting the
+        applier thread without it would double-apply every plan (inline
+        at submit AND via the queue drain)."""
+        self.plan_applier.start()
+        self._applier_running = True
+        for w in self.workers:
+            w.start()
+
+    def stop_scheduling(self) -> None:
+        for w in self.workers:
+            w.stop()
+        self.plan_applier.stop()
+        self._applier_running = False
+        self.plan_queue.set_enabled(True)   # re-arm for a next round
 
     # ------------------------------------------------------- job endpoint
 
@@ -569,6 +589,7 @@ class Server:
         self.deployments.tick(t)
         self.drainer.tick(t)
         self.periodic.tick(t)
+        self.volumes.tick(t)
 
     # ---------------------------------------------------------- dev drive
 

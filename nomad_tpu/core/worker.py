@@ -39,6 +39,11 @@ class Worker:
         # set per-eval by process():
         self._snapshot = None
         self._eval_token = ""
+        # the timebase of the eval currently being processed: eval
+        # updates (and their delayed follow-ups) must use the SAME clock
+        # the scheduler ran with, not a fresh wall-clock read (tests and
+        # deterministic replays inject synthetic time)
+        self._now: Optional[float] = None
 
     # ------------------------------------------------------------ running
 
@@ -145,6 +150,7 @@ class Worker:
         # fence yet missing from the snapshot (the applier would then
         # skip the fit re-check against state the scheduler never saw)
         self._snapshot, batch_seq0 = state.snapshot_and_placement_seq()
+        self._now = t
 
         # phase 1: build schedulers, reconcile batch-eligible evals
         work = []          # (ev, token, sched_or_None, prep_or_err)
@@ -216,6 +222,7 @@ class Worker:
         return len(work)
 
     def _invoke(self, evaluation: Evaluation, now: float) -> Optional[Exception]:
+        self._now = now
         state = self.server.state
         # wait for the state to catch up to the eval (waitForIndex)
         if evaluation.modify_index:
@@ -251,17 +258,17 @@ class Worker:
         return result, refreshed, None
 
     def update_eval(self, evaluation: Evaluation) -> None:
-        self.server.apply_eval_update([evaluation])
+        self.server.apply_eval_update([evaluation], now=self._now)
         if evaluation.status == "complete" and evaluation.failed_tg_allocs:
             pass  # blocked eval creation handled by the scheduler
 
     def create_eval(self, evaluation: Evaluation) -> None:
-        self.server.apply_eval_update([evaluation])
+        self.server.apply_eval_update([evaluation], now=self._now)
 
     def reblock_eval(self, evaluation: Evaluation) -> None:
         # apply_eval_update routes blocked evals to the tracker (and
         # cancels duplicates)
-        self.server.apply_eval_update([evaluation])
+        self.server.apply_eval_update([evaluation], now=self._now)
 
     def serves_plan(self) -> bool:
         return True

@@ -684,12 +684,27 @@ class PlacementEngine:
                 evictions=evictions_by_req.get(i, [])))
         return decisions
 
+    # the device preemption kernel pays a fixed per-launch cost (the chip
+    # sits behind a network transport) plus an O(N x MAX_VICTIMS) table
+    # upload; it wins only where the host loop's O(failed x nodes) work
+    # outgrows that (measured crossover ~2k nodes on the tunneled v5e) and
+    # the upload stays bounded
+    PREEMPT_DEVICE_MIN_NODES = 2000
+    PREEMPT_DEVICE_MAX_NODES = 8192
+    PREEMPT_DEVICE_MIN_FAILED = 4
+
     def _preempt_fallback(self, picks, snapshot, job, inp, tg_tensors,
                           tg_idx, t, used_dev, job_count_dev, p_real
                           ) -> Dict[int, List]:
-        """Host-side preemption for placements the kernel could not fit
-        (reference: BinPackIterator drives the Preemptor when Fit fails and
-        preemption is enabled for the scheduler type).  Mutates `picks`."""
+        """Preemption for placements the kernel could not fit (reference:
+        BinPackIterator drives the Preemptor when Fit fails and preemption
+        is enabled for the scheduler type).  Mutates `picks`.
+
+        Homogeneous failure batches resolve on DEVICE first
+        (ops.preempt.preempt_bulk: one launch scans all failed
+        placements); the host Preemptor covers the long tail — mixed task
+        groups, very large clusters (table upload cost), >MAX_VICTIMS-deep
+        nodes, and anything the kernel left unplaced."""
         evictions_by_req: Dict[int, List] = {}
         if (not np.any(picks < 0)
                 or not preemption_enabled(snapshot.scheduler_config(),
@@ -700,10 +715,25 @@ class PlacementEngine:
         static = np.asarray(feasible_mask_jit(
             inp.attrs, inp.elig, inp.dc_mask, inp.pool_mask,
             inp.con, inp.luts))[:, :t.n]
-        preemptor = Preemptor(job, snapshot, t, static,
-                              np.asarray(used_dev)[:t.n],
-                              job_count=np.asarray(job_count_dev)[:t.n],
+        used = np.asarray(used_dev)[:t.n]
+        job_count = np.asarray(job_count_dev)[:t.n]
+        pre_evicted: set = set()
+
+        failed = [i for i in range(p_real) if picks[i] < 0]
+        gs = {int(tg_idx[i]) for i in failed}
+        if (len(gs) == 1 and len(failed) >= self.PREEMPT_DEVICE_MIN_FAILED
+                and self.PREEMPT_DEVICE_MIN_NODES <= t.n
+                <= self.PREEMPT_DEVICE_MAX_NODES):
+            used, job_count = self._preempt_device(
+                failed, gs.pop(), snapshot, job, tg_tensors, t, static,
+                used, job_count, picks, evictions_by_req, pre_evicted)
+
+        if not np.any(picks < 0):
+            return evictions_by_req
+        preemptor = Preemptor(job, snapshot, t, static, used,
+                              job_count=job_count,
                               dh_limit=tg_tensors.dh_limit)
+        preemptor.evicted_ids |= pre_evicted
         for i in range(p_real):
             if picks[i] >= 0:
                 continue
@@ -713,6 +743,40 @@ class PlacementEngine:
                 picks[i] = res.node_row
                 evictions_by_req[i] = res.evictions
         return evictions_by_req
+
+    def _preempt_device(self, failed, g, snapshot, job, tg_tensors, t,
+                        static, used, job_count, picks, evictions_by_req,
+                        pre_evicted):
+        """One preempt_bulk launch for a homogeneous failed batch; maps
+        (node, k) results back to concrete victim allocs.  Returns the
+        post-eviction (used, job_count) for the host fallback."""
+        from .preempt import build_victim_tables, preempt_bulk_jit
+        prio, res, by_row = build_victim_tables(job, snapshot, t)
+        if not by_row:
+            return used, job_count
+        req = tg_tensors.req[g].astype(np.int32)
+        best_rows, ks, used2, jc2 = preempt_bulk_jit(
+            jnp.asarray(t.cap), jnp.asarray(used),
+            jnp.asarray(static[g]),
+            jnp.asarray(tg_tensors.dh_limit[g]),
+            jnp.asarray(job_count),
+            jnp.asarray(prio), jnp.asarray(res), jnp.asarray(req),
+            _pad_pow2(len(failed)), jnp.asarray(len(failed), jnp.int32))
+        best_rows = np.asarray(best_rows)
+        ks = np.asarray(ks)
+        taken: Dict[int, int] = {}      # row -> victims consumed so far
+        for j, i in enumerate(failed):
+            row = int(best_rows[j])
+            if row < 0:
+                continue
+            k = int(ks[j])
+            start = taken.get(row, 0)
+            victims = by_row[row][start:start + k]
+            taken[row] = start + k
+            picks[i] = row
+            evictions_by_req[i] = victims
+            pre_evicted.update(v.id for v in victims)
+        return np.asarray(used2), np.asarray(jc2)
 
     def _dc_counts(self, t: NodeTensors) -> Dict[str, int]:
         """Ready-node count per datacenter (AllocMetric.nodes_available),

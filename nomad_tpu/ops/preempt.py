@@ -11,10 +11,22 @@ reference's semantics:
     priority band the alloc whose resources best match the remaining
     shortfall (basicResourceDistance).
 
-This pass runs host-side (numpy) over the packed node tensors for the few
-placements that failed the device batch — the common case (everything
-places) never pays for it.  A fully device-resident priority-bucket design
-is sketched in the docstring of `usage_by_priority` for a later round.
+Two implementations share the packed victim tables:
+
+  - `preempt_bulk` — the DEVICE kernel: every failed placement of a
+    homogeneous batch resolves in ONE launch.  A `lax.scan` step computes,
+    for ALL nodes at once, the eviction count k needed to fit the ask
+    (prefix sums over the priority-sorted victim table) and its
+    priority-weighted cost, argmin-picks the cheapest node, and commits
+    (victims consumed, capacity updated) so later placements see earlier
+    evictions.  The host maps each (node, k) back to concrete alloc ids —
+    the first k unconsumed victims in priority order, deterministic.
+  - `Preemptor` — the host reference implementation (kept for the long
+    tail: deep victim tables, heterogeneous asks, and as the parity
+    oracle).  Within a priority band it picks by distance to the REMAINING
+    shortfall; the device kernel consumes strictly in priority-sorted
+    order — identical sets whenever bands are homogeneous (the common
+    case), cheaper-but-valid evictions otherwise.
 """
 
 from __future__ import annotations
@@ -22,6 +34,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from nomad_tpu.structs import (
@@ -34,6 +48,98 @@ from nomad_tpu.structs import (
     PreemptionConfig,
     SchedulerConfiguration,
 )
+
+# victim-table depth: nodes with more evictable allocs are truncated to
+# their MAX_VICTIMS lowest-priority ones (the kernel then may under-free
+# on such nodes; the host fallback covers any leftover failures)
+MAX_VICTIMS = 32
+BIG_COST = jnp.float32(1e30)
+
+
+def build_victim_tables(job: Job, snapshot, tensors
+                        ) -> Tuple[np.ndarray, np.ndarray, Dict[int, list]]:
+    """Pack each node's evictable allocs (priority < job.priority, not the
+    same job) into [N, A] priority-sorted tables.
+    Returns (prio [N,A] int32, res [N,A,3] int32, allocs {row: [Allocation
+    in the SAME sorted order]}).  Padding entries carry prio=2^30, res=0 —
+    they can never help fill an ask."""
+    n = tensors.n
+    prio = np.full((n, MAX_VICTIMS), 1 << 30, np.int32)
+    res = np.zeros((n, MAX_VICTIMS, 3), np.int32)
+    by_row: Dict[int, list] = {}
+    my_prio = job.priority
+    for row, node_id in enumerate(tensors.node_ids):
+        lst = []
+        for a in snapshot.allocs_by_node(node_id):
+            if a.terminal_status():
+                continue
+            p = a.job.priority if a.job is not None else 50
+            if p >= my_prio or a.job_id == job.id:
+                continue
+            lst.append((p, a))
+        if not lst:
+            continue
+        lst.sort(key=lambda t: t[0])
+        lst = lst[:MAX_VICTIMS]
+        by_row[row] = [a for _, a in lst]
+        for i, (p, a) in enumerate(lst):
+            prio[row, i] = p
+            res[row, i] = (a.resources.cpu, a.resources.memory_mb,
+                           a.resources.disk_mb)
+    return prio, res, by_row
+
+
+def preempt_bulk(cap, used0, static_g, dh_limit_g, job_count0,
+                 pre_prio, pre_res, req, n_place: int, n_real):
+    """Resolve up to n_real (<= n_place; n_place is the padded compile
+    shape) failed placements by preemption in ONE device program.
+    Returns (best_rows [P], k_counts [P], used, job_count) — best_rows[i]
+    = -1 when nothing could make placement i fit (or i is padding)."""
+    # per-victim cost: reference Preemptor cost = (prio+1)*1000 + res sum
+    vic_cost = ((pre_prio.astype(jnp.float32) + 1.0) * 1000.0
+                + pre_res.sum(axis=2).astype(jnp.float32))     # [N, A]
+
+    def step(carry, idx):
+        used, job_count, consumed = carry
+        alive = ~consumed                                       # [N, A]
+        res_alive = pre_res * alive[..., None]
+        freed = jnp.cumsum(res_alive, axis=1)                   # [N, A, 3]
+        free = (cap - used)[:, None, :]                         # [N, 1, 3]
+        ok_k = jnp.all(free + freed >= req[None, None, :], axis=2)  # [N,A]
+        any_ok = jnp.any(ok_k, axis=1)
+        k_idx = jnp.argmax(ok_k, axis=1)                        # first fit
+        cost_pfx = jnp.cumsum(jnp.where(alive, vic_cost, 0.0), axis=1)
+        cost = jnp.take_along_axis(cost_pfx, k_idx[:, None],
+                                   axis=1)[:, 0]                # [N]
+        dh_ok = jnp.where(dh_limit_g > 0, job_count < dh_limit_g, True)
+        valid = static_g & any_ok & dh_ok
+        cost = jnp.where(valid, cost, BIG_COST)
+        best = jnp.argmin(cost)
+        ok = (cost[best] < BIG_COST / 2) & (idx < n_real)
+
+        # consume the alive victims of `best` up to (and including) the
+        # first-fit index: freed at k_best summed exactly those entries
+        k_best = k_idx[best]
+        take = alive[best] & (jnp.arange(alive.shape[1]) <= k_best)
+        consumed = consumed.at[best].set(
+            jnp.where(ok, consumed[best] | take, consumed[best]))
+        freed_best = jnp.sum(pre_res[best] * take[:, None], axis=0)
+        delta = jnp.where(ok, req - freed_best, 0)
+        used = used.at[best].add(delta)
+        job_count = job_count.at[best].add(jnp.where(ok, 1, 0))
+        n_take = jnp.sum(take.astype(jnp.int32))
+        out = (jnp.where(ok, best, -1),
+               jnp.where(ok, n_take, 0))
+        return (used, job_count, consumed), out
+
+    consumed0 = jnp.zeros(pre_prio.shape, bool)
+    (used, job_count, _), (best_rows, ks) = jax.lax.scan(
+        step, (used0, job_count0, consumed0),
+        jnp.arange(n_place, dtype=jnp.int32))
+    return best_rows, ks, used, job_count
+
+
+preempt_bulk_jit = jax.jit(preempt_bulk, static_argnums=(8,))
 
 
 def preemption_enabled(cfg: SchedulerConfiguration, job_type: str) -> bool:

@@ -150,6 +150,12 @@ class ClusterPacker:
         # matrix stays O(#distinct predicates), not O(#evals).
         self._lut_cache: Dict[Tuple[str, str], List[int]] = {}
         self._luts: List[np.ndarray] = []
+        # CSI volume topology LUTs: membership of the node-id vocab in a
+        # volume's accessible-topology set, keyed by the topology tuple
+        # itself (claims replace the volume object but share the tuple; a
+        # topology CHANGE mints a new row — old rows go inert, bounded by
+        # volume re-registrations)
+        self._topo_luts: Dict[tuple, List[int]] = {}
         # usage accounting: which allocs are counted in `used`, and where.
         # Alloc store events apply O(1) arithmetic deltas to t.used instead
         # of rescanning a node's alloc list (the alloc list only grows —
@@ -483,6 +489,32 @@ class ClusterPacker:
         self.lut_epoch += 1
         return lid
 
+    def _csi_topology_lut(self, vol) -> int:
+        """LUT row: is a node-id vocab entry inside `vol`'s accessible
+        topology?  Same grow-in-place discipline as _lut_id."""
+        key = (vol.namespace, vol.id, vol.topology_node_ids)
+        v = len(self.interner)
+        hit = self._topo_luts.get(key)
+        if hit is not None:
+            lid, built = hit
+            if built < v:
+                allowed = set(vol.topology_node_ids)
+                ext = np.fromiter(
+                    (self.interner.string(i) in allowed
+                     for i in range(built, v)),
+                    dtype=bool, count=v - built)
+                self._luts[lid] = np.concatenate([self._luts[lid], ext])
+                hit[1] = v
+                self.lut_epoch += 1
+            return lid
+        allowed = set(vol.topology_node_ids)
+        lut = self.interner.build_lut(lambda s: s in allowed)
+        lid = len(self._luts)
+        self._luts.append(lut)
+        self._topo_luts[key] = [lid, v]
+        self.lut_epoch += 1
+        return lid
+
     def lut_matrix(self) -> np.ndarray:
         """[L, V] bool, padded to the current vocab size."""
         v = len(self.interner)
@@ -537,6 +569,14 @@ class ClusterPacker:
                         crows.append((
                             self.ensure_column("csi." + vol.plugin_id),
                             DOP_EQ, self.interner.intern("1")))
+                    if vol is not None and vol.topology_node_ids:
+                        # accessible-topology feasibility (reference:
+                        # CSIVolumeChecker topology segments): the volume
+                        # is reachable only from its topology's nodes —
+                        # a LUT row over the interned node-id column
+                        crows.append((
+                            self.ensure_column("node.unique.id"),
+                            DOP_LUT, self._csi_topology_lut(vol)))
             for scope, constraints in (
                     (None, job.constraints),
                     (tg.name, list(tg.constraints)

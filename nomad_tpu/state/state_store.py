@@ -598,6 +598,30 @@ class StateStore:
         if changed:
             self._csi_volumes = {**self._csi_volumes, **changed}
 
+    def release_csi_claim(self, namespace: str, vol_id: str,
+                          alloc_id: str) -> int:
+        """Drop one alloc's claim on a volume (the volume watcher's reap
+        step after a successful unpublish; reference: nomad/volumewatcher/
+        volume_reap).  Placement-relevant: a freed single-writer claim
+        makes the volume schedulable again."""
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is None or (alloc_id not in vol.read_allocs
+                               and alloc_id not in vol.write_allocs):
+                return self._index
+            idx = self._bump_placement()
+            import dataclasses
+            v = dataclasses.replace(
+                vol,
+                read_allocs={k: True for k in vol.read_allocs
+                             if k != alloc_id},
+                write_allocs={k: True for k in vol.write_allocs
+                              if k != alloc_id})
+            self._csi_volumes = {**self._csi_volumes,
+                                 (namespace, vol_id): v}
+            self._emit("CSIVolume", idx, v)
+            return idx
+
     def set_scheduler_config(self, cfg: SchedulerConfiguration) -> int:
         with self._lock:
             idx = self._bump()
@@ -815,6 +839,11 @@ class StateStore:
                 allocs.append(codec.encode(slim))
             return {
                 "Index": self._index,
+                # the coupled-batch fence counter MUST travel with the
+                # snapshot: a Raft replica restored without it would
+                # diverge from the leader and silently drop replicated
+                # fenced plan commits (upsert_plan_results returns -1)
+                "PlacementSeq": self._placement_seq,
                 "Nodes": [codec.encode(n) for n in self._nodes.values()],
                 "Jobs": [codec.encode(j) for j in self._jobs.values()],
                 "JobVersions": [
@@ -912,6 +941,7 @@ class StateStore:
             self._scheduler_config = codec.decode(
                 SC, doc.get("SchedulerConfig") or {})
             self._identity_secret = doc.get("IdentitySecret", "") or ""
+            self._placement_seq = int(doc.get("PlacementSeq", 0))
             self._index = max(int(doc.get("Index", 0)), self._index) + 1
             self._index_cv.notify_all()
             self._emit("Restore", self._index, None)
@@ -1091,6 +1121,10 @@ class StateSnapshot:
 
     def csi_volume_by_id(self, namespace: str, vol_id: str) -> Optional[CSIVolume]:
         return self._csi_volumes.get((namespace, vol_id))
+
+    def csi_volumes(self, namespace: Optional[str] = None):
+        return [v for (ns, _), v in self._csi_volumes.items()
+                if namespace is None or ns == namespace]
 
     def node_pool_by_name(self, name: str) -> Optional[NodePool]:
         return self._node_pools.get(name)

@@ -1,0 +1,196 @@
+"""CSI volume scheduling: plugin presence, accessible topology, claims,
+and the volume watcher (reference: scheduler/feasible.go CSIVolumeChecker,
+nomad/volumewatcher/)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import CSIVolume, VolumeRequest
+
+NOW = 1.7e9
+
+
+def make_cluster(s, n=12, plugin="ebs0", plugin_on_all=True):
+    nodes = []
+    for i in range(n):
+        nd = mock.node()
+        if plugin_on_all or i % 2 == 0:
+            nd.csi_node_plugins[plugin] = True
+        s.register_node(nd, now=NOW)
+        nodes.append(nd)
+    return nodes
+
+
+def csi_job(source, count=4, read_only=True):
+    job = mock.batch_job()
+    job.task_groups[0].count = count
+    job.task_groups[0].volumes = {
+        "data": VolumeRequest(name="data", type="csi", source=source,
+                              read_only=read_only)}
+    return job
+
+
+class TestCSITopology:
+    def test_topology_restricts_placement(self):
+        """A volume accessible from a node subset must pull every claiming
+        placement into that subset — the device-side feasibility mask, not
+        just the plan-apply claim re-check."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        nodes = make_cluster(s, n=12)
+        zone = tuple(nd.id for nd in nodes[:3])
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-z", plugin_id="ebs0", topology_node_ids=zone))
+        job = csi_job("vol-z", count=6)
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 6
+        assert {a.node_id for a in live} <= set(zone)
+
+    def test_without_topology_any_plugin_node(self):
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        nodes = make_cluster(s, n=8, plugin_on_all=False)  # every 2nd node
+        s.state.upsert_csi_volume(CSIVolume(id="vol-a", plugin_id="ebs0"))
+        job = csi_job("vol-a", count=4)
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 4
+        plugin_nodes = {nd.id for i, nd in enumerate(nodes) if i % 2 == 0}
+        assert {a.node_id for a in live} <= plugin_nodes
+
+    def test_topology_exhaustion_blocks(self):
+        """Topology narrower than demand: the surplus parks in a blocked
+        eval; adding a node to the topology (volume re-registration)
+        releases it."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        nodes = make_cluster(s, n=6)
+        small = nodes[0]
+        # tighten the node so only 2 allocs fit
+        small.resources.cpu = 4000
+        small.resources.memory_mb = 8192
+        s.register_node(small, now=NOW)
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-tight", plugin_id="ebs0",
+            topology_node_ids=(small.id,)))
+        job = csi_job("vol-tight", count=4)
+        job.task_groups[0].tasks[0].resources.cpu = 1500
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 2
+        assert s.blocked_evals.num_blocked() == 1
+        # widen the topology: volume re-registration + node capacity event
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-tight", plugin_id="ebs0",
+            topology_node_ids=(small.id, nodes[1].id)))
+        s.register_node(nodes[1], now=NOW + 1)   # capacity signal
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 4
+        assert {a.node_id for a in live} <= {small.id, nodes[1].id}
+
+    def test_volume_watcher_reaps_vanished_alloc_claim(self):
+        """A claim whose alloc was GC'd (never upserted terminal) is
+        invisible to the store's terminal-release path — the watcher must
+        reap it so the volume is schedulable again without operator
+        action."""
+        import dataclasses
+
+        # big TTL: the test ticks far ahead to promote the delayed
+        # follow-up eval, which must not expire the nodes' heartbeats
+        s = Server(dev_mode=True, heartbeat_ttl=1e9)
+        s.establish_leadership()
+        make_cluster(s, n=4)
+        vol = CSIVolume(id="vol-reap", plugin_id="ebs0",
+                        access_mode="single-node-writer")
+        # claim by an alloc id that does not exist in state (GC'd)
+        vol = dataclasses.replace(vol,
+                                  write_allocs={"ghost-alloc": True})
+        s.state.upsert_csi_volume(vol)
+        # single-writer with a ghost claim: a new write job cannot place
+        j = csi_job("vol-reap", count=1, read_only=False)
+        s.register_job(j, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        assert not [a for a in snap.allocs_by_job(j.namespace, j.id)
+                    if not a.terminal_status()]
+        # the watcher sweep releases the ghost claim -> schedulable
+        released = s.volumes.tick(NOW + 1)
+        assert released == 1
+        vol2 = s.state.snapshot().csi_volume_by_id("default", "vol-reap")
+        assert vol2.write_allocs == {}
+        # the claim refusal happened at plan apply (refute -> retry
+        # exhaustion -> failed eval + delayed follow-up), so advance past
+        # the follow-up window: the tick promotes it and the job places
+        s.tick(now=NOW + 400)
+        s.process_all(now=NOW + 400)
+        snap = s.state.snapshot()
+        assert [a for a in snap.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status()]
+
+    def test_volume_watcher_unpublish_retry_backoff(self):
+        """A failing unpublish (flaky storage controller) retries with
+        backoff instead of releasing the claim or wedging the tick."""
+        import dataclasses
+
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        make_cluster(s, n=2)
+        vol = dataclasses.replace(
+            CSIVolume(id="vol-flaky", plugin_id="ebs0"),
+            read_allocs={"ghost": True})
+        s.state.upsert_csi_volume(vol)
+        calls = []
+
+        def flaky(v, alloc_id):
+            calls.append(alloc_id)
+            if len(calls) < 3:
+                raise RuntimeError("controller timeout")
+
+        s.volumes.unpublish = flaky
+        assert s.volumes.tick(NOW) == 0          # fail #1 -> backoff
+        assert s.volumes.tick(NOW + 0.1) == 0    # inside backoff: no call
+        assert len(calls) == 1
+        assert s.volumes.tick(NOW + 2) == 0      # fail #2, longer backoff
+        assert s.volumes.tick(NOW + 2.5) == 0    # still backing off
+        assert len(calls) == 2
+        assert s.volumes.tick(NOW + 10) == 1     # succeeds, claim released
+        v2 = s.state.snapshot().csi_volume_by_id("default", "vol-flaky")
+        assert v2.read_allocs == {}
+        assert s.volumes.stats["unpublish_failures"] == 2
+
+    def test_single_writer_claim_refused_at_apply(self):
+        """single-node-writer: the second job's write claim is refused at
+        the serialization point even though feasibility passes."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        make_cluster(s, n=4)
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-w", plugin_id="ebs0",
+            access_mode="single-node-writer"))
+        j1 = csi_job("vol-w", count=1, read_only=False)
+        s.register_job(j1, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        assert [a for a in snap.allocs_by_job(j1.namespace, j1.id)
+                if not a.terminal_status()]
+        j2 = csi_job("vol-w", count=1, read_only=False)
+        s.register_job(j2, now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        live2 = [a for a in snap.allocs_by_job(j2.namespace, j2.id)
+                 if not a.terminal_status()]
+        assert live2 == []
