@@ -29,7 +29,10 @@ class Agent:
                  rpc_port: int = 0, raft_port: int = 0, serf_port: int = 0,
                  data_dir: Optional[str] = None,
                  plugin_dir: str = "",
-                 encrypt: str = "") -> None:
+                 encrypt: str = "",
+                 region: str = "global",
+                 join_wan: Optional[List[str]] = None,
+                 join_wan_token: str = "") -> None:
         # cluster shared secret: encrypt + authenticate every server-plane
         # wire frame (raft/gossip/RPC) — core/wire.py.  The key is
         # process-global (one cluster per process): set_key raises on a
@@ -102,6 +105,15 @@ class Agent:
                 self.clients.append(Client(rpc, node=node, data_dir=cdir,
                                            plugin_dir=plugin_dir))
         self.http = HTTPAPIServer(self, host=http_host, port=http_port)
+        # multi-region federation (reference: nomad/regions.go + WAN serf):
+        # this agent's region + the push-pull address table; ?region=X
+        # requests proxy through it (api/http_server.Router.route)
+        from .core.regions import RegionFederation
+        self.server.region = region
+        self.federation = RegionFederation(region)
+        self.federation.set_self_url(self.address)
+        self._join_wan = list(join_wan or [])
+        self._join_wan_token = join_wan_token
         self._started_at = time.time()
 
     # ------------------------------------------------------------ control
@@ -111,6 +123,8 @@ class Agent:
         for c in self.clients:
             c.start()
         self.http.start()
+        for peer in self._join_wan:
+            self.federation.join(peer, token=self._join_wan_token)
         return self
 
     def shutdown(self) -> None:

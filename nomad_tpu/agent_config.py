@@ -35,6 +35,8 @@ class AgentConfig:
     bind_addr: str = "127.0.0.1"
     http_port: int = 4646
     log_level: str = "info"
+    # scheduling domain (reference: the top-level `region` agent option)
+    region: str = "global"
     server_enabled: bool = True
     num_workers: int = 1
     heartbeat_ttl: float = 30.0
@@ -89,6 +91,8 @@ def parse_agent_config(src: str):
                 put("log_level", str(v).lower())
             elif node.name == "encrypt":
                 put("encrypt", str(v))
+            elif node.name == "region":
+                put("region", str(v))
             else:
                 raise ValueError(f"unknown agent setting {node.name!r}")
         elif isinstance(node, Block):

@@ -24,11 +24,14 @@ class APIException(Exception):
 class APIClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  namespace: str = "default", timeout: float = 35.0,
-                 token: str = "") -> None:
+                 token: str = "", region: str = "") -> None:
         self.address = address.rstrip("/")
         self.namespace = namespace
         self.timeout = timeout
         self.token = token
+        # non-empty: every request targets this region (the contacted
+        # agent forwards foreign regions through its federation table)
+        self.region = region
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -52,6 +55,8 @@ class APIClient:
                 body: Optional[Any] = None) -> Any:
         params = dict(params or {})
         params.setdefault("namespace", self.namespace)
+        if self.region:
+            params.setdefault("region", self.region)
         url = f"{self.address}{path}?{urllib.parse.urlencode(params, doseq=True)}"
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}

@@ -98,14 +98,87 @@ class Router:
         if not parts or parts[0] != "v1":
             raise APIError(404, "not found")
         parts = parts[1:]
+        # cross-region forwarding (reference: rpcHandler.forward region
+        # hop): a foreign ?region= proxies the request verbatim to that
+        # region's agent BEFORE local enforcement — the target region
+        # authenticates the forwarded token against ITS own ACL state
+        fed = getattr(self.agent, "federation", None)
+        region = (qs.get("region") or [""])[0]
+        if fed is not None and region and region != fed.region:
+            clean = {k: v for k, v in qs.items() if k != "region"}
+            qs_str = urllib.parse.urlencode(clean, doseq=True)
+            raw = (json.dumps(body).encode()
+                   if body is not None else None)
+            status, data = fed.forward(region, method, path, qs_str,
+                                       raw, token=token)
+            payload, err = self._decode_forwarded(status, data)
+            if err:
+                raise APIError(status, err)
+            return status, payload
         ns = (qs.get("namespace") or [DEFAULT_NAMESPACE])[0]
         acl = self._enforce(method, parts, ns, token)
         try:
-            return 200, self._dispatch(method, parts, ns, qs, body, acl)
+            return 200, self._dispatch(method, parts, ns, qs, body, acl,
+                                       token=token)
         except APIError:
             raise
         except (KeyError, IndexError) as e:
             raise APIError(404, f"not found: {e}")
+
+    def _register_multiregion(self, job: Job, token: str = "") -> Dict:
+        """Fan a multiregion job out as one registration per region
+        (reference: the `multiregion` stanza; staged deployment strategies
+        are enterprise upstream — the fan-out itself is the OSS-visible
+        contract).  Per-region Count/Datacenters override the template;
+        foreign regions register through the federation table with the
+        caller's token (each region enforces its own ACLs)."""
+        fed = getattr(self.agent, "federation", None)
+        if fed is None:
+            raise APIError(400, "multiregion job on a non-federated agent")
+        entries = job.multiregion.regions
+        results: Dict[str, Any] = {}
+        for entry in entries:
+            name = str(entry.get("Name") or entry.get("name") or "")
+            if not name:
+                raise APIError(400, "multiregion region entry needs a Name")
+            copy = job.copy()
+            copy.region = name
+            copy.multiregion = None      # the copies must not re-fan-out
+            dcs = entry.get("Datacenters") or entry.get("datacenters")
+            if dcs:
+                copy.datacenters = list(dcs)
+            count = entry.get("Count") or entry.get("count")
+            if count:
+                for tg in copy.task_groups:
+                    tg.count = int(count)
+            if name == fed.region:
+                ev = self.server.register_job(copy)
+                results[name] = {"EvalID": ev.id if ev else ""}
+                continue
+            raw = json.dumps({"Job": codec.encode(copy)}).encode()
+            qs_str = urllib.parse.urlencode(
+                {"namespace": copy.namespace})
+            status, data = fed.forward(name, "PUT", "/v1/jobs", qs_str,
+                                       raw, token=token)
+            payload, err = self._decode_forwarded(status, data)
+            results[name] = {"Error": err} if err else payload
+        local = results.get(fed.region, {})
+        return {"EvalID": local.get("EvalID", ""), "Regions": results}
+
+    @staticmethod
+    def _decode_forwarded(status: int, data: bytes):
+        """(status, raw bytes) from a federation forward ->
+        (payload, error message or '') — the one place forwarded response
+        bodies are interpreted."""
+        try:
+            payload = json.loads(data.decode() or "null")
+        except ValueError:
+            payload = data.decode(errors="replace")
+        if status < 400:
+            return payload, ""
+        msg = (payload.get("error", str(payload))
+               if isinstance(payload, dict) else str(payload))
+        return payload, msg or f"region request failed ({status})"
 
     @staticmethod
     def _check_ns(acl, ns: str, cap: str) -> None:
@@ -128,6 +201,8 @@ class Router:
         head = p[0] if p else ""
         if head == "acl" and p[1:2] == ["bootstrap"]:
             return None                 # one-shot, self-guarding
+        if head == "acl" and p[1:3] == ["token", "self"]:
+            return None                 # any valid token may read itself
         acl, err = s.resolve_token(token)
         if acl is None:
             raise APIError(403, err or "permission denied")
@@ -170,6 +245,14 @@ class Router:
             if not ok:
                 raise APIError(403, "permission denied: operator policy")
             return acl
+        if head == "regions":
+            # listing regions is status-class; rewriting the federation
+            # table is management-only (a poisoned table would hijack
+            # every cross-region forward)
+            if write and not acl.is_management():
+                raise APIError(403,
+                               "permission denied: management required")
+            return acl
         if head in ("agent", "metrics", "status", "event"):
             if not acl.allow_agent_read():
                 raise APIError(403, "permission denied: agent policy")
@@ -184,7 +267,7 @@ class Router:
 
     def _dispatch(self, method: str, p: List[str], ns: str,
                   qs: Dict[str, List[str]], body: Optional[Dict],
-                  acl=None) -> Any:
+                  acl=None, token: str = "") -> Any:
         s = self.server
         head = p[0] if p else ""
         if head == "jobs":
@@ -201,6 +284,8 @@ class Router:
                 job = _decode_job(wire, ns)
                 if job.namespace != ns:
                     self._check_ns(acl, job.namespace, "submit-job")
+                if job.multiregion is not None and job.multiregion.regions:
+                    return self._register_multiregion(job, token)
                 ev = s.register_job(job)
                 if ev is not None:
                     # the eval carries the LEADER's stored modify index —
@@ -220,6 +305,16 @@ class Router:
                         "JobModifyIndex": stored.job_modify_index}
         elif head == "job":
             return self._job(method, p[1:], ns, qs, body, acl)
+        elif head == "regions":
+            fed = getattr(self.agent, "federation", None)
+            if fed is None:
+                return ["global"]
+            if p[1:2] == ["federation"]:
+                if method in ("PUT", "POST"):
+                    fed.merge((body or {}).get("Regions", {}))
+                return {"Regions": fed.table()}
+            if method == "GET":
+                return fed.regions()
         elif head == "nodes":
             if method == "GET":
                 self._block(qs)
@@ -336,6 +431,24 @@ class Router:
                 if method in ("PUT", "POST"):
                     s.restore_snapshot(body or {})
                     return {"Restored": True}
+            if p[1:2] == ["raft"] and p[2:3] == ["configuration"]:
+                # reference: Operator.RaftGetConfiguration /
+                # `nomad operator raft list-peers`
+                raft = getattr(s, "raft", None)
+                if raft is None:
+                    return {"Servers": [{
+                        "Node": getattr(s, "region", "global") + ".dev",
+                        "Leader": True, "Voter": True}]}
+                servers = [{"Node": raft.name,
+                            "Address": f"{raft.addr[0]}:{raft.addr[1]}",
+                            "Leader": raft.is_leader(), "Voter": True}]
+                for name, addr in sorted(raft.peers.items()):
+                    servers.append({
+                        "Node": name,
+                        "Address": f"{addr[0]}:{addr[1]}",
+                        "Leader": raft.leader_name == name,
+                        "Voter": True})
+                return {"Servers": servers}
             if p[1:2] == ["debug"] and method == "GET":
                 # debug bundle (reference: `nomad operator debug` capture)
                 import sys as _sys
@@ -354,7 +467,7 @@ class Router:
                     "Python": _sys.version,
                 }
         elif head == "acl":
-            return self._acl(method, p[1:], body)
+            return self._acl(method, p[1:], body, token=token)
         elif head == "namespaces":
             if method == "GET":
                 return [codec.encode(n)
@@ -559,6 +672,18 @@ class Router:
                 if child is None:
                     raise APIError(400, "job is not periodic")
                 return {"DispatchedJobID": child.id}
+            if sub == "evaluate":
+                # reference: Job.Evaluate RPC / `nomad job eval` — force
+                # a fresh evaluation without changing the job
+                if job is None:
+                    raise APIError(404, "job not found")
+                from nomad_tpu.structs import Evaluation
+                ev = Evaluation(
+                    namespace=ns, priority=job.priority, type=job.type,
+                    triggered_by="job-eval", job_id=job.id,
+                    job_modify_index=job.modify_index)
+                s.apply_eval_update([ev])
+                return {"EvalID": ev.id}
             if sub == "scale":
                 # reference: Job.Scale RPC / `nomad job scale`
                 group = (body or {}).get("Target", {}).get("Group", "")
@@ -640,11 +765,18 @@ class Router:
                     if a.deployment_id == dep.id]
         return codec.encode(dep)
 
-    def _acl(self, method: str, p: List[str], body: Optional[Dict]) -> Any:
+    def _acl(self, method: str, p: List[str], body: Optional[Dict],
+             token: str = "") -> Any:
         from nomad_tpu.acl import parse_policy
         from nomad_tpu.structs import ACLPolicy, ACLToken
         s = self.server
         head = p[0] if p else ""
+        if head == "token" and p[1:2] == ["self"] and method == "GET":
+            # reference: `nomad acl token self` — introspect the caller
+            t = s.state.acl_token_by_secret(token)
+            if t is None:
+                raise APIError(403, "token not found")
+            return codec.encode(t)
         if head == "bootstrap" and method in ("PUT", "POST"):
             token, err = s.bootstrap_acl()
             if err:

@@ -1,7 +1,17 @@
-"""Minimal web UI (reference: ui/ — the reference ships a full Ember SPA;
-this is a deliberately small single-page dashboard over the same /v1 API:
-jobs with their allocations, nodes, deployments, and the live event
-stream).  Served at `/ui` by the HTTP API server."""
+"""Web UI (reference: ui/ — the reference ships a full Ember SPA; this is
+a dependency-free single-file SPA over the same /v1 API).  Served at
+`/ui`.
+
+Views (hash-routed):
+  #/                overview: jobs, cluster topology (nodes per DC,
+                    colored by status/utilization), deployments,
+                    services, live event stream
+  #/job/<ns>/<id>   job drill-down: definition summary, allocations,
+                    evaluations, versions
+  #/alloc/<id>      allocation drill-down: task states + event timeline
+  #/node/<id>       node drill-down: attributes, running allocations
+A region selector (federation table) retargets every API call.
+"""
 
 UI_HTML = """<!doctype html>
 <html lang="en">
@@ -12,12 +22,15 @@ UI_HTML = """<!doctype html>
   :root { color-scheme: light dark; }
   body { font: 14px/1.45 system-ui, sans-serif; margin: 0;
          background: Canvas; color: CanvasText; }
-  header { padding: .7rem 1.2rem; border-bottom: 1px solid color-mix(in srgb, CanvasText 18%, Canvas);
+  header { padding: .7rem 1.2rem; border-bottom: 1px solid
+           color-mix(in srgb, CanvasText 18%, Canvas);
            display: flex; gap: 1rem; align-items: baseline; }
   header h1 { font-size: 1.05rem; margin: 0; }
+  header h1 a { color: inherit; text-decoration: none; }
   header span { opacity: .65; font-size: .85rem; }
+  header select { margin-left: auto; font: inherit; }
   main { display: grid; grid-template-columns: 1fr 1fr; gap: 1rem;
-         padding: 1rem 1.2rem; max-width: 1200px; }
+         padding: 1rem 1.2rem; max-width: 1280px; }
   section { border: 1px solid color-mix(in srgb, CanvasText 14%, Canvas);
             border-radius: 8px; padding: .6rem .9rem; overflow: auto; }
   section.wide { grid-column: 1 / -1; }
@@ -29,70 +42,228 @@ UI_HTML = """<!doctype html>
   th { opacity: .6; font-weight: 600; }
   .ok   { color: #2e9e57; } .warn { color: #c7831c; }
   .bad  { color: #cc4125; } .dim  { opacity: .55; }
+  a { color: inherit; }
   #events { font-family: ui-monospace, monospace; font-size: .78rem;
             max-height: 14rem; }
   code { font-family: ui-monospace, monospace; font-size: .92em; }
+  .topo { display: flex; flex-wrap: wrap; gap: .9rem; }
+  .dc { border: 1px dashed color-mix(in srgb, CanvasText 25%, Canvas);
+        border-radius: 6px; padding: .4rem .6rem; }
+  .dc h3 { margin: 0 0 .3rem; font-size: .75rem; opacity: .7; }
+  .cells { display: grid; grid-template-columns: repeat(10, 14px);
+           gap: 3px; }
+  .cell { width: 14px; height: 14px; border-radius: 3px; cursor: pointer;
+          background: #2e9e57; }
+  .cell.mid { background: #c7831c; } .cell.hot { background: #e06c30; }
+  .cell.down { background: #cc4125; } .cell.inelig { background: #888; }
+  .bar { height: 6px; border-radius: 3px; background:
+         color-mix(in srgb, CanvasText 15%, Canvas); position: relative; }
+  .bar i { position: absolute; inset: 0 auto 0 0; border-radius: 3px;
+           background: #2e9e57; }
 </style>
 </head>
 <body>
-<header><h1>nomad-tpu</h1><span id="meta">connecting…</span></header>
-<main>
-  <section><h2>Jobs</h2><table id="jobs"></table></section>
-  <section><h2>Nodes</h2><table id="nodes"></table></section>
-  <section><h2>Deployments</h2><table id="deps"></table></section>
-  <section><h2>Services</h2><table id="svcs"></table></section>
-  <section class="wide"><h2>Events</h2><div id="events"></div></section>
-</main>
+<header>
+  <h1><a href="#/">nomad-tpu</a></h1>
+  <span id="meta">connecting…</span>
+  <select id="region" title="region"></select>
+</header>
+<main id="main"></main>
 <script>
-const $ = id => document.getElementById(id);
-const cls = s => ({running:'ok', ready:'ok', successful:'ok',
-                   passing:'ok', complete:'dim', dead:'dim',
-                   pending:'warn', paused:'warn',
-                   failed:'bad', down:'bad', critical:'bad',
-                   lost:'bad'}[s] || '');
+const cls = s => ({running:'ok', ready:'ok', successful:'ok', complete:'ok',
+                   passing:'ok', healthy:'ok',
+                   pending:'warn', paused:'warn', blocked:'warn',
+                   failed:'bad', down:'bad', critical:'bad', lost:'bad',
+                   dead:'dim', canceled:'dim'}[s] || '');
 const cell = (v, c) => `<td class="${c||''}">${v ?? ''}</td>`;
 const row = cells => `<tr>${cells.join('')}</tr>`;
+const code = s => `<code>${s}</code>`;
+const esc = s => String(s).replace(/[&<>"]/g,
+  ch => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[ch]));
+let REGION = '';
 
 async function get(path) {
-  const r = await fetch(path);
-  if (!r.ok) throw new Error(r.status);
+  const sep = path.includes('?') ? '&' : '?';
+  const r = await fetch(REGION ? `${path}${sep}region=${REGION}` : path);
+  if (!r.ok) throw new Error(`${r.status} ${path}`);
   return r.json();
 }
+const sect = (title, body, wide) =>
+  `<section${wide ? ' class="wide"' : ''}><h2>${title}</h2>${body}</section>`;
+const table = (heads, rows) =>
+  `<table>${row(heads.map(h => `<th>${h}</th>`))}${rows.join('')}</table>`;
 
-async function refresh() {
-  try {
-    const [jobs, nodes, deps, svcs, metrics] = await Promise.all([
-      get('/v1/jobs?namespace=*'), get('/v1/nodes'),
-      get('/v1/deployments?namespace=*'), get('/v1/services?namespace=*'),
-      get('/v1/metrics')]);
-    $('meta').textContent =
-      `${metrics['nomad.state.jobs']} jobs · ` +
-      `${metrics['nomad.state.nodes']} nodes · ` +
-      `broker ready ${metrics['nomad.broker.total_ready']} · ` +
-      `blocked ${metrics['nomad.blocked_evals.total_blocked']}`;
-    $('jobs').innerHTML =
-      row([ '<th>ID</th>','<th>Type</th>','<th>NS</th>','<th>Status</th>' ]) +
-      jobs.map(j => row([cell(`<code>${j.ID}</code>`), cell(j.Type),
-        cell(j.Namespace), cell(j.Status, cls(j.Status))])).join('');
-    $('nodes').innerHTML =
-      row(['<th>ID</th>','<th>DC</th>','<th>Status</th>','<th>Elig</th>']) +
-      nodes.map(n => row([cell(`<code>${n.ID.slice(0,8)}</code>`),
-        cell(n.Datacenter), cell(n.Status, cls(n.Status)),
-        cell(n.Drain ? 'draining' : n.SchedulingEligibility,
-             n.Drain ? 'warn' : '')])).join('');
-    $('deps').innerHTML =
-      row(['<th>Job</th>','<th>Ver</th>','<th>Status</th>']) +
-      deps.map(d => row([cell(`<code>${d.JobID}</code>`),
-        cell('v' + d.JobVersion),
-        cell(d.Status, cls(d.Status))])).join('');
-    $('svcs').innerHTML =
-      row(['<th>Service</th>','<th>Tags</th>']) +
-      svcs.flatMap(nsr => (nsr.Services || []).map(s =>
-        row([cell(`<code>${s.ServiceName}</code>`),
-             cell((s.Tags || []).join(', '))]))).join('');
-  } catch (e) {
-    $('meta').textContent = 'disconnected: ' + e;
+// ------------------------------------------------------------- overview
+async function viewOverview() {
+  const [jobs, nodes, allocs, deps, svcs, metrics] = await Promise.all([
+    get('/v1/jobs?namespace=*'), get('/v1/nodes'),
+    get('/v1/allocations?namespace=*'),
+    get('/v1/deployments?namespace=*'), get('/v1/services?namespace=*'),
+    get('/v1/metrics')]);
+  document.getElementById('meta').textContent =
+    `${metrics['nomad.state.jobs']} jobs · ` +
+    `${metrics['nomad.state.nodes']} nodes · ` +
+    `broker ready ${metrics['nomad.broker.total_ready']} · ` +
+    `blocked ${metrics['nomad.blocked_evals.total_blocked']}`;
+
+  // per-node live alloc counts for the topology heat
+  const byNode = {};
+  for (const a of allocs)
+    if (a.ClientStatus === 'running' || a.ClientStatus === 'pending')
+      byNode[a.NodeID] = (byNode[a.NodeID] || 0) + 1;
+  const dcs = {};
+  for (const n of nodes) (dcs[n.Datacenter] ||= []).push(n);
+  const topo = Object.keys(dcs).sort().map(dc => {
+    const cells = dcs[dc].map(n => {
+      const k = byNode[n.ID] || 0;
+      const c = n.Status === 'down' ? 'down'
+        : n.SchedulingEligibility !== 'eligible' || n.Drain ? 'inelig'
+        : k > 8 ? 'hot' : k > 3 ? 'mid' : '';
+      return `<a class="cell ${c}" href="#/node/${n.ID}"
+        title="${esc(n.Name || n.ID.slice(0,8))} · ${esc(n.Status)} · ` +
+        `${k} allocs"></a>`;
+    }).join('');
+    return `<div class="dc"><h3>${esc(dc)} · ${dcs[dc].length}</h3>
+            <div class="cells">${cells}</div></div>`;
+  }).join('');
+
+  const jobRows = jobs.map(j => row([
+    cell(`<a href="#/job/${encodeURIComponent(j.Namespace)}/` +
+         `${encodeURIComponent(j.ID)}">${code(esc(j.ID))}</a>`),
+    cell(esc(j.Type)), cell(esc(j.Namespace)),
+    cell(j.Status, cls(j.Status))]));
+  const depRows = deps.map(d => row([
+    cell(`<a href="#/job/${encodeURIComponent(d.Namespace||'default')}/` +
+         `${encodeURIComponent(d.JobID)}">${code(esc(d.JobID))}</a>`),
+    cell('v' + d.JobVersion), cell(d.Status, cls(d.Status))]));
+  const svcRows = svcs.flatMap(nsr => (nsr.Services || []).map(s =>
+    row([cell(code(esc(s.ServiceName))),
+         cell(esc((s.Tags || []).join(', ')))])));
+  // the event stream accumulates across re-renders: carry the box over
+  const prevEvents = document.getElementById('events')?.innerHTML || '';
+  document.getElementById('main').innerHTML =
+    sect('Cluster topology', `<div class="topo">${topo}</div>`, true) +
+    sect('Jobs', table(['ID','Type','NS','Status'], jobRows)) +
+    sect('Deployments', table(['Job','Ver','Status'], depRows)) +
+    sect('Services', table(['Service','Tags'], svcRows)) +
+    sect('Events', `<div id="events">${prevEvents}</div>`);
+}
+
+// ------------------------------------------------------------ job view
+async function viewJob(ns, id) {
+  const enc = encodeURIComponent(id);
+  const [job, allocs, evals] = await Promise.all([
+    get(`/v1/job/${enc}?namespace=${ns}`),
+    get(`/v1/job/${enc}/allocations?namespace=${ns}`),
+    get(`/v1/job/${enc}/evaluations?namespace=${ns}`)]);
+  const groups = (job.TaskGroups || []).map(tg => row([
+    cell(code(esc(tg.Name))), cell(tg.Count),
+    cell((tg.Tasks || []).map(t => `${esc(t.Name)} (${t.Driver})`)
+      .join(', '))]));
+  const allocRows = allocs.map(a => row([
+    cell(`<a href="#/alloc/${a.ID}">${code(a.ID.slice(0,8))}</a>`),
+    cell(code(esc(a.TaskGroup))),
+    cell(`<a href="#/node/${a.NodeID}">${code((a.NodeID||'').slice(0,8))}</a>`),
+    cell(a.ClientStatus, cls(a.ClientStatus)),
+    cell(a.DesiredStatus, cls(a.DesiredStatus))]));
+  const evalRows = evals.map(e => row([
+    cell(code(e.ID.slice(0,8))), cell(e.TriggeredBy),
+    cell(e.Status, cls(e.Status)),
+    cell(esc(e.StatusDescription || ''))]));
+  document.getElementById('main').innerHTML =
+    sect(`Job ${esc(id)} · ${job.Type} · v${job.Version} · ` +
+         `<span class="${cls(job.Status)}">${job.Status}</span>`,
+         table(['Group','Count','Tasks'], groups), true) +
+    sect('Allocations',
+         table(['ID','Group','Node','Client','Desired'], allocRows), true) +
+    sect('Evaluations',
+         table(['ID','Trigger','Status',''], evalRows), true);
+}
+
+// ---------------------------------------------------------- alloc view
+async function viewAlloc(id) {
+  const a = await get(`/v1/allocation/${id}?namespace=*`);
+  const states = Object.entries(a.TaskStates || {}).map(([name, ts]) => {
+    const evs = (ts.Events || []).map(e => row([
+      cell(new Date((e.Time || 0) * 1000).toLocaleTimeString()),
+      cell(e.Type), cell(esc(e.DisplayMessage || e.Message || ''))]));
+    return sect(`Task ${esc(name)} · ` +
+      `<span class="${cls(ts.State)}">${ts.State}</span>` +
+      (ts.Failed ? ' <span class="bad">failed</span>' : ''),
+      table(['Time','Event',''], evs), true);
+  }).join('');
+  document.getElementById('main').innerHTML =
+    sect(`Allocation ${code(a.ID.slice(0,8))} · ` +
+         `job <a href="#/job/${encodeURIComponent(a.Namespace)}/` +
+         `${encodeURIComponent(a.JobID)}">${code(esc(a.JobID))}</a> · ` +
+         `node <a href="#/node/${a.NodeID}">` +
+         `${code((a.NodeID||'').slice(0,8))}</a>`,
+         table(['Client','Desired',''], [row([
+           cell(a.ClientStatus, cls(a.ClientStatus)),
+           cell(a.DesiredStatus, cls(a.DesiredStatus)),
+           cell(esc(a.DesiredDescription || ''))])]), true) + states;
+}
+
+// ----------------------------------------------------------- node view
+async function viewNode(id) {
+  const [n, allocs] = await Promise.all([
+    get(`/v1/node/${id}`), get(`/v1/node/${id}/allocations`)]);
+  const live = allocs.filter(a => a.ClientStatus === 'running'
+                               || a.ClientStatus === 'pending');
+  const res = n.Resources || {};
+  let usedCpu = 0, usedMem = 0;
+  for (const a of live) {
+    usedCpu += (a.Resources || {}).CPU || 0;
+    usedMem += (a.Resources || {}).MemoryMB || 0;
   }
+  const bar = (used, cap) => cap ?
+    `<div class="bar"><i style="width:${Math.min(100, 100*used/cap)}%"></i>
+     </div><span class="dim">${used} / ${cap}</span>` : '';
+  const attrRows = Object.entries(n.Attributes || {}).sort()
+    .map(([k, v]) => row([cell(code(esc(k))), cell(esc(v))]));
+  const allocRows = allocs.map(a => row([
+    cell(`<a href="#/alloc/${a.ID}">${code(a.ID.slice(0,8))}</a>`),
+    cell(`<a href="#/job/${encodeURIComponent(a.Namespace)}/` +
+         `${encodeURIComponent(a.JobID)}">${code(esc(a.JobID))}</a>`),
+    cell(a.ClientStatus, cls(a.ClientStatus)),
+    cell(a.DesiredStatus, cls(a.DesiredStatus))]));
+  document.getElementById('main').innerHTML =
+    sect(`Node ${esc(n.Name || '')} ${code(n.ID.slice(0,8))} · ` +
+         `${esc(n.Datacenter)} · ` +
+         `<span class="${cls(n.Status)}">${n.Status}</span>` +
+         (n.Drain ? ' <span class="warn">draining</span>' : ''),
+         table(['CPU (MHz)','Memory (MB)'], [row([
+           cell(bar(usedCpu, res.CPU)),
+           cell(bar(usedMem, res.MemoryMB))])]), true) +
+    sect('Allocations',
+         table(['ID','Job','Client','Desired'], allocRows)) +
+    sect('Attributes', table(['Key','Value'], attrRows));
+}
+
+// ------------------------------------------------------- router/events
+async function route() {
+  const h = location.hash.replace(/^#\\/?/, '');
+  const p = h.split('/').filter(Boolean).map(decodeURIComponent);
+  try {
+    if (p[0] === 'job' && p.length >= 3) await viewJob(p[1], p[2]);
+    else if (p[0] === 'alloc') await viewAlloc(p[1]);
+    else if (p[0] === 'node') await viewNode(p[1]);
+    else await viewOverview();
+  } catch (e) {
+    document.getElementById('main').innerHTML =
+      sect('Error', `<span class="bad">${esc(e)}</span>`, true);
+  }
+}
+
+async function loadRegions() {
+  try {
+    const regions = await get('/v1/regions');
+    const sel = document.getElementById('region');
+    sel.innerHTML = '<option value="">local region</option>' +
+      regions.map(r => `<option value="${esc(r)}">${esc(r)}</option>`)
+        .join('');
+    sel.onchange = () => { REGION = sel.value; route(); };
+  } catch (e) { /* non-federated agent */ }
 }
 
 async function tailEvents() {
@@ -110,23 +281,26 @@ async function tailEvents() {
         const line = buf.slice(0, i); buf = buf.slice(i + 1);
         if (!line.trim()) continue;
         const batch = JSON.parse(line);
+        const box = document.getElementById('events');
         for (const ev of (batch.Events || [])) {
+          if (!box) continue;
           const el = document.createElement('div');
           el.textContent =
             `#${ev.Index} ${ev.Topic}/${ev.Type} ${ev.Key.slice(0,8)}`;
-          $('events').prepend(el);
+          box.prepend(el);
+          while (box.childNodes.length > 60)
+            box.removeChild(box.lastChild);
         }
-        while ($('events').childNodes.length > 60)
-          $('events').removeChild($('events').lastChild);
-        refresh();
       }
     }
   } catch (e) { /* reconnect below */ }
   setTimeout(tailEvents, 2000);
 }
 
-refresh();
-setInterval(refresh, 5000);
+window.addEventListener('hashchange', route);
+route();
+loadRegions();
+setInterval(route, 5000);
 tailEvents();
 </script>
 </body>

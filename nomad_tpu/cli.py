@@ -34,8 +34,10 @@ def _str2bool(v: str) -> bool:
 def _client(args) -> APIClient:
     import os
     token = getattr(args, "token", "") or os.environ.get("NOMAD_TOKEN", "")
+    region = getattr(args, "region", "") or os.environ.get(
+        "NOMAD_REGION", "")
     return APIClient(address=args.address, namespace=args.namespace,
-                     token=token)
+                     token=token, region=region)
 
 
 def _out(data) -> None:
@@ -94,9 +96,14 @@ def cmd_agent(args) -> int:
                   serf_port=getattr(args, "serf_port", 0),
                   data_dir=getattr(args, "data_dir", "") or None,
                   plugin_dir=getattr(args, "plugin_dir", ""),
-                  encrypt=cfg.encrypt)
+                  encrypt=cfg.encrypt,
+                  region=(getattr(args, "agent_region", "")
+                          or cfg.region or "global"),
+                  join_wan=getattr(args, "join_wan", []) or [],
+                  join_wan_token=getattr(args, "join_wan_token", ""))
     agent.start()
-    print(f"==> agent started; HTTP API at {agent.address}")
+    print(f"==> agent started; HTTP API at {agent.address} "
+          f"(region {agent.federation.region})")
     srv = agent.server
     if hasattr(srv, "gossip"):
         print(f"==> cluster server {srv.name}: rpc={srv.rpc.addr} "
@@ -209,6 +216,76 @@ def cmd_volume_deregister(args) -> int:
 
 def cmd_job_history(args) -> int:
     _out(_client(args).jobs.versions(args.job_id))
+    return 0
+
+
+def cmd_job_inspect(args) -> int:
+    """reference: `nomad job inspect` — the stored job definition."""
+    _out(_client(args).get(f"/v1/job/{args.job_id}"))
+    return 0
+
+
+def cmd_job_validate(args) -> int:
+    """reference: `nomad job validate` — parse + static checks, no
+    submission."""
+    from nomad_tpu.jobspec import parse_file
+    try:
+        job = parse_file(args.path)
+    except Exception as e:  # noqa: BLE001 - the error IS the output
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    problems = []
+    if not job.task_groups:
+        problems.append("job has no task groups")
+    for tg in job.task_groups:
+        if not tg.tasks:
+            problems.append(f"group {tg.name!r} has no tasks")
+        for t in tg.tasks:
+            if not t.driver:
+                problems.append(f"task {t.name!r} has no driver")
+    if problems:
+        for p in problems:
+            print(f"Error: {p}", file=sys.stderr)
+        return 1
+    print(f"job {job.id!r} is valid")
+    return 0
+
+
+def cmd_job_eval(args) -> int:
+    """reference: `nomad job eval` — force a fresh evaluation."""
+    out = _client(args).put(f"/v1/job/{args.job_id}/evaluate")
+    print(f"created evaluation {out['EvalID']}")
+    return 0
+
+
+def cmd_job_deployments(args) -> int:
+    _out(_client(args).get(f"/v1/job/{args.job_id}/deployments"))
+    return 0
+
+
+def cmd_operator_raft_list_peers(args) -> int:
+    out = _client(args).get("/v1/operator/raft/configuration")
+    for srv in out.get("Servers", []):
+        mark = "leader" if srv.get("Leader") else "follower"
+        print(f"{srv.get('Node', '?'):24} {srv.get('Address', ''):22} "
+              f"{mark}")
+    return 0
+
+
+def cmd_acl_token_self(args) -> int:
+    _out(_client(args).get("/v1/acl/token/self"))
+    return 0
+
+
+def cmd_regions_list(args) -> int:
+    for r in _client(args).get("/v1/regions"):
+        print(r)
+    return 0
+
+
+def cmd_version(args) -> int:
+    from nomad_tpu import __version__
+    print(f"nomad-tpu v{__version__}")
     return 0
 
 
@@ -574,6 +651,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-namespace", default="default")
     p.add_argument("-token", default="",
                    help="ACL secret (or NOMAD_TOKEN env)")
+    p.add_argument("-region", default="",
+                   help="target region; foreign regions are forwarded "
+                        "through the contacted agent's federation table "
+                        "(or NOMAD_REGION env)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser("agent", help="run an agent (server+client+http)")
@@ -597,6 +678,15 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-data-dir", dest="data_dir", default="")
     ag.add_argument("-plugin-dir", dest="plugin_dir", default="",
                     help="directory of external driver/device plugins")
+    ag.add_argument("-agent-region", dest="agent_region", default="",
+                    help="this agent's region (default: config or global)")
+    ag.add_argument("-join-wan", dest="join_wan", action="append",
+                    default=[],
+                    help="HTTP URL of an agent in another region to "
+                         "federate with (repeatable)")
+    ag.add_argument("-join-wan-token", dest="join_wan_token", default="",
+                    help="management token for the -join-wan peer "
+                         "(required when the peer enforces ACLs)")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(
@@ -634,6 +724,18 @@ def build_parser() -> argparse.ArgumentParser:
     jpf = job.add_parser("periodic-force")
     jpf.add_argument("job_id")
     jpf.set_defaults(fn=cmd_job_periodic_force)
+    ji = job.add_parser("inspect")
+    ji.add_argument("job_id")
+    ji.set_defaults(fn=cmd_job_inspect)
+    jva = job.add_parser("validate")
+    jva.add_argument("path")
+    jva.set_defaults(fn=cmd_job_validate)
+    jev = job.add_parser("eval")
+    jev.add_argument("job_id")
+    jev.set_defaults(fn=cmd_job_eval)
+    jde = job.add_parser("deployments")
+    jde.add_argument("job_id")
+    jde.set_defaults(fn=cmd_job_deployments)
 
     node = sub.add_parser("node", help="node commands").add_subparsers(
         dest="node_cmd", required=True)
@@ -729,6 +831,10 @@ def build_parser() -> argparse.ArgumentParser:
     odbg = op.add_parser("debug")
     odbg.add_argument("-output", default="")
     odbg.set_defaults(fn=cmd_operator_debug)
+    oraft = op.add_parser("raft").add_subparsers(dest="raft_cmd",
+                                                 required=True)
+    orl = oraft.add_parser("list-peers")
+    orl.set_defaults(fn=cmd_operator_raft_list_peers)
     osnap = op.add_parser("snapshot").add_subparsers(dest="snap_cmd",
                                                      required=True)
     osv = osnap.add_parser("save")
@@ -767,6 +873,8 @@ def build_parser() -> argparse.ArgumentParser:
     atd = atok.add_parser("delete")
     atd.add_argument("accessor_id")
     atd.set_defaults(fn=cmd_acl_token_delete)
+    ats = atok.add_parser("self")
+    ats.set_defaults(fn=cmd_acl_token_self)
 
     nsp = sub.add_parser("namespace",
                          help="namespace management").add_subparsers(
@@ -840,6 +948,12 @@ def build_parser() -> argparse.ArgumentParser:
                                                   required=True)
     sm = srv.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+
+    rg = sub.add_parser("regions", help="list federated regions")
+    rg.set_defaults(fn=cmd_regions_list)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=cmd_version)
 
     mon = sub.add_parser("monitor", help="stream agent logs")
     mon.add_argument("-log-level", dest="log_level", default="debug",

@@ -37,7 +37,8 @@ class AllocRunner:
                  restore_handles: Optional[Dict] = None,
                  on_handle: Optional[Callable] = None,
                  device_reserver: Optional[Callable] = None,
-                 identity_fetcher: Optional[Callable] = None) -> None:
+                 identity_fetcher: Optional[Callable] = None,
+                 secrets_provider=None) -> None:
         self.alloc = alloc
         self.node = node
         self.drivers = drivers
@@ -47,6 +48,7 @@ class AllocRunner:
         self.restore_handles = restore_handles or {}
         self._persist_handle = on_handle
         self.device_reserver = device_reserver
+        self.secrets_provider = secrets_provider
         # one derive RPC per ALLOC, shared by every task runner (the
         # server mints all task tokens in one call)
         self._identity_raw = identity_fetcher
@@ -109,7 +111,8 @@ class AllocRunner:
                 restore_handle=self.restore_handles.get(task.name),
                 on_handle=self._on_task_handle,
                 device_reserver=self.device_reserver,
-                identity_fetcher=self.identity_fetcher))
+                identity_fetcher=self.identity_fetcher,
+                secrets_provider=self.secrets_provider))
 
     # ------------------------------------------------------------ status
 

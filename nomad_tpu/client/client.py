@@ -57,6 +57,9 @@ class InProcessRPC:
     def remove_service_registrations(self, alloc_id: str) -> None:
         self.server.state.delete_service_registrations_by_alloc(alloc_id)
 
+    def read_variable(self, namespace: str, path: str, token: str):
+        return self.server.read_variable(namespace, path, token)
+
     def derive_identity_tokens(self, alloc_id: str):
         tokens, err = self.server.derive_identity_tokens(alloc_id)
         if err:
@@ -70,8 +73,15 @@ class Client:
                  heartbeat_interval: float = 10.0,
                  sync_interval: float = 0.2,
                  devices=None,
-                 plugin_dir: str = "") -> None:
+                 plugin_dir: str = "",
+                 secrets_provider=None) -> None:
         self.rpc = rpc
+        # the Vault seam (integrations/secrets.py): default to the native
+        # nomad-variables provider whenever the RPC surface supports it
+        if secrets_provider is None and hasattr(rpc, "read_variable"):
+            from nomad_tpu.integrations import VariablesSecretsProvider
+            secrets_provider = VariablesSecretsProvider(rpc)
+        self.secrets_provider = secrets_provider
         self.data_dir = data_dir
         self.drivers = drivers if drivers is not None \
             else new_driver_registry()
@@ -213,7 +223,8 @@ class Client:
                                      if self.plugin_manager else None),
                                  identity_fetcher=getattr(
                                      self.rpc, "derive_identity_tokens",
-                                     None))
+                                     None),
+                                 secrets_provider=self.secrets_provider)
                 with self._lock:
                     self.alloc_runners[alloc.id] = ar
                     self.state_db.put_allocation(alloc)

@@ -68,6 +68,48 @@ class ArtifactHook(TaskHook):
                 raise DriverError(f"artifact fetch unsupported: {src}")
 
 
+class SecretsHook(TaskHook):
+    """The Vault-analog secrets plane (reference: vault_hook.go + the
+    template runner's secret renders): template data may reference
+    secrets as ``${nomad_var.<path>#<key>}``; this hook resolves every
+    referenced path through the client's SecretsProvider using the
+    task's WORKLOAD IDENTITY (NOMAD_TOKEN) and injects the values into
+    the task env so TemplateHook's interpolation substitutes them.  A
+    missing or denied secret fails the task setup — exactly like a
+    failed Vault token derivation in the reference — so a task never
+    starts with an unrendered secret."""
+    name = "secrets"
+    PATTERN = __import__("re").compile(
+        r"\$\{nomad_var\.([^}#]+)#([^}]+)\}")
+
+    def prestart(self, runner: "TaskRunner") -> None:
+        provider = runner.secrets_provider
+        refs = {}
+        for tpl in runner.task.templates:
+            for m in self.PATTERN.finditer(tpl.get("data", "")):
+                refs.setdefault(m.group(1), set()).add(m.group(2))
+        if not refs:
+            return
+        if provider is None:
+            raise DriverError(
+                "template references nomad_var secrets but the client "
+                "has no secrets provider")
+        token = runner.env.get("NOMAD_TOKEN", "")
+        ns = runner.alloc.namespace
+        for path, keys in refs.items():
+            items = provider.fetch(ns, path, token)
+            if items is None:
+                raise DriverError(f"secret {path!r} does not exist")
+            for key in keys:
+                if key not in items:
+                    raise DriverError(
+                        f"secret {path!r} has no key {key!r}")
+                # secret_env, NOT env: the task env is handed verbatim to
+                # drivers (docker argv, /proc/<pid>/environ) — secrets
+                # exist only for the template render
+                runner.secret_env[f"nomad_var.{path}#{key}"] = items[key]
+
+
 class TemplateHook(TaskHook):
     """reference: taskrunner/template_hook.go — renders task.templates
     with ${...} interpolation against the task env."""
@@ -75,6 +117,9 @@ class TemplateHook(TaskHook):
 
     def prestart(self, runner: "TaskRunner") -> None:
         from .taskenv import interpolate
+        # secrets join the render context only — never the driver env
+        ctx = ({**runner.env, **runner.secret_env}
+               if runner.secret_env else runner.env)
         for tpl in runner.task.templates:
             data = tpl.get("data", "")
             dest = tpl.get("destination", "")
@@ -83,7 +128,7 @@ class TemplateHook(TaskHook):
             path = os.path.join(runner.task_dir, dest)
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with open(path, "w") as f:
-                f.write(interpolate(data, runner.env, runner.node))
+                f.write(interpolate(data, ctx, runner.node))
 
 
 class DispatchPayloadHook(TaskHook):
@@ -103,7 +148,8 @@ class DispatchPayloadHook(TaskHook):
                 f.write(payload)
 
 
-DEFAULT_HOOKS = (ArtifactHook, TemplateHook, DispatchPayloadHook)
+DEFAULT_HOOKS = (ArtifactHook, SecretsHook, TemplateHook,
+                 DispatchPayloadHook)
 
 
 class TaskRunner:
@@ -114,7 +160,8 @@ class TaskRunner:
                  restore_handle: Optional[TaskHandle] = None,
                  on_handle: Optional[Callable] = None,
                  device_reserver: Optional[Callable] = None,
-                 identity_fetcher: Optional[Callable] = None) -> None:
+                 identity_fetcher: Optional[Callable] = None,
+                 secrets_provider=None) -> None:
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -130,8 +177,11 @@ class TaskRunner:
         self.on_handle = on_handle
         self.device_reserver = device_reserver
         self.identity_fetcher = identity_fetcher
+        self.secrets_provider = secrets_provider
         self.handle: Optional[TaskHandle] = None
         self.env: Dict[str, str] = {}
+        # template-render-only values (secrets); never reaches drivers
+        self.secret_env: Dict[str, str] = {}
         self.hooks: List[TaskHook] = [h() for h in DEFAULT_HOOKS]
         self._kill = threading.Event()
         self._restart_requested = False

@@ -78,7 +78,7 @@ FORWARDED = frozenset({
 # else on the wire is rejected — the endpoint must never expose arbitrary
 # server attributes.
 RPC_METHODS = FORWARDED | {
-    "get_client_allocs", "derive_identity_tokens",
+    "get_client_allocs", "derive_identity_tokens", "read_variable",
 }
 
 
@@ -284,6 +284,9 @@ class RemoteRPC:
     def derive_identity_tokens(self, alloc_id: str):
         tokens, err = self.call("derive_identity_tokens", alloc_id)
         return {} if err else tokens
+
+    def read_variable(self, namespace: str, path: str, token: str):
+        return tuple(self.call("read_variable", namespace, path, token))
 
 
 class ClusterServer(Server):

@@ -64,6 +64,9 @@ class Server:
         # `state` may be a ReplicatedState proxy (cluster.py): every
         # component below then routes mutations through Raft transparently
         self.state = state if state is not None else StateStore()
+        # scheduling domain this server belongs to (reference:
+        # nomad/regions.go); the Agent overrides it from its config
+        self.region = "global"
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
@@ -296,6 +299,21 @@ class Server:
                         job_id=alloc.job_id, alloc_id=alloc_id, task=t)
                 for t in tasks}, ""
 
+    def read_variable(self, namespace: str, path: str, token: str):
+        """Read one variable under a caller credential — the secrets
+        plane's server half (reference: Variables.Read RPC; the workload
+        identity resolves to the implicit job-subtree read policy).
+        Returns (items, error)."""
+        acl, err = self.resolve_token(token)
+        if acl is None:
+            return None, err or "permission denied"
+        if not acl.allow_variable(namespace, path, write=False):
+            return None, f"permission denied: variables-read {path!r}"
+        var = self.state.variable_by_path(namespace, path)
+        if var is None:
+            return None, ""
+        return dict(var.items), ""
+
     def resolve_token(self, secret_id: str):
         """secret -> compiled ACL; (None, error) when unknown
         (reference: Server.ResolveToken + its ACL cache).  Workload
@@ -401,6 +419,8 @@ class Server:
 
     def register_node(self, node: Node, now: Optional[float] = None) -> None:
         t = now if now is not None else time.time()
+        if not node.region or node.region == "global":
+            node.region = self.region
         self.state.upsert_node(node)
         self.heartbeats.reset(node.id, t)
 

@@ -80,7 +80,7 @@ def node_property_map(node: Node) -> Dict[str, str]:
         "node.datacenter": node.datacenter,
         "node.class": node.node_class,
         "node.pool": node.node_pool,
-        "node.region": "global",
+        "node.region": node.region or "global",
         "node.unique.name": node.name,
         "node.unique.id": node.id,
     }

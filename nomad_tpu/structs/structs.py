@@ -297,6 +297,7 @@ class Node:
     id: str = field(default_factory=new_id)
     name: str = ""
     datacenter: str = "dc1"
+    region: str = "global"      # the registering server's region
     node_pool: str = "default"
     node_class: str = ""
     attributes: Dict[str, str] = field(default_factory=dict)

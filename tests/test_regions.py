@@ -1,0 +1,103 @@
+"""Multi-region federation (reference: nomad/regions.go, WAN serf,
+rpcHandler.forward region hop, the `multiregion` jobspec stanza)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient, APIException
+from nomad_tpu.structs import Multiregion, codec
+
+
+@pytest.fixture()
+def federated():
+    east = Agent(client_enabled=False, num_workers=1, region="east").start()
+    west = Agent(client_enabled=False, num_workers=1, region="west",
+                 join_wan=[east.address]).start()
+    for a in (east, west):
+        a.server.establish_leadership()
+        for _ in range(3):
+            a.server.register_node(mock.node())
+    try:
+        yield east, west
+    finally:
+        east.shutdown()
+        west.shutdown()
+
+
+class TestFederation:
+    def test_push_pull_join_teaches_both_sides(self, federated):
+        east, west = federated
+        assert west.federation.regions() == ["east", "west"]
+        # the join POSTed west's table into east as well
+        assert east.federation.regions() == ["east", "west"]
+
+    def test_regions_endpoint(self, federated):
+        east, west = federated
+        api = APIClient(address=west.address)
+        assert api.get("/v1/regions") == ["east", "west"]
+
+    def test_cross_region_forwarding(self, federated):
+        east, west = federated
+        # submit against WEST with ?region=east: lands in east's state
+        api = APIClient(address=west.address, region="east")
+        job = mock.job()
+        out = api.jobs.register(codec.encode(job))
+        assert out["EvalID"]
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            live = [a for a in east.server.state.snapshot()
+                    .allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()]
+            if live:
+                break
+            time.sleep(0.1)
+        assert live, "job never placed in east"
+        assert west.server.state.snapshot().job_by_id(
+            job.namespace, job.id) is None
+        # reads forward too
+        stub = api.get(f"/v1/job/{job.id}")
+        assert stub["ID"] == job.id
+        # node region stamped by the owning server
+        node = east.server.state.snapshot().nodes()[0]
+        assert node.region == "east"
+
+    def test_unknown_region_404(self, federated):
+        _, west = federated
+        api = APIClient(address=west.address, region="mars")
+        with pytest.raises(APIException) as e:
+            api.get("/v1/jobs")
+        assert e.value.status == 404
+
+    def test_multiregion_job_fans_out(self, federated):
+        east, west = federated
+        api = APIClient(address=west.address)
+        job = mock.batch_job()
+        job.task_groups[0].count = 5
+        job.multiregion = Multiregion(regions=[
+            {"Name": "west", "Count": 2},
+            {"Name": "east", "Count": 3},
+        ])
+        out = api.jobs.register(codec.encode(job))
+        assert set(out["Regions"]) == {"east", "west"}
+        assert all("Error" not in r for r in out["Regions"].values()), out
+        deadline = time.time() + 15
+        counts = {}
+        while time.time() < deadline:
+            counts = {
+                name: len([a for a in ag.server.state.snapshot()
+                           .allocs_by_job(job.namespace, job.id)
+                           if not a.terminal_status()])
+                for name, ag in (("east", east), ("west", west))}
+            if counts == {"east": 3, "west": 2}:
+                break
+            time.sleep(0.1)
+        assert counts == {"east": 3, "west": 2}, counts
+        # each region's stored copy carries its own region + count
+        for name, ag in (("east", east), ("west", west)):
+            stored = ag.server.state.snapshot().job_by_id(
+                job.namespace, job.id)
+            assert stored.region == name
+            assert stored.multiregion is None

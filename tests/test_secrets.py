@@ -1,0 +1,121 @@
+"""Secrets plane — the Vault integration seam (reference: nomad/vault.go
++ vault_hook/template secret renders; here backed natively by nomad
+variables read under the task's workload identity)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.client import Client, InProcessRPC
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import VariableItem
+
+NOW_WAIT = 20
+
+
+def run_job_with_template(server, client, job, timeout=NOW_WAIT):
+    server.register_job(job)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        allocs = server.state.snapshot().allocs_by_job(
+            job.namespace, job.id)
+        states = [a.client_status for a in allocs]
+        if states and all(s in ("complete", "failed") for s in states):
+            return allocs
+        time.sleep(0.1)
+    raise AssertionError(f"job never finished: {states}")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    s = Server(dev_mode=False, num_workers=1, heartbeat_ttl=1e9)
+    s.start(tick_interval=0.2)
+    c = Client(InProcessRPC(s), node=mock.node(),
+               data_dir=str(tmp_path / "client"))
+    c.start()
+    try:
+        yield s, c
+    finally:
+        c.shutdown()
+        s.shutdown()
+
+
+def secret_job(template_data):
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    task = tg.tasks[0]
+    task.driver = "mock"
+    task.config = {"run_for_s": 0}
+    task.templates = [{"data": template_data, "destination": "creds.txt"}]
+    return job
+
+
+class TestSecretsPlane:
+    def test_template_renders_workload_scoped_variable(self, cluster,
+                                                       tmp_path):
+        s, c = cluster
+        job = secret_job(
+            "user=${nomad_var.nomad/jobs/%s/db#user} "
+            "pass=${nomad_var.nomad/jobs/%s/db#password}")
+        job.task_groups[0].tasks[0].templates[0]["data"] = (
+            f"user=${{nomad_var.nomad/jobs/{job.id}/db#user}} "
+            f"pass=${{nomad_var.nomad/jobs/{job.id}/db#password}}")
+        s.state.upsert_variable(VariableItem(
+            path=f"nomad/jobs/{job.id}/db",
+            items={"user": "app", "password": "hunter2"}))
+        allocs = run_job_with_template(s, c, job)
+        assert all(a.client_status == "complete" for a in allocs), [
+            (a.client_status, a.task_states) for a in allocs]
+        import glob
+        rendered = glob.glob(str(tmp_path / "client" / "**" / "creds.txt"),
+                             recursive=True)
+        assert rendered
+        content = open(rendered[0]).read()
+        assert content == "user=app pass=hunter2"
+
+    def test_foreign_job_subtree_denied(self, cluster):
+        """The workload identity only reaches the job's OWN variable
+        subtree: referencing another job's secret fails the task."""
+        s, c = cluster
+        s.state.upsert_variable(VariableItem(
+            path="nomad/jobs/other-job/db", items={"password": "nope"}))
+        job = secret_job(
+            "${nomad_var.nomad/jobs/other-job/db#password}")
+        allocs = run_job_with_template(s, c, job)
+        assert all(a.client_status == "failed" for a in allocs)
+        events = [e for a in allocs
+                  for ts in a.task_states.values()
+                  for e in ts.events]
+        assert any("permission denied" in (e.message or "")
+                   for e in events), events
+
+    def test_missing_secret_fails_task(self, cluster):
+        s, c = cluster
+        job = secret_job("${nomad_var.nomad/jobs/%s/nope#key}")
+        job.task_groups[0].tasks[0].templates[0]["data"] = (
+            f"${{nomad_var.nomad/jobs/{job.id}/nope#key}}")
+        allocs = run_job_with_template(s, c, job)
+        assert all(a.client_status == "failed" for a in allocs)
+
+    def test_provider_seam_is_pluggable(self, cluster, tmp_path):
+        """An external provider (the Vault drop-in) plugs in at the
+        client and serves the same template references."""
+        s, c = cluster
+        from nomad_tpu.integrations import SecretsProvider
+
+        class FakeVault(SecretsProvider):
+            def fetch(self, namespace, path, token):
+                assert token, "provider must receive the task identity"
+                return {"api_key": f"vault:{path}"}
+
+        c.secrets_provider = FakeVault()
+        job = secret_job("key=${nomad_var.secret/data/app#api_key}")
+        allocs = run_job_with_template(s, c, job)
+        assert all(a.client_status == "complete" for a in allocs)
+        import glob
+        rendered = glob.glob(str(tmp_path / "client" / "**" / "creds.txt"),
+                             recursive=True)
+        content = open(rendered[0]).read()
+        assert content == "key=vault:secret/data/app"
