@@ -422,7 +422,7 @@ def run_config_5(args):
     # same snapshot collide on the same best nodes and refute each other
     # at the applier (measured: 2 workers -> ~25% solo-retry fallbacks)
     n_workers = args.workers or 1
-    batch = args.batch or 64
+    batch = args.batch or 128
 
     s = Server(dev_mode=False, num_workers=n_workers, eval_batch=batch,
                heartbeat_ttl=1e9)

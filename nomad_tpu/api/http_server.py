@@ -136,11 +136,14 @@ class Router:
         if fed is None:
             raise APIError(400, "multiregion job on a non-federated agent")
         entries = job.multiregion.regions
+        # validate EVERY entry before registering ANY region: a bad entry
+        # after valid ones would otherwise leave a partial fan-out behind
+        # a 400 (and a retry would re-register the good regions)
+        names = [str(e.get("Name") or e.get("name") or "") for e in entries]
+        if not all(names):
+            raise APIError(400, "multiregion region entry needs a Name")
         results: Dict[str, Any] = {}
-        for entry in entries:
-            name = str(entry.get("Name") or entry.get("name") or "")
-            if not name:
-                raise APIError(400, "multiregion region entry needs a Name")
+        for entry, name in zip(entries, names):
             copy = job.copy()
             copy.region = name
             copy.multiregion = None      # the copies must not re-fan-out

@@ -84,7 +84,8 @@ let REGION = '';
 
 async function get(path) {
   const sep = path.includes('?') ? '&' : '?';
-  const r = await fetch(REGION ? `${path}${sep}region=${REGION}` : path);
+  const r = await fetch(REGION ? `${path}${sep}region=` +
+    encodeURIComponent(REGION) : path);
   if (!r.ok) throw new Error(`${r.status} ${path}`);
   return r.json();
 }
@@ -146,19 +147,23 @@ async function viewOverview() {
     sect('Jobs', table(['ID','Type','NS','Status'], jobRows)) +
     sect('Deployments', table(['Job','Ver','Status'], depRows)) +
     sect('Services', table(['Service','Tags'], svcRows)) +
-    sect('Events', `<div id="events">${prevEvents}</div>`);
+    (REGION ? '' :   // the event stream does not region-forward:
+                     // showing local events under foreign data lies
+     sect('Events (local region)',
+          `<div id="events">${prevEvents}</div>`));
 }
 
 // ------------------------------------------------------------ job view
 async function viewJob(ns, id) {
   const enc = encodeURIComponent(id);
+  const encNs = encodeURIComponent(ns);
   const [job, allocs, evals] = await Promise.all([
-    get(`/v1/job/${enc}?namespace=${ns}`),
-    get(`/v1/job/${enc}/allocations?namespace=${ns}`),
-    get(`/v1/job/${enc}/evaluations?namespace=${ns}`)]);
+    get(`/v1/job/${enc}?namespace=${encNs}`),
+    get(`/v1/job/${enc}/allocations?namespace=${encNs}`),
+    get(`/v1/job/${enc}/evaluations?namespace=${encNs}`)]);
   const groups = (job.TaskGroups || []).map(tg => row([
     cell(code(esc(tg.Name))), cell(tg.Count),
-    cell((tg.Tasks || []).map(t => `${esc(t.Name)} (${t.Driver})`)
+    cell((tg.Tasks || []).map(t => `${esc(t.Name)} (${esc(t.Driver)})`)
       .join(', '))]));
   const allocRows = allocs.map(a => row([
     cell(`<a href="#/alloc/${a.ID}">${code(a.ID.slice(0,8))}</a>`),
@@ -171,7 +176,7 @@ async function viewJob(ns, id) {
     cell(e.Status, cls(e.Status)),
     cell(esc(e.StatusDescription || ''))]));
   document.getElementById('main').innerHTML =
-    sect(`Job ${esc(id)} · ${job.Type} · v${job.Version} · ` +
+    sect(`Job ${esc(id)} · ${esc(job.Type)} · v${job.Version} · ` +
          `<span class="${cls(job.Status)}">${job.Status}</span>`,
          table(['Group','Count','Tasks'], groups), true) +
     sect('Allocations',

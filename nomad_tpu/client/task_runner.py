@@ -8,6 +8,7 @@ alloc runner aggregates and ships to the server.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -79,8 +80,7 @@ class SecretsHook(TaskHook):
     failed Vault token derivation in the reference — so a task never
     starts with an unrendered secret."""
     name = "secrets"
-    PATTERN = __import__("re").compile(
-        r"\$\{nomad_var\.([^}#]+)#([^}]+)\}")
+    PATTERN = re.compile(r"\$\{nomad_var\.([^}#]+)#([^}]+)\}")
 
     def prestart(self, runner: "TaskRunner") -> None:
         provider = runner.secrets_provider

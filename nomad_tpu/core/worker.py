@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.core.logging import log
 from nomad_tpu.ops import PlacementEngine
@@ -44,6 +44,10 @@ class Worker:
         # the scheduler ran with, not a fresh wall-clock read (tests and
         # deterministic replays inject synthetic time)
         self._now: Optional[float] = None
+        # cross-batch pipeline: a dequeued batch whose kernel launch was
+        # dispatched (chained on the previous batch's device-side
+        # proposed usage) while the previous batch's host phase ran
+        self._prefetch = None
 
     # ------------------------------------------------------------ running
 
@@ -60,6 +64,14 @@ class Worker:
             # finish — abandoning a daemon thread inside the PJRT plugin
             # aborts the whole process at interpreter exit
             self._thread.join(timeout=60)
+        pf = self._prefetch
+        self._prefetch = None
+        if pf is not None:
+            # give the undrained batch's evals back immediately instead
+            # of stranding them until the nack timeout
+            t = time.time()
+            for ev, token in pf["batch"]:
+                self.server.eval_broker.nack(ev.id, token, now=t)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -114,16 +126,29 @@ class Worker:
         resulting plans — mutually consistent by construction — submit
         through the plan queue individually.  Ineligible evals (system,
         core GC, spread/device jobs, updates/stops) process through the
-        normal per-eval path in dequeue order."""
+        normal per-eval path in dequeue order.
+
+        Cross-batch pipelining: when a batch is fully coupled, the NEXT
+        ready batch is dequeued and its kernel DISPATCHED (chained on
+        this batch's device-side proposed usage) before this batch's
+        host phase runs — the device computes batch k+1 while the host
+        materializes and commits batch k."""
         broker = self.server.eval_broker
         t = now if now is not None else time.time()
-        batch = broker.dequeue_batch(SCHEDULERS_SERVED, max_n, now=t,
-                                     timeout=timeout)
-        if not batch:
-            return 0
+        pf = self._prefetch
+        self._prefetch = None
+        if pf is None:
+            batch = broker.dequeue_batch(SCHEDULERS_SERVED, max_n, now=t,
+                                         timeout=timeout)
+            if not batch:
+                return 0
+        else:
+            batch = pf["batch"]
         settled: set = set()
         try:
-            return self._run_batch_inner(batch, t, settled)
+            if pf is None:
+                pf = self._start_batch(batch, t)
+            return self._finish_batch(pf, t, settled, max_n)
         except Exception as e:  # noqa: BLE001 - the solo path nacks on
             # any failure; the batched path must give every dequeued
             # eval the same guarantee or a single bad snapshot kills the
@@ -135,7 +160,12 @@ class Worker:
                     self._settle(ev, token, e, t)
             return len(batch)
 
-    def _run_batch_inner(self, batch, t: float, settled: set) -> int:
+    def _start_batch(self, batch, t: float, chain=None):
+        """Phases 1-2: snapshot, per-eval reconcile, and the (async)
+        device dispatch.  `chain` = (batch_id, seq0, used_dev) continues
+        a coupled chain: the launch starts from the previous batch's
+        device-side proposed usage and its plans join the same applier
+        fence.  Returns the pending-batch dict for _finish_batch."""
         import zlib
 
         from nomad_tpu.ops.engine import BatchItem
@@ -149,8 +179,7 @@ class Worker:
         # foreign write between separate reads would be invisible to the
         # fence yet missing from the snapshot (the applier would then
         # skip the fit re-check against state the scheduler never saw)
-        self._snapshot, batch_seq0 = state.snapshot_and_placement_seq()
-        self._now = t
+        snapshot, batch_seq0 = state.snapshot_and_placement_seq()
 
         # phase 1: build schedulers, reconcile batch-eligible evals
         work = []          # (ev, token, sched_or_None, prep_or_err)
@@ -161,8 +190,7 @@ class Worker:
             else:
                 kwargs = {"now": t, "engine": self.server.engine}
             try:
-                sched = new_scheduler(ev.type, self._snapshot, self,
-                                      **kwargs)
+                sched = new_scheduler(ev.type, snapshot, self, **kwargs)
             except Exception as e:  # noqa: BLE001 - factory/init error
                 work.append((ev, token, None, e))
                 continue
@@ -175,46 +203,114 @@ class Worker:
                     prep = None
             work.append((ev, token, sched, prep))
 
-        # phase 2: ONE device launch for all eligible placement blocks
+        # phase 2: ONE device dispatch for all eligible placement blocks
         prepared = [(i, w) for i, w in enumerate(work)
                     if w[2] is not None
                     and isinstance(w[3], GenericScheduler.BatchPrep)]
-        bds = {}
+        pending = None
+        prepared_idx = []
         batch_id = ""
         if len(prepared) >= 2:
-            batch_id = new_id()
+            if chain is not None:
+                batch_id, batch_seq0, used_dev = chain
+            else:
+                batch_id, used_dev = new_id(), None
             items = [BatchItem(job=w[3].job, tg=w[3].tg, count=w[3].count)
                      for _, w in prepared]
             seed = (zlib.crc32(prepared[0][1][0].id.encode())
                     & 0xFFFFFFFF) or 1
             try:
-                decisions = self.server.engine.place_batch(
-                    self._snapshot, items, seed=seed)
-                bds = {i: d for (i, _), d in zip(prepared, decisions)}
+                pending = self.server.engine.dispatch_batch(
+                    snapshot, items, seed=seed, used0_dev=used_dev)
+                prepared_idx = [i for i, _ in prepared]
             except Exception as e:  # noqa: BLE001 - solo fallback
                 log("worker", "warn", "batch launch failed; going solo",
                     worker=self.id, error=str(e))
-                bds = {}
+                pending = None
+        return {"batch": batch, "work": work, "pending": pending,
+                "prepared_idx": prepared_idx, "batch_id": batch_id,
+                "batch_seq0": batch_seq0, "snapshot": snapshot}
+
+    def _finish_batch(self, pf, t: float, settled: set,
+                      max_n: int) -> int:
+        work = pf["work"]
+        batch_id = pf["batch_id"]
+        batch_seq0 = pf["batch_seq0"]
+        self._snapshot = pf["snapshot"]
+        self._now = t
+        bds = {}
+        if pf["pending"] is not None:
+            decisions = self.server.engine.collect_batch(pf["pending"])
+            bds = {i: d for i, d in zip(pf["prepared_idx"], decisions)}
+
+        # cross-batch prefetch: with this batch fully coupled and more
+        # evals ready, dispatch the next launch NOW so the device works
+        # through it while this thread runs phase 3.  Chained decisions
+        # start from this batch's proposed usage — a superset of what
+        # will commit, so they can under-pack but never oversubscribe.
+        if (isinstance(pf["pending"], dict) and bds
+                and len(bds) == len(work) and not self._stop.is_set()):
+            nxt = self.server.eval_broker.dequeue_batch(
+                SCHEDULERS_SERVED, max_n, now=t, timeout=0.0)
+            if nxt:
+                try:
+                    self._prefetch = self._start_batch(
+                        nxt, t, chain=(batch_id, batch_seq0,
+                                       pf["pending"]["used"]))
+                except Exception as e:  # noqa: BLE001 - hand them back
+                    log("worker", "warn", "prefetch dispatch failed",
+                        worker=self.id, error=repr(e))
+                    for ev, token in nxt:
+                        self.server.eval_broker.nack(ev.id, token, now=t)
 
         # phase 3: coupled plans FIRST — a solo eval's commit is a
         # placement write the batch snapshot never saw, which would break
         # the applier's fence and force full re-checks for the whole
-        # chain — then everything else in dequeue order
-        order = ([i for i in range(len(work)) if i in bds]
-                 + [i for i in range(len(work)) if i not in bds])
-        for i in order:
+        # chain — then everything else in dequeue order.  Coupled plans
+        # submit a BOUNDED window ahead of the finalize pass, so the
+        # applier commits plan k while this thread materializes plan k+1
+        # without letting plans pool in the queue (queue-wait is the
+        # north star's p99 plan-queue latency — an unbounded submit-all
+        # pass inflated it ~60x for zero wall-time gain).
+        coupled = [i for i in range(len(work)) if i in bds]
+        handles: Dict[int, object] = {}
+        window = 2
+
+        def submit(i):
+            ev, token, sched, prep = work[i]
+            try:
+                handles[i] = sched.submit_batched(
+                    ev, prep, bds[i],
+                    coupled_batch=(batch_id, batch_seq0))
+            except Exception as e:  # noqa: BLE001 - finalize pass nacks
+                handles[i] = e
+
+        for i in coupled[:window]:
+            submit(i)
+        for pos, i in enumerate(coupled):
+            if pos + window < len(coupled):
+                submit(coupled[pos + window])
+            # finalize i right here so the window stays bounded
+            ev, token, sched, prep = work[i]
+            try:
+                h = handles.get(i)
+                if isinstance(h, Exception):
+                    err = h
+                else:
+                    err = (sched.finalize_batched(ev, h) if h is not None
+                           else sched.process(ev))    # solo fallback
+            except Exception as e:  # noqa: BLE001 - nack, don't die
+                err = e
+            self._settle(ev, token, err, t)
+            settled.add(ev.id)
+        for i in [i for i in range(len(work)) if i not in bds]:
             ev, token, sched, prep = work[i]
             if sched is None:
                 self._settle(ev, token, prep, t)      # factory error
                 settled.add(ev.id)
                 continue
             try:
-                if i in bds:
-                    err = sched.process_batched(
-                        ev, prep, bds[i],
-                        coupled_batch=(batch_id, batch_seq0))
-                else:
-                    err = sched.process(ev)
+                err = sched.process(ev)
             except Exception as e:  # noqa: BLE001 - nack, don't die
                 err = e
             self._settle(ev, token, err, t)
@@ -242,13 +338,25 @@ class Worker:
 
     # ----------------------------------------------------------- Planner
 
-    def submit_plan(self, plan: Plan
-                    ) -> Tuple[Optional[PlanResult], object, Optional[Exception]]:
+    def submit_plan_async(self, plan: Plan):
+        """Enqueue a plan WITHOUT waiting for the applier — the batched
+        path submits a whole chain first and collects results after, so
+        plan apply overlaps the next plan's materialization."""
         plan.snapshot_index = self._snapshot.index if self._snapshot else 0
         pending = self.server.plan_queue.enqueue(plan)
         # the applier thread evaluates + commits; in single-threaded test
         # mode the server applies inline
         self.server.maybe_apply_inline(pending)
+        return pending
+
+    def refreshed_snapshot(self):
+        """Fresh state view after a partial commit (the retry loop must
+        see the refuting writes)."""
+        return self.server.state.snapshot()
+
+    def submit_plan(self, plan: Plan
+                    ) -> Tuple[Optional[PlanResult], object, Optional[Exception]]:
+        pending = self.submit_plan_async(plan)
         result, err = pending.wait()
         if err is not None:
             return None, None, err

@@ -850,16 +850,33 @@ class PlacementEngine:
         empty).  Preemption is NOT attempted here — a caller seeing
         failed picks with preemption enabled should fall back to the
         single-eval path, which carries the preemptor."""
+        pending = self.dispatch_batch(snapshot, items, seed=seed)
+        return self.collect_batch(pending)
+
+    def dispatch_batch(self, snapshot, items: Sequence[BatchItem],
+                       seed: int = 0, used0_dev=None):
+        """Asynchronous half of place_batch: pack + LAUNCH the kernel and
+        return a pending handle (kernel dispatch does not block; the
+        device computes while the host does other work — collect_batch
+        blocks on the result).
+
+        `used0_dev`: device-side usage to start from INSTEAD of the
+        packer-synced state — the cross-batch chaining hook: a worker may
+        hand batch k's proposed-usage output in so batch k+1 computes
+        against it before batch k's plans commit.  Proposed usage is a
+        SUPERSET of committed usage (refuted/no-op plans only release
+        capacity), so chained decisions can under-pack but never
+        oversubscribe."""
         if not items:
-            return []
+            return None
         t = self.packer.update(snapshot)
         n = t.n
         if n == 0:
-            return [None] * len(items)
+            return (None, items)
         t0 = time.perf_counter_ns()
         npad = self._padded_n(n)
         dev = self._node_arrays(t)
-        used0 = self._used_device(t)
+        used0 = used0_dev if used0_dev is not None else self._used_device(t)
         algo = snapshot.scheduler_config().scheduler_algorithm
 
         G = len(items)
@@ -917,10 +934,15 @@ class PlacementEngine:
             jc0 = jc0.at[jnp.asarray(np.array(jc_nz_idx, np.int32))].set(
                 jnp.asarray(_pad_cols(np.stack(jc_nz_rows), npad)))
 
-        # round schedule: item gi -> ceil(count / rs) consecutive rounds
+        # round schedule: item gi -> ceil(count / rs) consecutive rounds.
+        # The ladder matters: round cost is dominated by top_k(N, rs) and
+        # the [R, rs+16] buffer transfer, so the smallest bucket covering
+        # the biggest item wins (finer buckets would multiply compiles)
         counts = [max(it.count, 0) for it in items]
         biggest = max(counts) if counts else 0
-        rs = 1024 if biggest > 256 else (256 if biggest > 64 else 64)
+        for rs in (64, 256, 512, 1024):
+            if biggest <= rs:
+                break
         round_g: List[int] = []
         round_want: List[int] = []
         spans: List[Tuple[int, int]] = []
@@ -955,13 +977,35 @@ class PlacementEngine:
             seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
         )
         if self.mesh is not None:
-            buf, _, _ = self._sharded("multi", rs)(inp)
+            buf, used_out, _ = self._sharded("multi", rs)(inp)
         else:
-            buf, _, _ = place_multi_packed_jit(inp, rs)
-        buf_np = np.asarray(buf)
+            buf, used_out, _ = place_multi_packed_jit(inp, rs)
+        # prep_ns, not a wall t0: a prefetched batch may sit dispatched
+        # while the PREVIOUS batch's host phase runs — that gap is not
+        # scheduling time and must not inflate AllocMetric latency
+        return {"buf": buf, "used": used_out, "items": list(items),
+                "spans": spans, "counts": counts, "rs": rs, "t": t,
+                "ctxs": ctxs, "n": n, "npad": npad,
+                "prep_ns": time.perf_counter_ns() - t0}
+
+    def collect_batch(self, pending) -> List[Optional[BulkDecisions]]:
+        """Blocking half of place_batch: fetch the packed buffer and
+        expand per-item decisions."""
+        if pending is None:
+            return []
+        if isinstance(pending, tuple):      # empty-cluster dispatch
+            return [None] * len(pending[1])
+        items = pending["items"]
+        spans, counts, rs = (pending["spans"], pending["counts"],
+                             pending["rs"])
+        t, ctxs, n, npad = (pending["t"], pending["ctxs"],
+                            pending["n"], pending["npad"])
+        t1 = time.perf_counter_ns()
+        buf_np = np.asarray(pending["buf"])
 
         dc_counts = self._dc_counts(t)
-        elapsed = (time.perf_counter_ns() - t0) // max(sum(counts), 1)
+        elapsed = ((pending["prep_ns"] + time.perf_counter_ns() - t1)
+                   // max(sum(counts), 1))
         decisions: List[Optional[BulkDecisions]] = []
         for gi, it in enumerate(items):
             lo, hi = spans[gi]

@@ -685,7 +685,7 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
     count row) varies per round.  Output is the compact per-round packed
     buffer of place_bulk_packed, `[R, round_size + 16]`, one device→host
     transfer for the WHOLE batch; the host slices rows per eval.
-    Returns (buf, used, job_count [J, N])."""
+    Returns (buf, used, last job's count row [N])."""
     n = inp.attrs.shape[0]
     assert n < (1 << 20), "packed fill rows support < 2^20 nodes"
     assert round_size <= 1024, "packed fill counts support rounds <= 1024"
@@ -700,11 +700,17 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
     aff_any_all = jnp.any(inp.aff[..., 3] != 0, axis=1)         # [G]
     noise = tiebreak_noise(inp.seed, jnp.arange(n))
 
+    # The carry holds only the CURRENT job's count row, not [J, N]: a
+    # job's rounds are consecutive in the schedule, so a fresh job's row
+    # gathers from the read-only job_count0 input.  Carrying [J, N]
+    # cost a full copy of it per round (the scan can't alias through the
+    # dynamic row update) — at 64 jobs x 50k nodes that was ~1.6 GB of
+    # HBM traffic per launch, the dominant launch cost.
     def round_step(carry, xs):
-        used, jc = carry
+        used, cur_count, prev_j = carry
         g, want = xs
         j = inp.g_job[g]
-        job_count = jc[j]
+        job_count = jnp.where(j == prev_j, cur_count, inp.job_count0[j])
         req = inp.req[g]
         static = static_all[g]
         k_i, score = round_scores_g(
@@ -715,7 +721,7 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
             k_i, score, noise, want, inp.spread_algo, round_size)
 
         used = used + c_i[:, None] * req[None, :]
-        jc = jc.at[j].add(c_i)
+        job_count = job_count + c_i
 
         top_sc = sc_p[:top_k]
         top_rows = jnp.where(top_sc > NEG_INF / 2, rows_p[:top_k], -1)
@@ -723,14 +729,14 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
         n_feas = jnp.sum(k_round > 0).astype(jnp.int32)
         n_filt = jnp.sum(~static).astype(jnp.int32)
         n_exh, dim_ex = round_metrics_g(
-            inp.cap, req, inp.dh_limit[g], static, used, jc[j])
+            inp.cap, req, inp.dh_limit[g], static, used, job_count)
         out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
                n_feas, n_filt, n_exh.astype(jnp.int32),
                dim_ex.astype(jnp.int32), placed_total.astype(jnp.int32))
-        return (used, jc), out
+        return (used, job_count, j), out
 
-    carry0 = (inp.used0, inp.job_count0)
-    (used, jc), outs = jax.lax.scan(
+    carry0 = (inp.used0, inp.job_count0[0], jnp.int32(-1))
+    (used, jc, _), outs = jax.lax.scan(
         round_step, carry0, (inp.round_g, inp.round_want))
     (rows_p, cnt_p, sc_p, top_rows, top_sc,
      n_feas, n_filt, n_exh, dim_ex, placed) = outs

@@ -360,11 +360,15 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
     aff_any_all = jnp.any(inp.aff[..., 3] != 0, axis=1)
     noise = tiebreak_noise(inp.seed, global_rows)
 
+    # current-job count row carry, like ops.select.place_multi_packed: a
+    # job's rounds are consecutive, so fresh jobs gather their row from
+    # the read-only sharded job_count0 input instead of carrying (and
+    # copying) the whole [J, n_loc] table every round
     def round_step(carry, xs):
-        used, jc = carry
+        used, cur_count, prev_j = carry
         g, want = xs
         j = inp.g_job[g]
-        job_count = jc[j]
+        job_count = jnp.where(j == prev_j, cur_count, inp.job_count0[j])
         req = inp.req[g]
         static = static_all[g]
         k_i, score = round_scores_g(
@@ -376,17 +380,17 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
             k_i, score, noise, static, want, inp.spread_algo, round_size,
             top_k, n_loc, offset, global_rows)
         used = used + c_i[:, None] * req[None, :]
-        jc = jc.at[j].add(c_i)
+        job_count = job_count + c_i
         n_exh_l, dim_ex_l = round_metrics_g(
-            inp.cap, req, inp.dh_limit[g], static, used, jc[j])
+            inp.cap, req, inp.dh_limit[g], static, used, job_count)
         n_exh = jax.lax.psum(n_exh_l, AXIS).astype(jnp.int32)
         dim_ex = jax.lax.psum(dim_ex_l, AXIS).astype(jnp.int32)
         out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
                n_feas, n_filt, n_exh, dim_ex, placed)
-        return (used, jc), out
+        return (used, job_count, j), out
 
-    carry0 = (inp.used0, inp.job_count0)
-    (used, jc), outs = jax.lax.scan(
+    carry0 = (inp.used0, inp.job_count0[0], jnp.int32(-1))
+    (used, jc, _), outs = jax.lax.scan(
         round_step, carry0, (inp.round_g, inp.round_want))
     return outs + (used, jc)
 
@@ -404,7 +408,7 @@ def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
         extra_mask=P(None, AXIS),
     )
     out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                 spec_n, P(None, AXIS))
+                 spec_n, spec_n)
     top_k = TOP_K
     inner = jax.shard_map(
         partial(_multi_local, round_size=round_size, top_k=top_k),

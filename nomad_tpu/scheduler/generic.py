@@ -187,22 +187,23 @@ class GenericScheduler(Scheduler):
             return None
         return self.BatchPrep(job, tg, count, block, places, results)
 
-    def process_batched(self, evaluation: Evaluation, prep, bd,
-                        coupled_batch=None) -> Optional[Exception]:
-        """Phase 2: complete an eval whose placements were computed in a
-        multi-eval batch launch — materialize + submit the plan, falling
-        back to the full process() retry loop on partial commit or when
-        preemption could still place failed picks (the batch kernel never
-        preempts).  `coupled_batch` tags the plan for the applier's
-        skip-refit fast path (core/plan_apply.PlanApplier)."""
+    def submit_batched(self, evaluation: Evaluation, prep, bd,
+                       coupled_batch=None):
+        """Phase 2a of the batched path: materialize + ENQUEUE the plan
+        without waiting for the applier — the worker submits a whole
+        coupled chain first, so plan apply overlaps the next plan's
+        materialization.  Returns an opaque handle for finalize_batched,
+        or None when the eval needs the solo path (no decisions, or
+        preemption could still place failed picks — the batch kernel
+        never preempts)."""
         from nomad_tpu.ops.preempt import preemption_enabled
         job, results = prep.job, prep.results
         if bd is None:
-            return self.process(evaluation)
+            return None
         if ((bd.picks < 0).any()
                 and preemption_enabled(self.state.scheduler_config(),
                                        job.type)):
-            return self.process(evaluation)
+            return None
         self.failed_tg_allocs = {}
         self.queued_allocs = {tg.name: 0 for tg in job.task_groups}
         plan = Plan(eval_id=evaluation.id, priority=evaluation.priority,
@@ -211,8 +212,26 @@ class GenericScheduler(Scheduler):
                                results, block=prep.block)
         if plan.is_no_op():
             self._finalize(evaluation)
+            return ("done", None)
+        submit = getattr(self.planner, "submit_plan_async", None)
+        if submit is None:          # planner without the async surface
+            result, refreshed, err = self.planner.submit_plan(plan)
+            return ("sync", (plan, result, refreshed, err))
+        return ("pending", (plan, submit(plan)))
+
+    def finalize_batched(self, evaluation: Evaluation, handle
+                         ) -> Optional[Exception]:
+        """Phase 2b: collect the applier's verdict and finish the eval —
+        falling back to the full process() retry loop on partial commit."""
+        kind, payload = handle
+        if kind == "done":
             return None
-        result, refreshed_state, err = self.planner.submit_plan(plan)
+        if kind == "sync":
+            plan, result, refreshed_state, err = payload
+        else:
+            plan, pending = payload
+            result, err = pending.wait()
+            refreshed_state = None
         if err is not None:
             self._update_eval_status(evaluation, "failed", str(err))
             return err
@@ -222,11 +241,24 @@ class GenericScheduler(Scheduler):
                 # partial commit: some nodes were refuted against newer
                 # state — re-run the normal retry loop, which reconciles
                 # the committed remainder on a fresh snapshot
+                if refreshed_state is None:
+                    refresh = getattr(self.planner, "refreshed_snapshot",
+                                      None)
+                    refreshed_state = refresh() if refresh else None
                 if refreshed_state is not None:
                     self.state = refreshed_state
                 return self.process(evaluation)
         self._finalize(evaluation)
         return None
+
+    def process_batched(self, evaluation: Evaluation, prep, bd,
+                        coupled_batch=None) -> Optional[Exception]:
+        """Phase 2, synchronous form: submit + finalize in one call."""
+        handle = self.submit_batched(evaluation, prep, bd,
+                                     coupled_batch=coupled_batch)
+        if handle is None:
+            return self.process(evaluation)
+        return self.finalize_batched(evaluation, handle)
 
     # -------------------------------------------------------- single pass
 
