@@ -263,6 +263,27 @@ def cmd_job_deployments(args) -> int:
     return 0
 
 
+def cmd_job_allocs(args) -> int:
+    _out(_client(args).get(f"/v1/job/{args.job_id}/allocations"))
+    return 0
+
+
+def cmd_job_promote(args) -> int:
+    """reference: `nomad job promote` — promote the job's latest
+    deployment's canaries."""
+    c = _client(args)
+    deps = c.get(f"/v1/job/{args.job_id}/deployments")
+    if not deps:
+        print("Error: job has no deployments", file=sys.stderr)
+        return 1
+    latest = max(deps, key=lambda d: d.get("CreateIndex", 0))
+    out = c.put(f"/v1/deployment/promote/{latest['ID']}",
+                body={"All": True})
+    print(f"deployment {latest['ID'][:8]} promoted "
+          f"(modify index {out.get('DeploymentModifyIndex', '?')})")
+    return 0
+
+
 def cmd_operator_raft_list_peers(args) -> int:
     out = _client(args).get("/v1/operator/raft/configuration")
     for srv in out.get("Servers", []):
@@ -736,6 +757,12 @@ def build_parser() -> argparse.ArgumentParser:
     jde = job.add_parser("deployments")
     jde.add_argument("job_id")
     jde.set_defaults(fn=cmd_job_deployments)
+    jal = job.add_parser("allocs")
+    jal.add_argument("job_id")
+    jal.set_defaults(fn=cmd_job_allocs)
+    jpr = job.add_parser("promote")
+    jpr.add_argument("job_id")
+    jpr.set_defaults(fn=cmd_job_promote)
 
     node = sub.add_parser("node", help="node commands").add_subparsers(
         dest="node_cmd", required=True)

@@ -135,6 +135,17 @@ class EvalBroker:
                 out.append((nxt, self._issue_locked(nxt, now)))
         return out
 
+    def extend_outstanding(self, pairs, now: float) -> None:
+        """Restart the nack deadline for deliveries a worker is about to
+        process after holding them (the cross-batch prefetch window) —
+        prevents the tick loop from redelivering evals mid-processing."""
+        with self._lock:
+            for eval_id, token in pairs:
+                rec = self._outstanding.get(eval_id)
+                if rec is not None and rec[0] == token:
+                    self._outstanding[eval_id] = (
+                        token, now + self.nack_timeout, rec[2])
+
     def _issue_locked(self, ev: Evaluation, now: float) -> str:
         """Mint a delivery token + outstanding/redelivery bookkeeping —
         the single definition both dequeue paths share (nack/timeout

@@ -229,7 +229,7 @@ class Worker:
                 pending = None
         return {"batch": batch, "work": work, "pending": pending,
                 "prepared_idx": prepared_idx, "batch_id": batch_id,
-                "batch_seq0": batch_seq0, "snapshot": snapshot}
+                "batch_seq0": batch_seq0, "snapshot": snapshot, "t": t}
 
     def _finish_batch(self, pf, t: float, settled: set,
                       max_n: int) -> int:
@@ -237,7 +237,15 @@ class Worker:
         batch_id = pf["batch_id"]
         batch_seq0 = pf["batch_seq0"]
         self._snapshot = pf["snapshot"]
-        self._now = t
+        # a prefetched batch's schedulers were built with the PREVIOUS
+        # call's clock; eval updates (and their delayed follow-ups) must
+        # use that same clock, not this call's
+        self._now = pf["t"]
+        # the prefetched evals sat out the previous batch's host phase;
+        # restart their delivery deadlines so a long phase cannot expire
+        # them into redelivery while this worker is mid-processing
+        self.server.eval_broker.extend_outstanding(
+            [(ev.id, token) for ev, token in pf["batch"]], now=t)
         bds = {}
         if pf["pending"] is not None:
             decisions = self.server.engine.collect_batch(pf["pending"])
@@ -254,9 +262,11 @@ class Worker:
                 SCHEDULERS_SERVED, max_n, now=t, timeout=0.0)
             if nxt:
                 try:
+                    p = pf["pending"]
                     self._prefetch = self._start_batch(
                         nxt, t, chain=(batch_id, batch_seq0,
-                                       pf["pending"]["used"]))
+                                       (p["used"], p["node_version"],
+                                        p["npad"])))
                 except Exception as e:  # noqa: BLE001 - hand them back
                     log("worker", "warn", "prefetch dispatch failed",
                         worker=self.id, error=repr(e))

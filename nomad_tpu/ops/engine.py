@@ -860,13 +860,17 @@ class PlacementEngine:
         device computes while the host does other work — collect_batch
         blocks on the result).
 
-        `used0_dev`: device-side usage to start from INSTEAD of the
-        packer-synced state — the cross-batch chaining hook: a worker may
-        hand batch k's proposed-usage output in so batch k+1 computes
-        against it before batch k's plans commit.  Proposed usage is a
-        SUPERSET of committed usage (refuted/no-op plans only release
-        capacity), so chained decisions can under-pack but never
-        oversubscribe."""
+        `used0_dev`: a (usage array, node-table version, padded-n) triple
+        to start from INSTEAD of the packer-synced state — the
+        cross-batch chaining hook: a worker may hand batch k's
+        proposed-usage output in so batch k+1 computes against it before
+        batch k's plans commit.  Proposed usage is a SUPERSET of
+        committed usage (refuted/no-op plans only release capacity), so
+        chained decisions can under-pack but never oversubscribe.  The
+        version/padding guard matters: a node-table rebuild (membership
+        or attribute change) remaps rows, and per-node usage applied to
+        remapped rows would credit load to the wrong nodes — on any
+        mismatch the chain falls back to the packer-synced tensor."""
         if not items:
             return None
         t = self.packer.update(snapshot)
@@ -876,7 +880,13 @@ class PlacementEngine:
         t0 = time.perf_counter_ns()
         npad = self._padded_n(n)
         dev = self._node_arrays(t)
-        used0 = used0_dev if used0_dev is not None else self._used_device(t)
+        used0 = None
+        if used0_dev is not None:
+            arr, chain_ver, chain_npad = used0_dev
+            if chain_ver == t.version and chain_npad == npad:
+                used0 = arr
+        if used0 is None:
+            used0 = self._used_device(t)
         algo = snapshot.scheduler_config().scheduler_algorithm
 
         G = len(items)
@@ -986,6 +996,7 @@ class PlacementEngine:
         return {"buf": buf, "used": used_out, "items": list(items),
                 "spans": spans, "counts": counts, "rs": rs, "t": t,
                 "ctxs": ctxs, "n": n, "npad": npad,
+                "node_version": t.version,
                 "prep_ns": time.perf_counter_ns() - t0}
 
     def collect_batch(self, pending) -> List[Optional[BulkDecisions]]:

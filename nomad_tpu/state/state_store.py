@@ -545,7 +545,7 @@ class StateStore:
                 return "volume not found"
             if vol.read_allocs or vol.write_allocs:
                 return "volume has active claims"
-            self._bump()
+            self._bump_placement()
             vols = dict(self._csi_volumes)
             vols.pop((namespace, vol_id), None)
             self._csi_volumes = vols

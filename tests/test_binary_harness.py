@@ -1,0 +1,120 @@
+"""External-binary harness (reference: testutil/server.go — tests that
+shell out to a BUILT nomad binary with a config file and drive it over
+HTTP, rather than importing the server in-process).
+
+The analog here boots `python -m nomad_tpu agent` as a real subprocess
+and drives it through the public surfaces only: the HTTP API and the CLI
+binary.  This is the closest thing to the reference's external-binary
+tier this environment supports (no Go, no containers)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def agent_proc():
+    port = free_port()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nomad_tpu", "agent",
+         "-bind", f"127.0.0.1:{port}", "-clients", "1"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + 60
+    last = None
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace")
+            raise AssertionError(f"agent died at boot:\n{out[-2000:]}")
+        try:
+            with urllib.request.urlopen(base + "/v1/status/leader",
+                                        timeout=1) as r:
+                r.read()
+            break
+        except Exception as e:  # noqa: BLE001 - booting
+            last = e
+            time.sleep(0.25)
+    else:
+        proc.kill()
+        raise AssertionError(f"agent HTTP never came up: {last}")
+    try:
+        yield proc, base
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def cli(base, *args, check=True):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "nomad_tpu", "-address", base, *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+    if check:
+        assert r.returncode == 0, (args, r.stdout, r.stderr)
+    return r
+
+
+class TestExternalBinaryHarness:
+    def test_cli_job_lifecycle_against_live_binary(self, agent_proc):
+        proc, base = agent_proc
+        r = cli(base, "job", "run", "examples/web.hcl")
+        assert "registered" in r.stdout
+        # wait for a running alloc through the HTTP API
+        deadline = time.time() + 60
+        allocs = []
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    base + "/v1/job/web/allocations?namespace=default",
+                    timeout=5) as resp:
+                allocs = json.load(resp)
+            if allocs and any(a["ClientStatus"] == "running"
+                              for a in allocs):
+                break
+            time.sleep(0.3)
+        assert allocs, "no allocations appeared"
+        r = cli(base, "job", "status", "web")
+        assert "web" in r.stdout
+        r = cli(base, "eval", "list")
+        assert "job-register" in r.stdout or "web" in r.stdout
+        r = cli(base, "job", "stop", "web")
+        assert "stop" in r.stdout or "deregistered" in r.stdout
+
+    def test_node_and_operator_surface(self, agent_proc):
+        proc, base = agent_proc
+        with urllib.request.urlopen(base + "/v1/nodes", timeout=5) as r:
+            nodes = json.load(r)
+        assert nodes
+        r = cli(base, "node", "status")
+        assert nodes[0]["ID"][:8] in r.stdout
+        r = cli(base, "operator", "raft", "list-peers")
+        assert "leader" in r.stdout
+        r = cli(base, "version")
+        assert "nomad-tpu" in r.stdout
+
+    def test_snapshot_roundtrip_through_binary(self, agent_proc, tmp_path):
+        proc, base = agent_proc
+        snap = tmp_path / "state.snap"
+        r = cli(base, "operator", "snapshot", "save", str(snap))
+        assert snap.exists() and snap.stat().st_size > 10
