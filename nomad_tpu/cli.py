@@ -277,8 +277,7 @@ def cmd_job_promote(args) -> int:
         print("Error: job has no deployments", file=sys.stderr)
         return 1
     latest = max(deps, key=lambda d: d.get("CreateIndex", 0))
-    out = c.put(f"/v1/deployment/promote/{latest['ID']}",
-                body={"All": True})
+    out = c.deployments.promote(latest["ID"])
     print(f"deployment {latest['ID'][:8]} promoted "
           f"(modify index {out.get('DeploymentModifyIndex', '?')})")
     return 0

@@ -87,6 +87,10 @@ class StateStore:
         self._alloc_tables_shared = False
         self._fresh_node_buckets: set = set()
         self._fresh_job_buckets: set = set()
+        # volumes whose claim dicts were copied since the last snapshot
+        # (private to the head — claims mutate them in place; a busy
+        # volume otherwise paid a growing dict copy per PLAN)
+        self._fresh_claim_vols: set = set()
         # monotonic counter of writes that can change placement validity
         # (alloc inserts, node upserts/status, CSI volume changes) — the
         # plan applier's coupled-batch fast path compares it to prove
@@ -569,10 +573,15 @@ class StateStore:
             vol = changed.get(key) or self._csi_volumes.get(key)
             if vol is None:
                 continue
-            if key not in changed:
+            # copy-on-first-touch per snapshot-write cycle (same
+            # discipline as the alloc buckets): a volume copied since
+            # the last snapshot is private to the head and its claim
+            # dicts mutate in place
+            if key not in changed and key not in self._fresh_claim_vols:
                 vol = dataclasses.replace(
                     vol, read_allocs=dict(vol.read_allocs),
                     write_allocs=dict(vol.write_allocs))
+                self._fresh_claim_vols.add(key)
             if vreq.read_only:
                 vol.read_allocs[alloc.id] = True
             else:
@@ -865,6 +874,8 @@ class StateStore:
                               for t in self._acl_tokens.values()],
                 "Variables": [codec.encode(v)
                               for v in self._variables.values()],
+                "CSIVolumes": [codec.encode(v)
+                               for v in self._csi_volumes.values()],
                 "Services": [codec.encode(r)
                              for r in self._services.values()],
                 "SchedulerConfig": codec.encode(self._scheduler_config),
@@ -898,6 +909,7 @@ class StateStore:
             self._alloc_tables_shared = False
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
+            self._fresh_claim_vols = set()
             for d in doc["Allocs"]:
                 a = codec.decode(Allocation, d)
                 a.job = self._job_versions.get(
@@ -938,6 +950,10 @@ class StateStore:
                 r.id: r for r in
                 (codec.decode(ServiceRegistration, d)
                  for d in doc.get("Services", []))}
+            self._csi_volumes = {
+                (v.namespace, v.id): v for v in
+                (codec.decode(CSIVolume, d)
+                 for d in doc.get("CSIVolumes", []))}
             self._scheduler_config = codec.decode(
                 SC, doc.get("SchedulerConfig") or {})
             self._identity_secret = doc.get("IdentitySecret", "") or ""
@@ -964,6 +980,7 @@ class StateStore:
             self._alloc_tables_shared = True
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
+            self._fresh_claim_vols = set()
             return StateSnapshot(
                 store_id=self.store_id,
                 index=self._index,

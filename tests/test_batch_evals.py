@@ -296,6 +296,89 @@ class TestBatchedWorkerPath:
         assert err is None
         assert result.refuted_nodes == [node.id]
 
+    def test_cross_batch_prefetch_chain(self):
+        """Small eval_batch forces multiple coupled batches per drain:
+        the worker prefetch-chains batch k+1 on batch k's device-side
+        proposed usage.  Everything must still place exactly, without
+        refutes, and with the applier fast path active across batches."""
+        s = Server(dev_mode=True, eval_batch=4)
+        s.establish_leadership()
+        rng = random.Random(7)
+        for i in range(30):
+            n = mock.node()
+            n.datacenter = f"dc{1 + i % 3}"
+            n.resources.cpu = rng.choice([8000, 16000])
+            n.resources.memory_mb = 16384
+            s.register_node(n, now=NOW)
+        jobs = []
+        for _ in range(12):                      # 3 batches of 4
+            job = mock.batch_job()
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            job.task_groups[0].count = 15
+            job.task_groups[0].tasks[0].resources.cpu = 20
+            job.task_groups[0].tasks[0].resources.memory_mb = 16
+            s.register_job(job, now=NOW)
+            jobs.append(job)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        for job in jobs:
+            live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()]
+            assert len(live) == 15, (job.id, len(live))
+        assert s.workers[0].stats["nacked"] == 0
+        # chained batches share the fence: the fast path dominated
+        stats = s.plan_applier.stats
+        assert stats["fast_path"] >= 8, stats
+
+    def test_chain_resyncs_after_node_table_change(self):
+        """A node-table rebuild between chained batches remaps rows; the
+        chained usage must be dropped (version guard) — placements stay
+        valid."""
+        s = Server(dev_mode=True, eval_batch=4)
+        s.establish_leadership()
+        nodes = []
+        for _ in range(6):
+            n = mock.node()
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+            s.register_node(n, now=NOW)
+            nodes.append(n)
+        # wave 1 fills some capacity
+        first = []
+        for _ in range(4):
+            job = mock.batch_job()
+            job.task_groups[0].count = 3
+            job.task_groups[0].tasks[0].resources.cpu = 300
+            s.register_job(job, now=NOW)
+            first.append(job)
+        s.process_all(now=NOW)
+        # membership change rebuilds the node table (rows remap)
+        s.register_node(mock.node(), now=NOW + 1)
+        more = []
+        for _ in range(4):
+            job = mock.batch_job()
+            job.task_groups[0].count = 3
+            job.task_groups[0].tasks[0].resources.cpu = 300
+            s.register_job(job, now=NOW + 1)
+            more.append(job)
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        # capacity accounting stayed exact through the resync
+        for job in first + more:
+            live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()]
+            assert len(live) == 3
+        by_node = {}
+        for job in first + more:
+            for a in snap.allocs_by_job(job.namespace, job.id):
+                if not a.terminal_status():
+                    by_node[a.node_id] = (by_node.get(a.node_id, 0)
+                                          + a.resources.cpu)
+        for nid, cpu in by_node.items():
+            node = snap.node_by_id(nid)
+            usable = node.resources.cpu - node.reserved.cpu
+            assert cpu <= usable, (nid, cpu, usable)
+
     def test_preemption_falls_back_to_solo(self):
         from nomad_tpu.structs import (PreemptionConfig,
                                        SchedulerConfiguration)

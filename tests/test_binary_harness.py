@@ -38,7 +38,7 @@ def agent_proc():
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     base = f"http://127.0.0.1:{port}"
-    deadline = time.time() + 60
+    deadline = time.time() + 180
     last = None
     while time.time() < deadline:
         if proc.poll() is not None:
@@ -82,7 +82,7 @@ class TestExternalBinaryHarness:
         r = cli(base, "job", "run", "examples/web.hcl")
         assert "registered" in r.stdout
         # wait for a running alloc through the HTTP API
-        deadline = time.time() + 60
+        deadline = time.time() + 180
         allocs = []
         while time.time() < deadline:
             with urllib.request.urlopen(

@@ -172,6 +172,40 @@ class TestCSITopology:
         assert v2.read_allocs == {}
         assert s.volumes.stats["unpublish_failures"] == 2
 
+    def test_volumes_survive_snapshot_roundtrip(self):
+        """CSI volumes (with live claims and topology) must ride operator
+        snapshots — they are scheduling state, and a restore that loses
+        them leaves every volume-claiming job unschedulable."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        nodes = make_cluster(s, n=3)
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-snap", plugin_id="ebs0",
+            access_mode="single-node-writer",
+            topology_node_ids=(nodes[0].id,)))
+        job = csi_job("vol-snap", count=1, read_only=False)
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        vol = s.state.snapshot().csi_volume_by_id("default", "vol-snap")
+        assert vol.write_allocs
+        doc = s.save_snapshot()
+
+        s2 = Server(dev_mode=True)
+        s2.restore_snapshot(doc)
+        vol2 = s2.state.snapshot().csi_volume_by_id("default", "vol-snap")
+        assert vol2 is not None
+        assert vol2.plugin_id == "ebs0"
+        assert vol2.topology_node_ids == (nodes[0].id,)
+        assert set(vol2.write_allocs) == set(vol.write_allocs)
+        # a stale pre-restore volume must NOT survive into the restored
+        # state (restore REPLACES, not merges)
+        s3 = Server(dev_mode=True)
+        s3.establish_leadership()
+        s3.state.upsert_csi_volume(CSIVolume(id="ghost", plugin_id="x"))
+        s3.restore_snapshot(doc)
+        assert s3.state.snapshot().csi_volume_by_id("default",
+                                                    "ghost") is None
+
     def test_single_writer_claim_refused_at_apply(self):
         """single-node-writer: the second job's write claim is refused at
         the serialization point even though feasibility passes."""
