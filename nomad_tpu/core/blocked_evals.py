@@ -25,7 +25,15 @@ class BlockedEvals:
         # class-eligibility index: computed class -> set of job keys
         self._by_class: Dict[str, set] = {}
         self._escaped: set = set()
-        self.stats = {"blocked": 0, "unblocked": 0, "deduped": 0}
+        # state index of the newest capacity change seen (reference:
+        # blocked_evals.go unblockIndexes): an eval arriving to block
+        # whose scheduling snapshot PREDATES it raced a capacity change —
+        # park it and the change is missed forever; re-enqueue instead.
+        # One global watermark, not per-class: conservative (extra evals,
+        # never a stranded job).
+        self._last_unblock_index = 0
+        self.stats = {"blocked": 0, "unblocked": 0, "deduped": 0,
+                      "raced": 0}
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -49,6 +57,19 @@ class BlockedEvals:
                     return True      # same eval re-tracked (leader flap)
                 self.stats["deduped"] += 1
                 return False
+            if (evaluation.snapshot_index
+                    and evaluation.snapshot_index
+                    < self._last_unblock_index):
+                # capacity changed AFTER this eval's scheduling snapshot
+                # but BEFORE it reached the tracker: parking it would
+                # miss that unblock forever — retry immediately
+                e = evaluation.copy()
+                e.status = EVAL_STATUS_PENDING
+                e.status_description = ("unblocked: capacity changed "
+                                        "during scheduling")
+                self._broker.enqueue(e)
+                self.stats["raced"] += 1
+                return True
             self._blocked[key] = evaluation
             self.stats["blocked"] += 1
             if evaluation.escaped_computed_class or not evaluation.class_eligibility:
@@ -59,12 +80,16 @@ class BlockedEvals:
                         self._by_class.setdefault(klass, set()).add(key)
             return True
 
-    def unblock(self, computed_class: str, now: float = 0.0) -> int:
+    def unblock(self, computed_class: str, now: float = 0.0,
+                index: int = 0) -> int:
         """Capacity changed on a node of `computed_class`: release matching
-        blocked evals back to the broker."""
+        blocked evals back to the broker.  `index` is the state index of
+        the change (the block-time race guard's watermark)."""
         with self._lock:
             if not self._enabled:
                 return 0
+            if index > self._last_unblock_index:
+                self._last_unblock_index = index
             keys = set(self._escaped)
             keys |= self._by_class.pop(computed_class, set())
             released = 0

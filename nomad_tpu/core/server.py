@@ -571,13 +571,15 @@ class Server:
         (reference: BlockedEvals.Unblock wiring in nomad/fsm.go)."""
         if topic == "Node" and not isinstance(payload, str):
             if payload.ready():
-                self.blocked_evals.unblock(payload.computed_class)
+                self.blocked_evals.unblock(payload.computed_class,
+                                           index=index)
         elif topic == "Allocations":
             for a in payload:
                 if a.terminal_status() and a.node_id:
                     node = self.state.node_by_id(a.node_id)
                     if node is not None:
-                        self.blocked_evals.unblock(node.computed_class)
+                        self.blocked_evals.unblock(node.computed_class,
+                                                   index=index)
 
     # --------------------------------------------------------------- tick
 

@@ -48,6 +48,10 @@ class Worker:
         # dispatched (chained on the previous batch's device-side
         # proposed usage) while the previous batch's host phase ran
         self._prefetch = None
+        # when set (batched phase 3), planner eval updates buffer here
+        # and flush as ONE store transaction per settle window instead of
+        # one per eval (store-lock churn was a measurable wall slice)
+        self._defer_evals: Optional[List[Evaluation]] = None
 
     # ------------------------------------------------------------ running
 
@@ -295,24 +299,46 @@ class Worker:
             except Exception as e:  # noqa: BLE001 - finalize pass nacks
                 handles[i] = e
 
-        for i in coupled[:window]:
-            submit(i)
-        for pos, i in enumerate(coupled):
-            if pos + window < len(coupled):
-                submit(coupled[pos + window])
-            # finalize i right here so the window stays bounded
-            ev, token, sched, prep = work[i]
-            try:
-                h = handles.get(i)
-                if isinstance(h, Exception):
-                    err = h
-                else:
-                    err = (sched.finalize_batched(ev, h) if h is not None
-                           else sched.process(ev))    # solo fallback
-            except Exception as e:  # noqa: BLE001 - nack, don't die
-                err = e
-            self._settle(ev, token, err, t)
-            settled.add(ev.id)
+        # eval-status updates buffer and flush as ONE store transaction
+        # per settle window; an eval is only acked AFTER its status write
+        # flushed (ack-implies-persisted, like the solo path)
+        self._defer_evals = []
+        to_settle: List[tuple] = []
+
+        def flush_window():
+            if self._defer_evals:
+                self.server.apply_eval_update(self._defer_evals,
+                                              now=self._now)
+                self._defer_evals.clear()
+            for ev_, token_, err_ in to_settle:
+                self._settle(ev_, token_, err_, t)
+                settled.add(ev_.id)
+            to_settle.clear()
+
+        try:
+            for i in coupled[:window]:
+                submit(i)
+            for pos, i in enumerate(coupled):
+                if pos + window < len(coupled):
+                    submit(coupled[pos + window])
+                # finalize i right here so the window stays bounded
+                ev, token, sched, prep = work[i]
+                try:
+                    h = handles.get(i)
+                    if isinstance(h, Exception):
+                        err = h
+                    else:
+                        err = (sched.finalize_batched(ev, h)
+                               if h is not None
+                               else sched.process(ev))  # solo fallback
+                except Exception as e:  # noqa: BLE001 - nack, don't die
+                    err = e
+                to_settle.append((ev, token, err))
+                if len(to_settle) >= 16:
+                    flush_window()
+            flush_window()
+        finally:
+            self._defer_evals = None
         for i in [i for i in range(len(work)) if i not in bds]:
             ev, token, sched, prep = work[i]
             if sched is None:
@@ -375,18 +401,22 @@ class Worker:
             refreshed = self.server.state.snapshot()
         return result, refreshed, None
 
+    def _apply_or_defer(self, evaluation: Evaluation) -> None:
+        if self._defer_evals is not None:
+            self._defer_evals.append(evaluation)
+        else:
+            self.server.apply_eval_update([evaluation], now=self._now)
+
     def update_eval(self, evaluation: Evaluation) -> None:
-        self.server.apply_eval_update([evaluation], now=self._now)
-        if evaluation.status == "complete" and evaluation.failed_tg_allocs:
-            pass  # blocked eval creation handled by the scheduler
+        self._apply_or_defer(evaluation)
 
     def create_eval(self, evaluation: Evaluation) -> None:
-        self.server.apply_eval_update([evaluation], now=self._now)
+        self._apply_or_defer(evaluation)
 
     def reblock_eval(self, evaluation: Evaluation) -> None:
         # apply_eval_update routes blocked evals to the tracker (and
         # cancels duplicates)
-        self.server.apply_eval_update([evaluation], now=self._now)
+        self._apply_or_defer(evaluation)
 
     def serves_plan(self) -> bool:
         return True

@@ -101,6 +101,10 @@ class GenericScheduler(Scheduler):
             blocked = evaluation.create_blocked_eval(
                 class_eligibility={}, escaped=True,
                 failed_tg_allocs=self.failed_tg_allocs)
+            # the state index this scheduling pass saw: the blocked-evals
+            # tracker re-enqueues instead of parking when capacity
+            # changed after it (block-time race guard)
+            blocked.snapshot_index = getattr(self.state, "index", 0)
             self.planner.create_eval(blocked)
             evaluation.blocked_eval = blocked.id
         self._update_eval_status(evaluation, EVAL_STATUS_COMPLETE, "")

@@ -466,12 +466,13 @@ class TestEncryptedCluster:
                                heartbeat_interval=0.05,
                                election_timeout=(0.2, 0.4))
             s1.start(tick_interval=0.2)
+            servers.append(s1)   # appended as started: a failure
             s2 = ClusterServer("enc-2", bootstrap_expect=2,
                                join=[s1.gossip.addr],
                                heartbeat_interval=0.05,
                                election_timeout=(0.2, 0.4))
             s2.start(tick_interval=0.2)
-            servers = [s1, s2]
+            servers.append(s2)   # mid-setup still shuts down s1
             deadline = _t.time() + 20
             leader = None
             while _t.time() < deadline and leader is None:

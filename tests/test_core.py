@@ -481,3 +481,35 @@ class TestReviewRegressions:
             assert moved, "alloc never moved off the dead node in threaded mode"
         finally:
             s.shutdown()
+
+
+class TestBlockedEvalRaceGuard:
+    def test_stale_snapshot_block_requeues(self):
+        """A blocked eval whose scheduling snapshot predates the newest
+        capacity change must re-enqueue, not park — parking would miss
+        that unblock forever (reference: blocked_evals unblock indexes)."""
+        from nomad_tpu.structs import Evaluation
+
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        stale_index = s.state.latest_index()
+        # capacity change AFTER the snapshot the eval was scheduled on
+        s.register_node(mock.node(), now=NOW)
+        ev = Evaluation(job_id="raced-job", type="batch",
+                        status="blocked", snapshot_index=stale_index)
+        assert s.blocked_evals.block(ev)
+        assert s.blocked_evals.num_blocked() == 0      # not parked
+        assert s.blocked_evals.stats["raced"] == 1
+        assert s.eval_broker.pending_evals() == 1      # retrying instead
+
+    def test_fresh_snapshot_block_parks(self):
+        from nomad_tpu.structs import Evaluation
+
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.register_node(mock.node(), now=NOW)
+        ev = Evaluation(job_id="parked-job", type="batch",
+                        status="blocked",
+                        snapshot_index=s.state.latest_index())
+        assert s.blocked_evals.block(ev)
+        assert s.blocked_evals.num_blocked() == 1
