@@ -1,0 +1,33 @@
+# CSI volume claim: placement is restricted to nodes running the
+# volume's plugin AND inside its accessible topology; write claims on
+# single-node-writer volumes are enforced at the plan serialization
+# point.  Register the volume first:
+#   nomad-tpu volume register '{"ID": "pg-data", "PluginID": "ebs0",
+#                               "AccessMode": "single-node-writer"}'
+job "postgres" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "db" {
+    count = 1
+
+    volume "data" {
+      type      = "csi"
+      source    = "pg-data"
+      read_only = false
+    }
+
+    task "postgres" {
+      driver = "mock"
+
+      config {
+        run_for_s = 300
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}

@@ -1,0 +1,33 @@
+# Multiregion job: fans out one registration per federated region with
+# per-region count overrides (run against any agent of any region;
+# foreign regions are reached through the federation table).
+job "edge-cache" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  multiregion {
+    region "west" {
+      count = 3
+    }
+    region "east" {
+      count = 2
+    }
+  }
+
+  group "cache" {
+    count = 1   # overridden per region
+
+    task "memcached" {
+      driver = "mock"
+
+      config {
+        run_for_s = 300
+      }
+
+      resources {
+        cpu    = 200
+        memory = 128
+      }
+    }
+  }
+}

@@ -1,0 +1,29 @@
+# Secrets plane (the Vault seam): templates reference nomad variables
+# under the task's workload identity.  Seed the variable first:
+#   nomad-tpu var put nomad/jobs/db-app/creds user=app password=hunter2
+job "db-app" {
+  datacenters = ["dc1"]
+
+  group "app" {
+    count = 1
+
+    task "server" {
+      driver = "raw_exec"
+
+      config {
+        command = "/bin/sh"
+        args    = ["-c", "cat local/creds.env && sleep 300"]
+      }
+
+      template {
+        data        = "DB_USER=$${nomad_var.nomad/jobs/db-app/creds#user}\nDB_PASS=$${nomad_var.nomad/jobs/db-app/creds#password}\n"
+        destination = "local/creds.env"
+      }
+
+      resources {
+        cpu    = 100
+        memory = 64
+      }
+    }
+  }
+}

@@ -29,6 +29,11 @@ __all__ = ["parse", "parse_file", "parse_json", "parse_duration",
            "ParseError", "hcl_to_dict"]
 
 # Roots preserved verbatim for runtime interpolation.
+# NOTE: secrets references (${nomad_var.<path>#<key>}) are deliberately
+# NOT a runtime root: their paths contain '/' and '#', which HCL would
+# silently mangle as operators.  Jobspecs must escape them as
+# $${nomad_var...} (standard HCL2 literal-${ escaping) so the raw text
+# reaches the client's SecretsHook; unescaped uses fail loudly here.
 _RUNTIME_ROOTS = ("node", "attr", "meta", "env", "device", "NOMAD_*")
 
 
