@@ -261,10 +261,15 @@ class Router:
                 raise APIError(403, "permission denied: agent policy")
             return acl
         if head == "client":
-            # alloc fs/logs/stats need read-job in the alloc's namespace;
-            # the handler re-checks against the alloc's actual namespace
-            if not acl.allow_namespace_operation(ns, "read-job"):
-                raise APIError(403, "permission denied: needs read-job")
+            # alloc fs/logs/stats need read-job; exec needs alloc-exec.
+            # Accept EITHER here — the handler re-checks the exact
+            # capability against the alloc's actual namespace, so a
+            # least-privilege alloc-exec-only token is not rejected by
+            # the coarse pre-check
+            if not (acl.allow_namespace_operation(ns, "read-job")
+                    or acl.allow_namespace_operation(ns, "alloc-exec")):
+                raise APIError(403, "permission denied: needs read-job "
+                                    "or alloc-exec")
             return acl
         return acl
 
@@ -560,7 +565,8 @@ class Router:
                 s.force_gc()
                 return {}
         elif head == "client":
-            return self._client_fs(method, p[1:], ns, qs, acl)
+            return self._client_fs(method, p[1:], ns, qs, acl,
+                                   body=body)
         elif head == "status":
             if p[1:2] == ["leader"]:
                 if hasattr(s, "leader_rpc_addr"):   # cluster mode
@@ -879,7 +885,8 @@ class Router:
         raise APIError(404, "bad node pool request")
 
     def _client_fs(self, method: str, p: List[str], ns: str,
-                   qs: Dict[str, List[str]], acl=None) -> Any:
+                   qs: Dict[str, List[str]], acl=None,
+                   body: Optional[Dict] = None) -> Any:
         """/v1/client/* — alloc filesystem, task logs, alloc stats,
         served by the agent's in-process clients (reference:
         client/fs_endpoint.go + alloc stats, proxied by the HTTP agent).
@@ -893,8 +900,6 @@ class Router:
         """
         import os
         s = self.server
-        if method != "GET" or len(p) < 2:
-            raise APIError(404, "bad client request")
 
         def find_runner(alloc_id):
             for c in self.agent.clients:
@@ -903,14 +908,52 @@ class Router:
                     return c, ar
             raise APIError(404, "alloc not running on this agent")
 
-        def check_alloc_ns(alloc_id):
+        def check_alloc_ns(alloc_id, cap="read-job"):
             a = s.state.alloc_by_id(alloc_id)
             if a is None:
                 # fail CLOSED: a runner may outlive the server-side alloc
                 # (GC), and serving its files on the caller-chosen
                 # namespace's grant would leak across namespaces
                 raise APIError(404, "alloc not found")
-            self._check_ns(acl, a.namespace, "read-job")
+            self._check_ns(acl, a.namespace, cap)
+
+        if (method in ("PUT", "POST") and len(p) >= 3
+                and p[0] == "allocation" and p[2] == "exec"):
+            # non-interactive exec (reference: `nomad alloc exec`; the
+            # reference streams over websocket — this returns the
+            # command's combined output in one response)
+            import base64 as _b64
+            alloc_id = p[1]
+            check_alloc_ns(alloc_id, cap="alloc-exec")
+            _, ar = find_runner(alloc_id)
+            task = (body or {}).get("Task") or ""
+            if not task:
+                if len(ar.task_runners) != 1:
+                    # never guess among multiple tasks (the reference CLI
+                    # demands an explicit task name too)
+                    raise APIError(
+                        400, "alloc has multiple tasks; Task required")
+                task = ar.task_runners[0].task.name
+            cmd = (body or {}).get("Cmd") or []
+            if not cmd:
+                raise APIError(400, "Cmd required")
+            timeout = min(float((body or {}).get("Timeout") or 30.0),
+                          300.0)
+            tr = next((r for r in ar.task_runners
+                       if r.task.name == task), None)
+            if tr is None or tr.handle is None:
+                raise APIError(404, f"task {task!r} not running")
+            from nomad_tpu.client.drivers.base import DriverError
+            try:
+                out, code = tr.driver.exec_task(
+                    tr.handle, [str(c) for c in cmd], timeout=timeout)
+            except DriverError as e:
+                raise APIError(400, str(e))
+            return {"Output": _b64.b64encode(out).decode(),
+                    "ExitCode": code}
+
+        if method != "GET" or len(p) < 2:
+            raise APIError(404, "bad client request")
 
         if p[0] == "allocation" and p[2:3] == ["stats"]:
             alloc_id = p[1]

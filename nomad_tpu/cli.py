@@ -372,6 +372,19 @@ def cmd_alloc_fs(args) -> int:
     return 0
 
 
+def cmd_alloc_exec(args) -> int:
+    """reference: `nomad alloc exec` (non-interactive form)."""
+    import base64
+    body = {"Cmd": args.cmd}
+    if args.task:
+        body["Task"] = args.task
+    out = _client(args).put(
+        f"/v1/client/allocation/{args.alloc_id}/exec", body=body)
+    sys.stdout.write(base64.b64decode(out.get("Output", "")).decode(
+        errors="replace"))
+    return int(out.get("ExitCode", 0))
+
+
 def cmd_alloc_restart(args) -> int:
     _client(args).allocations.restart(args.alloc_id)
     print(f"restarted tasks of allocation {args.alloc_id}")
@@ -803,6 +816,13 @@ def build_parser() -> argparse.ArgumentParser:
     alfs.add_argument("-cat", action="store_true",
                       help="print the file instead of listing")
     alfs.set_defaults(fn=cmd_alloc_fs)
+    alx = alloc.add_parser("exec")
+    alx.add_argument("alloc_id")
+    alx.add_argument("-task", default="")
+    # REMAINDER: the command's own flags (ls -l, sh -c ...) must pass
+    # through untouched
+    alx.add_argument("cmd", nargs=argparse.REMAINDER)
+    alx.set_defaults(fn=cmd_alloc_exec)
     alrs = alloc.add_parser("restart")
     alrs.add_argument("alloc_id")
     alrs.set_defaults(fn=cmd_alloc_restart)

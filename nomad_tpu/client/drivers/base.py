@@ -75,6 +75,12 @@ class Driver:
     def signal_task(self, handle: TaskHandle, signal_num: int) -> None:
         raise DriverError(f"driver {self.name} does not support signals")
 
+    def exec_task(self, handle: TaskHandle, cmd, timeout: float = 30.0):
+        """Run `cmd` (argv list) inside the task's context and return
+        (combined output bytes, exit code) — the non-interactive form of
+        the reference's DriverPlugin.ExecTask (`nomad alloc exec`)."""
+        raise DriverError(f"driver {self.name} does not support exec")
+
     def recover_task(self, handle: TaskHandle) -> bool:
         """Reattach after agent restart. True if the task is still live."""
         return False

@@ -115,6 +115,19 @@ class DockerDriver(Driver):
         data = json.loads(r.stdout)
         return data[0] if data else None
 
+    def exec_task(self, handle, cmd, timeout: float = 30.0):
+        cid = handle.driver_state.get("container_id", "")
+        if not cid:
+            raise DriverError("no container for exec")
+        try:
+            r = subprocess.run(["docker", "exec", cid] + list(cmd),
+                               capture_output=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            raise DriverError("exec timed out")
+        except OSError as e:
+            raise DriverError(f"docker exec failed: {e}")
+        return r.stdout + r.stderr, r.returncode
+
     def wait_task(self, handle, timeout=None) -> Optional[TaskResult]:
         cid = handle.driver_state.get("container_id", "")
         deadline = None if timeout is None else time.time() + timeout

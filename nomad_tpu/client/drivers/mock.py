@@ -74,6 +74,11 @@ class MockDriver(Driver):
         return TaskHandle(task_id=task_id, driver=self.name,
                           driver_state={"config": dict(cfg)})
 
+    def exec_task(self, handle, cmd, timeout: float = 30.0):
+        """Deterministic fake exec: echoes the argv (tests drive the
+        alloc-exec plumbing without real processes)."""
+        return ("exec:" + " ".join(cmd)).encode() + b"\n", 0
+
     def wait_task(self, handle, timeout=None) -> Optional[TaskResult]:
         mt = self._tasks.get(handle.task_id)
         if mt is None:

@@ -73,6 +73,26 @@ class RawExecDriver(Driver):
                           driver_state={
                               "proc_start": _proc_start_ticks(proc.pid)})
 
+    def exec_task(self, handle, cmd, timeout: float = 30.0):
+        """Non-interactive exec inside the live task's working directory
+        (its sandbox) — reference: DriverPlugin.ExecTask backing
+        `nomad alloc exec`."""
+        # the task's live working directory IS the sandbox: refusing on
+        # an unreadable cwd (exited task, stale recovered pid) beats
+        # silently running the command in the agent's own cwd
+        try:
+            cwd = os.readlink(f"/proc/{handle.pid}/cwd")
+        except OSError:
+            raise DriverError("task process not available for exec")
+        try:
+            r = subprocess.run(list(cmd), cwd=cwd, capture_output=True,
+                               timeout=timeout)
+        except subprocess.TimeoutExpired:
+            raise DriverError("exec timed out")
+        except OSError as e:
+            raise DriverError(f"exec failed: {e}")
+        return r.stdout + r.stderr, r.returncode
+
     def wait_task(self, handle, timeout=None) -> Optional[TaskResult]:
         proc = self._procs.get(handle.task_id)
         if proc is None:

@@ -137,3 +137,32 @@ class TestCLI:
                    "speaker"])
         assert rc == 0
         assert "artifact.txt" in capsys.readouterr().out
+
+
+class TestAllocExec:
+    def test_exec_runs_in_task_sandbox(self, agent_with_job):
+        """Non-interactive `alloc exec` (reference: DriverPlugin.ExecTask):
+        the command runs in the live task's working directory."""
+        import base64
+        agent, alloc_id = agent_with_job
+        req = urllib.request.Request(
+            agent.address + f"/v1/client/allocation/{alloc_id}/exec",
+            data=json.dumps({"Cmd": ["cat", "artifact.txt"]}).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["ExitCode"] == 0
+        assert base64.b64decode(out["Output"]).decode().strip() == "data"
+
+    def test_exec_nonzero_exit(self, agent_with_job):
+        import base64
+        agent, alloc_id = agent_with_job
+        req = urllib.request.Request(
+            agent.address + f"/v1/client/allocation/{alloc_id}/exec",
+            data=json.dumps({"Cmd": ["/bin/sh", "-c",
+                                     "echo boom >&2; exit 3"]}).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["ExitCode"] == 3
+        assert "boom" in base64.b64decode(out["Output"]).decode()
