@@ -380,8 +380,10 @@ def cmd_alloc_exec(args) -> int:
         body["Task"] = args.task
     out = _client(args).put(
         f"/v1/client/allocation/{args.alloc_id}/exec", body=body)
-    sys.stdout.write(base64.b64decode(out.get("Output", "")).decode(
-        errors="replace"))
+    # raw bytes to stdout: decode-with-replace would corrupt binary
+    # output (e.g. `alloc exec <id> cat binary > out`)
+    sys.stdout.buffer.write(base64.b64decode(out.get("Output", "")))
+    sys.stdout.buffer.flush()
     return int(out.get("ExitCode", 0))
 
 

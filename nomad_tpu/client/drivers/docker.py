@@ -122,8 +122,14 @@ class DockerDriver(Driver):
         try:
             r = subprocess.run(["docker", "exec", cid] + list(cmd),
                                capture_output=True, timeout=timeout)
-        except subprocess.TimeoutExpired:
-            raise DriverError("exec timed out")
+        except subprocess.TimeoutExpired as e:
+            partial = ((e.stdout or b"") + (e.stderr or b""))[-2048:]
+            # killing the local docker-exec client does NOT reap the
+            # in-container process; say so instead of pretending
+            raise DriverError(
+                "exec timed out (the in-container process may still be "
+                "running); partial output: "
+                + partial.decode(errors="replace"))
         except OSError as e:
             raise DriverError(f"docker exec failed: {e}")
         return r.stdout + r.stderr, r.returncode

@@ -78,8 +78,12 @@ class RawExecDriver(Driver):
         (its sandbox) — reference: DriverPlugin.ExecTask backing
         `nomad alloc exec`."""
         # the task's live working directory IS the sandbox: refusing on
-        # an unreadable cwd (exited task, stale recovered pid) beats
-        # silently running the command in the agent's own cwd
+        # an unreadable cwd (exited task) beats silently running the
+        # command in the agent's own cwd — and the pid-reuse check keeps
+        # a RECYCLED pid (whose /proc entry is readable but belongs to a
+        # stranger) from leaking an arbitrary directory
+        if not self._same_process(handle):
+            raise DriverError("task process not available for exec")
         try:
             cwd = os.readlink(f"/proc/{handle.pid}/cwd")
         except OSError:
@@ -87,8 +91,11 @@ class RawExecDriver(Driver):
         try:
             r = subprocess.run(list(cmd), cwd=cwd, capture_output=True,
                                timeout=timeout)
-        except subprocess.TimeoutExpired:
-            raise DriverError("exec timed out")
+        except subprocess.TimeoutExpired as e:
+            partial = ((e.stdout or b"") + (e.stderr or b""))[-2048:]
+            raise DriverError(
+                "exec timed out; partial output: "
+                + partial.decode(errors="replace"))
         except OSError as e:
             raise DriverError(f"exec failed: {e}")
         return r.stdout + r.stderr, r.returncode

@@ -1,0 +1,122 @@
+"""Randomized validity properties: every placement any kernel path emits
+must satisfy the independently-written host oracles — capacity
+(structs.allocs_fit), static constraints (re-derived checkConstraint),
+datacenter membership, and distinct_hosts — across random clusters and
+random jobs.  Catches whole classes of lowering/padding/masking bugs the
+hand-built scenario tests can't enumerate."""
+
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import Constraint, allocs_fit
+
+from test_ops import host_check  # the independent constraint oracle
+
+NOW = 1.7e9
+
+OPS = [("=", lambda v: v), ("!=", lambda v: v),
+       ("set_contains_any", lambda v: f"{v},zzz"),
+       ("regexp", lambda v: v[:2])]
+
+
+def random_cluster(rng, n_nodes):
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.datacenter = f"dc{1 + rng.randrange(3)}"
+        n.attributes["rack"] = f"r{rng.randrange(4)}"
+        n.attributes["gen"] = str(rng.randrange(3))
+        n.resources.cpu = rng.choice([2000, 4000, 8000])
+        n.resources.memory_mb = rng.choice([4096, 8192])
+        nodes.append(n)
+    return nodes
+
+
+def random_job(rng, i):
+    job = mock.batch_job()
+    job.datacenters = rng.sample(["dc1", "dc2", "dc3"],
+                                 k=rng.randrange(1, 4))
+    tg = job.task_groups[0]
+    tg.count = rng.randrange(1, 40)
+    t = tg.tasks[0]
+    t.resources.cpu = rng.choice([50, 200, 700])
+    t.resources.memory_mb = rng.choice([32, 128, 512])
+    cons = []
+    if rng.random() < 0.7:
+        attr = rng.choice(["rack", "gen"])
+        target = f"r{rng.randrange(4)}" if attr == "rack" \
+            else str(rng.randrange(3))
+        op, mk = rng.choice(OPS)
+        cons.append(Constraint(f"${{attr.{attr}}}", op, mk(target)))
+    if rng.random() < 0.2:
+        cons.append(Constraint("", "distinct_hosts", "2"))
+    tg.constraints = cons
+    return job
+
+
+def node_props(n):
+    out = {"node.datacenter": n.datacenter}
+    for k, v in n.attributes.items():
+        out["attr." + k] = v
+    return out
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_random_workloads_place_validly(seed):
+    rng = random.Random(seed)
+    s = Server(dev_mode=True, eval_batch=rng.choice([0, 8, 64]))
+    s.establish_leadership()
+    nodes = random_cluster(rng, rng.randrange(20, 120))
+    s.state.upsert_nodes(nodes)
+    by_id = {n.id: n for n in nodes}
+    jobs = [random_job(rng, i) for i in range(rng.randrange(4, 16))]
+    for j in jobs:
+        s.register_job(j, now=NOW)
+    s.process_all(now=NOW)
+    snap = s.state.snapshot()
+
+    total_live = 0
+    for job in jobs:
+        tg = job.task_groups[0]
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        total_live += len(live)
+        assert len(live) <= tg.count
+        per_node = {}
+        for a in live:
+            node = by_id[a.node_id]
+            props = node_props(node)
+            # datacenter membership
+            assert node.datacenter in job.datacenters, (
+                job.id, node.datacenter, job.datacenters)
+            # every static constraint holds on the chosen node
+            for c in tg.constraints:
+                if c.operand == "distinct_hosts":
+                    continue
+                assert host_check(props, c), (job.id, c, props)
+            per_node[a.node_id] = per_node.get(a.node_id, 0) + 1
+        # distinct_hosts limit
+        for c in tg.constraints:
+            if c.operand == "distinct_hosts":
+                limit = int(c.rtarget)
+                assert all(v <= limit for v in per_node.values()), (
+                    job.id, per_node)
+        # unplaced remainder must be accounted: blocked eval or failed
+        if len(live) < tg.count:
+            evs = snap.evals_by_job(job.namespace, job.id)
+            assert any(e.status in ("blocked", "pending", "failed")
+                       for e in evs), (job.id, len(live), tg.count,
+                                       [e.status for e in evs])
+
+    # capacity: the committed alloc set fits every node per the oracle
+    for n in nodes:
+        allocs = [a for a in snap.allocs_by_node(n.id)
+                  if not a.terminal_status()]
+        if not allocs:
+            continue
+        ok, dim, _ = allocs_fit(n, allocs)
+        assert ok, (n.id, dim, len(allocs))
+    assert total_live > 0     # the scenario actually exercised placement
