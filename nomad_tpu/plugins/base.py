@@ -27,6 +27,8 @@ and ships the file; the client's PluginManager discovers it in
 from __future__ import annotations
 
 import json
+from collections import deque
+import itertools
 import os
 import socket
 import struct
@@ -162,7 +164,10 @@ class PluginClient:
                 self.proc.kill()
 
 
-def launch_plugin(cmd, socket_dir: str, timeout: float = 20.0,
+_LAUNCH_SEQ = itertools.count()
+
+
+def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
                   ) -> PluginClient:
     """Launch a plugin executable and perform the handshake
     (reference: go-plugin Client.Start)."""
@@ -178,10 +183,32 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 20.0,
         env["PYTHONPATH"] = (sdk_root + (os.pathsep + prev if prev else ""))
     sock_path = os.path.join(
         socket_dir, f"plugin-{os.getpid()}-{threading.get_ident()}-"
-        f"{abs(hash(tuple(cmd))) % 99999}.sock")
+        f"{next(_LAUNCH_SEQ)}.sock")
     env[SOCKET_ENV] = sock_path
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.DEVNULL, env=env)
+    # stderr is drained by a daemon thread into the bounded log ring —
+    # NOT DEVNULL (a crashing child's traceback is the only diagnosis
+    # there is), NOT an undrained pipe (blocks a chatty child at 64KB),
+    # NOT a temp file (a long-lived chatty plugin would grow unlinked
+    # disk invisibly).  The tail deque feeds launch-failure messages;
+    # later stderr stays observable via `monitor`.
+    from nomad_tpu.core.logging import log as _log
+    err_tail: deque = deque(maxlen=30)
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env)
+    except OSError as e:
+        raise PluginError(f"plugin launch failed: {e}") from e
+
+    def _drain():
+        for raw in proc.stderr:
+            line = raw.decode(errors="replace").rstrip()
+            if line:
+                err_tail.append(line)
+                _log("plugins", "debug", "plugin stderr",
+                     cmd=cmd[-1], line=line)
+
+    threading.Thread(target=_drain, daemon=True,
+                     name="plugin-stderr").start()
     tmp: Optional[PluginClient] = None
     try:
         line = _read_handshake_line(proc, timeout)
@@ -205,14 +232,22 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 20.0,
         return tmp
     except Exception as e:
         # never leak the subprocess, and surface everything as PluginError
-        # so callers have ONE failure type to supervise on
+        # (WITH the child's stderr tail — the only diagnosis a startup
+        # crash leaves) so callers have ONE failure type to supervise on
         if tmp is not None:
             tmp.close()
         elif proc.poll() is None:
             proc.kill()
-        if isinstance(e, PluginError):
-            raise
-        raise PluginError(f"plugin launch failed: {e}") from e
+        try:
+            proc.wait(timeout=3)   # lets the drain thread see EOF
+        except Exception:  # noqa: BLE001 - diagnosis is best-effort
+            pass
+        msg = f"{e}" if isinstance(e, PluginError) else \
+            f"plugin launch failed: {e}"
+        tail = "\n".join(list(err_tail)[-8:])
+        if tail:
+            msg += f"; child stderr: {tail[-500:]}"
+        raise PluginError(msg) from e
 
 
 def _read_handshake_line(proc: subprocess.Popen, timeout: float) -> str:

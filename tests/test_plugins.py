@@ -36,7 +36,7 @@ class TestProtocol:
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         p = subprocess.run(
             [sys.executable, os.path.join(PLUGDIR, "hello_driver.py")],
-            capture_output=True, timeout=30, env=env)
+            capture_output=True, timeout=120, env=env)
         assert p.returncode == 1
         assert b"plugin manager" in p.stderr
 
@@ -46,7 +46,7 @@ class TestProtocol:
         import sys
         with pytest.raises(PluginError):
             launch_plugin([sys.executable, str(bad)],
-                          str(tmp_path / "socks"), timeout=10.0)
+                          str(tmp_path / "socks"), timeout=60.0)
 
 
 class TestExternalDriver:
@@ -59,7 +59,7 @@ class TestExternalDriver:
         task.config = {"message": "hi", "run_for_s": 0.2}
         h = drv.start_task("t1", task, {"NOMAD_TASK_NAME": "web"}, "")
         assert h.pid > 0
-        res = drv.wait_task(h, timeout=10.0)
+        res = drv.wait_task(h, timeout=60.0)
         assert res is not None and res.successful()
 
     def test_stop_task(self, manager):
@@ -69,7 +69,7 @@ class TestExternalDriver:
         h = drv.start_task("t2", task, {}, "")
         assert drv.recover_task(h)
         drv.stop_task(h, kill_timeout=2.0)
-        res = drv.wait_task(h, timeout=10.0)
+        res = drv.wait_task(h, timeout=60.0)
         assert res is not None
 
     def test_concurrent_wait_does_not_block_other_calls(self, manager):
@@ -96,6 +96,14 @@ class TestSupervision:
     def test_crashed_plugin_relaunched(self, tmp_path):
         m = PluginManager(PLUGDIR, socket_dir=str(tmp_path / "socks"))
         m.scan()
+        if "hello" not in m.drivers:
+            # cold interpreter starts on a loaded host can outlast even
+            # the manager's internal retries; one more scan, and carry
+            # the log ring into the assertion so a real failure explains
+            # itself
+            m.scan()
+        from nomad_tpu.core.logging import RING
+        assert "hello" in m.drivers, RING.tail(6)
         try:
             drv = m.drivers["hello"]
             assert drv.fingerprint()
@@ -105,7 +113,8 @@ class TestSupervision:
             time.sleep(0.2)
             assert drv.fingerprint() == {}      # dead connection
             m.start_supervisor(interval=0.5)
-            deadline = time.time() + 20
+            # relaunch spawns a fresh interpreter; allow for a loaded host
+            deadline = time.time() + 90
             while time.time() < deadline:
                 if drv.fingerprint().get("driver.hello") == "1":
                     break
