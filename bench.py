@@ -505,12 +505,29 @@ def run_config_5(args):
     # every kernel compile happens here (tiny asks -> negligible capacity)
     run_wave(batch, per_eval, cpu=1, mem=1, tag="warmup")
 
-    dt, wave_jobs = run_wave(n_evals, per_eval, cpu=10, mem=10,
-                             tag="measure")
+    # best of --iters measured waves, like configs 2-4: the shared
+    # host's steal/iowait noise swings single runs ~30%.  Later waves
+    # run against an increasingly loaded cluster (state accumulates), so
+    # the FIRST wave anchors the quality comparison (stock places on an
+    # empty zoned cluster) and each wave's plan-queue latencies are
+    # isolated — the report carries the winning wave's quantiles only.
+    iters = max(args.iters, 1)
+    dt = None
+    q = None
+    first_jobs = None
+    for i in range(iters):
+        s.plan_queue.latencies.clear()
+        dt_i, jobs_i = run_wave(n_evals, per_eval, cpu=10, mem=10,
+                                tag=f"measure{i}")
+        q_i = s.plan_queue.latency_quantiles((0.5, 0.99))
+        if first_jobs is None:
+            first_jobs = jobs_i
+        if dt is None or dt_i < dt:
+            dt, q = dt_i, q_i
+    wave_jobs = first_jobs
     n_place = n_evals * per_eval
     evals_per_sec = n_evals / dt
     tpu_rate = n_place / dt
-    q = s.plan_queue.latency_quantiles((0.5, 0.99))
 
     # baseline: compiled stock emulation placing the same allocs
     # sequentially at the same node scale with the SAME per-zone
@@ -545,6 +562,7 @@ def run_config_5(args):
             "p50_plan_queue_ms": round(q["p50"] * 1000, 2),
             "placements_per_sec": round(tpu_rate, 1),
             "n_evals": n_evals, "placements_per_eval": per_eval,
+            "runs": iters,
             "baseline_compiled_stock_per_sec": round(base_rate_c, 1),
             "baseline_compiled_stock_evals_per_sec":
                 round(base_evals_per_sec, 3),

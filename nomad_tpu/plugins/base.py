@@ -199,16 +199,19 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
     except OSError as e:
         raise PluginError(f"plugin launch failed: {e}") from e
 
-    def _drain():
-        for raw in proc.stderr:
+    def _drain(stream, label):
+        for raw in stream:
             line = raw.decode(errors="replace").rstrip()
             if line:
-                err_tail.append(line)
-                _log("plugins", "debug", "plugin stderr",
+                if label == "stderr":
+                    err_tail.append(line)
+                _log("plugins", "debug", f"plugin {label}",
                      cmd=cmd[-1], line=line)
 
-    threading.Thread(target=_drain, daemon=True,
-                     name="plugin-stderr").start()
+    drain_t = threading.Thread(target=_drain,
+                               args=(proc.stderr, "stderr"),
+                               daemon=True, name="plugin-stderr")
+    drain_t.start()
     tmp: Optional[PluginClient] = None
     try:
         line = _read_handshake_line(proc, timeout)
@@ -229,6 +232,10 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
         tmp = PluginClient(proc, sock, {})
         info = tmp.call("plugin_info", timeout=timeout)
         tmp.info = info
+        # post-handshake stdout also needs a drain: a handler print()ing
+        # diagnostics would otherwise block the plugin at the 64KB pipe
+        threading.Thread(target=_drain, args=(proc.stdout, "stdout"),
+                         daemon=True, name="plugin-stdout").start()
         return tmp
     except Exception as e:
         # never leak the subprocess, and surface everything as PluginError
@@ -239,12 +246,16 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
         elif proc.poll() is None:
             proc.kill()
         try:
-            proc.wait(timeout=3)   # lets the drain thread see EOF
+            proc.wait(timeout=3)
+            drain_t.join(timeout=1)   # let the tail settle before reading
         except Exception:  # noqa: BLE001 - diagnosis is best-effort
             pass
         msg = f"{e}" if isinstance(e, PluginError) else \
             f"plugin launch failed: {e}"
-        tail = "\n".join(list(err_tail)[-8:])
+        try:
+            tail = "\n".join(list(err_tail)[-8:])
+        except RuntimeError:          # drain still appending: one retry
+            tail = "\n".join(list(err_tail)[-8:])
         if tail:
             msg += f"; child stderr: {tail[-500:]}"
         raise PluginError(msg) from e
