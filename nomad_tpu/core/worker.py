@@ -38,6 +38,7 @@ class Worker:
         self.stats = {"invoked": 0, "acked": 0, "nacked": 0}
         # set per-eval by process():
         self._snapshot = None
+        self._snapshot_seq: Optional[int] = None
         self._eval_token = ""
         # the timebase of the eval currently being processed: eval
         # updates (and their delayed follow-ups) must use the SAME clock
@@ -241,6 +242,7 @@ class Worker:
         batch_id = pf["batch_id"]
         batch_seq0 = pf["batch_seq0"]
         self._snapshot = pf["snapshot"]
+        self._snapshot_seq = batch_seq0
         # a prefetched batch's schedulers were built with the PREVIOUS
         # call's clock; eval updates (and their delayed follow-ups) must
         # use that same clock, not this call's
@@ -359,7 +361,8 @@ class Worker:
         # wait for the state to catch up to the eval (waitForIndex)
         if evaluation.modify_index:
             state.wait_for_index(evaluation.modify_index, timeout=5.0)
-        self._snapshot = state.snapshot()
+        self._snapshot, self._snapshot_seq = \
+            state.snapshot_and_placement_seq()
         self.stats["invoked"] += 1
         if evaluation.type == "_core":
             kwargs = {"now": now, "store": state}
@@ -377,7 +380,12 @@ class Worker:
     def submit_plan_async(self, plan: Plan):
         """Enqueue a plan WITHOUT waiting for the applier — the batched
         path submits a whole chain first and collects results after, so
-        plan apply overlaps the next plan's materialization."""
+        plan apply overlaps the next plan's materialization.
+
+        Solo plans are fence-tagged by their SCHEDULER (generic/system)
+        from the snapshot they were actually computed against — never
+        from mutable worker state, which can advance past a stale
+        scheduler's view mid-batch."""
         plan.snapshot_index = self._snapshot.index if self._snapshot else 0
         pending = self.server.plan_queue.enqueue(plan)
         # the applier thread evaluates + commits; in single-threaded test
@@ -387,8 +395,12 @@ class Worker:
 
     def refreshed_snapshot(self):
         """Fresh state view after a partial commit (the retry loop must
-        see the refuting writes)."""
-        return self.server.state.snapshot()
+        see the refuting writes) — the fence tracks it so the retry's
+        next plan may fast-path again."""
+        snap, self._snapshot_seq = \
+            self.server.state.snapshot_and_placement_seq()
+        self._snapshot = snap
+        return snap
 
     def submit_plan(self, plan: Plan
                     ) -> Tuple[Optional[PlanResult], object, Optional[Exception]]:
@@ -398,7 +410,7 @@ class Worker:
             return None, None, err
         refreshed = None
         if result is not None and result.refuted_nodes:
-            refreshed = self.server.state.snapshot()
+            refreshed = self.refreshed_snapshot()
         return result, refreshed, None
 
     def _apply_or_defer(self, evaluation: Evaluation) -> None:

@@ -215,6 +215,11 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
     tmp: Optional[PluginClient] = None
     try:
         line = _read_handshake_line(proc, timeout)
+        # stdout drains from here on — BEFORE the plugin_info RPC: a
+        # plugin print()ing >64KB between its handshake line and that
+        # reply would wedge on the full pipe and time the launch out
+        threading.Thread(target=_drain, args=(proc.stdout, "stdout"),
+                         daemon=True, name="plugin-stdout").start()
         parts = line.strip().split("|")
         if len(parts) < 5 or parts[2] != "unix" or parts[4] != "json":
             raise PluginError(f"bad plugin handshake line: {line!r}")
@@ -232,10 +237,6 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
         tmp = PluginClient(proc, sock, {})
         info = tmp.call("plugin_info", timeout=timeout)
         tmp.info = info
-        # post-handshake stdout also needs a drain: a handler print()ing
-        # diagnostics would otherwise block the plugin at the 64KB pipe
-        threading.Thread(target=_drain, args=(proc.stdout, "stdout"),
-                         daemon=True, name="plugin-stdout").start()
         return tmp
     except Exception as e:
         # never leak the subprocess, and surface everything as PluginError

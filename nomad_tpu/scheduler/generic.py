@@ -354,6 +354,15 @@ class GenericScheduler(Scheduler):
         if plan.is_no_op():
             return True, None
 
+        # fence-tag from THE snapshot this pass computed against (a chain
+        # of length 1): while the applier's placement fence proves no
+        # foreign write intervened, its per-node re-fit is provably
+        # redundant — the kernels enforced capacity against this exact
+        # state.  The re-check exists for optimistic concurrency, which
+        # the fence detects precisely.
+        fence = getattr(self.state, "placement_fence", None)
+        if fence is not None:
+            plan.coupled_batch = (evaluation.id, fence)
         result, refreshed_state, err = self.planner.submit_plan(plan)
         if err is not None:
             return False, err

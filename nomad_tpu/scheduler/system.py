@@ -208,6 +208,13 @@ class SystemScheduler(Scheduler):
 
     def _submit(self, plan: Plan, evaluation: Evaluation):
         if not plan.is_no_op():
+            # chain-of-1 fence tag (see generic._process_once): this
+            # scheduler ran allocs_fit per node itself against this
+            # snapshot, so the applier's re-fit is redundant while the
+            # fence holds
+            fence = getattr(self.state, "placement_fence", None)
+            if fence is not None:
+                plan.coupled_batch = (evaluation.id, fence)
             _, _, err = self.planner.submit_plan(plan)
             if err is not None:
                 self._update_eval(evaluation, "failed", str(err))

@@ -971,7 +971,8 @@ class StateStore:
         missing from the snapshot (the applier would then skip the fit
         re-check against state the scheduler never saw)."""
         with self._lock:
-            return self.snapshot(), self._placement_seq
+            snap = self.snapshot()
+            return snap, snap.placement_fence
 
     def snapshot(self) -> "StateSnapshot":
         with self._lock:
@@ -982,6 +983,7 @@ class StateStore:
             self._fresh_job_buckets = set()
             self._fresh_claim_vols = set()
             return StateSnapshot(
+                placement_fence=self._placement_seq,
                 store_id=self.store_id,
                 index=self._index,
                 nodes=self._nodes,
@@ -1047,9 +1049,13 @@ class StateSnapshot:
     def __init__(self, index, nodes, jobs, job_versions, evals, allocs,
                  deployments, namespaces, node_pools, csi_volumes,
                  scheduler_config, allocs_by_node, allocs_by_job,
-                 evals_by_job, store_id=""):
+                 evals_by_job, store_id="", placement_fence=None):
         self.store_id = store_id
         self.index = index
+        # the placement-write counter AT this snapshot (see StateStore
+        # placement_seq): plans computed from this snapshot carry it so
+        # the applier can prove its fit re-check redundant
+        self.placement_fence = placement_fence
         self._nodes = nodes
         self._jobs = jobs
         self._job_versions = job_versions
