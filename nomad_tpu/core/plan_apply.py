@@ -30,6 +30,13 @@ from nomad_tpu.structs import (
     allocs_fit,
 )
 
+# _node_plan_ok verdicts: claim refusals are RETRIABLE within the plan's
+# fixpoint pass (a later node's accepted release can clear them); node-down
+# and fit failures are final.
+NODE_OK = 0
+NODE_REFUSED = 1
+NODE_CLAIM_REFUSED = 2
+
 
 @dataclass
 class PendingPlan:
@@ -182,7 +189,10 @@ class PlanApplier:
                 if self._chain is None or self._chain[0] != bid:
                     self._chain = (bid, seq0)
                 fast = seq_now == self._chain[1]
-            result = self.evaluate_plan(plan, skip_fit=fast)
+            result = self.evaluate_plan(
+                plan, skip_fit=fast,
+                fenced_first=(fast and plan.coupled_batch is not None
+                              and seq_now == plan.coupled_batch[1]))
             idx = self.state.upsert_plan_results(
                 plan, result, expected_placement_seq=seq_now if fast
                 else None)
@@ -208,13 +218,16 @@ class PlanApplier:
             self._chain = None
             pending.respond(None, e)
 
-    def evaluate_plan(self, plan: Plan, skip_fit: bool = False
-                      ) -> PlanResult:
+    def evaluate_plan(self, plan: Plan, skip_fit: bool = False,
+                      fenced_first: bool = False) -> PlanResult:
         """Re-check each touched node against the latest snapshot; refuted
         nodes are dropped from the result (partial commit).
         reference: evaluatePlan / evaluateNodePlan.  `skip_fit` is the
         coupled-batch fast path (see apply_one): node existence/status and
-        CSI claims are still checked, only AllocsFit is skipped."""
+        CSI claims are still checked, only AllocsFit is skipped.
+        `fenced_first`: the plan sits at its chain's FIRST position (no
+        prior chain commit exists), so host-assigned ports/devices cannot
+        collide with a batch-mate and need not demote the skip."""
         snap = self.state.snapshot()
         result = PlanResult(
             node_update=dict(plan.node_update),
@@ -222,27 +235,107 @@ class PlanApplier:
             deployment=plan.deployment,
             deployment_updates=plan.deployment_updates,
         )
+        if (skip_fit and not fenced_first
+                and self._carries_host_assigned(plan)):
+            # Ports and device instances are HOST-assigned state the device
+            # fence does not couple: plans of one batch assign from private
+            # indexes over the same snapshot and can collide even behind an
+            # intact fence — the fit re-check (which carries the collision
+            # detection) must run for such plans.  Exception: at the FIRST
+            # chain position (placement_seq still equals the plan's own
+            # snapshot fence) no batch-mate has committed, so there is no
+            # counterpart to collide with and the skip stays safe — this
+            # keeps the fence optimization for solo fenced plans (the
+            # system scheduler's chain-of-1) and the head of every batch.
+            skip_fit = False
         self.stats["fast_path" if skip_fit else "full_check"] += 1
-        for node_id, new_allocs in plan.node_allocation.items():
-            if self._node_plan_ok(snap, plan, node_id, new_allocs,
-                                  skip_fit=skip_fit):
-                result.node_allocation[node_id] = new_allocs
-            else:
-                result.refuted_nodes.append(node_id)
-                # stops/preemptions for a refuted node are also withheld
-                result.node_update.pop(node_id, None)
-                result.node_preemptions.pop(node_id, None)
+        # write claims accumulated by ALREADY-ACCEPTED nodes of THIS plan:
+        # without it two writers to a single-writer volume inside one plan
+        # are each checked against the pre-plan claim set and both commit
+        plan_claims: Dict[Tuple[str, str], int] = {}
+        # Alloc removals whose commit is certain so far: stops/preemptions
+        # on nodes with no placements always commit (only placement nodes
+        # refute), and a placement node's removals join once it is
+        # ACCEPTED.  Crediting the whole plan's removals up front would let
+        # a writer admitted on the strength of a release commit while the
+        # releasing node refutes and the release is withheld.
+        committed_releases: set = set()
+        for removals in (plan.node_update, plan.node_preemptions):
+            for node_id, allocs in removals.items():
+                if node_id not in plan.node_allocation:
+                    committed_releases.update(a.id for a in allocs)
+        # Releasing nodes first (fewer passes), then iterate to a
+        # FIXPOINT: a node refused on a claim may become admissible once a
+        # later node accepts and its releases join the credit — without
+        # the loop, acceptance would depend on dict insertion order.
+        # Release CYCLES (a two-node writer swap) still refute both sides:
+        # per-node partial commit cannot guarantee both halves land, and
+        # admitting one on a credit that may be withheld is the exact bug
+        # this accounting exists to prevent.  Plans without volume claims
+        # accept every node in pass one — no extra cost.
+        pending_nodes = sorted(
+            plan.node_allocation,
+            key=lambda nid: not (nid in plan.node_update
+                                 or nid in plan.node_preemptions))
+        final_refused: List[str] = []
+        fit_cleared: set = set()      # claim-deferred nodes already fit-checked
+        while pending_nodes:
+            progressed = False
+            deferred = []
+            for node_id in pending_nodes:
+                new_allocs = plan.node_allocation[node_id]
+                verdict = self._node_plan_ok(snap, plan, node_id, new_allocs,
+                                             skip_fit=skip_fit or
+                                             node_id in fit_cleared,
+                                             plan_claims=plan_claims,
+                                             released=committed_releases)
+                if verdict == NODE_OK:
+                    result.node_allocation[node_id] = new_allocs
+                    committed_releases.update(
+                        a.id for a in plan.node_update.get(node_id, ()))
+                    committed_releases.update(
+                        a.id for a in plan.node_preemptions.get(node_id, ()))
+                    progressed = True
+                elif verdict == NODE_CLAIM_REFUSED:
+                    # may clear on a later credit; its fit verdict (already
+                    # passed — fit failure is final) need not be redone
+                    fit_cleared.add(node_id)
+                    deferred.append(node_id)
+                else:
+                    final_refused.append(node_id)   # down/fit: won't change
+            if not progressed:
+                final_refused.extend(deferred)
+                break
+            pending_nodes = deferred
+        for node_id in final_refused:
+            result.refuted_nodes.append(node_id)
+            # stops/preemptions for a refuted node are withheld too
+            result.node_update.pop(node_id, None)
+            result.node_preemptions.pop(node_id, None)
         return result
+
+    @staticmethod
+    def _carries_host_assigned(plan: Plan) -> bool:
+        """Any placement carrying a port/device assignment — or even just
+        a network ask (allocs_fit counts reserved-port asks too)."""
+        for allocs in plan.node_allocation.values():
+            for a in allocs:
+                if (a.allocated_ports or a.allocated_devices
+                        or a.resources.networks):
+                    return True
+        return False
 
     def _node_plan_ok(self, snap, plan: Plan, node_id: str,
                       new_allocs: List[Allocation],
-                      skip_fit: bool = False) -> bool:
+                      skip_fit: bool = False,
+                      plan_claims: Optional[Dict] = None,
+                      released: frozenset = frozenset()) -> int:
         node = snap.node_by_id(node_id)
         if node is None:
-            return False
+            return NODE_REFUSED
         if node.status == "down":
             # only stops are allowed on down nodes
-            return False
+            return NODE_REFUSED
         if not skip_fit:
             existing = {a.id: a for a in snap.allocs_by_node(node_id)
                         if not a.terminal_status()}
@@ -258,19 +351,26 @@ class PlanApplier:
             ok, _, _ = allocs_fit(node, list(existing.values()),
                                   check_devices=True)
             if not ok:
-                return False
+                return NODE_REFUSED
         # CSI claim re-check (reference: CSIVolumeChecker claim_ok at the
         # serialization point): access-mode limits and schedulable=false
         # refute here — the device mask only checks plugin presence.
-        # Claims held by allocs this plan removes anywhere (stops,
-        # preemptions, same-id replacements) count as released.
-        # Known gap: two claims inside ONE plan are both checked against
-        # the pre-plan claim set.
-        releasing = {a.id for allocs in plan.node_update.values()
-                     for a in allocs}
-        releasing |= {a.id for allocs in plan.node_preemptions.values()
-                      for a in allocs}
+        # Released claims credited: removals whose commit is already
+        # certain (`released` — non-placement nodes + accepted nodes,
+        # maintained by evaluate_plan), THIS node's own stops/preemptions
+        # (they commit iff this node is accepted — consistent either way),
+        # and same-id replacements.  Removals on not-yet-accepted OTHER
+        # nodes are NOT credited: that node may refute and keep its
+        # claim-holder running.  Write claims accepted by earlier nodes of
+        # this plan count via `plan_claims` (merged only after this node
+        # passes every check — a refuted node's claims never commit, so
+        # they must not block later nodes).
+        releasing = set(released)
+        releasing.update(a.id for a in plan.node_update.get(node_id, ()))
+        releasing.update(
+            a.id for a in plan.node_preemptions.get(node_id, ()))
         releasing |= {a.id for a in new_allocs}
+        local_claims: Dict = {}
         for a in new_allocs:
             tg = a.job.lookup_task_group(a.task_group) \
                 if a.job is not None else None
@@ -279,8 +379,21 @@ class PlanApplier:
             for vreq in tg.volumes.values():
                 if vreq.type != "csi" or not vreq.source:
                     continue
+                key = (a.namespace, vreq.source)
                 vol = snap.csi_volume_by_id(a.namespace, vreq.source)
-                if vol is None or not vol.claim_ok(vreq.read_only,
-                                                   releasing):
-                    return False
-        return True
+                if vol is None or not vol.schedulable:
+                    return NODE_REFUSED      # can never clear in-plan
+                if not vol.claim_ok(vreq.read_only, releasing):
+                    return NODE_CLAIM_REFUSED
+                if not vreq.read_only:
+                    # in-plan claims only grow — refusal here is final
+                    if (vol.access_mode.startswith("single-node-writer")
+                            and plan_claims is not None
+                            and (plan_claims.get(key, 0)
+                                 + local_claims.get(key, 0))):
+                        return NODE_REFUSED
+                    local_claims[key] = local_claims.get(key, 0) + 1
+        if plan_claims is not None:
+            for key, cnt in local_claims.items():
+                plan_claims[key] = plan_claims.get(key, 0) + cnt
+        return NODE_OK

@@ -189,6 +189,14 @@ class GenericScheduler(Scheduler):
         from .device import tg_device_requests
         if tg_device_requests(tg):
             return None
+        # Port asks are host-side state the coupled-batch fence cannot
+        # couple: each batched scheduler assigns ports from a private
+        # NetworkIndex built on the same shared snapshot, so two
+        # batch-mates landing on one node pick IDENTICAL dynamic ports and
+        # the applier's skip-fit would commit the collision (the reference
+        # refutes this at evaluatePlan via AllocsFit's port check).
+        if tg.networks or any(task.resources.networks for task in tg.tasks):
+            return None
         return self.BatchPrep(job, tg, count, block, places, results)
 
     def submit_batched(self, evaluation: Evaluation, prep, bd,

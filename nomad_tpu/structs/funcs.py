@@ -166,9 +166,18 @@ def allocs_fit(node: Node, allocs: List[Allocation],
         used.cpu += a.resources.cpu
         used.memory_mb += a.resources.memory_mb
         used.disk_mb += a.resources.disk_mb
+        # An alloc's static port appears BOTH in its allocated_ports (the
+        # assignment) and in its resources.networks reserved_ports (the
+        # ask): ask + fulfillment are ONE claim, not a self-collision.
+        # But two labels assigned the same value, or two networks both
+        # reserving one value, ARE a real within-alloc collision and must
+        # still refute — so an ask is skipped only when ITS OWN label
+        # (assign_ports keys unlabeled ports by value) holds its value.
         ports = list(a.allocated_ports.values())
+        ap_get = a.allocated_ports.get
         for net in a.resources.networks:
-            ports.extend(p.value for p in net.reserved_ports)
+            ports.extend(p.value for p in net.reserved_ports
+                         if ap_get(p.label or str(p.value)) != p.value)
         for port in ports:
             if port in seen_ports:
                 return False, "network: port collision", used

@@ -417,3 +417,112 @@ class TestBatchedWorkerPath:
         evicted = [a for a in snap.allocs_by_job(low.namespace, low.id)
                    if a.desired_status == "evict"]
         assert len(evicted) == 4
+
+
+class TestPortSafetyInBatch:
+    """Port asks must never ride the coupled-batch skip-fit path: each
+    batched scheduler assigns ports from a private NetworkIndex over the
+    same shared snapshot, so two batch-mates on one node pick identical
+    dynamic ports — only the applier's AllocsFit port check catches it
+    (reference: plan_apply.go evaluateNodePlan)."""
+
+    def test_prepare_batch_excludes_port_asks(self):
+        from nomad_tpu.scheduler.generic import GenericScheduler
+        from nomad_tpu.structs import NetworkResource, Port
+
+        h, _ = build_cluster(20)
+        job = mock.batch_job()
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = 8
+        tg.tasks[0].resources.networks = [NetworkResource(
+            dynamic_ports=[Port(label="http")])]
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id, type=job.type)
+        h.state.upsert_evals([e])
+        sched = GenericScheduler(h.state.snapshot(), h, is_batch=True,
+                                 now=NOW)
+        assert sched.prepare_batch(e) is None
+        # control: the same shape without the port ask IS batchable
+        job2 = mock.batch_job()
+        job2.datacenters = ["dc1", "dc2", "dc3"]
+        job2.task_groups[0].count = 8
+        h.state.upsert_job(job2)
+        e2 = mock.eval(job_id=job2.id, type=job2.type)
+        h.state.upsert_evals([e2])
+        sched2 = GenericScheduler(h.state.snapshot(), h, is_batch=True,
+                                  now=NOW)
+        assert sched2.prepare_batch(e2) is not None
+
+    def test_skip_fit_still_refutes_port_collision(self):
+        """Defense at the serialization point: even a fenced coupled plan
+        whose allocs carry port assignments must run the fit check — a
+        static-port collision behind an intact fence is refuted, not
+        committed."""
+        from nomad_tpu.core import PlanApplier, PlanQueue
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs import (NetworkResource, Plan, Port,
+                                       Resources)
+
+        state = StateStore()
+        q = PlanQueue()
+        q.set_enabled(True)
+        applier = PlanApplier(state, q)
+        node = mock.node()
+        state.upsert_node(node)
+        job = mock.job()
+        state.upsert_job(job)
+
+        def mkplan(eid, bid, seq0):
+            a = mock.alloc(job=job, node_id=node.id)
+            a.resources = Resources(
+                cpu=50, memory_mb=32,
+                networks=[NetworkResource(
+                    reserved_ports=[Port(label="http", value=8080)])])
+            a.allocated_ports = {"http": 8080}
+            p = Plan(eval_id=eid, job=job, coupled_batch=(bid, seq0))
+            p.append_alloc(a)
+            return p
+
+        seq0 = state.placement_seq()
+        p1 = q.enqueue(mkplan("e1", "batch1", seq0))
+        applier.apply_one(p1)
+        r1, err1 = p1.wait(1)
+        assert err1 is None and not r1.refuted_nodes
+
+        # same static port, same node, same (still-intact) chain fence
+        p2 = q.enqueue(mkplan("e2", "batch1", seq0))
+        applier.apply_one(p2)
+        r2, err2 = p2.wait(1)
+        assert err2 is None
+        assert r2.refuted_nodes == [node.id]
+        # the collision never reached state
+        ports = [a.allocated_ports for a in
+                 state.snapshot().allocs_by_node(node.id)
+                 if not a.terminal_status()]
+        assert ports == [{"http": 8080}]
+
+    def test_static_port_job_places_end_to_end(self):
+        """A static-port alloc carries its port in BOTH allocated_ports
+        and its resources ask; the applier must not read that as a
+        self-collision (regression: allocs_fit double-counted it)."""
+        from nomad_tpu.structs import NetworkResource, Port
+
+        s = Server(dev_mode=True, eval_batch=64)
+        s.establish_leadership()
+        for _ in range(4):
+            s.register_node(mock.node(), now=NOW)
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 3
+        tg.tasks[0].resources.networks = [NetworkResource(
+            reserved_ports=[Port(label="http", value=8080)])]
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 3
+        # static port -> three distinct nodes, each alloc owns 8080
+        assert len({a.node_id for a in live}) == 3
+        assert all(a.allocated_ports.get("http") == 8080 for a in live)

@@ -228,3 +228,132 @@ class TestCSITopology:
         live2 = [a for a in snap.allocs_by_job(j2.namespace, j2.id)
                  if not a.terminal_status()]
         assert live2 == []
+
+    def test_single_writer_two_claims_in_one_plan(self):
+        """Two writers to a single-node-writer volume inside ONE plan:
+        the applier must count in-plan claims, committing exactly one
+        (VERDICT r3 weak #6: both were checked against the pre-plan
+        claim set and both committed)."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        make_cluster(s, n=4)
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-w1", plugin_id="ebs0",
+            access_mode="single-node-writer"))
+        # one job, count=2 -> both placements ride one plan
+        j = csi_job("vol-w1", count=2, read_only=False)
+        s.register_job(j, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status()]
+        assert len(live) == 1, [a.node_id for a in live]
+        vol = snap.csi_volume_by_id("default", "vol-w1")
+        assert len(vol.write_allocs) == 1
+
+    def test_refuted_release_does_not_credit_new_writer(self):
+        """A writer admitted on the credit of a release must not commit
+        when the releasing node refutes (its stop is withheld): the old
+        writer keeps running and the volume must not end up with two live
+        write claims (code-review r4 finding)."""
+        from nomad_tpu.core import PlanApplier, PlanQueue
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs import Plan, Resources
+
+        state = StateStore()
+        q = PlanQueue()
+        q.set_enabled(True)
+        applier = PlanApplier(state, q)
+        na, nb = mock.node(), mock.node()
+        state.upsert_node(na)
+        state.upsert_node(nb)
+        vol = CSIVolume(id="vol-m", plugin_id="ebs0",
+                        access_mode="single-node-writer")
+        state.upsert_csi_volume(vol)
+        job = csi_job("vol-m", count=1, read_only=False)
+        state.upsert_job(job)
+        # X: current writer, running on node B, holding the write claim
+        x = mock.alloc(job=job, node_id=nb.id)
+        x.task_group = job.task_groups[0].name
+        state.upsert_allocs([x])
+        plan0 = Plan(eval_id="seed", job=job)
+        plan0.node_allocation[nb.id] = [state.alloc_by_id(x.id)]
+        state.upsert_plan_results(plan0, applier.evaluate_plan(plan0))
+        assert state.snapshot().csi_volume_by_id(
+            "default", "vol-m").write_allocs
+
+        # migration plan: stop X on B + overfitting replacement on B
+        # (forces B to refute, withholding the stop), new writer Y on A
+        plan = Plan(eval_id="mig", job=job)
+        stopped = state.alloc_by_id(x.id).copy_skip_job()
+        stopped.desired_status = "stop"
+        plan.node_update[nb.id] = [stopped]
+        big = mock.alloc(job=job, node_id=nb.id)
+        big.task_group = job.task_groups[0].name
+        big.resources = Resources(cpu=10 ** 9, memory_mb=10 ** 9)
+        plan.node_allocation[nb.id] = [big]
+        y = mock.alloc(job=job, node_id=na.id)
+        y.task_group = job.task_groups[0].name
+        plan.node_allocation[na.id] = [y]
+
+        result = applier.evaluate_plan(plan)
+        # B refuted (overfit) -> X's stop withheld -> Y must NOT be
+        # admitted on the strength of that release
+        assert nb.id in result.refuted_nodes
+        assert na.id in result.refuted_nodes
+        state.upsert_plan_results(plan, result)
+        vol2 = state.snapshot().csi_volume_by_id("default", "vol-m")
+        assert list(vol2.write_allocs) == [x.id]
+
+    def test_release_credit_reaches_fixpoint_regardless_of_order(self):
+        """Node A places a writer that needs node B's release, while A
+        itself carries an unrelated stop (so no static ordering puts B
+        first): the fixpoint pass must admit A after B accepts."""
+        from nomad_tpu.core import PlanApplier, PlanQueue
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs import Plan
+
+        state = StateStore()
+        q = PlanQueue()
+        q.set_enabled(True)
+        applier = PlanApplier(state, q)
+        na, nb = mock.node(), mock.node()
+        state.upsert_node(na)
+        state.upsert_node(nb)
+        state.upsert_csi_volume(CSIVolume(
+            id="vol-f", plugin_id="ebs0",
+            access_mode="single-node-writer"))
+        vjob = csi_job("vol-f", count=1, read_only=False)
+        state.upsert_job(vjob)
+        plain = mock.job()
+        state.upsert_job(plain)
+        # X: current writer on node B; U: unrelated alloc on node A
+        x = mock.alloc(job=vjob, node_id=nb.id)
+        x.task_group = vjob.task_groups[0].name
+        u = mock.alloc(job=plain, node_id=na.id)
+        state.upsert_allocs([x, u])
+        seed = Plan(eval_id="seed", job=vjob)
+        seed.node_allocation[nb.id] = [state.alloc_by_id(x.id)]
+        state.upsert_plan_results(seed, applier.evaluate_plan(seed))
+
+        plan = Plan(eval_id="mig", job=vjob)
+        # node A FIRST in insertion order, carrying a stop of U (so the
+        # releasing-first sort cannot separate A and B) + new writer Y
+        ustop = state.alloc_by_id(u.id).copy_skip_job()
+        ustop.desired_status = "stop"
+        plan.node_update[na.id] = [ustop]
+        y = mock.alloc(job=vjob, node_id=na.id)
+        y.task_group = vjob.task_groups[0].name
+        plan.node_allocation[na.id] = [y]
+        # node B: stop X + unrelated replacement Z that fits
+        xstop = state.alloc_by_id(x.id).copy_skip_job()
+        xstop.desired_status = "stop"
+        plan.node_update[nb.id] = [xstop]
+        z = mock.alloc(job=plain, node_id=nb.id)
+        plan.node_allocation[nb.id] = [z]
+
+        result = applier.evaluate_plan(plan)
+        assert result.refuted_nodes == []
+        state.upsert_plan_results(plan, result)
+        vol = state.snapshot().csi_volume_by_id("default", "vol-f")
+        assert list(vol.write_allocs) == [y.id]
