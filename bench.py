@@ -53,6 +53,63 @@ C1M_PLACEMENTS_PER_SEC = 3300.0   # external anchor, BASELINE.md
 
 
 # --------------------------------------------------------------------------
+# phase timers (--phases): where does wave wall-time go, host vs device?
+# --------------------------------------------------------------------------
+
+class PhaseTimers:
+    """Accumulating wall-clock timers wrapped around the pipeline's key
+    methods (VERDICT r3 #1b: publish the host-vs-device split).  Reset at
+    the start of the measured wave so warmup/compile time is excluded."""
+
+    def __init__(self):
+        import collections
+        import threading
+        self.acc = collections.defaultdict(float)
+        self.cnt = collections.defaultdict(int)
+        self.lock = threading.Lock()
+
+    def _wrap(self, obj, name, tag):
+        fn = getattr(obj, name)
+
+        def inner(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                dt = time.perf_counter() - t0
+                with self.lock:
+                    self.acc[tag] += dt
+                    self.cnt[tag] += 1
+        setattr(obj, name, inner)
+
+    def install(self):
+        from nomad_tpu.core.plan_apply import PlanApplier
+        from nomad_tpu.ops.engine import PlacementEngine
+        from nomad_tpu.scheduler.generic import GenericScheduler
+        from nomad_tpu.state.state_store import StateStore
+        self._wrap(GenericScheduler, "prepare_batch", "host.reconcile")
+        self._wrap(GenericScheduler, "_materialize_bulk", "host.materialize")
+        self._wrap(PlacementEngine, "dispatch_batch", "device.dispatch")
+        self._wrap(PlacementEngine, "collect_batch", "device.wait+expand")
+        self._wrap(PlanApplier, "evaluate_plan", "host.applier_evaluate")
+        self._wrap(StateStore, "upsert_plan_results", "host.store_commit")
+        return self
+
+    def reset(self):
+        with self.lock:
+            self.acc.clear()
+            self.cnt.clear()
+
+    def report(self):
+        with self.lock:
+            return {k: round(self.acc[k], 3) for k in
+                    sorted(self.acc, key=self.acc.get, reverse=True)}
+
+
+_PHASES: "PhaseTimers | None" = None
+
+
+# --------------------------------------------------------------------------
 # cluster builders
 # --------------------------------------------------------------------------
 
@@ -113,11 +170,11 @@ def _stock_lib():
                  "-o", so, src],
                 check=True, capture_output=True)
         lib = ctypes.CDLL(so)
-        lib.stock_place.restype = ctypes.c_int64
-        lib.stock_place.argtypes = [
+        lib.stock_place_evals.restype = ctypes.c_int64
+        lib.stock_place_evals.argtypes = [
             ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
             ctypes.c_void_p]
         _STOCK_LIB = lib
         return lib
@@ -129,20 +186,37 @@ def _stock_lib():
 
 
 def stock_zoned_rate_compiled(nodes, cpu: int, mem: int, n_place: int,
-                              n_zones: int = 5, seed: int = 1):
-    """Config-5-faithful compiled baseline: placements are split across
-    the CSI volume zones exactly like the bench jobs (each job's volume
-    topology restricts it to one zone's nodes), so both rate and packing
-    quality face the same feasibility the TPU pipeline does.  Returns
-    (placements/sec, nodes_touched); falls back to the interpreted
-    emulation's rate when no toolchain exists."""
+                              per_eval: int, n_zones: int = 5,
+                              seed: int = 1, workers: int = 1):
+    """Config-5-faithful compiled baseline: the SAME eval structure the
+    TPU pipeline is measured on (n_place/per_eval evals of per_eval
+    placements each), zoned exactly like the bench jobs' CSI volume
+    topologies.  The emulation is algorithmically faithful to stock
+    (per-eval shuffle, prefix walk, O(allocs-on-node) AllocsFit per
+    candidate, plan-apply re-check — see native/stock_baseline/stock.cc)
+    and deliberately generous to it (flat arrays, pre-cached
+    feasibility, no raft/RPC).
+
+    `workers` > 1 emulates stock's num_schedulers worker pool: N threads
+    each run the compiled scheduler over a disjoint zone shard (ctypes
+    releases the GIL, so this is real OS parallelism) — zero plan
+    conflicts, i.e. stock's BEST-case scaling.
+
+    Returns (placements/sec, nodes_touched); falls back to the
+    interpreted emulation's rate when no toolchain exists."""
+    import threading
+
     import numpy as np
     lib = _stock_lib()
     if lib is None:
-        # rate falls back to the UNZONED interpreted emulation; there is
-        # no comparable quality read (None -> the key is omitted, never
-        # a fake 'stock used 0 nodes')
-        return stock_baseline_rate(nodes, cpu, mem, n_place, seed), None
+        # rate falls back to the UNZONED interpreted emulation on a
+        # bounded sample (O(n_nodes) per placement interpreted — the full
+        # 100k workload would run for hours); there is no comparable
+        # quality read (None -> the key is omitted, never a fake
+        # 'stock used 0 nodes').  `workers` is ignored here — the caller
+        # must not label a fallback rate as multi-worker.
+        return stock_baseline_rate(nodes, cpu, mem,
+                                   min(n_place, 2000), seed), None
     n = len(nodes)
     cap_cpu = np.array([nd.resources.cpu for nd in nodes], np.int32)
     cap_mem = np.array([nd.resources.memory_mb for nd in nodes], np.int32)
@@ -153,20 +227,35 @@ def stock_zoned_rate_compiled(nodes, cpu: int, mem: int, n_place: int,
     zones = np.array([int(nd.attributes.get("storage.topology",
                                             "zone0")[4:]) % n_zones
                       for nd in nodes], np.int32)
-    used_cpu = np.zeros(n, np.int32)
-    used_mem = np.zeros(n, np.int32)
-    per_zone = max(n_place // n_zones, 1)
-    t0 = time.perf_counter()
-    placed = 0
-    for z in range(n_zones):
+    touched = np.zeros(n, np.uint8)
+    n_evals = max(n_place // max(per_eval, 1), 1)
+    placed = [0] * n_zones
+
+    def run_zone(z, zone_evals):
         elig = (base_ok & (zones == z)).astype(np.uint8)
-        placed += lib.stock_place(
+        placed[z] = lib.stock_place_evals(
             n, cap_cpu.ctypes.data, cap_mem.ctypes.data, elig.ctypes.data,
-            cpu, mem, per_zone, seed + z,
-            used_cpu.ctypes.data, used_mem.ctypes.data)
+            cpu, mem, zone_evals, per_eval, seed + z, touched.ctypes.data)
+
+    # evals split round-robin over zones like the bench jobs (zone=i%5)
+    zone_evals = [n_evals // n_zones + (1 if z < n_evals % n_zones else 0)
+                  for z in range(n_zones)]
+    t0 = time.perf_counter()
+    if workers <= 1:
+        for z in range(n_zones):
+            run_zone(z, zone_evals[z])
+    else:
+        # one thread per zone (5 zones ~ a small num_schedulers pool);
+        # disjoint node shards -> no synchronization needed
+        threads = [threading.Thread(target=run_zone, args=(z, zone_evals[z]))
+                   for z in range(n_zones)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
     dt = time.perf_counter() - t0
-    rate = placed / dt if dt > 0 else 0.0
-    return rate, int((used_cpu > 0).sum())
+    rate = sum(placed) / dt if dt > 0 else 0.0
+    return rate, int(touched.sum())
 
 
 def stock_baseline_rate(nodes, cpu: int, mem: int, n_place: int,
@@ -514,9 +603,12 @@ def run_config_5(args):
     iters = max(args.iters, 1)
     dt = None
     q = None
+    phases = None
     first_jobs = None
     for i in range(iters):
         s.plan_queue.latencies.clear()
+        if _PHASES is not None:
+            _PHASES.reset()
         dt_i, jobs_i = run_wave(n_evals, per_eval, cpu=10, mem=10,
                                 tag=f"measure{i}")
         q_i = s.plan_queue.latency_quantiles((0.5, 0.99))
@@ -524,19 +616,29 @@ def run_config_5(args):
             first_jobs = jobs_i
         if dt is None or dt_i < dt:
             dt, q = dt_i, q_i
+            if _PHASES is not None:
+                phases = _PHASES.report()
     wave_jobs = first_jobs
     n_place = n_evals * per_eval
     evals_per_sec = n_evals / dt
     tpu_rate = n_place / dt
 
-    # baseline: compiled stock emulation placing the same allocs
-    # sequentially at the same node scale with the SAME per-zone
-    # feasibility the jobs' volume topologies impose (sampled +
-    # extrapolated; the per-placement cost is O(n_nodes) and
-    # state-independent enough that the sample rate holds)
-    base_sample = min(n_place, 20000)
+    # baseline: the corrected compiled stock emulation (per-eval shuffle,
+    # prefix walk, O(allocs-on-node) AllocsFit, plan-apply re-check —
+    # round-3 verdict #2) placing the FULL workload with the same eval
+    # structure and per-zone feasibility the TPU pipeline is measured on.
+    # Reported twice: one worker (stock's serial scheduler loop) and a
+    # 5-thread zone-sharded pool (stock's num_schedulers workers at their
+    # conflict-free best).
     base_rate_c, stock_nodes_used = stock_zoned_rate_compiled(
-        nodes, cpu=10, mem=10, n_place=base_sample)
+        nodes, cpu=10, mem=10, n_place=n_place, per_eval=per_eval)
+    if _stock_lib() is not None:
+        base_rate_mw, _ = stock_zoned_rate_compiled(
+            nodes, cpu=10, mem=10, n_place=n_place, per_eval=per_eval,
+            workers=5)
+    else:
+        base_rate_mw = None    # no toolchain: never mislabel the serial
+        # interpreted fallback as a 5-worker compiled figure
     base_sample_py = min(n_place, 300)
     base_rate_py = stock_baseline_rate(nodes, cpu=10, mem=10,
                                        n_place=base_sample_py)
@@ -569,15 +671,16 @@ def run_config_5(args):
     giant_dt, giant_placed = run_giant(10, 10)
     giant_rate = giant_placed / giant_dt if giant_dt > 0 else 0.0
 
-    # placement QUALITY at the same sample size: stock's LimitIterator(2)
-    # scores a 2-node random subset per placement; the kernel argmaxes
-    # every feasible node.  Bin-pack quality = how few nodes absorb the
-    # same number of placements (fewer -> tighter packing -> more
-    # whole-node headroom left for big asks).
+    # placement QUALITY over the full workload on both sides: bin-pack
+    # quality = how few nodes absorb the same placements (fewer ->
+    # tighter packing -> more whole-node headroom left for big asks).
+    # The corrected stock emulation walks each eval's shuffled order from
+    # the start, so it also packs densely (one node per eval until full)
+    # — the comparison is now close rather than the old 200x gap against
+    # the shuffle-per-placement strawman.
     snap = s.state.snapshot()
-    sample_jobs = wave_jobs[:max(base_sample // per_eval, 1)]
     tpu_used = {a.node_id
-                for job in sample_jobs
+                for job in wave_jobs
                 for a in snap.allocs_by_job(job.namespace, job.id)
                 if not a.terminal_status()}
     tpu_nodes_used = len(tpu_used)
@@ -591,6 +694,11 @@ def run_config_5(args):
             "n_evals": n_evals, "placements_per_eval": per_eval,
             "runs": iters,
             "baseline_compiled_stock_per_sec": round(base_rate_c, 1),
+            **({"baseline_compiled_stock_5workers_per_sec":
+                    round(base_rate_mw, 1),
+                "vs_baseline_5workers":
+                    round(tpu_rate / base_rate_mw, 2)}
+               if base_rate_mw else {}),
             "baseline_compiled_stock_evals_per_sec":
                 round(base_evals_per_sec, 3),
             "baseline_interpreted_stock_per_sec": round(base_rate_py, 1),
@@ -609,7 +717,9 @@ def run_config_5(args):
             # zoned baseline is unavailable (no fake zeros)
             **({"quality_nodes_used_tpu": tpu_nodes_used,
                 "quality_nodes_used_stock": stock_nodes_used}
-               if stock_nodes_used is not None else {})}
+               if stock_nodes_used is not None else {}),
+            # --phases: measured-wave wall split (winning wave only)
+            **({"phase_split_s": phases} if phases else {})}
 
 
 RUNNERS = {1: run_config_1, 2: run_config_2, 3: run_config_3,
@@ -632,7 +742,13 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="write a JAX profiler (xprof) trace of the "
                          "benched kernel launches to DIR (SURVEY §6.1)")
+    ap.add_argument("--phases", action="store_true",
+                    help="report the measured wave's wall-time split "
+                         "across pipeline phases (host vs device)")
     args = ap.parse_args()
+    if args.phases:
+        global _PHASES
+        _PHASES = PhaseTimers().install()
 
     def run_one(c):
         if args.profile:

@@ -1155,7 +1155,8 @@ class Router:
             if plan is not None and plan.annotations else None,
         }
         if plan is not None:
-            n_alloc = sum(len(v) for v in plan.node_allocation.values())
+            n_alloc = (sum(len(v) for v in plan.node_allocation.values())
+                       + sum(b.count for b in plan.alloc_blocks))
             out["CreatedAllocs"] = n_alloc
         return out
 

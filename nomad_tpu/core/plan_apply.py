@@ -12,6 +12,7 @@ eval workers becomes refuted plans, never corrupted state — the reference's
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import itertools
 import threading
@@ -36,6 +37,8 @@ from nomad_tpu.structs import (
 NODE_OK = 0
 NODE_REFUSED = 1
 NODE_CLAIM_REFUSED = 2
+
+_NULL_GUARD = contextlib.nullcontext()
 
 
 @dataclass
@@ -228,13 +231,6 @@ class PlanApplier:
         `fenced_first`: the plan sits at its chain's FIRST position (no
         prior chain commit exists), so host-assigned ports/devices cannot
         collide with a batch-mate and need not demote the skip."""
-        snap = self.state.snapshot()
-        result = PlanResult(
-            node_update=dict(plan.node_update),
-            node_preemptions=dict(plan.node_preemptions),
-            deployment=plan.deployment,
-            deployment_updates=plan.deployment_updates,
-        )
         if (skip_fit and not fenced_first
                 and self._carries_host_assigned(plan)):
             # Ports and device instances are HOST-assigned state the device
@@ -248,6 +244,21 @@ class PlanApplier:
             # keeps the fence optimization for solo fenced plans (the
             # system scheduler's chain-of-1) and the head of every batch.
             skip_fit = False
+        # The fast path reads the LIVE head, not a snapshot: it needs only
+        # point reads (node existence/status, volume lookups) plus claim
+        # dicts guarded by the store lock below.  A snapshot per plan
+        # would mark the alloc tables COW-shared, forcing the commit right
+        # after it to re-copy the outer tables — at bench scale that copy
+        # (100k-entry dicts, per plan) WAS the plan pipeline's largest
+        # host cost.  The full-check path keeps the snapshot: allocs_fit
+        # iterates alloc buckets, which may mutate under the head.
+        snap = self.state if skip_fit else self.state.snapshot()
+        result = PlanResult(
+            node_update=dict(plan.node_update),
+            node_preemptions=dict(plan.node_preemptions),
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+        )
         self.stats["fast_path" if skip_fit else "full_check"] += 1
         # write claims accumulated by ALREADY-ACCEPTED nodes of THIS plan:
         # without it two writers to a single-writer volume inside one plan
@@ -273,12 +284,43 @@ class PlanApplier:
         # admitting one on a credit that may be withheld is the exact bug
         # this accounting exists to prevent.  Plans without volume claims
         # accept every node in pass one — no extra cost.
-        pending_nodes = sorted(
-            plan.node_allocation,
-            key=lambda nid: not (nid in plan.node_update
-                                 or nid in plan.node_preemptions))
+        # Columnar blocks: on the full-check path they expand to per-node
+        # lists (AllocsFit needs them); on the fenced fast path a quick
+        # whole-block check (nodes up, volumes schedulable, no write
+        # claims) accepts them WHOLESALE — per-node granularity is only
+        # bought when something actually needs refuting.
+        if plan.alloc_blocks and not skip_fit:
+            plan.expand_blocks()
         final_refused: List[str] = []
         fit_cleared: set = set()      # claim-deferred nodes already fit-checked
+        # live-head claim dicts can mutate in place between snapshots;
+        # the fast-path loop holds the store lock while it reads them
+        # (short: point reads + claim set math, no allocs_fit)
+        guard = (self.state.locked() if snap is self.state
+                 else _NULL_GUARD)
+        with guard:
+            if plan.alloc_blocks:
+                if self._blocks_ok(snap, plan):
+                    result.alloc_blocks = list(plan.alloc_blocks)
+                else:
+                    plan.expand_blocks()    # rare: something needs refuting
+            pending_nodes = sorted(
+                plan.node_allocation,
+                key=lambda nid: not (nid in plan.node_update
+                                     or nid in plan.node_preemptions))
+            self._eval_nodes(snap, plan, result, skip_fit, plan_claims,
+                             committed_releases, pending_nodes,
+                             final_refused, fit_cleared)
+        for node_id in final_refused:
+            result.refuted_nodes.append(node_id)
+            # stops/preemptions for a refuted node are withheld too
+            result.node_update.pop(node_id, None)
+            result.node_preemptions.pop(node_id, None)
+        return result
+
+    def _eval_nodes(self, snap, plan, result, skip_fit, plan_claims,
+                    committed_releases, pending_nodes, final_refused,
+                    fit_cleared) -> None:
         while pending_nodes:
             progressed = False
             deferred = []
@@ -307,12 +349,36 @@ class PlanApplier:
                 final_refused.extend(deferred)
                 break
             pending_nodes = deferred
-        for node_id in final_refused:
-            result.refuted_nodes.append(node_id)
-            # stops/preemptions for a refuted node are withheld too
-            result.node_update.pop(node_id, None)
-            result.node_preemptions.pop(node_id, None)
-        return result
+
+    @staticmethod
+    def _blocks_ok(snap, plan: Plan) -> bool:
+        """Whole-block admission on the fenced fast path: every touched
+        node up, volumes present + schedulable, and nothing the columnar
+        form cannot express safely (ports, write claims) — else the
+        caller expands to the per-node path."""
+        for block in plan.alloc_blocks:
+            tmpl = block.template
+            if (tmpl.allocated_ports or tmpl.allocated_devices
+                    or tmpl.resources.networks):
+                return False
+            for nid in block.node_table:
+                node = snap.node_by_id(nid)
+                if node is None or node.status == "down":
+                    return False
+            job = tmpl.job
+            tg = job.lookup_task_group(tmpl.task_group) if job else None
+            if tg is not None and tg.volumes:
+                for vreq in tg.volumes.values():
+                    if vreq.type != "csi" or not vreq.source:
+                        continue
+                    if not vreq.read_only:
+                        # write-claim accounting is per node; buy it
+                        return False
+                    vol = snap.csi_volume_by_id(tmpl.namespace,
+                                                vreq.source)
+                    if vol is None or not vol.schedulable:
+                        return False
+        return True
 
     @staticmethod
     def _carries_host_assigned(plan: Plan) -> bool:

@@ -144,13 +144,19 @@ class EventBroker:
         store.subscribe(self._on_state_event)
 
     def _on_state_event(self, topic: str, index: int, payload) -> None:
+        if topic == "AllocBlock":
+            # columnar bulk commit: surfaces as ordinary alloc events with
+            # null payloads (consumers re-fetch) — the ids list already
+            # exists on the block, so this stays O(1) python work here
+            topic, payload = "Allocations", _AllocIds(payload.ids)
         if topic not in _TYPE_BY_TOPIC:
             return
         with self._lock:
             subs = list(self._subs)
             buffered = payload
             if topic == "Allocations":
-                buffered = _AllocIds([a.id for a in payload])
+                buffered = _AllocIds([a.id for a in payload]) \
+                    if not isinstance(payload, _AllocIds) else payload
             self._buffer.append((topic, index, buffered))
             if len(self._buffer) > self._buffer_size:
                 del self._buffer[:len(self._buffer) - self._buffer_size]

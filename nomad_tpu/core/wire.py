@@ -55,6 +55,7 @@ import msgpack
 
 _EXT_DATACLASS = 1
 _EXT_SET = 2
+_EXT_NDARRAY = 3
 
 _NONCE_LEN = 12
 _TS_LEN = 8
@@ -142,6 +143,16 @@ def _default(obj: Any) -> Any:
         return msgpack.ExtType(_EXT_DATACLASS, packb([cls, fields]))
     if isinstance(obj, (set, frozenset)):
         return msgpack.ExtType(_EXT_SET, packb(sorted(obj)))
+    import numpy as _np
+    if isinstance(obj, _np.ndarray):
+        # AllocBlock picks ride replicated plan commits; contiguous
+        # (dtype, shape, raw bytes) is still data-only
+        a = _np.ascontiguousarray(obj)
+        return msgpack.ExtType(
+            _EXT_NDARRAY, packb([str(a.dtype), list(a.shape),
+                                 a.tobytes()]))
+    if isinstance(obj, _np.generic):
+        return obj.item()
     raise TypeError(
         f"wire codec cannot encode {type(obj).__name__} (data-only wire; "
         "no arbitrary objects)")
@@ -157,6 +168,10 @@ def _ext_hook(code: int, data: bytes) -> Any:
         return cls(**fields)
     if code == _EXT_SET:
         return set(unpackb(data))
+    if code == _EXT_NDARRAY:
+        import numpy as _np
+        dtype, shape, raw = unpackb(data)
+        return _np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
     return msgpack.ExtType(code, data)
 
 

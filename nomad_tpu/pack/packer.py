@@ -162,6 +162,14 @@ class ClusterPacker:
         # terminal allocs linger until GC — so rescans get slower forever).
         self._alloc_node: Dict[str, str] = {}       # alloc id -> node id
         self._counted: Dict[str, Dict[str, Tuple[int, int, int]]] = {}
+        # columnar-block usage, tracked as UNITS (node id -> block id ->
+        # (per-alloc res tuple, alloc count)): an AllocBlock event is one
+        # vectorized scatter, no per-alloc ledger entries.  When the store
+        # materializes a block (a member is about to be updated), the
+        # BlockMaterialized event migrates its nodes into the per-alloc
+        # ledger with zero net usage change.
+        self._block_counted: Dict[str, Dict[str, Tuple[Tuple[int, int, int],
+                                                       int]]] = {}
         # replay log of usage deltas for device-resident `used` tensors:
         # entries are (used_version, rows, vals) or (used_version, None,
         # None) — the sentinel marks a full/row rescan (device copies must
@@ -203,12 +211,17 @@ class ClusterPacker:
                     self._dirty.add(nid)
                 elif topic == "Allocations":
                     self._on_allocs_locked(payload)
+                elif topic == "AllocBlock":
+                    self._on_block_locked(payload)
+                elif topic == "BlockMaterialized":
+                    self._on_block_materialized_locked(payload)
                 elif topic == "Restore":
                     # full-state replacement: every tensor and the usage
                     # ledger are stale; next update() rebuilds from scratch
                     self._all_dirty = True
                     self._counted.clear()
                     self._alloc_node.clear()
+                    self._block_counted.clear()
 
         store.subscribe(on_event)
 
@@ -261,6 +274,63 @@ class ClusterPacker:
         # else: the batch touched no tensor rows — leave the version alone
         # so device caches stay hits and the bounded replay window isn't
         # consumed by no-op entries
+
+    def _on_block_locked(self, block) -> None:
+        """A columnar block committed: ONE vectorized usage scatter over
+        its unique nodes (the block path's whole point — no per-alloc
+        python work), tracked as a unit in _block_counted."""
+        t = self._tensors
+        res = block.resources_tuple()
+        counts = block.node_counts()
+        rows: List[int] = []
+        vals: List[Tuple[int, int, int]] = []
+        for bi, nid in enumerate(block.node_table):
+            c = int(counts[bi])
+            if c == 0:
+                continue
+            per_node = self._block_counted.get(nid)
+            if per_node is None:
+                self._block_counted[nid] = per_node = {}
+            per_node[block.id] = (res, c)
+            if t is not None:
+                row = t.id_to_row.get(nid)
+                if row is not None:
+                    rows.append(row)
+                    vals.append((res[0] * c, res[1] * c, res[2] * c))
+        if t is not None and rows:
+            r = np.asarray(rows, np.intp)
+            v = np.asarray(vals, np.int32)
+            np.add.at(t.used, r, v)
+            t.used_version = self._log_delta(r, v)
+
+    def _on_block_materialized_locked(self, block) -> None:
+        """Representation change only (block -> table rows): migrate the
+        unit entry into the per-alloc ledger with ZERO usage delta so the
+        follow-up Allocations events find their predecessors.  Nodes
+        whose ledger was re-anchored by a rescan (their block rows were
+        counted per alloc already) are skipped via the alloc_node guard."""
+        res = block.resources_tuple()
+        alloc_node = self._alloc_node
+        counted = self._counted
+        for a in block.materialize_all():
+            aid = a.id
+            if aid in alloc_node:
+                continue        # a rescan already counted it per alloc
+            nid = a.node_id
+            per_node = self._block_counted.get(nid)
+            if per_node is None or block.id not in per_node:
+                continue        # this node was re-anchored; unit gone
+            c = counted.get(nid)
+            if c is None:
+                counted[nid] = c = {}
+            c[aid] = res
+            alloc_node[aid] = nid
+        for nid in block.node_table:
+            per_node = self._block_counted.get(nid)
+            if per_node is not None:
+                per_node.pop(block.id, None)
+                if not per_node:
+                    del self._block_counted[nid]
 
     def _log_delta(self, rows, vals) -> int:
         """Append one used-version bump to the replay log.  `rows is None`
@@ -344,6 +414,7 @@ class ClusterPacker:
         )
         self._alloc_node.clear()
         self._counted.clear()
+        self._block_counted.clear()
         for i, nd in enumerate(nodes):
             self._fill_row(t, i, nd, snapshot, prop_maps[i])
         self._seq += 1
@@ -422,6 +493,10 @@ class ClusterPacker:
                 used[0] += res[0]
                 used[1] += res[1]
                 used[2] += res[2]
+            for res, c in self._block_counted.get(nd.id, {}).values():
+                used[0] += res[0] * c
+                used[1] += res[1] * c
+                used[2] += res[2] * c
             t.used[i] = used
         else:
             # full usage rescan for this row: re-anchor the delta accounting
@@ -430,6 +505,9 @@ class ClusterPacker:
                 for aid in old:
                     if self._alloc_node.get(aid) == nd.id:
                         del self._alloc_node[aid]
+            # block rows come back per-alloc from the snapshot read below,
+            # so this node's block UNITS are re-anchored away with the rest
+            self._block_counted.pop(nd.id, None)
             counted: Dict[str, Tuple[int, int, int]] = {}
             used = [0, 0, 0]
             for alc in snapshot.allocs_by_node(nd.id):

@@ -648,7 +648,6 @@ class GenericScheduler(Scheduler):
         tmpl_d = tmpl.__dict__
         count = len(block.indexes) if block is not None else len(places)
         ids = new_ids(count)
-        picks_l = bd.picks.tolist()
         node_ids = bd.node_ids
         metrics = bd.metrics
         rs = bd.round_size
@@ -664,39 +663,59 @@ class GenericScheduler(Scheduler):
         if (block is not None and not has_net and not bd.evictions
                 and results.deployment is None):
             # hottest shape (the bench/batch pattern): fresh block, no
-            # ports, no preemptions — a minimal clone loop, iterated per
-            # round so the shared metric and failure accounting hoist out
-            alloc_new = Allocation.__new__
-            tg_name = tg.name
-            i = 0
-            for m in metrics:
-                end = min(i + rs, count)
-                while i < end:
-                    pick = picks_l[i]
-                    if pick < 0:
-                        self._record_failure_shared(tg_name, m)
-                        i += 1
-                        continue
-                    nid = node_ids[pick]
-                    alloc = alloc_new(Allocation)
-                    d2 = dict(tmpl_d)
-                    alloc.__dict__ = d2
-                    d2["id"] = ids[i]
-                    d2["name"] = prefix + str(indexes[i]) + "]"
-                    d2["node_id"] = nid
-                    d2["metrics"] = m
-                    d2["task_states"] = {}
-                    if nid is last_nid:
-                        last_list.append(alloc)
-                    else:
-                        last_nid = nid
-                        last_list = node_alloc.get(nid)
-                        if last_list is None:
-                            node_alloc[nid] = last_list = []
-                        last_list.append(alloc)
-                    i += 1
+            # ports, no preemptions — stays COLUMNAR end-to-end: the
+            # picks array + shared template become one AllocBlock on the
+            # plan; per-alloc objects never exist on this path (the
+            # store materializes them lazily on first read).
+            import numpy as np
+
+            from nomad_tpu.structs import AllocBlock
+            picks = bd.picks
+            ok_mask = picks >= 0
+            n_ok = int(ok_mask.sum())
+            n_fail = count - n_ok
+            if n_fail:
+                # aggregate failure accounting: one stored metric (the
+                # first failing round's), coalesced + queued counters
+                # match the per-pick loop's totals
+                tg_name = tg.name
+                first_fail = int(np.argmin(ok_mask))
+                m = metrics[min(first_fail // rs, len(metrics) - 1)]
+                self._record_failure_shared(tg_name, m)
+                if n_fail > 1:
+                    self.failed_tg_allocs[tg_name].coalesced_failures \
+                        += n_fail - 1
+                    self.queued_allocs[tg_name] = \
+                        self.queued_allocs.get(tg_name, 0) + n_fail - 1
+            if n_ok == 0:
+                return
+            if n_fail:
+                import itertools
+                sel = ok_mask.tolist()
+                ids_ok = list(itertools.compress(ids, sel))
+                idx_ok = list(itertools.compress(indexes, sel))
+                picks_ok = picks[ok_mask]
+            else:
+                ids_ok = ids
+                idx_ok = list(indexes)
+                picks_ok = picks
+            # block-local node table: unique picked rows only (hundreds),
+            # never the full cluster table
+            uniq, inv = np.unique(picks_ok, return_inverse=True)
+            plan.alloc_blocks.append(AllocBlock(
+                id=new_id(),
+                template=tmpl,
+                ids=ids_ok,
+                name_prefix=prefix,
+                indexes=idx_ok,
+                picks=inv.astype(np.int32),
+                node_table=[node_ids[int(r)] for r in uniq],
+                metrics=list(metrics),
+                round_size=rs,
+            ))
             return
 
+        picks_l = bd.picks.tolist()
         for i in range(count):
             p = places[i] if block is None else None
             pick = picks_l[i]

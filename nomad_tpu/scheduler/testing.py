@@ -54,6 +54,7 @@ class Harness:
             node_update=plan.node_update,
             node_allocation=plan.node_allocation,
             node_preemptions=plan.node_preemptions,
+            alloc_blocks=plan.alloc_blocks,
             deployment=plan.deployment,
             deployment_updates=plan.deployment_updates,
         )

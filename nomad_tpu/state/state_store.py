@@ -77,6 +77,17 @@ class StateStore:
         self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
         self._allocs_by_job: Dict[Tuple[str, str], Dict[str, Allocation]] = {}
         self._evals_by_job: Dict[Tuple[str, str], Dict[str, Evaluation]] = {}
+        # columnar alloc blocks (structs.AllocBlock): bulk placements kept
+        # as picks + template, never materialized on the commit path.
+        # Registries are COW-published dicts (every write publishes fresh
+        # dicts) so snapshots capture consistent references; a write to
+        # any MEMBER alloc (client update, same-id stop) first
+        # materializes the whole block into the normal tables — after
+        # which it behaves exactly like per-alloc state.  Blocks are
+        # immutable once inserted, like every stored object.
+        self._alloc_blocks: Dict[str, object] = {}
+        self._blocks_by_job: Dict[Tuple[str, str], tuple] = {}
+        self._blocks_by_node: Dict[str, tuple] = {}
         # amortized COW for the alloc tables: snapshot() marks them shared;
         # the NEXT write copies the outer dicts once and then mutates in
         # place until another snapshot.  Without this every plan apply paid
@@ -312,21 +323,82 @@ class StateStore:
             self._insert_allocs(allocs, idx)
             return idx
 
-    def _insert_allocs(self, allocs: Iterable[Allocation], idx: int,
-                       copy: bool = True) -> None:
+    def _writable_alloc_tables(self):
+        """The head alloc tables, COW-copied once if a snapshot may hold
+        them (then mutated in place until the next snapshot)."""
         if self._alloc_tables_shared:
-            # a snapshot may hold the current tables: copy the outer dicts
-            # once, then mutate in place until the next snapshot
-            table = dict(self._allocs)
-            by_node = dict(self._allocs_by_node)
-            by_job = dict(self._allocs_by_job)
+            self._allocs = dict(self._allocs)
+            self._allocs_by_node = dict(self._allocs_by_node)
+            self._allocs_by_job = dict(self._allocs_by_job)
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
             self._alloc_tables_shared = False
+        return self._allocs, self._allocs_by_node, self._allocs_by_job
+
+    def _materialize_block_locked(self, block) -> None:
+        """Convert a live alloc block into ordinary per-alloc table rows
+        (the cold path: a member alloc is about to be updated, or a full
+        scan needs uniform rows).  Pure representation change — no index
+        bump, no claims, no Allocations event; the packer migrates its
+        block-unit ledger on the BlockMaterialized event."""
+        rows = block.materialize_all()
+        table, by_node, by_job = self._writable_alloc_tables()
+        fresh_node = self._fresh_node_buckets
+        fresh_job = self._fresh_job_buckets
+        jkey = (block.template.namespace, block.template.job_id)
+        if jkey not in fresh_job:
+            by_job[jkey] = dict(by_job.get(jkey, {}))
+            fresh_job.add(jkey)
+        job_bucket = by_job[jkey]
+        for a in rows:
+            table[a.id] = a
+            nid = a.node_id
+            if nid not in fresh_node:
+                by_node[nid] = dict(by_node.get(nid, {}))
+                fresh_node.add(nid)
+            by_node[nid][a.id] = a
+            job_bucket[a.id] = a
+        # drop from the COW registries
+        blocks = dict(self._alloc_blocks)
+        blocks.pop(block.id, None)
+        self._alloc_blocks = blocks
+        bj = dict(self._blocks_by_job)
+        rest = tuple(b for b in bj.get(jkey, ()) if b is not block)
+        if rest:
+            bj[jkey] = rest
         else:
-            table = self._allocs
-            by_node = self._allocs_by_node
-            by_job = self._allocs_by_job
+            bj.pop(jkey, None)
+        self._blocks_by_job = bj
+        bn = dict(self._blocks_by_node)
+        for nid in block.node_table:
+            restn = tuple(b for b in bn.get(nid, ()) if b is not block)
+            if restn:
+                bn[nid] = restn
+            else:
+                bn.pop(nid, None)
+        self._blocks_by_node = bn
+        self._emit("BlockMaterialized", self._index, block)
+
+    def _resolve_block_member_locked(self, alloc_id: str,
+                                     namespace: str = None,
+                                     job_id: str = None) -> bool:
+        """If `alloc_id` lives in a block, materialize that block so the
+        caller can treat it as a table row.  Returns True on a hit."""
+        if not self._alloc_blocks:
+            return False
+        if namespace is not None:
+            candidates = self._blocks_by_job.get((namespace, job_id), ())
+        else:
+            candidates = self._alloc_blocks.values()
+        for b in list(candidates):
+            if b.contains_id(alloc_id):
+                self._materialize_block_locked(b)
+                return True
+        return False
+
+    def _insert_allocs(self, allocs: Iterable[Allocation], idx: int,
+                       copy: bool = True) -> None:
+        table, by_node, by_job = self._writable_alloc_tables()
         # Copy-on-first-touch per bucket: buckets possibly shared with live
         # snapshots are copied once per snapshot-write cycle, not once per
         # alloc (a 10k-alloc plan for one job would otherwise copy the job
@@ -341,6 +413,13 @@ class StateStore:
         for a in allocs:
             aid = a.id
             prev = table_get(aid)
+            if prev is None and self._alloc_blocks:
+                # the id may live in a columnar block (same-id stop or
+                # client update of a bulk placement): materialize it so
+                # this write sees its predecessor like any table row
+                if self._resolve_block_member_locked(aid, a.namespace,
+                                                     a.job_id):
+                    prev = table_get(aid)
             if copy:
                 a = a.copy_skip_job()   # embedded job ptr shared by design
             a.create_index = prev.create_index if prev else idx
@@ -392,6 +471,9 @@ class StateStore:
             merged = []
             for u in updates:
                 cur = self._allocs.get(u.id)
+                if cur is None and self._resolve_block_member_locked(
+                        u.id, u.namespace, u.job_id):
+                    cur = self._allocs.get(u.id)
                 if cur is None:
                     continue
                 a = cur.copy_skip_job()
@@ -417,6 +499,8 @@ class StateStore:
             merged = []
             for aid in alloc_ids:
                 cur = self._allocs.get(aid)
+                if cur is None and self._resolve_block_member_locked(aid):
+                    cur = self._allocs.get(aid)
                 if cur is None:
                     continue
                 a = cur.copy_skip_job()
@@ -497,6 +581,8 @@ class StateStore:
                         vol_tg[key] = has
                     if has:
                         self._claim_csi_volumes_locked(a, changed_vols)
+            for block in result.alloc_blocks:
+                self._commit_block_locked(block, idx, changed_vols)
             if changed_vols:
                 self._csi_volumes = {**self._csi_volumes, **changed_vols}
             if result.deployment is not None:
@@ -515,6 +601,47 @@ class StateStore:
                     self._deployments = {**self._deployments, d.id: d}
             self._emit("PlanResult", idx, result)
             return idx
+
+    def _commit_block_locked(self, block, idx: int, changed_vols) -> None:
+        """Insert a columnar alloc block: registry publishes + bulk CSI
+        claims.  O(unique nodes) python work — never O(count)."""
+        block.create_index = idx
+        block.modify_index = idx
+        self._alloc_blocks = {**self._alloc_blocks, block.id: block}
+        tmpl = block.template
+        jkey = (tmpl.namespace, tmpl.job_id)
+        bj = dict(self._blocks_by_job)
+        bj[jkey] = bj.get(jkey, ()) + (block,)
+        self._blocks_by_job = bj
+        bn = dict(self._blocks_by_node)
+        for nid in block.node_table:
+            bn[nid] = bn.get(nid, ()) + (block,)
+        self._blocks_by_node = bn
+        # CSI claims for the whole block in one dict update per volume
+        job = tmpl.job
+        tg = job.lookup_task_group(tmpl.task_group) if job else None
+        if tg is not None and tg.volumes:
+            import dataclasses
+            for vreq in tg.volumes.values():
+                if vreq.type != "csi" or not vreq.source:
+                    continue
+                key = (tmpl.namespace, vreq.source)
+                vol = changed_vols.get(key) or self._csi_volumes.get(key)
+                if vol is None:
+                    continue
+                if key not in changed_vols \
+                        and key not in self._fresh_claim_vols:
+                    vol = dataclasses.replace(
+                        vol, read_allocs=dict(vol.read_allocs),
+                        write_allocs=dict(vol.write_allocs))
+                    self._fresh_claim_vols.add(key)
+                claims = dict.fromkeys(block.ids, True)
+                if vreq.read_only:
+                    vol.read_allocs.update(claims)
+                else:
+                    vol.write_allocs.update(claims)
+                changed_vols[key] = vol
+        self._emit("AllocBlock", idx, block)
 
     # ----------------------------------------------------------- csi / cfg
 
@@ -558,6 +685,16 @@ class StateStore:
     def csi_volumes(self, namespace: Optional[str] = None):
         return [v for (ns, _), v in self._csi_volumes.items()
                 if namespace is None or ns == namespace]
+
+    def csi_volume_by_id(self, namespace: str,
+                         vol_id: str) -> Optional[CSIVolume]:
+        return self._csi_volumes.get((namespace, vol_id))
+
+    def locked(self):
+        """The store's write lock, for short read sections that iterate
+        head-state dicts mutated in place between snapshots (claim dicts,
+        fresh alloc buckets).  Point reads (dict.get) don't need it."""
+        return self._lock
 
     def _claim_csi_volumes_locked(self, alloc: Allocation,
                                   changed: Dict) -> None:
@@ -841,6 +978,10 @@ class StateStore:
         restore (they would otherwise duplicate every job per alloc)."""
         from nomad_tpu.structs import codec
         with self._lock:
+            # columnar blocks flatten for the snapshot document (cold
+            # path); the restored store starts block-free
+            for b in list(self._alloc_blocks.values()):
+                self._materialize_block_locked(b)
             allocs = []
             for a in self._allocs.values():
                 slim = a.copy_skip_job()
@@ -906,6 +1047,9 @@ class StateStore:
             self._allocs = {}
             self._allocs_by_node = {}
             self._allocs_by_job = {}
+            self._alloc_blocks = {}
+            self._blocks_by_job = {}
+            self._blocks_by_node = {}
             self._alloc_tables_shared = False
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
@@ -999,6 +1143,9 @@ class StateStore:
                 allocs_by_node=self._allocs_by_node,
                 allocs_by_job=self._allocs_by_job,
                 evals_by_job=self._evals_by_job,
+                alloc_blocks=self._alloc_blocks,
+                blocks_by_job=self._blocks_by_job,
+                blocks_by_node=self._blocks_by_node,
             )
 
     # convenience pass-throughs (read the live head; schedulers must use
@@ -1015,12 +1162,21 @@ class StateStore:
         return self._evals.get(eval_id)
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self._allocs.get(alloc_id)
+        a = self._allocs.get(alloc_id)
+        if a is None and self._alloc_blocks:
+            for b in list(self._alloc_blocks.values()):
+                i = b.index_of(alloc_id)
+                if i is not None:
+                    return b.materialize_all()[i]
+        return a
 
     def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
         with self._lock:
-            return list(self._allocs_by_job.get((namespace, job_id),
-                                                {}).values())
+            out = list(self._allocs_by_job.get((namespace, job_id),
+                                               {}).values())
+            for b in self._blocks_by_job.get((namespace, job_id), ()):
+                out.extend(b.materialize_all())
+            return out
 
     def deployment_by_id(self, dep_id: str) -> Optional[Deployment]:
         return self._deployments.get(dep_id)
@@ -1049,7 +1205,9 @@ class StateSnapshot:
     def __init__(self, index, nodes, jobs, job_versions, evals, allocs,
                  deployments, namespaces, node_pools, csi_volumes,
                  scheduler_config, allocs_by_node, allocs_by_job,
-                 evals_by_job, store_id="", placement_fence=None):
+                 evals_by_job, store_id="", placement_fence=None,
+                 alloc_blocks=None, blocks_by_job=None,
+                 blocks_by_node=None):
         self.store_id = store_id
         self.index = index
         # the placement-write counter AT this snapshot (see StateStore
@@ -1069,6 +1227,14 @@ class StateSnapshot:
         self._allocs_by_node = allocs_by_node
         self._allocs_by_job = allocs_by_job
         self._evals_by_job = evals_by_job
+        # columnar block registries AT snapshot time (COW-published dicts;
+        # blocks immutable): reads merge block rows with bucket rows.  A
+        # block and a table row for the same id can never coexist in one
+        # snapshot — materialization swaps representation atomically under
+        # the store lock.
+        self._alloc_blocks = alloc_blocks or {}
+        self._blocks_by_job = blocks_by_job or {}
+        self._blocks_by_node = blocks_by_node or {}
 
     # --- scheduler.State interface ---
 
@@ -1105,10 +1271,16 @@ class StateSnapshot:
 
     def allocs_by_job(self, namespace: str, job_id: str,
                       anystate: bool = True) -> List[Allocation]:
-        return list(self._allocs_by_job.get((namespace, job_id), {}).values())
+        out = list(self._allocs_by_job.get((namespace, job_id), {}).values())
+        for b in self._blocks_by_job.get((namespace, job_id), ()):
+            out.extend(b.materialize_all())
+        return out
 
     def allocs_by_node(self, node_id: str) -> List[Allocation]:
-        return list(self._allocs_by_node.get(node_id, {}).values())
+        out = list(self._allocs_by_node.get(node_id, {}).values())
+        for b in self._blocks_by_node.get(node_id, ()):
+            out.extend(b.rows_for_node(node_id))
+        return out
 
     def allocs_by_node_terminal(self, node_id: str,
                                 terminal: bool) -> List[Allocation]:
@@ -1116,7 +1288,13 @@ class StateSnapshot:
                 if a.terminal_status() == terminal]
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self._allocs.get(alloc_id)
+        a = self._allocs.get(alloc_id)
+        if a is None and self._alloc_blocks:
+            for b in self._alloc_blocks.values():
+                i = b.index_of(alloc_id)
+                if i is not None:
+                    return b.materialize_all()[i]
+        return a
 
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
         return self._evals.get(eval_id)

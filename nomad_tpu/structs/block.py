@@ -1,0 +1,129 @@
+"""Columnar allocation block — bulk placements without per-alloc objects.
+
+The TPU placement kernels decide thousands of placements per launch; the
+round-3 profile showed the pipeline then spending MORE wall-time turning
+those picks into per-alloc Python dicts (materialize) and inserting them
+one-by-one into the state store (commit) than the device spent deciding
+them.  An `AllocBlock` keeps one eval's homogeneous placements COLUMNAR
+end-to-end: one shared template alloc plus numpy pick rows, flowing
+through Plan -> applier -> state store as a single object.  Individual
+`Allocation` objects materialize lazily — on first read of a covered
+(job, node) bucket — so the scheduling hot path never pays the per-alloc
+cost and cold reads (CLI, API, client sync) see ordinary allocs.
+
+The reference has no analog: stock materializes full Allocation structs
+per placement (structs.Plan NodeAllocation; scheduler/generic_sched.go
+computePlacements).  This is the TPU-native replacement for exactly that
+host cost, per SURVEY §7 P1's packed-plane design stance.
+
+Ownership/mutability: a block is IMMUTABLE once inserted into the store
+(same convention as every stored object).  The lazy caches (materialized
+rows, id set, per-node row map) are monotone fill-once structures shared
+safely across snapshots and the head under the store lock or the GIL.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .structs import Allocation, AllocMetric
+
+
+@dataclass
+class AllocBlock:
+    """`count` placements of ONE task group sharing every field except
+    (id, name, node, metrics)."""
+
+    id: str = ""
+    template: Optional[Allocation] = None
+    ids: List[str] = field(default_factory=list)
+    # names derive from the reconciler's block form: prefix + index + "]"
+    name_prefix: str = ""
+    indexes: List[int] = field(default_factory=list)
+    # picks[i] indexes node_table (block-local, UNIQUE nodes only)
+    picks: Optional[np.ndarray] = None
+    node_table: List[str] = field(default_factory=list)
+    # one AllocMetric per water-fill round, shared by the round's allocs
+    metrics: List[AllocMetric] = field(default_factory=list)
+    round_size: int = 1024
+    create_index: int = 0
+    modify_index: int = 0
+
+    def __post_init__(self) -> None:
+        # lazy caches — deliberately NOT dataclass fields (they must not
+        # ride the wire codec or compare)
+        self._rows: Optional[List[Allocation]] = None
+        self._id_index: Optional[Dict[str, int]] = None
+        self._rows_by_node: Optional[Dict[str, list]] = None
+
+    # ------------------------------------------------------------- shape
+
+    @property
+    def count(self) -> int:
+        return len(self.ids)
+
+    def unique_node_ids(self) -> List[str]:
+        return self.node_table
+
+    def resources_tuple(self):
+        r = self.template.resources
+        return (r.cpu, r.memory_mb, r.disk_mb)
+
+    def node_counts(self) -> np.ndarray:
+        """allocs per node_table row (for vectorized usage scatters)."""
+        return np.bincount(self.picks, minlength=len(self.node_table))
+
+    def index_of(self, alloc_id: str) -> Optional[int]:
+        if self._id_index is None:
+            self._id_index = {aid: i for i, aid in enumerate(self.ids)}
+        return self._id_index.get(alloc_id)
+
+    def contains_id(self, alloc_id: str) -> bool:
+        return self.index_of(alloc_id) is not None
+
+    # ------------------------------------------------------ materializing
+
+    def materialize_all(self) -> List[Allocation]:
+        """All rows, built once and cached (objects immutable-once-read
+        by store convention, so the cache is shared across snapshots)."""
+        if self._rows is None:
+            picks = self.picks.tolist()
+            node_table = self.node_table
+            ids = self.ids
+            indexes = self.indexes
+            prefix = self.name_prefix
+            metrics = self.metrics
+            rs = self.round_size
+            tmpl_d = self.template.__dict__
+            ci, mi = self.create_index, self.modify_index
+            rows = []
+            alloc_new = Allocation.__new__
+            n_m = len(metrics) - 1
+            for i in range(len(ids)):
+                a = alloc_new(Allocation)
+                d = dict(tmpl_d)
+                a.__dict__ = d
+                d["id"] = ids[i]
+                d["name"] = prefix + str(indexes[i]) + "]"
+                d["node_id"] = node_table[picks[i]]
+                d["metrics"] = metrics[min(i // rs, n_m)] if metrics \
+                    else None
+                d["task_states"] = {}
+                d["create_index"] = ci
+                d["modify_index"] = mi
+                rows.append(a)
+            self._rows = rows
+        return self._rows
+
+    def rows_for_node(self, node_id: str) -> List[Allocation]:
+        """Materialized rows placed on `node_id` (lazy per-node index)."""
+        if self._rows_by_node is None:
+            rows = self.materialize_all()
+            by_node: Dict[str, list] = {nid: [] for nid in self.node_table}
+            for a in rows:
+                by_node[a.node_id].append(a)
+            self._rows_by_node = by_node
+        return self._rows_by_node.get(node_id, [])

@@ -169,15 +169,21 @@ def allocs_fit(node: Node, allocs: List[Allocation],
         # An alloc's static port appears BOTH in its allocated_ports (the
         # assignment) and in its resources.networks reserved_ports (the
         # ask): ask + fulfillment are ONE claim, not a self-collision.
-        # But two labels assigned the same value, or two networks both
-        # reserving one value, ARE a real within-alloc collision and must
-        # still refute — so an ask is skipped only when ITS OWN label
-        # (assign_ports keys unlabeled ports by value) holds its value.
+        # But two labels assigned the same value, or two asks of one
+        # value (even sharing a label), ARE a real within-alloc collision
+        # and must still refute — so each assignment entry absorbs AT
+        # MOST ONE matching ask (assign_ports keys unlabeled ports by
+        # value).
         ports = list(a.allocated_ports.values())
         ap_get = a.allocated_ports.get
+        consumed: Set[str] = set()
         for net in a.resources.networks:
-            ports.extend(p.value for p in net.reserved_ports
-                         if ap_get(p.label or str(p.value)) != p.value)
+            for p in net.reserved_ports:
+                label = p.label or str(p.value)
+                if label not in consumed and ap_get(label) == p.value:
+                    consumed.add(label)     # fulfilled by the assignment
+                    continue
+                ports.append(p.value)
         for port in ports:
             if port in seen_ports:
                 return False, "network: port collision", used

@@ -930,6 +930,13 @@ class Plan:
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     # node_id -> allocs preempted to make room
     node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    # columnar bulk placements (structs.block.AllocBlock): one eval's
+    # homogeneous placements as picks + shared template, committed to the
+    # store WITHOUT materializing per-alloc objects (the round-3 profile's
+    # dominant host cost).  The applier expands a block into
+    # node_allocation only when it must re-check per node (broken fence,
+    # refused node) — see Plan.expand_blocks.
+    alloc_blocks: List = field(default_factory=list)
     deployment: Optional[Deployment] = None
     deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
     annotations: Optional["PlanAnnotations"] = None
@@ -966,8 +973,18 @@ class Plan:
 
     def is_no_op(self) -> bool:
         return (not self.node_update and not self.node_allocation
-                and not self.node_preemptions and self.deployment is None
+                and not self.node_preemptions and not self.alloc_blocks
+                and self.deployment is None
                 and not self.deployment_updates)
+
+    def expand_blocks(self) -> None:
+        """Materialize every alloc block into node_allocation (the
+        applier's fallback when it needs per-node granularity: broken
+        fence -> AllocsFit re-check, or a refused node in a block)."""
+        for block in self.alloc_blocks:
+            for a in block.materialize_all():
+                self.node_allocation.setdefault(a.node_id, []).append(a)
+        self.alloc_blocks = []
 
 
 @dataclass
@@ -975,14 +992,17 @@ class PlanResult:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    alloc_blocks: List = field(default_factory=list)
     deployment: Optional[Deployment] = None
     deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
     refuted_nodes: List[str] = field(default_factory=list)
     alloc_index: int = 0
 
     def full_commit(self, plan: Plan) -> Tuple[bool, int, int]:
-        expected = sum(len(v) for v in plan.node_allocation.values())
-        actual = sum(len(v) for v in self.node_allocation.values())
+        expected = (sum(len(v) for v in plan.node_allocation.values())
+                    + sum(b.count for b in plan.alloc_blocks))
+        actual = (sum(len(v) for v in self.node_allocation.values())
+                  + sum(b.count for b in self.alloc_blocks))
         return actual == expected, expected, actual
 
 

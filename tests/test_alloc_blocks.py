@@ -1,0 +1,204 @@
+"""Columnar alloc blocks (structs.block.AllocBlock): bulk placements
+commit as picks + template, materialize lazily on read, and convert to
+ordinary table rows the moment a member alloc is written.
+
+No reference analog — this replaces stock's per-placement Allocation
+materialization (scheduler/generic_sched.go computePlacements), which the
+round-3 profile showed costing more than the device placement work.
+"""
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import AllocBlock, Allocation, Resources
+
+NOW = 1.7e9
+
+
+def run_bulk(count=100, n_nodes=20, eval_batch=0, cpu=100, mem=64):
+    s = Server(dev_mode=True, eval_batch=eval_batch)
+    s.establish_leadership()
+    for _ in range(n_nodes):
+        n = mock.node()
+        n.resources.cpu = 8000
+        n.resources.memory_mb = 16384
+        s.register_node(n, now=NOW)
+    job = mock.batch_job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].resources.cpu = cpu
+    job.task_groups[0].tasks[0].resources.memory_mb = mem
+    s.register_job(job, now=NOW)
+    s.process_all(now=NOW)
+    return s, job
+
+
+class TestBlockCommit:
+    def test_bulk_placement_commits_columnar(self):
+        s, job = run_bulk(count=100)
+        # the commit itself stayed columnar: a live block, no table rows
+        assert s.state._alloc_blocks, "bulk placements should be a block"
+        assert not s.state._allocs_by_job.get((job.namespace, job.id))
+        # reads materialize lazily and see ordinary allocs
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 100
+        names = {a.name for a in live}
+        assert f"{job.id}.{job.task_groups[0].name}[0]" in names
+        assert len({a.id for a in live}) == 100
+        assert all(a.create_index > 0 for a in live)
+        # per-node reads agree with per-job reads
+        by_node_total = sum(
+            len(snap.allocs_by_node(nid))
+            for nid in {a.node_id for a in live})
+        assert by_node_total == 100
+
+    def test_alloc_by_id_reads_block_rows(self):
+        s, job = run_bulk(count=80)
+        snap = s.state.snapshot()
+        some = snap.allocs_by_job(job.namespace, job.id)[5]
+        assert snap.alloc_by_id(some.id).id == some.id
+        assert s.state.alloc_by_id(some.id).id == some.id
+
+    def test_member_write_materializes_block(self):
+        s, job = run_bulk(count=80)
+        assert s.state._alloc_blocks
+        a = s.state.allocs_by_job(job.namespace, job.id)[0]
+        upd = a.copy_skip_job()
+        upd.client_status = "complete"
+        s.state.update_allocs_from_client([upd])
+        # representation flipped: block gone, all rows in tables
+        assert not s.state._alloc_blocks
+        bucket = s.state._allocs_by_job[(job.namespace, job.id)]
+        assert len(bucket) == 80
+        assert bucket[a.id].client_status == "complete"
+        # non-updated rows keep their identity
+        live = [x for x in s.state.allocs_by_job(job.namespace, job.id)
+                if not x.terminal_status()]
+        assert len(live) == 79
+
+    def test_snapshot_isolation_across_materialization(self):
+        s, job = run_bulk(count=80)
+        snap_before = s.state.snapshot()
+        a = s.state.allocs_by_job(job.namespace, job.id)[0]
+        upd = a.copy_skip_job()
+        upd.client_status = "failed"
+        s.state.update_allocs_from_client([upd])
+        snap_after = s.state.snapshot()
+        # both views count every alloc exactly once
+        before = snap_before.allocs_by_job(job.namespace, job.id)
+        after = snap_after.allocs_by_job(job.namespace, job.id)
+        assert len(before) == len(after) == 80
+        assert len({x.id for x in before}) == 80
+        # the old snapshot must not see the update
+        assert all(x.client_status == "pending" for x in before)
+        assert sum(x.client_status == "failed" for x in after) == 1
+
+    def test_usage_tracked_through_block_lifecycle(self):
+        s, job = run_bulk(count=100, cpu=50, mem=32)
+        packer = s.engine.packer
+        t = packer.update(s.state.snapshot())
+        assert int(t.used[:, 0].sum()) == 100 * 50
+        assert int(t.used[:, 1].sum()) == 100 * 32
+        # a member going terminal releases exactly its usage
+        a = s.state.allocs_by_job(job.namespace, job.id)[0]
+        upd = a.copy_skip_job()
+        upd.client_status = "complete"
+        s.state.update_allocs_from_client([upd])
+        t = packer.update(s.state.snapshot())
+        assert int(t.used[:, 0].sum()) == 99 * 50
+        assert int(t.used[:, 1].sum()) == 99 * 32
+
+    def test_snapshot_save_restore_flattens_blocks(self):
+        s, job = run_bulk(count=80)
+        assert s.state._alloc_blocks
+        doc = s.state.snapshot_save()
+        from nomad_tpu.state import StateStore
+        fresh = StateStore()
+        fresh.snapshot_restore(doc)
+        live = [a for a in fresh.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()]
+        assert len(live) == 80
+        assert not fresh._alloc_blocks
+
+    def test_same_id_stop_through_plan_materializes(self):
+        """A later plan stopping a block member (job update path) sees it
+        as its predecessor."""
+        s, job = run_bulk(count=80)
+        a = s.state.allocs_by_job(job.namespace, job.id)[0]
+        from nomad_tpu.structs import Plan, PlanResult
+        stop = a.copy_skip_job()
+        plan = Plan(eval_id="stop", job=job)
+        plan.append_stopped_alloc(stop, "test stop")
+        result = PlanResult(node_update=plan.node_update)
+        s.state.upsert_plan_results(plan, result)
+        got = s.state.alloc_by_id(a.id)
+        assert got.desired_status == "stop"
+        assert got.create_index == a.create_index   # predecessor seen
+        live = [x for x in s.state.allocs_by_job(job.namespace, job.id)
+                if not x.terminal_status() and x.desired_status == "run"]
+        assert len(live) == 79
+
+
+class TestBlockApplier:
+    def test_broken_fence_expands_blocks(self):
+        """With a foreign write between snapshot and apply, block plans
+        take the full per-node path (and still commit correctly)."""
+        s, job = run_bulk(count=100, eval_batch=64)
+        stats = s.plan_applier.stats
+        assert stats["fast_path"] >= 1
+        # now force full checks: concurrent foreign writes each round
+        job2 = mock.batch_job()
+        job2.task_groups[0].count = 100
+        job2.task_groups[0].tasks[0].resources.cpu = 10
+        job2.task_groups[0].tasks[0].resources.memory_mb = 10
+        s.register_job(job2, now=NOW + 1)
+        # break the fence mid-flight: a node write after the snapshot
+        s.register_node(mock.node(), now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(job2.namespace, job2.id)
+                if not a.terminal_status()]
+        assert len(live) == 100
+
+    def test_down_node_in_block_refutes_only_that_node(self):
+        """Whole-block admission fails when a picked node is down; the
+        expansion path refutes that node's rows and commits the rest."""
+        from nomad_tpu.core import PlanApplier, PlanQueue
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs import Plan
+
+        state = StateStore()
+        q = PlanQueue()
+        q.set_enabled(True)
+        applier = PlanApplier(state, q)
+        n1, n2 = mock.node(), mock.node()
+        state.upsert_node(n1)
+        state.upsert_node(n2)
+        job = mock.batch_job()
+        state.upsert_job(job)
+        tg = job.task_groups[0]
+        tmpl = Allocation(namespace=job.namespace, job_id=job.id, job=job,
+                          task_group=tg.name, desired_status="run",
+                          client_status="pending",
+                          resources=Resources(cpu=10, memory_mb=10))
+        from nomad_tpu.structs import new_ids
+        ids = new_ids(10)
+        block = AllocBlock(id="blk1", template=tmpl, ids=ids,
+                           name_prefix=f"{job.id}.{tg.name}[",
+                           indexes=list(range(10)),
+                           picks=np.array([0, 1] * 5, np.int32),
+                           node_table=[n1.id, n2.id])
+        seq0 = state.placement_seq()
+        state.update_node_status(n2.id, "down")
+        plan = Plan(eval_id="e1", job=job, coupled_batch=("b1", seq0))
+        plan.alloc_blocks = [block]
+        p = q.enqueue(plan)
+        applier.apply_one(p)
+        result, err = p.wait(1)
+        assert err is None
+        assert result.refuted_nodes == [n2.id]
+        snap = state.snapshot()
+        assert len(snap.allocs_by_node(n1.id)) == 5
+        assert len(snap.allocs_by_node(n2.id)) == 0
