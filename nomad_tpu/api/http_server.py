@@ -204,8 +204,12 @@ class Router:
         head = p[0] if p else ""
         if head == "acl" and p[1:2] == ["bootstrap"]:
             return None                 # one-shot, self-guarding
-        if head == "acl" and p[1:3] == ["token", "self"]:
-            return None                 # any valid token may read itself
+        if (head == "acl" and p[1:3] == ["token", "self"]
+                and method == "GET"):
+            # any valid token may READ itself; non-GET verbs fall through
+            # to normal enforcement (the bypass must stay scoped to the
+            # single handler that uses it)
+            return None
         acl, err = s.resolve_token(token)
         if acl is None:
             raise APIError(403, err or "permission denied")

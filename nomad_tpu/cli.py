@@ -717,8 +717,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="this agent's region (default: config or global)")
     ag.add_argument("-join-wan", dest="join_wan", action="append",
                     default=[],
-                    help="HTTP URL of an agent in another region to "
-                         "federate with (repeatable)")
+                    help="URL of an agent in another region to federate "
+                         "with (repeatable).  Use https on untrusted "
+                         "networks: cross-region forwarding carries ACL "
+                         "tokens and variable contents, and the cluster "
+                         "wire encryption does NOT cover federation "
+                         "HTTP (plaintext URLs are adopted with a "
+                         "loud warning)")
     ag.add_argument("-join-wan-token", dest="join_wan_token", default="",
                     help="management token for the -join-wan peer "
                          "(required when the peer enforces ACLs)")

@@ -45,6 +45,17 @@ class EvalBroker:
         self._outstanding: Dict[str, Tuple[str, float, Evaluation]] = {}
         self._dequeues: Dict[str, int] = {}       # delivery attempts
         self._failed: List[Evaluation] = []
+        # optional batch-partition callback (eval -> hashable key): when
+        # set, dequeue_batch hands out SINGLE-KEY batches — evals whose
+        # key differs from the batch head's stay queued for another
+        # worker.  The server wires this with >1 worker so concurrent
+        # batches operate on (probably) disjoint node sets: jobs sharing
+        # a placement-domain signature (datacenters, pool, CSI volume
+        # topologies) contend for the same nodes; distinct signatures
+        # mostly do not, so the per-node fence keeps every worker on the
+        # applier fast path.  (reference contrast: nomad's num_schedulers
+        # workers dequeue blindly and resolve collisions at plan apply.)
+        self.partition_of = None
         self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0,
                       "nacked": 0, "failed": 0}
 
@@ -126,14 +137,36 @@ class EvalBroker:
         if ev is None:
             return out
         out.append((ev, token))
+        part = self.partition_of
+        want_key = part(ev) if part is not None else None
         with self._cv:
             self._tick_locked(now)     # expired redeliveries join the batch
+            skipped: List[Evaluation] = []
             while len(out) < max_n and self._enabled:
                 nxt = self._pop_ready_locked(schedulers)
                 if nxt is None:
                     break
+                if part is not None and part(nxt) != want_key:
+                    skipped.append(nxt)    # another partition's work
+                    continue
                 out.append((nxt, self._issue_locked(nxt, now)))
+            # put other partitions' evals back for the next worker
+            for ev2 in skipped:
+                heap = self._ready.setdefault(ev2.type, [])
+                heapq.heappush(heap, (-ev2.priority, next(self._seq), ev2))
+            if skipped:
+                self._cv.notify()
         return out
+
+    def token_valid(self, eval_id: str, token: str) -> bool:
+        """Is `token` the CURRENT delivery of `eval_id`?  The plan
+        applier rejects plans carrying a superseded token — a worker that
+        held a batch past the redelivery deadline (device compile, GC
+        pause) must not commit concurrently with the redelivery's worker
+        (reference: the Evaluation.EvalToken check at plan submission)."""
+        with self._lock:
+            rec = self._outstanding.get(eval_id)
+            return rec is not None and rec[0] == token
 
     def extend_outstanding(self, pairs, now: float) -> None:
         """Restart the nack deadline for deliveries a worker is about to

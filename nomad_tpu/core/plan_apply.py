@@ -38,6 +38,10 @@ NODE_OK = 0
 NODE_REFUSED = 1
 NODE_CLAIM_REFUSED = 2
 
+
+class StaleDeliveryError(Exception):
+    """The plan's eval delivery token was superseded by a redelivery."""
+
 _NULL_GUARD = contextlib.nullcontext()
 
 
@@ -140,17 +144,24 @@ class PlanApplier:
         self.queue = queue
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # coupled-batch fast path: (batch_id, expected placement_seq).
-        # Plans of one multi-eval batch were computed against shared
-        # proposed capacity on device — they cannot oversubscribe a node
-        # collectively — so while the store's placement_seq shows ONLY
-        # this chain's own commits since the batch's snapshot, the
-        # per-node AllocsFit re-check is provably redundant and skipped.
-        # Any foreign placement-relevant write breaks the seq fence and
-        # restores the full re-check (optimistic-concurrency safety
-        # exactly as the reference's evaluatePlan).
-        self._chain: Optional[Tuple[str, int]] = None
-        self.stats = {"fast_path": 0, "full_check": 0}
+        # Coupled-batch fast path, fenced PER NODE: a fenced plan's
+        # AllocsFit re-check is provably redundant while each of its
+        # placement nodes was last written either BEFORE the plan's
+        # snapshot or BY the plan's own chain — chain plans were
+        # co-computed on device against shared proposed capacity and
+        # cannot oversubscribe a node collectively.  A foreign write to
+        # one of the plan's nodes restores the full re-check for that
+        # plan only; disjoint concurrent workers (zone-partitioned
+        # batches) never demote each other (optimistic-concurrency safety
+        # exactly as the reference's evaluatePlan, at the reference's own
+        # per-node granularity).
+        self.stats = {"fast_path": 0, "full_check": 0, "stale_token": 0}
+        # optional (eval_id, token) -> bool gate, wired by the Server to
+        # the eval broker: plans from a SUPERSEDED delivery (the eval was
+        # redelivered while this worker sat in a device compile) are
+        # rejected instead of double-committing (reference: the EvalToken
+        # check at plan submission)
+        self.token_check = None
 
     # ------------------------------------------------------------ running
 
@@ -175,50 +186,61 @@ class PlanApplier:
 
     # ------------------------------------------------------------- apply
 
+    @staticmethod
+    def _plan_nodes(plan: Plan):
+        """The plan's placement nodes — what the per-node fence covers."""
+        nodes = set(plan.node_allocation)
+        for block in plan.alloc_blocks:
+            nodes.update(block.node_table)
+        return nodes
+
     def apply_one(self, pending: PendingPlan) -> None:
         plan = pending.plan
         try:
-            # coupled-batch fast path: decide against the CURRENT fence.
-            # The commit itself re-verifies the fence under the store lock
-            # (upsert_plan_results returns -1 on a slipped-in foreign
-            # write) and the chain advances ONLY on fast commits — after
-            # any full-checked commit the remaining batch plans were
-            # computed against a snapshot that never saw the foreign
-            # write, so they must full-check too.
-            seq_now = self.state.placement_seq()
+            if (self.token_check is not None and plan.eval_token
+                    and not self.token_check(plan.eval_id,
+                                             plan.eval_token)):
+                self.stats["stale_token"] += 1
+                pending.respond(None, StaleDeliveryError(
+                    f"eval {plan.eval_id} was redelivered; this "
+                    "worker's delivery is superseded"))
+                return
+            # per-node fence decision; the commit re-verifies it under
+            # the store lock (upsert_plan_results returns -1 when a
+            # foreign write to one of the plan's nodes slipped between
+            # the decision and the commit)
             fast = False
+            fenced_first = False
+            touched = None
+            bid = seq0 = None
             if plan.coupled_batch is not None:
                 bid, seq0 = plan.coupled_batch
-                if self._chain is None or self._chain[0] != bid:
-                    self._chain = (bid, seq0)
-                fast = seq_now == self._chain[1]
-            result = self.evaluate_plan(
-                plan, skip_fit=fast,
-                fenced_first=(fast and plan.coupled_batch is not None
-                              and seq_now == plan.coupled_batch[1]))
+                touched = self._plan_nodes(plan)
+                fast = self.state.nodes_unchanged_since(touched, seq0, bid)
+                # "first" = not even the plan's own chain has written these
+                # nodes: that is where batch-mate port collisions hide, so
+                # the port/device demotion keys off it
+                fenced_first = fast and self.state.nodes_unchanged_since(
+                    touched, seq0, bid, own_chain_ok=False)
+            result = self.evaluate_plan(plan, skip_fit=fast,
+                                        fenced_first=fenced_first)
             idx = self.state.upsert_plan_results(
-                plan, result, expected_placement_seq=seq_now if fast
-                else None)
+                plan, result,
+                expected_nodes=(touched, seq0, bid,
+                                getattr(result, "volume_seq", None))
+                if fast else None)
             if idx == -1:
-                # a foreign write landed between the fence read and the
-                # commit: redo with the full optimistic re-check
-                self._chain = (self._chain[0], -1)
-                fast = False
+                # a foreign write landed on one of the plan's nodes between
+                # the fence read and the commit: redo with the full check
                 result = self.evaluate_plan(plan, skip_fit=False)
                 self.state.upsert_plan_results(plan, result)
             if result.refuted_nodes:
                 log("plan", "warn", "plan partially refuted",
                     eval_id=plan.eval_id,
                     refuted=len(result.refuted_nodes))
-            if plan.coupled_batch is not None:
-                self._chain = (self._chain[0],
-                               seq_now + 1 if fast else -1)
             result.alloc_index = self.state.latest_index()
             pending.respond(result, None)
         except Exception as e:  # noqa: BLE001
-            # no (or unknown) commit: the chain's arithmetic no longer
-            # holds — drop it so the rest of the batch full-checks
-            self._chain = None
             pending.respond(None, e)
 
     def evaluate_plan(self, plan: Plan, skip_fit: bool = False,
@@ -299,6 +321,11 @@ class PlanApplier:
         guard = (self.state.locked() if snap is self.state
                  else _NULL_GUARD)
         with guard:
+            # volume-mutation counter AT the guarded claim checks: the
+            # commit re-verifies it (expected_nodes) so a volume write
+            # landing after the guard releases forces a full redo
+            result.volume_seq = (self.state.volume_seq()
+                                 if snap is self.state else None)
             if plan.alloc_blocks:
                 if self._blocks_ok(snap, plan):
                     result.alloc_blocks = list(plan.alloc_blocks)
@@ -383,12 +410,21 @@ class PlanApplier:
     @staticmethod
     def _carries_host_assigned(plan: Plan) -> bool:
         """Any placement carrying a port/device assignment — or even just
-        a network ask (allocs_fit counts reserved-port asks too)."""
+        a network ask (allocs_fit counts reserved-port asks too).  Block
+        TEMPLATES are inspected too: a block the scheduler should never
+        build (ports ride the per-alloc path) must still demote if a
+        caller hand-built one, because the expanded per-node path only
+        re-checks collisions when skip_fit is off."""
         for allocs in plan.node_allocation.values():
             for a in allocs:
                 if (a.allocated_ports or a.allocated_devices
                         or a.resources.networks):
                     return True
+        for block in plan.alloc_blocks:
+            tmpl = block.template
+            if (tmpl.allocated_ports or tmpl.allocated_devices
+                    or tmpl.resources.networks):
+                return True
         return False
 
     def _node_plan_ok(self, snap, plan: Plan, node_id: str,

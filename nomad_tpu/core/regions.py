@@ -56,12 +56,25 @@ class RegionFederation:
 
     def merge(self, table: Dict[str, str]) -> None:
         """Adopt peer entries; NEVER let a peer overwrite our own region's
-        address (a misconfigured peer must not hijack local forwarding)."""
+        address (a misconfigured peer must not hijack local forwarding).
+
+        Plaintext federation URLs are adopted but LOUDLY flagged:
+        cross-region forwarding carries the caller's ACL token, job
+        bodies, and variable contents, and the cluster's wire encryption
+        covers only raft/serf/rpc — over an untrusted WAN these must ride
+        https (reference posture: TLS-only cross-region RPC)."""
         with self._lock:
             for region, url in (table or {}).items():
                 if region == self.region:
                     continue
                 if isinstance(region, str) and isinstance(url, str):
+                    if url.startswith("http://"):
+                        log("regions", "warn",
+                            "PLAINTEXT federation URL adopted — "
+                            "cross-region requests (including ACL "
+                            "tokens and variable contents) will be "
+                            "unencrypted on the WAN; use https",
+                            region=region, url=url)
                     self._urls[region] = url.rstrip("/")
 
     # -------------------------------------------------------------- join

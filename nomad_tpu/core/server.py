@@ -57,7 +57,8 @@ class Server:
                  failed_follow_up_delay: tuple = (60.0, 240.0),
                  acl_enabled: bool = False,
                  state: Optional[StateStore] = None,
-                 eval_batch: int = 64) -> None:
+                 eval_batch: int = 64,
+                 nack_timeout: Optional[float] = None) -> None:
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -67,10 +68,22 @@ class Server:
         # scheduling domain this server belongs to (reference:
         # nomad/regions.go); the Agent overrides it from its config
         self.region = "global"
-        self.eval_broker = EvalBroker()
+        self.eval_broker = (EvalBroker(nack_timeout=nack_timeout)
+                            if nack_timeout else EvalBroker())
+        if num_workers > 1:
+            # zone/domain-partitioned batches: concurrent workers get
+            # single-signature batches whose jobs contend for (mostly)
+            # disjoint node sets, so the applier's per-node fence keeps
+            # every worker on the skip-fit fast path (see
+            # EvalBroker.partition_of)
+            self.eval_broker.partition_of = self._eval_partition
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.state, self.plan_queue)
+        # stale-delivery gate: a worker that held evals past the
+        # redelivery deadline (device compile) must not double-commit
+        # concurrently with the redelivery's worker
+        self.plan_applier.token_check = self.eval_broker.token_valid
         self.heartbeats = HeartbeatTimers(ttl=heartbeat_ttl)
         self.deployments = DeploymentWatcher(self)
         self.drainer = NodeDrainer(self)
@@ -582,6 +595,20 @@ class Server:
                                                    index=index)
 
     # --------------------------------------------------------------- tick
+
+    def _eval_partition(self, ev):
+        """Placement-domain signature of an eval's job: jobs sharing it
+        contend for the same nodes (same datacenters/pool and the same
+        CSI volume topologies); distinct signatures mostly don't.  Used
+        by the broker to hand concurrent workers disjoint batches."""
+        job = self.state.job_by_id(ev.namespace, ev.job_id)
+        if job is None:
+            return None
+        vols = tuple(sorted(
+            vr.source for tg in job.task_groups
+            for vr in (tg.volumes or {}).values()
+            if vr.type == "csi" and vr.source))
+        return (tuple(sorted(job.datacenters)), job.node_pool, vols)
 
     def tick(self, now: Optional[float] = None) -> None:
         """Periodic leader duties: broker delayed-eval promotion + nack

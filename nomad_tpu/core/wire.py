@@ -234,8 +234,28 @@ def _register_nonce(nonce: bytes, ts: float, now: float) -> None:
                 del _seen_nonces[k]
             else:
                 break
-        while len(_seen_nonces) > MAX_SEEN_NONCES:
-            del _seen_nonces[next(iter(_seen_nonces))]
+        if len(_seen_nonces) > MAX_SEEN_NONCES:
+            # Overflow: sweep EVERY expired entry (a single
+            # future-timestamped nonce from a clock-skewed peer at the
+            # dict front must not pin expired entries behind it — an
+            # insertion-order-only sweep caused exactly that, a
+            # cluster-wide frame outage).  Only if the cache is still
+            # over the cap after the full sweep — genuinely full of
+            # unexpired nonces — is the NEW frame rejected (fail closed:
+            # evicting an unexpired nonce would let a captured frame
+            # replay inside its freshness window).  Attackers cannot
+            # force this (registration is post-auth); a cluster
+            # organically sustaining > MAX_SEEN_NONCES / REPLAY_WINDOW_S
+            # frames/sec needs the cap raised, and the error says so.
+            expired = [k for k, exp in _seen_nonces.items() if exp < now]
+            for k in expired:
+                del _seen_nonces[k]
+            if len(_seen_nonces) > MAX_SEEN_NONCES:
+                del _seen_nonces[nonce]
+                raise ValueError(
+                    "replay cache full of unexpired nonces; frame "
+                    "rejected (sustained frame rate exceeds "
+                    "MAX_SEEN_NONCES / REPLAY_WINDOW_S — raise the cap)")
 
 
 def decode_body(body: bytes, tag: bytes = b"") -> Any:

@@ -40,6 +40,11 @@ class Worker:
         self._snapshot = None
         self._snapshot_seq: Optional[int] = None
         self._eval_token = ""
+        # delivery tokens of the batch in flight, keyed by eval id: every
+        # submitted plan carries its eval's CURRENT token so the applier
+        # can reject plans from superseded deliveries (see
+        # PlanApplier.token_check)
+        self._batch_tokens: Dict[str, str] = {}
         # the timebase of the eval currently being processed: eval
         # updates (and their delayed follow-ups) must use the SAME clock
         # the scheduler ran with, not a fresh wall-clock read (tests and
@@ -99,6 +104,7 @@ class Worker:
         if evaluation is None:
             return 0
         self._eval_token = token
+        self._batch_tokens = {evaluation.id: token}
         try:
             err = self._invoke(evaluation, t)
         except Exception as e:  # noqa: BLE001 - a scheduler bug must nack,
@@ -228,6 +234,12 @@ class Worker:
                 pending = self.server.engine.dispatch_batch(
                     snapshot, items, seed=seed, used0_dev=used_dev)
                 prepared_idx = [i for i, _ in prepared]
+                # the batch now heads into a device wait that may include
+                # a first-time compile: restart the delivery deadlines so
+                # the broker doesn't redeliver mid-launch
+                self.server.eval_broker.extend_outstanding(
+                    [(ev.id, token) for ev, token in batch],
+                    now=time.time())
             except Exception as e:  # noqa: BLE001 - solo fallback
                 log("worker", "warn", "batch launch failed; going solo",
                     worker=self.id, error=str(e))
@@ -252,9 +264,17 @@ class Worker:
         # them into redelivery while this worker is mid-processing
         self.server.eval_broker.extend_outstanding(
             [(ev.id, token) for ev, token in pf["batch"]], now=t)
+        self._batch_tokens = {ev.id: token for ev, token in pf["batch"]}
         bds = {}
         if pf["pending"] is not None:
             decisions = self.server.engine.collect_batch(pf["pending"])
+            # the collect may have sat in a first-time device compile for
+            # longer than the redelivery deadline: restart the batch's
+            # deadlines so the HOST phase doesn't run superseded (plans
+            # from a superseded delivery are rejected at the applier)
+            self.server.eval_broker.extend_outstanding(
+                [(ev.id, token) for ev, token in pf["batch"]],
+                now=time.time())
             bds = {i: d for i, d in zip(pf["prepared_idx"], decisions)}
 
         # cross-batch prefetch: with this batch fully coupled and more
@@ -387,6 +407,7 @@ class Worker:
         from mutable worker state, which can advance past a stale
         scheduler's view mid-batch."""
         plan.snapshot_index = self._snapshot.index if self._snapshot else 0
+        plan.eval_token = self._batch_tokens.get(plan.eval_id, "")
         pending = self.server.plan_queue.enqueue(plan)
         # the applier thread evaluates + commits; in single-threaded test
         # mode the server applies inline

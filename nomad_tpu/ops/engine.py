@@ -901,20 +901,25 @@ class PlacementEngine:
         # batches land on a handful of compiled shapes
         c_max = _pad_pow2(max(tt.con.shape[1] for tt in tgts), lo=1)
         a_max = _pad_pow2(max(tt.aff.shape[1] for tt in tgts), lo=1)
-        con = np.zeros((g_pad, c_max, 3), np.int32)
-        aff = np.zeros((g_pad, a_max, 4), np.int32)
         req = np.zeros((g_pad, 3), np.int32)
         desired = np.ones(g_pad, np.int32)
         dh_limit = np.zeros(g_pad, np.int32)
-        g_mask = np.zeros(g_pad, np.int32)
+        # Constraint/affinity signatures dedupe across the batch: the
+        # kernel evaluates ONE [N] landscape per distinct signature and
+        # rounds index into them (a uniform 384-eval batch carries ~5).
+        g_static = np.zeros(g_pad, np.int32)
+        g_aff = np.zeros(g_pad, np.int32)
+        static_keys: Dict[bytes, int] = {}
+        static_con: List[np.ndarray] = []
+        static_mi: List[int] = []
+        aff_keys: Dict[bytes, int] = {}
+        aff_rows: List[np.ndarray] = []
         mask_keys: Dict[tuple, int] = {}
         mask_rows: List[object] = []
         jc_nz_idx: List[int] = []
         jc_nz_rows: List[np.ndarray] = []
         for gi, it in enumerate(items):
             tt, ctx = tgts[gi], ctxs[gi]
-            con[gi, :tt.con.shape[1]] = tt.con[0]
-            aff[gi, :tt.aff.shape[1]] = tt.aff[0]
             req[gi] = tt.req[0]
             desired[gi] = max(it.tg.count, 1)
             dh_limit[gi] = tt.dh_limit[0]
@@ -927,7 +932,25 @@ class PlacementEngine:
                     ("basemask", t.version, npad) + key,
                     lambda ctx=ctx: _pad_rows(
                         ctx.dc_mask & ctx.pool_mask, npad, False)))
-            g_mask[gi] = mi
+            con_row = np.zeros((c_max, 3), np.int32)
+            con_row[:tt.con.shape[1]] = tt.con[0]
+            skey = con_row.tobytes() + mi.to_bytes(4, "little")
+            si = static_keys.get(skey)
+            if si is None:
+                si = len(static_con)
+                static_keys[skey] = si
+                static_con.append(con_row)
+                static_mi.append(mi)
+            g_static[gi] = si
+            aff_row = np.zeros((a_max, 4), np.int32)
+            aff_row[:tt.aff.shape[1]] = tt.aff[0]
+            akey = aff_row.tobytes()
+            ai = aff_keys.get(akey)
+            if ai is None:
+                ai = len(aff_rows)
+                aff_keys[akey] = ai
+                aff_rows.append(aff_row)
+            g_aff[gi] = ai
             if ctx.job_count.any():
                 jc_nz_idx.append(gi)
                 jc_nz_rows.append(ctx.job_count)
@@ -936,6 +959,16 @@ class PlacementEngine:
                                lambda: np.zeros(npad, bool))
         mask_rows.extend([zrow] * (m_pad - len(mask_rows)))
         base_mask = jnp.stack(mask_rows)
+        u_pad = _pad_pow2(len(static_con), lo=1)
+        con = np.zeros((u_pad, c_max, 3), np.int32)
+        u_mask = np.zeros(u_pad, np.int32)
+        for si, row in enumerate(static_con):
+            con[si] = row
+            u_mask[si] = static_mi[si]
+        ua_pad = _pad_pow2(len(aff_rows), lo=1)
+        aff = np.zeros((ua_pad, a_max, 4), np.int32)
+        for ai, row in enumerate(aff_rows):
+            aff[ai] = row
 
         # per-job alloc-count rows: device zeros + a scatter of only the
         # jobs that actually have live allocs (fresh jobs upload nothing)
@@ -976,9 +1009,11 @@ class PlacementEngine:
         inp = MultiEvalInputs(
             attrs=dev["attrs"], cap=dev["cap"], used0=used0,
             elig=dev["elig"], luts=luts_dev, base_mask=base_mask,
-            con=jnp.asarray(con), aff=jnp.asarray(aff),
+            con=jnp.asarray(con), u_mask=jnp.asarray(u_mask),
+            aff=jnp.asarray(aff),
             req=jnp.asarray(req), desired=jnp.asarray(desired),
-            dh_limit=jnp.asarray(dh_limit), g_mask=jnp.asarray(g_mask),
+            dh_limit=jnp.asarray(dh_limit),
+            g_static=jnp.asarray(g_static), g_aff=jnp.asarray(g_aff),
             g_job=jnp.arange(g_pad, dtype=jnp.int32),
             job_count0=jc0,
             spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),

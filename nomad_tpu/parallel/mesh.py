@@ -352,28 +352,33 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
     offset = jax.lax.axis_index(AXIS) * n_loc
     global_rows = offset + jnp.arange(n_loc)
 
-    base = inp.elig[None, :] & inp.base_mask[inp.g_mask]        # [G, n_loc]
-    static_all = constraint_mask(inp.attrs, inp.con, inp.luts) & base
-    if inp.extra_mask is not None:
-        static_all = static_all & inp.extra_mask
-    aff_all = affinity_score(inp.attrs, inp.aff, inp.luts)
-    aff_any_all = jnp.any(inp.aff[..., 3] != 0, axis=1)
+    # deduped signature landscapes, same as ops.select.place_multi_packed
+    # (per-signature [U, n_loc], NOT per task group — the per-G form's
+    # LUT/attr gathers were the dominant launch cost)
+    static_u = (constraint_mask(inp.attrs, inp.con, inp.luts)
+                & inp.elig[None, :] & inp.base_mask[inp.u_mask])
+    aff_u = affinity_score(inp.attrs, inp.aff, inp.luts)
+    aff_any_u = jnp.any(inp.aff[..., 3] != 0, axis=1)
     noise = tiebreak_noise(inp.seed, global_rows)
+    rg = inp.round_g
+    u_r = inp.g_static[rg]
+    a_r = inp.g_aff[rg]
+    jc_r = inp.job_count0[inp.g_job[rg]]
+    req_r = inp.req[rg]
+    des_r = inp.desired[rg]
+    dh_r = inp.dh_limit[rg]
+    jobs_r = inp.g_job[rg]
+    same_r = jnp.concatenate([jnp.zeros(1, bool),
+                              jobs_r[1:] == jobs_r[:-1]])
 
-    # current-job count row carry, like ops.select.place_multi_packed: a
-    # job's rounds are consecutive, so fresh jobs gather their row from
-    # the read-only sharded job_count0 input instead of carrying (and
-    # copying) the whole [J, n_loc] table every round
     def round_step(carry, xs):
-        used, cur_count, prev_j = carry
-        g, want = xs
-        j = inp.g_job[g]
-        job_count = jnp.where(j == prev_j, cur_count, inp.job_count0[j])
-        req = inp.req[g]
-        static = static_all[g]
+        used, cur_count = carry
+        (u, a, jc0_row, req, desired, dh_limit, want, same) = xs
+        static = static_u[u]
+        job_count = jnp.where(same, cur_count, jc0_row)
         k_i, score = round_scores_g(
-            inp.cap, req, inp.desired[g], inp.dh_limit[g], static,
-            aff_all[g], aff_any_all[g], used, job_count,
+            inp.cap, req, desired, dh_limit, static,
+            aff_u[a], aff_any_u[a], used, job_count,
             inp.spread_algo, round_size)
         (rows_p, cnt_p, sc_p, top_rows, top_sc, n_feas, n_filt,
          c_i, placed) = _sharded_waterfill(
@@ -382,16 +387,17 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
         used = used + c_i[:, None] * req[None, :]
         job_count = job_count + c_i
         n_exh_l, dim_ex_l = round_metrics_g(
-            inp.cap, req, inp.dh_limit[g], static, used, job_count)
+            inp.cap, req, dh_limit, static, used, job_count)
         n_exh = jax.lax.psum(n_exh_l, AXIS).astype(jnp.int32)
         dim_ex = jax.lax.psum(dim_ex_l, AXIS).astype(jnp.int32)
         out = (rows_p, cnt_p, sc_p, top_rows, top_sc,
                n_feas, n_filt, n_exh, dim_ex, placed)
-        return (used, job_count, j), out
+        return (used, job_count), out
 
-    carry0 = (inp.used0, inp.job_count0[0], jnp.int32(-1))
-    (used, jc, _), outs = jax.lax.scan(
-        round_step, carry0, (inp.round_g, inp.round_want))
+    carry0 = (inp.used0, inp.job_count0[0])
+    (used, jc), outs = jax.lax.scan(
+        round_step, carry0,
+        (u_r, a_r, jc_r, req_r, des_r, dh_r, inp.round_want, same_r))
     return outs + (used, jc)
 
 
@@ -402,10 +408,10 @@ def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
     in_specs = MultiEvalInputs(
         attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n, luts=P(),
         base_mask=P(None, AXIS),
-        con=P(), aff=P(), req=P(), desired=P(), dh_limit=P(),
-        g_mask=P(), g_job=P(), job_count0=P(None, AXIS),
+        con=P(), u_mask=P(), aff=P(), req=P(), desired=P(),
+        dh_limit=P(), g_static=P(), g_aff=P(), g_job=P(),
+        job_count0=P(None, AXIS),
         spread_algo=P(), round_g=P(), round_want=P(), seed=P(),
-        extra_mask=P(None, AXIS),
     )
     out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
                  spec_n, spec_n)

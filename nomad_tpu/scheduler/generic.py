@@ -42,18 +42,27 @@ from .util import ALLOC_RESCHEDULED, tainted_nodes
 MAX_SERVICE_ATTEMPTS = 5
 MAX_BATCH_ATTEMPTS = 2
 
-# Shared engine so the packed node tensors + jit caches persist across evals
-# of one in-process scheduler session (the worker wires its own).
-_default_engine: Optional[PlacementEngine] = None
+# Shared engines so packed node tensors + jit caches persist across evals
+# of one in-process scheduler session (the worker wires its own).  Keyed
+# by the backing store's identity: two Harness/Server instances in one
+# process must never share packed tensors — an engine caching one store's
+# rows would serve the other stale state (ADVICE r2 #4 pattern).  Bounded
+# LRU-ish: old stores' engines are dropped, not leaked.
+_engines: Dict[str, PlacementEngine] = {}
 
 
-def _engine(explicit: Optional[PlacementEngine]) -> PlacementEngine:
-    global _default_engine
+def _engine(explicit: Optional[PlacementEngine],
+            state) -> PlacementEngine:
     if explicit is not None:
         return explicit
-    if _default_engine is None:
-        _default_engine = PlacementEngine()
-    return _default_engine
+    key = getattr(state, "store_id", "") or "<unkeyed>"
+    eng = _engines.get(key)
+    if eng is None:
+        if len(_engines) > 8:
+            for old in list(_engines)[:4]:
+                _engines.pop(old, None)
+        _engines[key] = eng = PlacementEngine()
+    return eng
 
 
 class GenericScheduler(Scheduler):
@@ -65,7 +74,7 @@ class GenericScheduler(Scheduler):
         self.state = state
         self.planner = planner
         self.is_batch = is_batch
-        self.engine = _engine(engine)
+        self.engine = _engine(engine, state)
         self.now = now if now is not None else time.time()
         self.max_attempts = (MAX_BATCH_ATTEMPTS if is_batch
                              else MAX_SERVICE_ATTEMPTS)

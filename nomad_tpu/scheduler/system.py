@@ -44,7 +44,7 @@ class SystemScheduler(Scheduler):
         self.state = state
         self.planner = planner
         self.sysbatch = sysbatch
-        self.engine = _engine(engine)
+        self.engine = _engine(engine, state)
         self.now = now if now is not None else time.time()
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
 

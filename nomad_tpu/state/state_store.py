@@ -107,6 +107,24 @@ class StateStore:
         # plan applier's coupled-batch fast path compares it to prove
         # nothing placement-relevant changed since a plan's snapshot
         self._placement_seq = 0
+        # per-node fence: node id -> (placement_seq of last FIT-relevant
+        # write, origin chain id or None).  The applier skips a fenced
+        # plan's AllocsFit re-check per NODE: a node last touched before
+        # the plan's snapshot — or by the plan's own chain, whose plans
+        # were co-computed on device against shared proposed capacity —
+        # cannot invalidate the kernel's capacity verdict.  Disjoint
+        # workers (zone-partitioned batches) therefore never demote each
+        # other to full checks, unlike a global fence.
+        self._node_place_seq: Dict[str, Tuple[int, Optional[str]]] = {}
+        # after a restore the per-node history is gone: every node is
+        # treated as touched at the floor, so pre-restore fences full-check
+        self._node_seq_floor = 0
+        # counter of CSI volume mutations (upsert/delete/claim/release):
+        # the applier captures it while its guarded claim checks run and
+        # the commit refuses (-1) if it moved — closing the window where
+        # a volume write lands between evaluate and commit that the
+        # per-NODE fence cannot see
+        self._volume_seq = 0
         # listeners for state-change events (event broker seam, SURVEY §6.5)
         self._listeners: List[Callable[[str, int, object], None]] = []
 
@@ -131,6 +149,39 @@ class StateStore:
         allocs, CSI volumes) — advances the applier's fast-path fence."""
         self._placement_seq += 1
         return self._bump()
+
+    def volume_seq(self) -> int:
+        """Counter of CSI volume mutations (see __init__)."""
+        with self._lock:
+            return self._volume_seq
+
+    def _touch_node(self, node_id: str, origin: Optional[str] = None
+                    ) -> None:
+        """Record a fit-relevant write to `node_id` (see _node_place_seq).
+        Callers hold the lock and have already bumped placement_seq."""
+        self._node_place_seq[node_id] = (self._placement_seq, origin)
+
+    def nodes_unchanged_since(self, node_ids, seq0: int,
+                              chain_id: Optional[str] = None,
+                              own_chain_ok: bool = True) -> bool:
+        """True when every node in `node_ids` had no fit-relevant write
+        after placement_seq `seq0` — writes by `chain_id` itself
+        tolerated when `own_chain_ok` (chain plans are co-computed).
+        Point reads; values monotone, so a stale read can only cause a
+        spurious full check, never a wrong skip — and the commit re-checks
+        under the lock via upsert_plan_results' expected_nodes."""
+        nps = self._node_place_seq
+        floor = self._node_seq_floor
+        if floor > seq0:
+            return False
+        for nid in node_ids:
+            e = nps.get(nid)
+            if e is None or e[0] <= seq0:
+                continue
+            if own_chain_ok and chain_id is not None and e[1] == chain_id:
+                continue
+            return False
+        return True
 
     def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
         """Block until the store has applied at least `index` (the eval
@@ -166,6 +217,7 @@ class StateStore:
             # feasibility caching after attribute changes.
             node.computed_class = compute_class(node)
             self._nodes = {**self._nodes, node.id: node}
+            self._touch_node(node.id)
             self._emit("Node", idx, node)
             return idx
 
@@ -184,6 +236,7 @@ class StateStore:
                 node.modify_index = idx
                 node.computed_class = compute_class(node)
                 table[node.id] = node
+                self._touch_node(node.id)
                 inserted.append(node)
             self._nodes = table          # publish before events fire
             for node in inserted:
@@ -196,6 +249,7 @@ class StateStore:
             nodes = dict(self._nodes)
             nodes.pop(node_id, None)
             self._nodes = nodes
+            self._touch_node(node_id)
             self._emit("Node", idx, node_id)
             return idx
 
@@ -397,7 +451,8 @@ class StateStore:
         return False
 
     def _insert_allocs(self, allocs: Iterable[Allocation], idx: int,
-                       copy: bool = True) -> None:
+                       copy: bool = True,
+                       origin: Optional[str] = None) -> None:
         table, by_node, by_job = self._writable_alloc_tables()
         # Copy-on-first-touch per bucket: buckets possibly shared with live
         # snapshots are copied once per snapshot-write cycle, not once per
@@ -434,11 +489,13 @@ class StateStore:
                     by_node[pnid] = dict(by_node.get(pnid, {}))
                     fn_add(pnid)
                 by_node[pnid].pop(aid, None)
+                self._touch_node(pnid, origin)
             if nid:
                 if nid not in fresh_node:
                     by_node[nid] = dict(by_node.get(nid, {}))
                     fn_add(nid)
                 by_node[nid][aid] = a
+                self._touch_node(nid, origin)
             jkey = (a.namespace, a.job_id)
             if jkey not in fresh_job:
                 by_job[jkey] = dict(by_job.get(jkey, {}))
@@ -529,7 +586,8 @@ class StateStore:
     # ------------------------------------------------------- plan results
 
     def upsert_plan_results(self, plan: Plan, result: PlanResult,
-                            expected_placement_seq: Optional[int] = None
+                            expected_placement_seq: Optional[int] = None,
+                            expected_nodes: Optional[Tuple] = None
                             ) -> int:
         """Apply a committed plan (reference: FSM ApplyPlanResults →
         state.UpsertPlanResults): stops, preemption evictions, placements,
@@ -542,11 +600,25 @@ class StateStore:
         returning -1 and the applier redoes the full re-check.  Checked
         under the same lock as the commit, so the fast path is exactly as
         safe as the full path.  Deterministic across Raft replicas: all
-        placement writes ride the log, so every replica's counter agrees."""
+        placement writes ride the log, so every replica's counter agrees.
+
+        `expected_nodes`: the PER-NODE form of the same re-verify —
+        (node_ids, seq0, chain_id): refuse (-1) unless every listed node
+        is unchanged since seq0 except by the plan's own chain (see
+        nodes_unchanged_since)."""
         with self._lock:
             if (expected_placement_seq is not None
                     and self._placement_seq != expected_placement_seq):
                 return -1
+            if expected_nodes is not None:
+                nids, seq0, chain_id, vseq = expected_nodes
+                if not self.nodes_unchanged_since(nids, seq0, chain_id):
+                    return -1
+                if vseq is not None and self._volume_seq != vseq:
+                    # a volume mutation (claim release, schedulable flip,
+                    # deletion) landed after the applier's guarded claim
+                    # checks — redo them against current state
+                    return -1
             idx = self._bump_placement()
             allocs: List[Allocation] = []
             for node_allocs in result.node_update.values():
@@ -561,7 +633,9 @@ class StateStore:
             # go-memdb convention the reference itself relies on, objects
             # are immutable once inserted (state.UpsertPlanResults stores
             # the submitted pointers directly).
-            self._insert_allocs(allocs, idx, copy=False)
+            origin = (plan.coupled_batch[0]
+                      if plan.coupled_batch is not None else None)
+            self._insert_allocs(allocs, idx, copy=False, origin=origin)
             # CSI claims ride the plan commit (reference: the client's
             # claim RPC; the applier's claim_ok re-check reads these).
             # Released when the alloc goes terminal.  Changed volumes
@@ -582,7 +656,8 @@ class StateStore:
                     if has:
                         self._claim_csi_volumes_locked(a, changed_vols)
             for block in result.alloc_blocks:
-                self._commit_block_locked(block, idx, changed_vols)
+                self._commit_block_locked(block, idx, changed_vols,
+                                          origin=origin)
             if changed_vols:
                 self._csi_volumes = {**self._csi_volumes, **changed_vols}
             if result.deployment is not None:
@@ -602,11 +677,14 @@ class StateStore:
             self._emit("PlanResult", idx, result)
             return idx
 
-    def _commit_block_locked(self, block, idx: int, changed_vols) -> None:
+    def _commit_block_locked(self, block, idx: int, changed_vols,
+                             origin: Optional[str] = None) -> None:
         """Insert a columnar alloc block: registry publishes + bulk CSI
         claims.  O(unique nodes) python work — never O(count)."""
         block.create_index = idx
         block.modify_index = idx
+        for nid in block.node_table:
+            self._touch_node(nid, origin)
         self._alloc_blocks = {**self._alloc_blocks, block.id: block}
         tmpl = block.template
         jkey = (tmpl.namespace, tmpl.job_id)
@@ -656,6 +734,7 @@ class StateStore:
     def upsert_csi_volume(self, vol: CSIVolume) -> int:
         with self._lock:
             idx = self._bump_placement()
+            self._volume_seq += 1
             key = (vol.namespace, vol.id)
             prev = self._csi_volumes.get(key)
             if prev is not None:
@@ -677,6 +756,7 @@ class StateStore:
             if vol.read_allocs or vol.write_allocs:
                 return "volume has active claims"
             self._bump_placement()
+            self._volume_seq += 1
             vols = dict(self._csi_volumes)
             vols.pop((namespace, vol_id), None)
             self._csi_volumes = vols
@@ -742,6 +822,7 @@ class StateStore:
                               if k not in dead_ids})
             changed[key] = v
         if changed:
+            self._volume_seq += 1
             self._csi_volumes = {**self._csi_volumes, **changed}
 
     def release_csi_claim(self, namespace: str, vol_id: str,
@@ -756,6 +837,7 @@ class StateStore:
                                and alloc_id not in vol.write_allocs):
                 return self._index
             idx = self._bump_placement()
+            self._volume_seq += 1
             import dataclasses
             v = dataclasses.replace(
                 vol,
@@ -1102,6 +1184,8 @@ class StateStore:
                 SC, doc.get("SchedulerConfig") or {})
             self._identity_secret = doc.get("IdentitySecret", "") or ""
             self._placement_seq = int(doc.get("PlacementSeq", 0))
+            self._node_place_seq = {}
+            self._node_seq_floor = self._placement_seq
             self._index = max(int(doc.get("Index", 0)), self._index) + 1
             self._index_cv.notify_all()
             self._emit("Restore", self._index, None)

@@ -284,12 +284,31 @@ class TestBatchedWorkerPath:
             mkplan(50, "bX", seq0), skip_fit=True)
         assert not r1.refuted_nodes
 
-        # a plan that oversubscribes the node: with the fence intact it
-        # would slip through skip_fit; a foreign write breaks the chain
-        # arithmetic so apply_one full-checks and refutes it
-        big = mkplan(10 ** 9, "bX", seq0)
-        s.register_node(mock.node(), now=NOW + 1)    # foreign write
+        # a foreign write to an UNRELATED node must NOT demote the fence
+        # (per-node granularity — the whole point: disjoint workers never
+        # poison each other's chains)
+        s.register_node(mock.node(), now=NOW + 1)
+        fp_before = s.plan_applier.stats["fast_path"]
         from nomad_tpu.core.plan_apply import PendingPlan
+        ok_plan = mkplan(10, "bX", seq0)
+        pending = PendingPlan(ok_plan)
+        s.plan_applier.apply_one(pending)
+        result, err = pending.wait(timeout=5)
+        assert err is None and not result.refuted_nodes
+        assert s.plan_applier.stats["fast_path"] == fp_before + 1
+
+        # a plan that oversubscribes the node: a foreign write TO THE
+        # PLAN'S NODE breaks its fence, so apply_one full-checks and
+        # refutes it.  (The foreign write: an unfenced alloc commit on
+        # that node.)
+        from nomad_tpu.structs import Resources
+        foreign = Allocation(namespace=job.namespace, job_id=job.id,
+                             job=job, task_group=job.task_groups[0].name,
+                             desired_status="run", client_status="pending",
+                             node_id=node.id,
+                             resources=Resources(cpu=1, memory_mb=1))
+        s.state.upsert_allocs([foreign])
+        big = mkplan(10 ** 9, "bX", seq0)
         pending = PendingPlan(big)
         s.plan_applier.apply_one(pending)
         result, err = pending.wait(timeout=5)
@@ -526,3 +545,107 @@ class TestPortSafetyInBatch:
         # static port -> three distinct nodes, each alloc owns 8080
         assert len({a.node_id for a in live}) == 3
         assert all(a.allocated_ports.get("http") == 8080 for a in live)
+
+
+class TestMultiWorkerSafety:
+    """Per-node fencing, delivery-token gating, and partitioned dequeue —
+    the machinery that lets num_schedulers-style concurrent workers
+    coexist with the coupled-batch fast path (reference contrast:
+    nomad/worker.go workers dequeue blindly and resolve every collision
+    at plan apply; here disjoint workers never even collide)."""
+
+    def test_per_node_fence_tolerates_own_chain(self):
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.structs import Plan
+
+        state = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        state.upsert_node(n1)
+        state.upsert_node(n2)
+        job = mock.job()
+        state.upsert_job(job)
+        seq0 = state.placement_seq()
+        # chain A commits on n1
+        a = mock.alloc(job=job, node_id=n1.id)
+        plan = Plan(eval_id="e1", job=job, coupled_batch=("chainA", seq0))
+        plan.append_alloc(a)
+        from nomad_tpu.structs import PlanResult
+        state.upsert_plan_results(plan, PlanResult(
+            node_allocation=plan.node_allocation))
+        # chain A's own write on n1 is tolerated; a foreign view is not
+        assert state.nodes_unchanged_since([n1.id], seq0, "chainA")
+        assert not state.nodes_unchanged_since([n1.id], seq0, "chainB")
+        # n2 untouched: everyone passes
+        assert state.nodes_unchanged_since([n2.id], seq0, "chainB")
+
+    def test_stale_delivery_token_rejected_at_applier(self):
+        """An eval redelivered while worker A sat in a device compile:
+        worker A's plan must be rejected, not double-committed
+        (reference: the EvalToken check at plan submission)."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        s.register_node(mock.node(), now=NOW)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        ev = s.register_job(job, now=NOW)
+
+        # worker A dequeues; then the delivery expires and B gets it
+        e1, tok_a = s.eval_broker.dequeue(["service"], now=NOW)
+        assert e1.id == ev.id
+        s.eval_broker.tick(NOW + 10_000)          # expire A's delivery
+        e2, tok_b = s.eval_broker.dequeue(["service"], now=NOW + 10_000)
+        assert e2.id == ev.id and tok_b != tok_a
+
+        from nomad_tpu.core.plan_apply import PendingPlan, StaleDeliveryError
+        from nomad_tpu.structs import Plan
+        stale = Plan(eval_id=ev.id, eval_token=tok_a, job=job)
+        stale.append_alloc(mock.alloc(job=job,
+                                      node_id=s.state.snapshot().nodes()[0].id))
+        p = PendingPlan(stale)
+        s.plan_applier.apply_one(p)
+        result, err = p.wait(1)
+        assert result is None and isinstance(err, StaleDeliveryError)
+        assert s.plan_applier.stats["stale_token"] == 1
+        # the CURRENT delivery's plan commits fine
+        fresh = Plan(eval_id=ev.id, eval_token=tok_b, job=job)
+        fresh.append_alloc(mock.alloc(job=job,
+                                      node_id=s.state.snapshot().nodes()[0].id))
+        p2 = PendingPlan(fresh)
+        s.plan_applier.apply_one(p2)
+        result2, err2 = p2.wait(1)
+        assert err2 is None and not result2.refuted_nodes
+
+    def test_partitioned_dequeue_single_key_batches(self):
+        """With partition_of set (num_workers > 1), each batch carries a
+        single placement-domain signature; other signatures stay queued
+        for the next worker."""
+        from nomad_tpu.structs import VolumeRequest
+
+        s = Server(dev_mode=True, num_workers=2)
+        s.establish_leadership()
+        for _ in range(4):
+            n = mock.node()
+            n.csi_node_plugins["ebs0"] = True
+            s.register_node(n, now=NOW)
+        from nomad_tpu.structs import CSIVolume
+        for z in ("a", "b"):
+            s.state.upsert_csi_volume(CSIVolume(id=f"vol-{z}",
+                                                plugin_id="ebs0"))
+        jobs = []
+        for i in range(6):
+            job = mock.batch_job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].volumes = {
+                "d": VolumeRequest(name="d", type="csi",
+                                   source=f"vol-{'a' if i % 2 else 'b'}",
+                                   read_only=True)}
+            s.register_job(job, now=NOW)
+            jobs.append(job)
+        batch1 = s.eval_broker.dequeue_batch(
+            ["service", "batch"], 16, now=NOW)
+        batch2 = s.eval_broker.dequeue_batch(
+            ["service", "batch"], 16, now=NOW)
+        assert len(batch1) == 3 and len(batch2) == 3
+        key1 = {s._eval_partition(ev) for ev, _ in batch1}
+        key2 = {s._eval_partition(ev) for ev, _ in batch2}
+        assert len(key1) == 1 and len(key2) == 1 and key1 != key2

@@ -167,13 +167,27 @@ class TestFrameAuth:
         with pytest.raises(ValueError):
             wire.decode_body(real)
 
-    def test_replay_cache_hard_cap(self, monkeypatch):
+    def test_replay_cache_hard_cap_fails_closed(self, monkeypatch):
+        """Overflow while the oldest nonce is UNEXPIRED rejects the new
+        frame instead of evicting (an evicted fresh nonce would let a
+        captured frame replay inside its freshness window — ADVICE r3)."""
         wire.set_key("cluster-secret")
         monkeypatch.setattr(wire, "MAX_SEEN_NONCES", 64)
+        accepted = 0
+        rejected = 0
         for i in range(200):
-            wire.decode_body(wire.encode_frame({"i": i})[4:])
+            try:
+                wire.decode_body(wire.encode_frame({"i": i})[4:])
+                accepted += 1
+            except ValueError:
+                rejected += 1
+        # the cap holds, overflow traffic is rejected (not silently
+        # weakening replay protection), and the cache never exceeds the
+        # cap by more than the in-flight frame
+        assert accepted >= 64
+        assert rejected == 200 - accepted
         with wire._seen_lock:
-            assert len(wire._seen_nonces) <= 64
+            assert len(wire._seen_nonces) <= 65
 
     def test_confidentiality(self):
         wire.set_key("cluster-secret")
