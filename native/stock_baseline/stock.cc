@@ -167,4 +167,94 @@ int64_t stock_place_evals(int32_t n, const int32_t* cap_cpu,
   return placed_total;
 }
 
+// Config-4 (mixed-priority preemption) emulation: a cluster pre-filled
+// with priority-`low_prio` allocs (one per node, `low_cpu`/`low_mem`
+// each), then `n_place` high-priority placements that must EVICT to fit.
+// Per placement (reference: scheduler/preemption.go driven from
+// BinPackIterator when Fit fails):
+//   walk the shuffled order; no node fits -> for each feasible node,
+//   greedily take lowest-priority victims until the ask fits, cost =
+//   sum((prio+1)*1000 + res) (basicResourceDistance flavor); evict on
+//   the cheapest node, commit the placement.
+// Returns placements committed; *evictions_out counts victims.
+int64_t stock_preempt_evals(int32_t n, const int32_t* cap_cpu,
+                            const int32_t* cap_mem, const uint8_t* elig,
+                            int32_t low_prio, int32_t low_cpu,
+                            int32_t low_mem,
+                            int32_t ask_cpu, int32_t ask_mem,
+                            int64_t n_evals, int64_t per_eval,
+                            uint64_t seed, int64_t* evictions_out) {
+  std::vector<int32_t> order(n);
+  for (int32_t i = 0; i < n; i++) order[i] = i;
+  uint64_t rng = seed | 1;
+  int64_t placed_total = 0, evicted_total = 0;
+
+  struct Victim { int32_t prio, cpu, mem; };
+  std::vector<std::vector<Victim>> allocs(n);   // low-pri fill + placements
+  for (int32_t i = 0; i < n; i++)
+    allocs[i].push_back({low_prio, low_cpu, low_mem});
+
+  auto used_of = [&](int32_t idx, int64_t* uc, int64_t* um) {
+    int64_t c = 0, m2 = 0;
+    for (const auto& v : allocs[idx]) { c += v.cpu; m2 += v.mem; }
+    *uc = c; *um = m2;
+  };
+
+  for (int64_t e = 0; e < n_evals; e++) {
+    for (int32_t i = n - 1; i > 0; i--) {
+      int32_t j = (int32_t)(next_rand(&rng) % (uint64_t)(i + 1));
+      int32_t t = order[i]; order[i] = order[j]; order[j] = t;
+    }
+    for (int64_t p = 0; p < per_eval; p++) {
+      // normal Select first (LimitIterator(2))
+      int32_t best = -1; double best_score = -1e300; int32_t seen = 0;
+      for (int32_t k = 0; k < n; k++) {
+        int32_t idx = order[k];
+        if (!elig[idx]) continue;
+        int64_t uc, um; used_of(idx, &uc, &um);
+        int64_t fc = cap_cpu[idx] - uc - ask_cpu;
+        int64_t fm = cap_mem[idx] - um - ask_mem;
+        if (fc < 0 || fm < 0) continue;
+        double score =
+            (18.0 - 18.0 * std::sqrt((double)fc / cap_cpu[idx])) +
+            (18.0 - 18.0 * std::sqrt((double)fm / cap_mem[idx]));
+        seen++;
+        if (score * 0.5 > best_score) { best_score = score * 0.5; best = idx; }
+        if (seen >= 2) break;
+      }
+      if (best < 0) {
+        // preemption pass: cheapest eviction set across feasible nodes
+        double best_cost = 1e300; int32_t best_idx = -1; int32_t best_k = 0;
+        for (int32_t k = 0; k < n; k++) {
+          int32_t idx = order[k];
+          if (!elig[idx]) continue;
+          // victims ascending by priority (fill is homogeneous: order
+          // within the list is already fine)
+          int64_t uc, um; used_of(idx, &uc, &um);
+          int64_t need_c = uc + ask_cpu - cap_cpu[idx];
+          int64_t need_m = um + ask_mem - cap_mem[idx];
+          double cost = 0; int32_t kk = 0;
+          for (const auto& v : allocs[idx]) {
+            if (need_c <= 0 && need_m <= 0) break;
+            if (v.prio >= 80) { cost = 1e300; break; }  // only lower prio
+            cost += (v.prio + 1) * 1000.0 + v.cpu + v.mem;
+            need_c -= v.cpu; need_m -= v.mem; kk++;
+          }
+          if (need_c > 0 || need_m > 0) continue;
+          if (cost < best_cost) { best_cost = cost; best_idx = idx; best_k = kk; }
+        }
+        if (best_idx < 0) continue;   // unplaceable
+        allocs[best_idx].erase(allocs[best_idx].begin(),
+                               allocs[best_idx].begin() + best_k);
+        evicted_total += best_k;
+        best = best_idx;
+      }
+      allocs[best].push_back({80, ask_cpu, ask_mem});
+      placed_total++;
+    }
+  }
+  if (evictions_out) *evictions_out = evicted_total;
+  return placed_total;
+}
+
 }  // extern "C"

@@ -57,17 +57,24 @@ BIG_COST = jnp.float32(1e30)
 
 
 def build_victim_tables(job: Job, snapshot, tensors
-                        ) -> Tuple[np.ndarray, np.ndarray, Dict[int, list]]:
-    """Pack each node's evictable allocs (priority < job.priority, not the
-    same job) into [N, A] priority-sorted tables.
-    Returns (prio [N,A] int32, res [N,A,3] int32, allocs {row: [Allocation
-    in the SAME sorted order]}).  Padding entries carry prio=2^30, res=0 —
-    they can never help fill an ask."""
-    n = tensors.n
-    prio = np.full((n, MAX_VICTIMS), 1 << 30, np.int32)
-    res = np.zeros((n, MAX_VICTIMS, 3), np.int32)
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                   Dict[int, list]]:
+    """Pack evictable allocs (priority < job.priority, not the same job)
+    into COMPACT priority-sorted tables covering only candidate nodes —
+    nodes with at least one victim.  The depth axis sizes to the deepest
+    candidate on a pow2 ladder (capped at MAX_VICTIMS), so the device
+    upload is O(candidates x actual depth), not O(cluster x 32): the
+    homogeneous one-victim-per-node shape at 50k nodes is [50k, 1]
+    (~800KB) instead of the [50k, 32] (~25MB) that previously forced the
+    8192-node cap.
+
+    Returns (cand_rows [M] int32 — tensor row per table row, prio [M,A],
+    res [M,A,3], allocs {TENSOR row: [Allocation in sorted order]}).
+    Padding entries carry prio=2^30, res=0 — they can never help fill an
+    ask."""
     by_row: Dict[int, list] = {}
     my_prio = job.priority
+    deepest = 1
     for row, node_id in enumerate(tensors.node_ids):
         lst = []
         for a in snapshot.allocs_by_node(node_id):
@@ -82,17 +89,29 @@ def build_victim_tables(job: Job, snapshot, tensors
         lst.sort(key=lambda t: t[0])
         lst = lst[:MAX_VICTIMS]
         by_row[row] = [a for _, a in lst]
-        for i, (p, a) in enumerate(lst):
-            prio[row, i] = p
-            res[row, i] = (a.resources.cpu, a.resources.memory_mb,
-                           a.resources.disk_mb)
-    return prio, res, by_row
+        deepest = max(deepest, len(lst))
+    a_eff = 1
+    while a_eff < deepest:
+        a_eff *= 2
+    m = len(by_row)
+    cand_rows = np.fromiter(by_row.keys(), np.int32, m)
+    prio = np.full((m, a_eff), 1 << 30, np.int32)
+    res = np.zeros((m, a_eff, 3), np.int32)
+    for ci, (row, allocs) in enumerate(by_row.items()):
+        for i, a in enumerate(allocs):
+            prio[ci, i] = (a.job.priority if a.job is not None else 50)
+            res[ci, i] = (a.resources.cpu, a.resources.memory_mb,
+                          a.resources.disk_mb)
+    return cand_rows, prio, res, by_row
 
 
 def preempt_bulk(cap, used0, static_g, dh_limit_g, job_count0,
-                 pre_prio, pre_res, req, n_place: int, n_real):
+                 pre_prio, pre_res, req, k0, n_place: int, n_real):
     """Resolve up to n_real (<= n_place; n_place is the padded compile
     shape) failed placements by preemption in ONE device program.
+    `k0` [N]: per-row count of victims ALREADY consumed by earlier
+    launches of the same eval (prefix-ordered) — they start consumed so
+    the per-placement victim counts cover only real, fresh victims.
     Returns (best_rows [P], k_counts [P], used, job_count) — best_rows[i]
     = -1 when nothing could make placement i fit (or i is padding)."""
     # per-victim cost: reference Preemptor cost = (prio+1)*1000 + res sum
@@ -132,14 +151,15 @@ def preempt_bulk(cap, used0, static_g, dh_limit_g, job_count0,
                jnp.where(ok, n_take, 0))
         return (used, job_count, consumed), out
 
-    consumed0 = jnp.zeros(pre_prio.shape, bool)
+    consumed0 = (jnp.arange(pre_prio.shape[1])[None, :]
+                 < k0[:, None])
     (used, job_count, _), (best_rows, ks) = jax.lax.scan(
         step, (used0, job_count0, consumed0),
         jnp.arange(n_place, dtype=jnp.int32))
     return best_rows, ks, used, job_count
 
 
-preempt_bulk_jit = jax.jit(preempt_bulk, static_argnums=(8,))
+preempt_bulk_jit = jax.jit(preempt_bulk, static_argnums=(9,))
 
 
 def preemption_enabled(cfg: SchedulerConfiguration, job_type: str) -> bool:

@@ -378,7 +378,7 @@ class GenericScheduler(Scheduler):
         # state.  The re-check exists for optimistic concurrency, which
         # the fence detects precisely.
         fence = getattr(self.state, "placement_fence", None)
-        if fence is not None:
+        if fence is not None and not plan.host_redirected:
             plan.coupled_batch = (evaluation.id, fence)
         result, refreshed_state, err = self.planner.submit_plan(plan)
         if err is not None:
@@ -462,22 +462,28 @@ class GenericScheduler(Scheduler):
             if has_net:
                 ask = ask.copy()
             if ask.networks:
-                ni = net_idx.get(d.node_id)
-                if ni is None:
-                    ni = NetworkIndex()
-                    node = self.state.node_by_id(d.node_id)
-                    if node is not None:
-                        ni.set_node(node)
-                    ni.add_allocs(
-                        a for a in self.state.allocs_by_node(d.node_id)
-                        if a.id not in victim_ids)
-                    net_idx[d.node_id] = ni
+                ni = self._net_index(d.node_id, net_idx, victim_ids)
                 ports, fail = ni.assign_ports(ask.networks)
                 if ports is None:
-                    d.metric.exhausted_node(fail)
-                    self._record_failure(tg.name, d.metric)
-                    continue
-                ni.commit(ports)
+                    # stock moves to the NEXT candidate when the picked
+                    # node can't satisfy the ask (rank.go iterator pull);
+                    # the kernel returned its runner-ups in the metric's
+                    # top-k — retry them before declaring failure.
+                    # Never redirect a placement bound to its node by
+                    # evictions or device instances.
+                    alt_ports = alt = None
+                    if not d.evictions and i not in dev_assign:
+                        alt_ports, alt = self._ports_from_runner_up(
+                            plan, d.node_id, d.metric.score_meta_data,
+                            ask, net_idx, victim_ids)
+                    if alt_ports is None:
+                        d.metric.exhausted_node(fail)
+                        self._record_failure(tg.name, d.metric)
+                        continue
+                    ports = alt_ports
+                    d.node_id = alt
+                else:
+                    ni.commit(ports)
 
             tmpl = alloc_templates.get(tg.name)
             if tmpl is None:
@@ -522,6 +528,50 @@ class GenericScheduler(Scheduler):
                     append_reschedule_tracker(alloc, p.previous_alloc, self.now)
                     alloc.desired_description = ALLOC_RESCHEDULED
             plan.append_alloc(alloc)
+
+    def _net_index(self, node_id: str, cache: Dict[str, NetworkIndex],
+                   victim_ids) -> NetworkIndex:
+        """Per-node port bookkeeping for this plan, built lazily
+        (preemption victims' ports count as free)."""
+        ni = cache.get(node_id)
+        if ni is None:
+            ni = NetworkIndex()
+            node = self.state.node_by_id(node_id)
+            if node is not None:
+                ni.set_node(node)
+            ni.add_allocs(a for a in self.state.allocs_by_node(node_id)
+                          if a.id not in victim_ids)
+            cache[node_id] = ni
+        return ni
+
+    def _ports_from_runner_up(self, plan: Plan, picked_node: str,
+                              score_meta, ask, net_idx, victim_ids):
+        """Port exhaustion on the picked node: try the top-k runner-up
+        rows (reference: the rank iterator simply pulls the next
+        candidate).  Returns (ports, runner_up_node_id) or (None, None).
+        On success the PLAN loses its fence — the kernel's capacity
+        accounting assumed the original pick, so the applier must run
+        the full AllocsFit re-check; the caller moves the placement.
+
+        Callers must NOT redirect placements that carry preemption
+        victims or device-instance assignments: both are bound to the
+        ORIGINAL node (victims evicted there; instances exist there) and
+        would be orphaned by the move."""
+        for meta in score_meta[1:]:
+            alt = meta.node_id
+            if not alt or alt == picked_node:
+                continue
+            ni = self._net_index(alt, net_idx, victim_ids)
+            ports, _ = ni.assign_ports(ask.networks)
+            if ports is None:
+                continue
+            ni.commit(ports)
+            # host-side redirection invalidates the device's coupled
+            # capacity view (the flag also blocks the fence-tag step)
+            plan.coupled_batch = None
+            plan.host_redirected = True
+            return ports, alt
+        return None, None
 
     def _compute_placements_block(self, plan: Plan, job: Job, block,
                                   evaluation: Evaluation,
@@ -744,17 +794,21 @@ class GenericScheduler(Scheduler):
             d2["task_states"] = {}
             if has_net:
                 a2 = ask.copy()
-                ni = net_idx.get(nid)
-                if ni is None:
-                    ni = NetworkIndex()
-                    node = self.state.node_by_id(nid)
-                    if node is not None:
-                        ni.set_node(node)
-                    ni.add_allocs(
-                        a for a in self.state.allocs_by_node(nid)
-                        if a.id not in victim_ids)
-                    net_idx[nid] = ni
+                ni = self._net_index(nid, net_idx, victim_ids)
                 ports, fail = ni.assign_ports(a2.networks)
+                if ports is not None:
+                    ni.commit(ports)
+                elif not bd.evictions.get(i):
+                    # retry the round's top-k runner-ups (stock pulls the
+                    # next candidate on exhaustion — rank.go iterator);
+                    # eviction-backed placements stay put (victims are
+                    # bound to the original node)
+                    ports, alt = self._ports_from_runner_up(
+                        plan, nid, m.score_meta_data, a2, net_idx,
+                        victim_ids)
+                    if ports is not None:
+                        nid = alt
+                        d2["node_id"] = alt
                 if ports is None:
                     # never mutate the round-shared metric: exhausted_node
                     # writes dimension_exhausted on a private copy
@@ -762,7 +816,6 @@ class GenericScheduler(Scheduler):
                     fm.exhausted_node(fail)
                     self._record_failure_shared(tg.name, fm, copied=True)
                     continue
-                ni.commit(ports)
                 d2["resources"] = a2
                 d2["allocated_ports"] = ports
             ev = bd.evictions.get(i)

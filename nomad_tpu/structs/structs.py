@@ -947,6 +947,10 @@ class Plan:
     # applier may skip the per-node AllocsFit re-check while the store's
     # placement_seq proves no foreign write intervened (core/plan_apply)
     coupled_batch: Optional[Tuple[str, int]] = None
+    # a host-side fallback redirected a placement off its kernel pick
+    # (port exhaustion -> runner-up): the device's coupled capacity view
+    # no longer matches, so the plan must never be fence-tagged
+    host_redirected: bool = False
 
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)

@@ -389,3 +389,53 @@ class TestReviewRegressions:
         plan = h.plans[0]
         assert plan.deployment is not None
         assert set(plan.deployment.task_groups) == {"web", "api"}
+
+
+class TestPortExhaustionFallback:
+    def test_exhausted_ports_fall_back_to_runner_up(self):
+        """Static port taken on the kernel's preferred node: the
+        placement must land on the metric's runner-up, not fail
+        (VERDICT r4 #5; reference: rank.go iterator pulls the next
+        candidate)."""
+        from nomad_tpu import mock
+        from nomad_tpu.scheduler import Harness
+        from nomad_tpu.structs import NetworkResource, Port, Resources
+
+        h = Harness()
+        # node A fuller than B -> binpack prefers A
+        na, nb = mock.node(), mock.node()
+        for n in (na, nb):
+            n.resources.cpu = 8000
+            n.resources.memory_mb = 16384
+        h.state.upsert_nodes([na, nb])
+        filler = mock.job()
+        h.state.upsert_job(filler)
+        base = mock.alloc(job=filler, node_id=na.id)
+        base.resources = Resources(cpu=3000, memory_mb=1024)
+        h.state.upsert_allocs([base])
+        # an alloc on A already owns port 8080
+        holder = mock.alloc(job=filler, node_id=na.id)
+        holder.resources = Resources(cpu=100, memory_mb=64)
+        holder.allocated_ports = {"http": 8080}
+        h.state.upsert_allocs([holder])
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].resources.networks = [NetworkResource(
+            reserved_ports=[Port(label="http", value=8080)])]
+        h.state.upsert_job(job)
+        e = mock.eval(job_id=job.id, type=job.type)
+        h.state.upsert_evals([e])
+        err = h.process("service", e, now=1.7e9)
+        assert err is None
+        plan = h.plans[-1]
+        placed = [a for allocs in plan.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 1, h.evals[-1].failed_tg_allocs
+        # the kernel preferred A (fuller), but 8080 is taken there: the
+        # runner-up B must carry the placement
+        assert placed[0].node_id == nb.id
+        assert placed[0].allocated_ports == {"http": 8080}
+        # host redirection dropped the fence: the applier full-checks
+        assert plan.coupled_batch is None and plan.host_redirected
