@@ -22,7 +22,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import (
-    Allocation,
     DrainStrategy,
     Evaluation,
     Job,

@@ -60,6 +60,15 @@ UI_HTML = """<!doctype html>
          color-mix(in srgb, CanvasText 15%, Canvas); position: relative; }
   .bar i { position: absolute; inset: 0 auto 0 0; border-radius: 3px;
            background: #2e9e57; }
+  #term { font-family: ui-monospace, monospace; font-size: .82rem;
+          background: color-mix(in srgb, CanvasText 92%, Canvas);
+          color: color-mix(in srgb, Canvas 92%, CanvasText);
+          border-radius: 6px; padding: .6rem; min-height: 16rem;
+          max-height: 28rem; overflow-y: auto; white-space: pre-wrap; }
+  #termcmd { width: 60%; font-family: ui-monospace, monospace; }
+  .diff-add { color: #2e9e57; } .diff-del { color: #cc4125; }
+  .diff { font-family: ui-monospace, monospace; font-size: .82rem;
+          white-space: pre-wrap; }
 </style>
 </head>
 <body>
@@ -88,6 +97,17 @@ async function get(path) {
     encodeURIComponent(REGION) : path);
   if (!r.ok) throw new Error(`${r.status} ${path}`);
   return r.json();
+}
+async function post(path, body) {
+  const sep = path.includes('?') ? '&' : '?';
+  const r = await fetch(REGION ? `${path}${sep}region=` +
+    encodeURIComponent(REGION) : path,
+    {method: 'POST', headers: {'Content-Type': 'application/json'},
+     body: JSON.stringify(body)});
+  const data = await r.json().catch(() => null);
+  if (!r.ok) throw new Error((data && (data.Error || data.error))
+                             || `${r.status} ${path}`);
+  return data;
 }
 const sect = (title, body, wide) =>
   `<section${wide ? ' class="wide"' : ''}><h2>${title}</h2>${body}</section>`;
@@ -175,6 +195,17 @@ async function viewJob(ns, id) {
     cell(code(e.ID.slice(0,8))), cell(e.TriggeredBy),
     cell(e.Status, cls(e.Status)),
     cell(esc(e.StatusDescription || ''))]));
+  let versions = [];
+  try {
+    versions = (await get(`/v1/job/${enc}/versions?namespace=${encNs}`))
+      .Versions || [];
+  } catch (e) { /* older agents */ }
+  const vRows = versions.map(v => row([
+    cell(code('v' + v.Version)),
+    cell(v.Stable ? 'stable' : '', 'dim'),
+    cell(v.Version > 0
+      ? `<a href="#/diff/${encNs}/${enc}/${v.Version - 1}/${v.Version}">` +
+        `diff v${v.Version - 1} → v${v.Version}</a>` : '')]));
   document.getElementById('main').innerHTML =
     sect(`Job ${esc(id)} · ${esc(job.Type)} · v${job.Version} · ` +
          `<span class="${cls(job.Status)}">${job.Status}</span>`,
@@ -182,7 +213,64 @@ async function viewJob(ns, id) {
     sect('Allocations',
          table(['ID','Group','Node','Client','Desired'], allocRows), true) +
     sect('Evaluations',
-         table(['ID','Trigger','Status',''], evalRows), true);
+         table(['ID','Trigger','Status',''], evalRows), true) +
+    (vRows.length > 1
+      ? sect('Versions', table(['Version','','Diff'], vRows), true) : '');
+}
+
+// ---------------------------------------------------- job version diff
+// Flatten both versions' wire forms and show added/removed/changed
+// fields (reference: `nomad job history -p` / plan annotations diff;
+// index-churn fields are elided).
+function flatten(obj, prefix, out) {
+  const SKIP = new Set(['CreateIndex', 'ModifyIndex', 'JobModifyIndex',
+                        'SubmitTime', 'Version', 'Status', 'Stable']);
+  for (const [k, v] of Object.entries(obj || {})) {
+    if (SKIP.has(k)) continue;
+    const key = prefix ? `${prefix}.${k}` : k;
+    if (v && typeof v === 'object' && !Array.isArray(v)) {
+      flatten(v, key, out);
+    } else if (Array.isArray(v) && v.length &&
+               typeof v[0] === 'object') {
+      v.forEach((el2, i) => flatten(el2, `${key}[${i}]`, out));
+    } else {
+      out[key] = JSON.stringify(v);
+    }
+  }
+  return out;
+}
+
+async function viewDiff(ns, id, va, vb) {
+  const enc = encodeURIComponent(id);
+  const encNs = encodeURIComponent(ns);
+  const versions = (await get(
+    `/v1/job/${enc}/versions?namespace=${encNs}`)).Versions || [];
+  const byV = {};
+  for (const v of versions) byV[v.Version] = v;
+  const a = byV[va], b = byV[vb];
+  if (!a || !b) throw new Error(`version ${!a ? va : vb} not found`);
+  const fa = flatten(a, '', {});
+  const fb = flatten(b, '', {});
+  const lines = [];
+  const keys = [...new Set([...Object.keys(fa), ...Object.keys(fb)])]
+    .sort();
+  for (const k of keys) {
+    if (!(k in fa)) {
+      lines.push(`<div class="diff-add">+ ${esc(k)} = ${esc(fb[k])}</div>`);
+    } else if (!(k in fb)) {
+      lines.push(`<div class="diff-del">- ${esc(k)} = ${esc(fa[k])}</div>`);
+    } else if (fa[k] !== fb[k]) {
+      lines.push(`<div class="diff-del">- ${esc(k)} = ${esc(fa[k])}</div>` +
+                 `<div class="diff-add">+ ${esc(k)} = ${esc(fb[k])}</div>`);
+    }
+  }
+  document.getElementById('main').innerHTML =
+    sect(`Diff · <a href="#/job/${encNs}/${enc}">${esc(id)}</a> · ` +
+         `v${esc(va)} → v${esc(vb)}`,
+         `<div class="diff">` +
+         (lines.length ? lines.join('')
+                       : '<span class="dim">no differences</span>') +
+         `</div>`, true);
 }
 
 // ---------------------------------------------------------- alloc view
@@ -202,11 +290,59 @@ async function viewAlloc(id) {
          `job <a href="#/job/${encodeURIComponent(a.Namespace)}/` +
          `${encodeURIComponent(a.JobID)}">${code(esc(a.JobID))}</a> · ` +
          `node <a href="#/node/${a.NodeID}">` +
-         `${code((a.NodeID||'').slice(0,8))}</a>`,
+         `${code((a.NodeID||'').slice(0,8))}</a> · ` +
+         `<a href="#/exec/${a.ID}">exec terminal</a>`,
          table(['Client','Desired',''], [row([
            cell(a.ClientStatus, cls(a.ClientStatus)),
            cell(a.DesiredStatus, cls(a.DesiredStatus)),
            cell(esc(a.DesiredDescription || ''))])]), true) + states;
+}
+
+// -------------------------------------------------------- exec terminal
+// Command-at-a-time terminal over /v1/client/allocation/:id/exec (the
+// reference streams a PTY over websocket; this surface runs one command
+// per submit and appends combined output — same DriverPlugin.ExecTask
+// seam).  The view pauses the 5s auto-refresh so scrollback survives.
+async function viewExec(id) {
+  PAUSE_REFRESH = true;
+  const a = await get(`/v1/allocation/${id}?namespace=*`);
+  const tasks = Object.keys(a.TaskStates || {});
+  const opts = tasks.map(t => `<option>${esc(t)}</option>`).join('');
+  document.getElementById('main').innerHTML =
+    sect(`Exec · allocation <a href="#/alloc/${a.ID}">` +
+         `${code(a.ID.slice(0,8))}</a> · job ${code(esc(a.JobID))}`,
+         `<div id="term"></div>
+          <div style="margin-top:.5rem">
+            <select id="termtask">${opts}</select>
+            <input id="termcmd" placeholder="command… (Enter to run)"
+                   autocomplete="off">
+          </div>`, true);
+  const term = document.getElementById('term');
+  const input = document.getElementById('termcmd');
+  const say = (s, cls2) => {
+    const el = document.createElement('div');
+    if (cls2) el.className = cls2;
+    el.textContent = s;
+    term.appendChild(el);
+    term.scrollTop = term.scrollHeight;
+  };
+  say(`connected · tasks: ${tasks.join(', ') || '(none)'}`);
+  input.onkeydown = async ev => {
+    if (ev.key !== 'Enter' || !input.value.trim()) return;
+    const cmdline = input.value;
+    input.value = '';
+    say(`$ ${cmdline}`);
+    try {
+      const out = await post(`/v1/client/allocation/${id}/exec`, {
+        Task: document.getElementById('termtask').value,
+        Cmd: ['/bin/sh', '-c', cmdline]});
+      const text = new TextDecoder().decode(
+        Uint8Array.from(atob(out.Output || ''), c => c.charCodeAt(0)));
+      if (text) say(text);
+      say(`(exit ${out.ExitCode})`, out.ExitCode ? 'bad' : 'dim');
+    } catch (e) { say(String(e), 'bad'); }
+  };
+  input.focus();
 }
 
 // ----------------------------------------------------------- node view
@@ -246,13 +382,18 @@ async function viewNode(id) {
 }
 
 // ------------------------------------------------------- router/events
+let PAUSE_REFRESH = false;
 async function route() {
   const h = location.hash.replace(/^#\\/?/, '');
   const p = h.split('/').filter(Boolean).map(decodeURIComponent);
+  PAUSE_REFRESH = false;
   try {
     if (p[0] === 'job' && p.length >= 3) await viewJob(p[1], p[2]);
     else if (p[0] === 'alloc') await viewAlloc(p[1]);
     else if (p[0] === 'node') await viewNode(p[1]);
+    else if (p[0] === 'exec') await viewExec(p[1]);
+    else if (p[0] === 'diff' && p.length >= 5)
+      await viewDiff(p[1], p[2], +p[3], +p[4]);
     else await viewOverview();
   } catch (e) {
     document.getElementById('main').innerHTML =
@@ -305,7 +446,7 @@ async function tailEvents() {
 window.addEventListener('hashchange', route);
 route();
 loadRegions();
-setInterval(route, 5000);
+setInterval(() => { if (!PAUSE_REFRESH) route(); }, 5000);
 tailEvents();
 </script>
 </body>

@@ -12,7 +12,7 @@ import json
 import os
 import sqlite3
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from .drivers.base import TaskHandle
 

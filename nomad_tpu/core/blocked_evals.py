@@ -10,7 +10,7 @@ class tracking is absent, which is correct — just extra evals)."""
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from nomad_tpu.structs import EVAL_STATUS_PENDING, Evaluation
 

@@ -28,11 +28,9 @@ from nomad_tpu.core.logging import log
 from nomad_tpu.structs import (
     DesiredTransition,
     DrainStrategy,
-    Evaluation,
     JOB_TYPE_SERVICE,
     JOB_TYPE_SYSBATCH,
     JOB_TYPE_SYSTEM,
-    TRIGGER_NODE_DRAIN,
 )
 
 SYSTEM_TYPES = (JOB_TYPE_SYSTEM, JOB_TYPE_SYSBATCH)

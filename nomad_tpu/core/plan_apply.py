@@ -25,7 +25,6 @@ from nomad_tpu.core.logging import log
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import (
     Allocation,
-    NetworkIndex,
     Plan,
     PlanResult,
     allocs_fit,

@@ -34,7 +34,6 @@ from nomad_tpu.structs import (
     TRIGGER_JOB_DEREGISTER,
     TRIGGER_JOB_REGISTER,
     TRIGGER_NODE_DRAIN,
-    TRIGGER_NODE_UPDATE,
     new_id,
 )
 

@@ -32,7 +32,7 @@ from nomad_tpu.structs import (
 from .feasibility import feasible_mask_jit
 from .preempt import Preemptor, preemption_enabled
 from .select import (
-    BulkInputs, MultiEvalInputs, PlacementInputs, PlacementOutputs,
+    BulkInputs, MultiEvalInputs, PlacementInputs,
     place_bulk_packed_jit, place_multi_packed_jit, place_packed_jit)
 
 # Minimum homogeneous batch size before the rounds-based bulk kernel beats
@@ -917,6 +917,32 @@ class PlacementEngine:
         mismatch the chain falls back to the packer-synced tensor."""
         if not items:
             return None
+        built = self.build_multi_inputs(snapshot, items, seed=seed,
+                                        used0_dev=used0_dev)
+        if isinstance(built, tuple):
+            return built                 # empty-cluster sentinel
+        inp, rs, aux = built["inp"], built["rs"], built
+        if self.mesh is not None:
+            buf, used_out, _ = self._sharded("multi", rs)(inp)
+        else:
+            buf, used_out, _ = place_multi_packed_jit(inp, rs)
+        # prep_ns, not a wall t0: a prefetched batch may sit dispatched
+        # while the PREVIOUS batch's host phase runs — that gap is not
+        # scheduling time and must not inflate AllocMetric latency
+        return {"buf": buf, "used": used_out, "items": list(items),
+                "spans": aux["spans"], "counts": aux["counts"], "rs": rs,
+                "t": aux["t"], "ctxs": aux["ctxs"], "n": aux["n"],
+                "npad": aux["npad"], "node_version": aux["t"].version,
+                "prep_ns": time.perf_counter_ns() - aux["t0"]}
+
+    def build_multi_inputs(self, snapshot, items: Sequence[BatchItem],
+                           seed: int = 0, used0_dev=None):
+        """Host half of dispatch_batch: pack + lower a multi-eval batch
+        into MultiEvalInputs WITHOUT launching.  Exposed so non-JAX
+        launchers (the C++ PJRT bridge, bench --bridge) can export the
+        exact production kernel + inputs at any scale.  Returns a dict
+        {inp, rs, spans, counts, t, ctxs, n, npad, t0} or the
+        empty-cluster sentinel tuple."""
         t = self.packer.update(snapshot)
         n = t.n
         if n == 0:
@@ -1065,18 +1091,8 @@ class PlacementEngine:
             round_want=jnp.asarray(np.array(round_want, np.int32)),
             seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
         )
-        if self.mesh is not None:
-            buf, used_out, _ = self._sharded("multi", rs)(inp)
-        else:
-            buf, used_out, _ = place_multi_packed_jit(inp, rs)
-        # prep_ns, not a wall t0: a prefetched batch may sit dispatched
-        # while the PREVIOUS batch's host phase runs — that gap is not
-        # scheduling time and must not inflate AllocMetric latency
-        return {"buf": buf, "used": used_out, "items": list(items),
-                "spans": spans, "counts": counts, "rs": rs, "t": t,
-                "ctxs": ctxs, "n": n, "npad": npad,
-                "node_version": t.version,
-                "prep_ns": time.perf_counter_ns() - t0}
+        return {"inp": inp, "rs": rs, "spans": spans, "counts": counts,
+                "t": t, "ctxs": ctxs, "n": n, "npad": npad, "t0": t0}
 
     def collect_batch(self, pending) -> List[Optional[BulkDecisions]]:
         """Blocking half of place_batch: fetch the packed buffer and

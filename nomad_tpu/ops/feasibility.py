@@ -23,7 +23,6 @@ from nomad_tpu.pack.packer import (
     DOP_IS_SET,
     DOP_LUT,
     DOP_NEQ,
-    DOP_TRUE,
 )
 
 

@@ -29,16 +29,13 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from nomad_tpu.structs import (
-    Affinity,
     Constraint,
     Job,
     Node,
     OP_DISTINCT_HOSTS,
     OP_DISTINCT_PROPERTY,
-    OP_EQ,
     OP_IS_NOT_SET,
     OP_IS_SET,
-    OP_NEQ,
     OP_REGEX,
     OP_SEMVER,
     OP_SET_CONTAINS,

@@ -294,8 +294,9 @@ def serve(handlers: Dict[str, Callable[..., Any]], info: Dict) -> None:
     request runs in its own thread so blocking calls (wait_task) don't
     stall the connection."""
     if os.environ.get(MAGIC_COOKIE_KEY) != MAGIC_COOKIE_VALUE:
-        print("this binary is a nomad-tpu plugin and must be launched by "
-              "the agent's plugin manager, not run directly",
+        # lint: allow-print (pre-handshake: stderr is the only channel)
+        print("this binary is a nomad-tpu plugin and must be launched "  # lint: allow-print
+              "by the agent's plugin manager, not run directly",
               file=sys.stderr)
         sys.exit(1)
     sock_path = os.environ[SOCKET_ENV]
@@ -306,7 +307,7 @@ def serve(handlers: Dict[str, Callable[..., Any]], info: Dict) -> None:
     srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     srv.bind(sock_path)
     srv.listen(1)
-    print(f"{CORE_PROTOCOL}|{APP_PROTOCOL}|unix|{sock_path}|json",
+    print(f"{CORE_PROTOCOL}|{APP_PROTOCOL}|unix|{sock_path}|json",  # lint: allow-print
           flush=True)
     conn, _ = srv.accept()
     send_lock = threading.Lock()

@@ -12,7 +12,7 @@ The two narrow seams the scheduler touches the rest of the system through
 from __future__ import annotations
 
 import abc
-from typing import Callable, Dict, List, Optional, Protocol, Tuple
+from typing import Callable, Dict, Optional, Protocol, Tuple
 
 from nomad_tpu.structs import Evaluation, Plan, PlanResult
 

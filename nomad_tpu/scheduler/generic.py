@@ -475,7 +475,7 @@ class GenericScheduler(Scheduler):
                     if not d.evictions and i not in dev_assign:
                         alt_ports, alt = self._ports_from_runner_up(
                             plan, d.node_id, d.metric.score_meta_data,
-                            ask, net_idx, victim_ids)
+                            ask, net_idx, victim_ids, job, tg)
                     if alt_ports is None:
                         d.metric.exhausted_node(fail)
                         self._record_failure(tg.name, d.metric)
@@ -545,21 +545,43 @@ class GenericScheduler(Scheduler):
         return ni
 
     def _ports_from_runner_up(self, plan: Plan, picked_node: str,
-                              score_meta, ask, net_idx, victim_ids):
+                              score_meta, ask, net_idx, victim_ids,
+                              job, tg):
         """Port exhaustion on the picked node: try the top-k runner-up
         rows (reference: the rank iterator simply pulls the next
         candidate).  Returns (ports, runner_up_node_id) or (None, None).
         On success the PLAN loses its fence — the kernel's capacity
         accounting assumed the original pick, so the applier must run
         the full AllocsFit re-check; the caller moves the placement.
+        The candidate must also pass a host-side capacity check against
+        existing + in-plan allocs (the kernel verified the ORIGINAL
+        node, not this one).
 
         Callers must NOT redirect placements that carry preemption
         victims or device-instance assignments: both are bound to the
         ORIGINAL node (victims evicted there; instances exist there) and
-        would be orphaned by the move."""
-        for meta in score_meta[1:]:
+        would be orphaned by the move.  distinct_hosts groups never
+        redirect either — the kernel enforced the one-per-node limit for
+        the original pick only."""
+        from nomad_tpu.structs import (OP_DISTINCT_HOSTS,
+                                       OP_DISTINCT_PROPERTY)
+        cons = (list(job.constraints) + list(tg.constraints)
+                + [c for task in tg.tasks for c in task.constraints])
+        if any(c.operand in (OP_DISTINCT_HOSTS, OP_DISTINCT_PROPERTY)
+               for c in cons):
+            # the kernel enforced per-node/per-property limits for the
+            # ORIGINAL pick only; a host-side move could violate them
+            # invisibly (allocs_fit checks neither)
+            return None, None
+        # ALL top-k entries are candidates: for round-shared bulk
+        # metrics, entry 0 is the round's best node, not necessarily
+        # this placement's pick (the picked-node filter below covers
+        # the per-decision case where entry 0 IS the pick)
+        for meta in score_meta:
             alt = meta.node_id
             if not alt or alt == picked_node:
+                continue
+            if not self._alt_fits(plan, alt, ask):
                 continue
             ni = self._net_index(alt, net_idx, victim_ids)
             ports, _ = ni.assign_ports(ask.networks)
@@ -572,6 +594,41 @@ class GenericScheduler(Scheduler):
             plan.host_redirected = True
             return ports, alt
         return None, None
+
+    def _alt_fits(self, plan: Plan, node_id: str, ask) -> bool:
+        """Capacity check for a redirect candidate: existing live allocs
+        + this plan's placements on the node + the ask must fit (the
+        applier re-checks too — this avoids redirecting into a
+        guaranteed refute)."""
+        node = self.state.node_by_id(node_id)
+        if node is None or node.status == "down":
+            return False
+        cpu = mem = disk = 0
+        for a in self.state.allocs_by_node(node_id):
+            if a.terminal_status():
+                continue
+            cpu += a.resources.cpu
+            mem += a.resources.memory_mb
+            disk += a.resources.disk_mb
+        for a in plan.node_allocation.get(node_id, ()):
+            cpu += a.resources.cpu
+            mem += a.resources.memory_mb
+            disk += a.resources.disk_mb
+        # columnar blocks bypass node_allocation: count their load too
+        for block in plan.alloc_blocks:
+            i = block.node_table.index(node_id) \
+                if node_id in block.node_table else -1
+            if i >= 0:
+                k = int(block.node_counts()[i])
+                r = block.template.resources
+                cpu += k * r.cpu
+                mem += k * r.memory_mb
+                disk += k * r.disk_mb
+        return (cpu + ask.cpu <= node.resources.cpu - node.reserved.cpu
+                and mem + ask.memory_mb
+                <= node.resources.memory_mb - node.reserved.memory_mb
+                and disk + ask.disk_mb
+                <= node.resources.disk_mb - node.reserved.disk_mb)
 
     def _compute_placements_block(self, plan: Plan, job: Job, block,
                                   evaluation: Evaluation,
@@ -805,7 +862,7 @@ class GenericScheduler(Scheduler):
                     # bound to the original node)
                     ports, alt = self._ports_from_runner_up(
                         plan, nid, m.score_meta_data, a2, net_idx,
-                        victim_ids)
+                        victim_ids, job, tg)
                     if ports is not None:
                         nid = alt
                         d2["node_id"] = alt

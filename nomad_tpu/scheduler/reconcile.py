@@ -19,7 +19,6 @@ from typing import Dict, List, Optional
 from nomad_tpu.structs import (
     ALLOC_CLIENT_FAILED,
     ALLOC_CLIENT_LOST,
-    ALLOC_DESIRED_STOP,
     Allocation,
     DEPLOYMENT_STATUS_CANCELLED,
     DEPLOYMENT_STATUS_FAILED,

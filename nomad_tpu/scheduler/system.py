@@ -10,7 +10,7 @@ alloc), so no scan is needed; capacity is checked host-side per node.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -19,7 +19,6 @@ from nomad_tpu.structs import (
     ReschedulePolicy,
     RescheduleEvent,
     RescheduleTracker,
-    TaskGroup,
 )
 
 

@@ -12,7 +12,7 @@ in lenient (version) mode a malformed version never matches.
 from __future__ import annotations
 
 import re
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 # Framework version (reference: version/version.go).
 VERSION = "0.1.0"

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# CI pipeline — the single command that reproduces CI locally
+# (reference: .github/workflows/test-core.yaml).  Three stages:
+#   lint   — scripts/lint.py (AST checks: syntax, unused imports, stray
+#            prints, whitespace; no external linters required)
+#   test   — the full pytest suite on the 8-virtual-device CPU mesh
+#            (tests/conftest.py forces JAX_PLATFORMS=cpu +
+#            xla_force_host_platform_device_count=8, so the sharded
+#            kernels run everywhere)
+#   smoke  — bench.py at reduced scale on the CPU backend: the whole
+#            broker -> batched-worker -> plan-queue -> applier pipeline
+#            must place every alloc (the run asserts completeness
+#            internally; a scheduling regression fails the run)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint =="
+python scripts/lint.py
+
+echo "== tests (8-virtual-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== bench smoke (CPU backend, reduced scale) =="
+JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \
+    --placements 2000 --iters 1 | python -c '
+import json, sys
+out = json.load(sys.stdin)
+assert out["value"] > 0, out
+print("smoke ok:", out["metric"], out["value"], out["unit"])'
+
+echo "== CI green =="

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Self-contained lint (no external linters in the image): AST +
+text-level checks over nomad_tpu/, tests/, bench.py.
+
+Checks:
+  - syntax (ast.parse)
+  - unused imports (module scope, names never referenced)
+  - stray debug prints in library code (cli/ui/agent/bench/__main__ and
+    scripts/ legitimately print)
+  - trailing whitespace / tabs
+  - lines > 99 chars
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PRINT_OK = {"cli.py", "ui.py", "agent.py", "__main__.py", "bench.py",
+            "logging.py", "__graft_entry__.py"}
+
+
+def imported_names(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                yield node, a.asname or a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                yield node, a.asname or a.name
+
+
+def lint_file(path: Path) -> list:
+    problems = []
+    text = path.read_text()
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    used |= {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
+    # names referenced only inside string annotations / __all__ exports
+    used |= set(text.split())       # crude but kills false positives
+    if path.name != "__init__.py":      # __init__ re-exports are the API
+        for node, name in imported_names(tree):
+            if name not in used:
+                problems.append(
+                    f"{path}:{node.lineno}: unused import {name!r}")
+
+    if (path.name not in PRINT_OK and "tests" not in path.parts
+            and "scripts" not in path.parts):
+        lines = text.splitlines()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+                    else ""
+                if "lint: allow-print" in line:
+                    continue     # deliberate (plugin handshake protocol)
+                problems.append(
+                    f"{path}:{node.lineno}: print() in library code "
+                    "(use core.logging.log, or '# lint: allow-print')")
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if line != line.rstrip():
+            problems.append(f"{path}:{i}: trailing whitespace")
+        if "\t" in line:
+            problems.append(f"{path}:{i}: tab character")
+        if len(line) > 99:
+            problems.append(f"{path}:{i}: line > 99 chars ({len(line)})")
+    return problems
+
+
+def main() -> int:
+    targets = [ROOT / "bench.py", ROOT / "__graft_entry__.py"]
+    for pkg in ("nomad_tpu", "tests", "scripts"):
+        targets.extend(sorted((ROOT / pkg).rglob("*.py")))
+    problems = []
+    for path in targets:
+        if "__pycache__" in path.parts:
+            continue
+        problems.extend(lint_file(path))
+    for p in problems:
+        print(p)
+    print(f"lint: {len(problems)} problem(s) over {len(targets)} files")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -336,3 +336,47 @@ class TestScaleAndVolumes:
         _wait(lambda: not api.volumes.info("vol-sw")["WriteAllocs"]
               or None)
         api.volumes.deregister("vol-sw")
+
+
+class TestUISurfaces:
+    def test_ui_serves_exec_and_diff_views(self, agent):
+        """The SPA ships the exec-terminal and version-diff views
+        (VERDICT r3 #8) and they are wired into the hash router."""
+        import urllib.request
+        with urllib.request.urlopen(agent.address + "/ui/") as r:
+            html = r.read().decode()
+        for needle in ("viewExec", "viewDiff", "p[0] === 'exec'",
+                       "p[0] === 'diff'", "termcmd", "PAUSE_REFRESH"):
+            assert needle in html, needle
+
+    def test_exec_surface_the_terminal_drives(self, api, agent):
+        """The terminal's POST /v1/client/allocation/:id/exec round-trip
+        against a running mock-driver task."""
+        import base64
+
+        wire, job = _wire_batch_job(count=1)
+        api.jobs.register(wire)
+        allocs = _wait(lambda: [
+            a for a in api.jobs.allocations(job.id)
+            if a["ClientStatus"] == "running"])
+        assert allocs
+        out = api.request(
+            "POST", f"/v1/client/allocation/{allocs[0]['ID']}/exec",
+            body={"Cmd": ["/bin/sh", "-c", "echo terminal-ping"]})
+        assert out["ExitCode"] == 0
+        assert "terminal-ping" in base64.b64decode(
+            out["Output"]).decode()
+
+    def test_version_diff_data(self, api, agent):
+        """The diff view's data source: two versions with a visible
+        count change."""
+        wire, job = _wire_batch_job(count=1)
+        api.jobs.register(wire)
+        wire2 = dict(wire)
+        wire2["TaskGroups"] = [dict(wire["TaskGroups"][0], Count=3)]
+        api.jobs.register(wire2)
+        vs = api.request(
+            "GET", f"/v1/job/{job.id}/versions")["Versions"]
+        assert [v["Version"] for v in vs][:2] == [1, 0]
+        assert vs[0]["TaskGroups"][0]["Count"] == 3
+        assert vs[1]["TaskGroups"][0]["Count"] == 1

@@ -18,7 +18,6 @@ from nomad_tpu.structs import (
     ALLOC_CLIENT_COMPLETE,
     ALLOC_CLIENT_FAILED,
     ALLOC_CLIENT_RUNNING,
-    Allocation,
     RestartPolicy,
     Task,
     TASK_STATE_DEAD,

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from nomad_tpu import mock
-from nomad_tpu.ops import PlacementEngine, PlacementRequest
+from nomad_tpu.ops import PlacementRequest
 from nomad_tpu.ops.select import PlacementInputs, place_jit
 from nomad_tpu.pack import ClusterPacker, lower_spreads
 from nomad_tpu.parallel import make_mesh, pad_nodes, place_sharded_fn

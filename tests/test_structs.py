@@ -6,12 +6,9 @@ import pytest
 
 from nomad_tpu import mock
 from nomad_tpu.structs import (
-    Allocation,
     MAX_FIT_SCORE,
     NetworkIndex,
     NetworkResource,
-    Node,
-    NodeResources,
     Port,
     Resources,
     allocs_fit,
