@@ -203,6 +203,9 @@ class Router:
         head = p[0] if p else ""
         if head == "acl" and p[1:2] == ["bootstrap"]:
             return None                 # one-shot, self-guarding
+        if (head == "acl" and p[1:2] == ["login"]
+                and method in ("PUT", "POST")):
+            return None     # token exchange: the JWT itself authenticates
         if (head == "acl" and p[1:3] == ["token", "self"]
                 and method == "GET"):
             # any valid token may READ itself; non-GET verbs fall through
@@ -817,6 +820,92 @@ class Router:
                 return {}
             if method == "DELETE":
                 s.state.delete_acl_policy(name)
+                return {}
+        if head == "login" and method in ("PUT", "POST"):
+            # token EXCHANGE: a third-party JWT in, an ACL token out
+            # (reference: ACL.Login; unauthenticated by design — see
+            # _enforce)
+            from nomad_tpu.acl.auth_methods import AuthError, login
+            name = (body or {}).get("AuthMethodName", "")
+            jwt = (body or {}).get("LoginToken", "")
+            if not name or not jwt:
+                raise APIError(400, "AuthMethodName and LoginToken "
+                                    "required")
+            try:
+                tok, _ = login(s.state, name, jwt)
+            except AuthError as e:
+                raise APIError(403, str(e))
+            s.state.upsert_acl_token(tok)
+            return codec.encode(tok)
+        if head == "auth-methods" and method == "GET":
+            return [{"Name": m.name, "Type": m.type,
+                     "Default": m.default,
+                     "TokenLocality": m.token_locality}
+                    for m in s.state.acl_auth_methods()]
+        if head == "auth-method":
+            from nomad_tpu.acl.auth_methods import validate_method
+            from nomad_tpu.structs import ACLAuthMethod
+            if method in ("PUT", "POST") and len(p) >= 2:
+                b = body or {}
+                # TTL: codec wire form "MaxTokenTTL" is nanoseconds (it
+                # must round-trip through GET); "MaxTokenTTLS" seconds
+                # accepted as the human-friendly alternative
+                if "MaxTokenTTL" in b:
+                    ttl_s = float(b["MaxTokenTTL"]) / 1e9
+                else:
+                    ttl_s = float(b.get("MaxTokenTTLS", 3600.0))
+                m = ACLAuthMethod(
+                    name=p[1],
+                    type=b.get("Type", "JWT"),
+                    token_locality=b.get("TokenLocality", "local"),
+                    max_token_ttl_s=ttl_s,
+                    default=bool(b.get("Default", False)),
+                    config=dict(b.get("Config") or {}))
+                err = validate_method(m)
+                if err:
+                    raise APIError(400, err)
+                s.state.upsert_acl_auth_method(m)
+                return codec.encode(m)
+            if method == "GET" and len(p) >= 2:
+                m = s.state.acl_auth_method_by_name(p[1])
+                if m is None:
+                    raise APIError(404, "auth method not found")
+                return codec.encode(m)
+            if method == "DELETE" and len(p) >= 2:
+                s.state.delete_acl_auth_method(p[1])
+                return {}
+        if head == "binding-rules" and method == "GET":
+            return [codec.encode(r) for r in s.state.acl_binding_rules()]
+        if head == "binding-rule":
+            from nomad_tpu.structs import ACLBindingRule
+            if method in ("PUT", "POST") and len(p) == 1:
+                b = body or {}
+                if s.state.acl_auth_method_by_name(
+                        b.get("AuthMethod", "")) is None:
+                    raise APIError(400, "unknown AuthMethod")
+                if b.get("BindType", "policy") not in ("policy",
+                                                       "management"):
+                    raise APIError(400, "BindType must be policy or "
+                                        "management")
+                if (b.get("BindType", "policy") == "policy"
+                        and not b.get("BindName")):
+                    raise APIError(400, "policy binding rules need a "
+                                        "BindName (reference rejects "
+                                        "these at create time too)")
+                r = ACLBindingRule(
+                    auth_method=b["AuthMethod"],
+                    selector=b.get("Selector", ""),
+                    bind_type=b.get("BindType", "policy"),
+                    bind_name=b.get("BindName", ""))
+                s.state.upsert_acl_binding_rule(r)
+                return codec.encode(r)
+            if method == "GET" and len(p) >= 2:
+                r = s.state.acl_binding_rule_by_id(p[1])
+                if r is None:
+                    raise APIError(404, "binding rule not found")
+                return codec.encode(r)
+            if method == "DELETE" and len(p) >= 2:
+                s.state.delete_acl_binding_rule(p[1])
                 return {}
         if head == "tokens" and method == "GET":
             return [_token_stub(t) for t in s.state.acl_tokens()]

@@ -516,6 +516,63 @@ def cmd_acl_token_delete(args) -> int:
     return 0
 
 
+def cmd_acl_auth_method_create(args) -> int:
+    import json as _json
+    cfg = _json.loads(args.config) if args.config else {}
+    out = _client(args).request(
+        "POST", f"/v1/acl/auth-method/{args.name}",
+        body={"Type": args.type, "TokenLocality": args.token_locality,
+              "MaxTokenTTLS": args.max_token_ttl,
+              "Default": args.default, "Config": cfg})
+    print(f"auth method {out['Name']!r} ({out['Type']}) created")
+    return 0
+
+
+def cmd_acl_auth_method_list(args) -> int:
+    for m in _client(args).request("GET", "/v1/acl/auth-methods"):
+        print(f"{m['Name']:<24} {m['Type']:<6} {m['TokenLocality']}"
+              + ("  (default)" if m["Default"] else ""))
+    return 0
+
+
+def cmd_acl_auth_method_delete(args) -> int:
+    _client(args).request("DELETE", f"/v1/acl/auth-method/{args.name}")
+    print(f"auth method {args.name!r} deleted")
+    return 0
+
+
+def cmd_acl_binding_rule_create(args) -> int:
+    out = _client(args).request(
+        "POST", "/v1/acl/binding-rule",
+        body={"AuthMethod": args.auth_method,
+              "Selector": args.selector,
+              "BindType": args.bind_type, "BindName": args.bind_name})
+    print(f"binding rule {out['ID'][:8]} created")
+    return 0
+
+
+def cmd_acl_binding_rule_list(args) -> int:
+    for r in _client(args).request("GET", "/v1/acl/binding-rules"):
+        print(f"{r['ID'][:8]}  {r['AuthMethod']:<16} "
+              f"{r['BindType']:<11} {r['BindName']:<20} "
+              f"{r['Selector']}")
+    return 0
+
+
+def cmd_acl_login(args) -> int:
+    jwt = args.token
+    if jwt == "-":
+        import sys as _sys
+        jwt = _sys.stdin.read().strip()
+    tok = _client(args).request(
+        "POST", "/v1/acl/login",
+        body={"AuthMethodName": args.method, "LoginToken": jwt})
+    print(f"Accessor ID: {tok['AccessorID']}")
+    print(f"Secret  ID: {tok['SecretID']}")
+    print(f"Policies:   {', '.join(tok['Policies']) or '(management)'}")
+    return 0
+
+
 def cmd_namespace_list(args) -> int:
     for n in _client(args).namespaces.list():
         print(f"{n['Name']:<24} {n.get('Description', '')}")
@@ -928,6 +985,43 @@ def build_parser() -> argparse.ArgumentParser:
     atd.set_defaults(fn=cmd_acl_token_delete)
     ats = atok.add_parser("self")
     ats.set_defaults(fn=cmd_acl_token_self)
+    am = acl.add_parser("auth-method").add_subparsers(dest="am_cmd",
+                                                     required=True)
+    amc = am.add_parser("create")
+    amc.add_argument("name")
+    amc.add_argument("-type", default="JWT")
+    amc.add_argument("-token-locality", dest="token_locality",
+                     default="local", choices=["local", "global"])
+    amc.add_argument("-max-token-ttl", dest="max_token_ttl",
+                     type=float, default=3600.0)
+    amc.add_argument("-default", action="store_true")
+    amc.add_argument("-config", default="",
+                     help='JSON config: {"JWTValidationPubKeys": [...] '
+                          'or "JWTValidationSecrets": [...], '
+                          '"BoundIssuer": ..., "BoundAudiences": [...]}')
+    amc.set_defaults(fn=cmd_acl_auth_method_create)
+    aml = am.add_parser("list")
+    aml.set_defaults(fn=cmd_acl_auth_method_list)
+    amd = am.add_parser("delete")
+    amd.add_argument("name")
+    amd.set_defaults(fn=cmd_acl_auth_method_delete)
+    br = acl.add_parser("binding-rule").add_subparsers(dest="br_cmd",
+                                                      required=True)
+    brc = br.add_parser("create")
+    brc.add_argument("-auth-method", dest="auth_method", required=True)
+    brc.add_argument("-selector", default="")
+    brc.add_argument("-bind-type", dest="bind_type", default="policy",
+                     choices=["policy", "management"])
+    brc.add_argument("-bind-name", dest="bind_name", default="")
+    brc.set_defaults(fn=cmd_acl_binding_rule_create)
+    brl = br.add_parser("list")
+    brl.set_defaults(fn=cmd_acl_binding_rule_list)
+    alog = acl.add_parser("login")
+    alog.add_argument("-method", default="",
+                      help="auth method (default: the method marked "
+                           "-default)")
+    alog.add_argument("token", help="the JWT ('-' reads stdin)")
+    alog.set_defaults(fn=cmd_acl_login)
 
     nsp = sub.add_parser("namespace",
                          help="namespace management").add_subparsers(

@@ -58,6 +58,8 @@ MUTATIONS = frozenset({
     "upsert_node_pool", "delete_node_pool",
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token", "bootstrap_acl_token",
+    "upsert_acl_auth_method", "delete_acl_auth_method",
+    "upsert_acl_binding_rule", "delete_acl_binding_rule",
     "upsert_service_registrations", "delete_service_registrations_by_alloc",
     "upsert_variable", "delete_variable",
     "snapshot_restore",

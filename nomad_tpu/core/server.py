@@ -360,6 +360,8 @@ class Server:
         token = self.state.acl_token_by_secret(secret_id)
         if token is None:
             return None, "ACL token not found"
+        if token.expired(time.time()):
+            return None, "ACL token expired"
         if token.is_management():
             return management_acl(), ""
         pols = [(name, self.state.acl_policy_by_name(name))

@@ -21,6 +21,8 @@ import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from nomad_tpu.structs import (
+    ACLAuthMethod,
+    ACLBindingRule,
     ACLPolicy,
     ACLToken,
     Allocation,
@@ -66,6 +68,8 @@ class StateStore:
         self._acl_policies: Dict[str, ACLPolicy] = {}
         self._acl_tokens: Dict[str, ACLToken] = {}       # accessor -> token
         self._acl_by_secret: Dict[str, ACLToken] = {}
+        self._acl_auth_methods: Dict[str, ACLAuthMethod] = {}
+        self._acl_binding_rules: Dict[str, ACLBindingRule] = {}
         self._variables: Dict[Tuple[str, str], VariableItem] = {}
         self._services: Dict[str, ServiceRegistration] = {}
         self._scheduler_config = SchedulerConfiguration()
@@ -989,6 +993,66 @@ class StateStore:
     def acl_tokens(self) -> List[ACLToken]:
         return list(self._acl_tokens.values())
 
+    # ------------------------------------------------- acl auth methods
+
+    def upsert_acl_auth_method(self, method: ACLAuthMethod) -> int:
+        with self._lock:
+            idx = self._bump()
+            prev = self._acl_auth_methods.get(method.name)
+            method.create_index = prev.create_index if prev else idx
+            method.modify_index = idx
+            self._acl_auth_methods = {**self._acl_auth_methods,
+                                      method.name: method}
+            return idx
+
+    def delete_acl_auth_method(self, name: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            methods = dict(self._acl_auth_methods)
+            methods.pop(name, None)
+            self._acl_auth_methods = methods
+            # a method's binding rules die with it (reference: cascade)
+            if any(r.auth_method == name
+                   for r in self._acl_binding_rules.values()):
+                self._acl_binding_rules = {
+                    k: r for k, r in self._acl_binding_rules.items()
+                    if r.auth_method != name}
+            return idx
+
+    def acl_auth_method_by_name(self, name: str
+                                ) -> Optional[ACLAuthMethod]:
+        return self._acl_auth_methods.get(name)
+
+    def acl_auth_methods(self) -> List[ACLAuthMethod]:
+        return list(self._acl_auth_methods.values())
+
+    def upsert_acl_binding_rule(self, rule: ACLBindingRule) -> int:
+        with self._lock:
+            idx = self._bump()
+            prev = self._acl_binding_rules.get(rule.id)
+            rule.create_index = prev.create_index if prev else idx
+            rule.modify_index = idx
+            self._acl_binding_rules = {**self._acl_binding_rules,
+                                       rule.id: rule}
+            return idx
+
+    def delete_acl_binding_rule(self, rule_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            rules = dict(self._acl_binding_rules)
+            rules.pop(rule_id, None)
+            self._acl_binding_rules = rules
+            return idx
+
+    def acl_binding_rule_by_id(self, rule_id: str
+                               ) -> Optional[ACLBindingRule]:
+        return self._acl_binding_rules.get(rule_id)
+
+    def acl_binding_rules(self, auth_method: Optional[str] = None
+                          ) -> List[ACLBindingRule]:
+        return [r for r in self._acl_binding_rules.values()
+                if auth_method is None or r.auth_method == auth_method]
+
     # ----------------------------------------------------------- services
 
     def upsert_service_registrations(self, regs) -> int:
@@ -1095,6 +1159,12 @@ class StateStore:
                                 for p in self._acl_policies.values()],
                 "ACLTokens": [codec.encode(t)
                               for t in self._acl_tokens.values()],
+                "ACLAuthMethods": [
+                    codec.encode(m)
+                    for m in self._acl_auth_methods.values()],
+                "ACLBindingRules": [
+                    codec.encode(r)
+                    for r in self._acl_binding_rules.values()],
                 "Variables": [codec.encode(v)
                               for v in self._variables.values()],
                 "CSIVolumes": [codec.encode(v)
@@ -1168,6 +1238,14 @@ class StateStore:
                 t = codec.decode(ACLToken, d)
                 self._acl_tokens[t.accessor_id] = t
                 self._acl_by_secret[t.secret_id] = t
+            self._acl_auth_methods = {
+                m.name: m for m in
+                (codec.decode(ACLAuthMethod, d)
+                 for d in doc.get("ACLAuthMethods", []))}
+            self._acl_binding_rules = {
+                r.id: r for r in
+                (codec.decode(ACLBindingRule, d)
+                 for d in doc.get("ACLBindingRules", []))}
             self._variables = {}
             for d in doc.get("Variables", []):
                 v = codec.decode(VariableItem, d)

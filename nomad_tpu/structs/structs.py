@@ -1111,11 +1111,54 @@ class ACLToken:
     policies: List[str] = field(default_factory=list)
     global_: bool = False
     create_time: float = 0.0
+    # epoch seconds; 0 = never expires.  Login-minted tokens carry the
+    # auth method's max_token_ttl_s (reference: ExpirationTime).
+    expiration_time: float = 0.0
     create_index: int = 0
     modify_index: int = 0
 
     def is_management(self) -> bool:
         return self.type == ACL_TOKEN_TYPE_MANAGEMENT
+
+    def expired(self, now: float) -> bool:
+        return bool(self.expiration_time) and now > self.expiration_time
+
+
+@dataclass
+class ACLAuthMethod:
+    """SSO auth method (reference: structs.ACLAuthMethod [v1.5+] —
+    `nomad acl auth-method`).  Type "JWT" validates bearer JWTs locally
+    against configured keys; "OIDC" requires interactive discovery +
+    egress and is declared unsupported in this build (the create path
+    rejects it with the reason)."""
+    name: str = ""
+    type: str = "JWT"            # "JWT" (supported) | "OIDC" (rejected)
+    token_locality: str = "local"
+    max_token_ttl_s: float = 3600.0
+    default: bool = False
+    # type-specific config (reference: ACLAuthMethodConfig):
+    #   JWTValidationPubKeys: [PEM RSA public keys]  (RS256)
+    #   JWTValidationSecrets: [shared secrets]       (HS256; deviation —
+    #       handy where no PKI exists; same claims checks apply)
+    #   BoundIssuer: str, BoundAudiences: [str]
+    config: Dict[str, Any] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class ACLBindingRule:
+    """Maps verified claims to ACL grants (reference:
+    structs.ACLBindingRule).  `selector` is a comma-ANDed list of
+    `claims.<name>==<value>` terms (empty = match every login);
+    `bind_name` interpolates `${claims.<name>}`."""
+    id: str = field(default_factory=new_id)
+    auth_method: str = ""
+    selector: str = ""
+    bind_type: str = "policy"    # "policy" | "management"
+    bind_name: str = ""
+    create_index: int = 0
+    modify_index: int = 0
 
 
 @dataclass
