@@ -140,7 +140,8 @@ def submit(h, job):
 
 
 def count_placed(plan):
-    return sum(len(a) for a in plan.node_allocation.values())
+    return (sum(len(a) for a in plan.node_allocation.values())
+            + sum(b.count for b in plan.alloc_blocks))
 
 
 # --------------------------------------------------------------------------
