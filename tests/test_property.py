@@ -120,3 +120,59 @@ def test_random_workloads_place_validly(seed):
         ok, dim, _ = allocs_fit(n, allocs)
         assert ok, (n.id, dim, len(allocs))
     assert total_live > 0     # the scenario actually exercised placement
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_block_reads_equal_classic_reads(seed):
+    """Columnar-block state is INVISIBLE to readers: for random bulk
+    workloads, every read surface (by job, by node, by id, counts,
+    snapshot vs head) returns the same allocs whether placements
+    committed as blocks or were flattened to table rows."""
+    rng = random.Random(seed)
+    s = Server(dev_mode=True, eval_batch=64)
+    s.establish_leadership()
+    for n in random_cluster(rng, 30):
+        n.resources.cpu = 16000
+        n.resources.memory_mb = 32768
+        s.register_node(n, now=NOW)
+    jobs = []
+    for i in range(4):
+        job = mock.batch_job()
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = rng.randrange(64, 150)   # >= 64 -> block path
+        tg.tasks[0].resources.cpu = 10
+        tg.tasks[0].resources.memory_mb = 10
+        s.register_job(job, now=NOW)
+        jobs.append(job)
+    s.process_all(now=NOW)
+    assert s.state._alloc_blocks, "expected columnar commits"
+
+    def read_everything():
+        snap = s.state.snapshot()
+        out = {}
+        for job in jobs:
+            rows = sorted(
+                (a.id, a.name, a.node_id)
+                for a in snap.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status())
+            out[job.id] = rows
+        by_node = {}
+        for nid in {r[2] for rows in out.values() for r in rows}:
+            by_node[nid] = sorted(a.id for a in snap.allocs_by_node(nid))
+        some_ids = [rows[0][0] for rows in out.values() if rows]
+        by_id = {aid: snap.alloc_by_id(aid) is not None
+                 for aid in some_ids}
+        return out, by_node, by_id
+
+    before = read_everything()
+    # flatten EVERY block (the cold path) and re-read: identical
+    for b in list(s.state._alloc_blocks.values()):
+        with s.state.locked():
+            s.state._materialize_block_locked(b)
+    assert not s.state._alloc_blocks
+    after = read_everything()
+    assert before == after
+    # counts match the asked counts
+    for job in jobs:
+        assert len(before[0][job.id]) == job.task_groups[0].count
