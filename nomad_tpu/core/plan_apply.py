@@ -484,11 +484,13 @@ class PlanApplier:
                 vol = snap.csi_volume_by_id(a.namespace, vreq.source)
                 if vol is None or not vol.schedulable:
                     return NODE_REFUSED      # can never clear in-plan
+                if not vreq.read_only and vol.reader_only():
+                    return NODE_REFUSED      # mode mismatch: also final
                 if not vol.claim_ok(vreq.read_only, releasing):
                     return NODE_CLAIM_REFUSED
                 if not vreq.read_only:
                     # in-plan claims only grow — refusal here is final
-                    if (vol.access_mode.startswith("single-node-writer")
+                    if (vol.writer_limited()
                             and plan_claims is not None
                             and (plan_claims.get(key, 0)
                                  + local_claims.get(key, 0))):

@@ -56,6 +56,8 @@ class CoreScheduler(Scheduler):
                 self._node_gc(force)
             if kind in (CORE_JOB_DEPLOYMENT_GC, CORE_JOB_FORCE_GC):
                 self._deployment_gc(force)
+            if kind in (CORE_JOB_EVAL_GC, CORE_JOB_FORCE_GC):
+                self._token_gc()
         done = evaluation.copy()
         done.status = EVAL_STATUS_COMPLETE
         self.planner.update_eval(done)
@@ -86,6 +88,16 @@ class CoreScheduler(Scheduler):
                 dead.append(ev.id)
         if dead:
             self.store.delete_evals(dead)
+
+    def _token_gc(self) -> None:
+        """Reap EXPIRED login-minted ACL tokens (reference: the token
+        expiration GC added with auth methods).  Rides the eval-GC core
+        job; expiry itself is enforced at resolve time — this just keeps
+        the table from growing forever."""
+        dead = [t.accessor_id for t in self.store.acl_tokens()
+                if t.expired(self.now)]
+        for accessor in dead:
+            self.store.delete_acl_token(accessor)
 
     def _job_gc(self, force: bool) -> None:
         snap = self.store.snapshot()

@@ -1205,6 +1205,16 @@ class CSIVolume:
     write_allocs: Dict[str, bool] = field(default_factory=dict)
     schedulable: bool = True
 
+    def writer_limited(self) -> bool:
+        """Access modes permitting at most ONE live writer (reference:
+        CSIVolumeAccessModeSingleNodeWriter / MultiNodeSingleWriter)."""
+        return (self.access_mode.startswith("single-node-writer")
+                or self.access_mode == "multi-node-single-writer")
+
+    def reader_only(self) -> bool:
+        return self.access_mode in ("single-node-reader-only",
+                                    "multi-node-reader-only")
+
     def claim_ok(self, read_only: bool, releasing=()) -> bool:
         """`releasing`: alloc ids whose claims are being released by the
         same plan (stops / preemptions / same-id replacements) — without
@@ -1213,9 +1223,11 @@ class CSIVolume:
         refute also withholds the stop that would release it."""
         if not self.schedulable:
             return False
+        if not read_only and self.reader_only():
+            return False         # write claim against a read-only mode
         if read_only:
             return True
-        if self.access_mode.startswith("single-node-writer"):
+        if self.writer_limited():
             return not (set(self.write_allocs) - set(releasing))
         return True
 

@@ -497,3 +497,22 @@ class TestAuthMethods:
             "repo": "acme/app", "env": "ci", "exp": int(now) + 300})
         tok, policies = login(st, "", jwt, now=now)
         assert policies == ["deploy-ci"]
+
+    def test_expired_tokens_reaped_by_gc(self):
+        import time
+
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.structs import ACLToken
+        s = Server(dev_mode=True, acl_enabled=True)
+        s.establish_leadership()
+        now = time.time()
+        dead = ACLToken(name="old-login", expiration_time=now - 10)
+        live = ACLToken(name="fresh", expiration_time=now + 3600)
+        forever = ACLToken(name="static")
+        for t in (dead, live, forever):
+            s.state.upsert_acl_token(t)
+        s.force_gc(now=now)
+        s.process_all(now=now)
+        names = {t.name for t in s.state.acl_tokens()}
+        assert "old-login" not in names
+        assert {"fresh", "static"} <= names

@@ -357,3 +357,37 @@ class TestCSITopology:
         state.upsert_plan_results(plan, result)
         vol = state.snapshot().csi_volume_by_id("default", "vol-f")
         assert list(vol.write_allocs) == [y.id]
+
+    def test_multi_node_single_writer_and_reader_only_modes(self):
+        """multi-node-single-writer admits exactly one writer anywhere;
+        reader-only modes refuse write claims outright."""
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        make_cluster(s, n=4)
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-mnsw", plugin_id="ebs0",
+            access_mode="multi-node-single-writer"))
+        s.state.upsert_csi_volume(CSIVolume(
+            id="vol-ro", plugin_id="ebs0",
+            access_mode="multi-node-reader-only"))
+        w = csi_job("vol-mnsw", count=2, read_only=False)
+        s.register_job(w, now=NOW)
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        live = [a for a in snap.allocs_by_job(w.namespace, w.id)
+                if not a.terminal_status()]
+        assert len(live) == 1          # one writer, cluster-wide
+        # a write claim against a reader-only volume never places
+        bad = csi_job("vol-ro", count=1, read_only=False)
+        s.register_job(bad, now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        snap = s.state.snapshot()
+        assert [a for a in snap.allocs_by_job(bad.namespace, bad.id)
+                if not a.terminal_status()] == []
+        # readers against the same volume are fine
+        ok = csi_job("vol-ro", count=2, read_only=True)
+        s.register_job(ok, now=NOW + 2)
+        s.process_all(now=NOW + 2)
+        snap = s.state.snapshot()
+        assert len([a for a in snap.allocs_by_job(ok.namespace, ok.id)
+                    if not a.terminal_status()]) == 2
