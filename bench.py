@@ -184,6 +184,13 @@ def _stock_lib():
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
             ctypes.c_void_p]
+        lib.stock_place_evals_realistic.restype = ctypes.c_int64
+        lib.stock_place_evals_realistic.argtypes = [
+            ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_void_p]
         _STOCK_LIB = lib
         return lib
     except Exception as e:  # noqa: BLE001 - toolchain absent: degrade loud
@@ -191,6 +198,26 @@ def _stock_lib():
               "interpreted emulation", file=sys.stderr)
         _STOCK_LIB = False
         return None
+
+
+def _zoned_arrays(nodes, n_zones: int):
+    """Shared packing for the zoned baselines: capacity arrays + each
+    node's storage zone (both stock tiers must parse zones identically
+    or the bracketing ladder desynchronizes)."""
+    import numpy as np
+    cap_cpu = np.array([nd.resources.cpu for nd in nodes], np.int32)
+    cap_mem = np.array([nd.resources.memory_mb for nd in nodes], np.int32)
+    zones = np.array([int(nd.attributes.get("storage.topology",
+                                            "zone0")[4:]) % n_zones
+                      for nd in nodes], np.int32)
+    return cap_cpu, cap_mem, zones
+
+
+def _zone_evals_split(n_place: int, per_eval: int, n_zones: int):
+    """Round-robin eval split over zones, like the bench jobs (zone=i%5)."""
+    n_evals = max(n_place // max(per_eval, 1), 1)
+    return [n_evals // n_zones + (1 if z < n_evals % n_zones else 0)
+            for z in range(n_zones)]
 
 
 def stock_zoned_rate_compiled(nodes, cpu: int, mem: int, n_place: int,
@@ -226,17 +253,12 @@ def stock_zoned_rate_compiled(nodes, cpu: int, mem: int, n_place: int,
         return stock_baseline_rate(nodes, cpu, mem,
                                    min(n_place, 2000), seed), None
     n = len(nodes)
-    cap_cpu = np.array([nd.resources.cpu for nd in nodes], np.int32)
-    cap_mem = np.array([nd.resources.memory_mb for nd in nodes], np.int32)
+    cap_cpu, cap_mem, zones = _zoned_arrays(nodes, n_zones)
     base_ok = np.array(
         [nd.datacenter in ("dc1", "dc2", "dc3")
          and nd.attributes.get("kernel.name", "linux") == "linux"
          for nd in nodes], bool)
-    zones = np.array([int(nd.attributes.get("storage.topology",
-                                            "zone0")[4:]) % n_zones
-                      for nd in nodes], np.int32)
     touched = np.zeros(n, np.uint8)
-    n_evals = max(n_place // max(per_eval, 1), 1)
     placed = [0] * n_zones
 
     def run_zone(z, zone_evals):
@@ -245,9 +267,7 @@ def stock_zoned_rate_compiled(nodes, cpu: int, mem: int, n_place: int,
             n, cap_cpu.ctypes.data, cap_mem.ctypes.data, elig.ctypes.data,
             cpu, mem, zone_evals, per_eval, seed + z, touched.ctypes.data)
 
-    # evals split round-robin over zones like the bench jobs (zone=i%5)
-    zone_evals = [n_evals // n_zones + (1 if z < n_evals % n_zones else 0)
-                  for z in range(n_zones)]
+    zone_evals = _zone_evals_split(n_place, per_eval, n_zones)
     t0 = time.perf_counter()
     if workers <= 1:
         for z in range(n_zones):
@@ -264,6 +284,43 @@ def stock_zoned_rate_compiled(nodes, cpu: int, mem: int, n_place: int,
     dt = time.perf_counter() - t0
     rate = sum(placed) / dt if dt > 0 else 0.0
     return rate, int(touched.sum())
+
+
+def stock_zoned_rate_realistic(nodes, cpu: int, mem: int, n_place: int,
+                               per_eval: int, n_zones: int = 5,
+                               seed: int = 3):
+    """The REALISTIC middle-tier stock emulation (round-5 verdict #1) at
+    the same zoned config-5 shape: per candidate, a ComputedClass-keyed
+    eval-cache string lookup with the full attr-map constraint chain on
+    miss; AllocsFit as a pointer-chase over heap alloc records with
+    per-task resource-map gets; per-placement AllocMetric + Allocation
+    construction (UUID strings, string-keyed score maps); ordered-map
+    store commits at plan apply.  See native/stock_baseline/stock.cc for
+    the line-by-line cost model and the documented omissions (Raft, RPC,
+    GC — whose magnitude the C1M anchor brackets from below).
+
+    ONE C call: the cluster state is built once (untimed, mirroring the
+    TPU side whose packer build precedes its measured wave) and all
+    zones' eval loops run serially inside the timed window — serial is
+    stock's shape on this host, whose num_schedulers default is one per
+    core and os.cpu_count() == 1 here.  Returns placements/sec or None
+    without a toolchain."""
+    import numpy as np
+    lib = _stock_lib()
+    if lib is None:
+        return None
+    n = len(nodes)
+    cap_cpu, cap_mem, zones = _zoned_arrays(nodes, n_zones)
+    elig = np.ones(n, np.uint8)
+    zone_evals = np.array(_zone_evals_split(n_place, per_eval, n_zones),
+                          np.int64)
+    el = ctypes.c_int64(0)
+    placed = lib.stock_place_evals_realistic(
+        n, cap_cpu.ctypes.data, cap_mem.ctypes.data, elig.ctypes.data,
+        zones.ctypes.data, n_zones, zone_evals.ctypes.data, cpu, mem,
+        per_eval, seed, ctypes.byref(el), None)
+    dt = el.value / 1e9
+    return placed / dt if dt > 0 else None
 
 
 def stock_rate_compiled(nodes, cpu: int, mem: int, n_evals: int,
@@ -734,15 +791,24 @@ def run_config_5(args):
     # Reported twice: one worker (stock's serial scheduler loop) and a
     # 5-thread zone-sharded pool (stock's num_schedulers workers at their
     # conflict-free best).
+    have_lib = _stock_lib() is not None
     base_rate_c, stock_nodes_used = stock_zoned_rate_compiled(
         nodes, cpu=10, mem=10, n_place=n_place, per_eval=per_eval)
-    if _stock_lib() is not None:
+    if have_lib:
         base_rate_mw, _ = stock_zoned_rate_compiled(
             nodes, cpu=10, mem=10, n_place=n_place, per_eval=per_eval,
             workers=5)
+        # the REALISTIC middle tier (round-5 verdict #1): the leading
+        # denominator — flat tier above it, C1M anchor below it.  Serial
+        # only: this host has one core (os.cpu_count() == 1 — reported
+        # as host_cores below), so stock's num_schedulers default here
+        # IS 1, and a threaded emulation on one core can only interleave
+        base_rate_real = stock_zoned_rate_realistic(
+            nodes, cpu=10, mem=10, n_place=n_place, per_eval=per_eval)
     else:
         base_rate_mw = None    # no toolchain: never mislabel the serial
         # interpreted fallback as a 5-worker compiled figure
+        base_rate_real = None
     base_sample_py = min(n_place, 300)
     base_rate_py = stock_baseline_rate(nodes, cpu=10, mem=10,
                                        n_place=base_sample_py)
@@ -804,30 +870,49 @@ def run_config_5(args):
     zone_balance = (round(zone_counts[-1] / zone_counts[0], 2)
                     if zone_counts[0] else float("inf"))
     s.shutdown()
+    # the LEADING ratio is against the realistic middle tier (round-5
+    # verdict #1): the flat-array tier is reported as the labeled upper
+    # bound, the interpreted tier and the C1M anchor bracket from below
+    vs_real = (round(tpu_rate / base_rate_real, 2)
+               if base_rate_real else None)
     return {"metric": "northstar_50knodes_100kallocs_evals_per_sec",
             "value": round(evals_per_sec, 2), "unit": "evals/sec",
-            "vs_baseline": round(evals_per_sec / base_evals_per_sec, 2),
+            **({"vs_baseline": vs_real,
+                "vs_baseline_realistic": vs_real,
+                "baseline_realistic_stock_per_sec":
+                    round(base_rate_real, 1),
+                "baseline_realistic_stock_evals_per_sec":
+                    round(base_rate_real / per_eval, 3)}
+               if base_rate_real else
+               # no toolchain: base_rate_c is the INTERPRETED sampled
+               # fallback — label the ratio as such, never as a tier
+               {"vs_baseline":
+                    round(evals_per_sec / base_evals_per_sec, 2),
+                "baseline_is_interpreted_fallback": True}),
+            "host_cores": os.cpu_count(),
             "p99_plan_queue_ms": round(q["p99"] * 1000, 2),
             "p50_plan_queue_ms": round(q["p50"] * 1000, 2),
             "placements_per_sec": round(tpu_rate, 1),
             "n_evals": n_evals, "placements_per_eval": per_eval,
             "runs": iters,
-            "baseline_compiled_stock_per_sec": round(base_rate_c, 1),
-            **({"baseline_compiled_stock_5workers_per_sec":
-                    round(base_rate_mw, 1),
-                "vs_baseline_5workers":
-                    round(tpu_rate / base_rate_mw, 2)}
+            **({"baseline_flat_upper_bound_per_sec": round(base_rate_c, 1),
+                "vs_baseline_flat_upper_bound":
+                    round(tpu_rate / base_rate_c, 2)}
+               if have_lib and base_rate_c else {}),
+            **({"baseline_flat_upper_bound_5workers_per_sec":
+                    round(base_rate_mw, 1)}
                if base_rate_mw else {}),
-            "baseline_compiled_stock_evals_per_sec":
-                round(base_evals_per_sec, 3),
             "baseline_interpreted_stock_per_sec": round(base_rate_py, 1),
             "vs_c1m_anchor": round(tpu_rate / C1M_PLACEMENTS_PER_SEC, 2),
             # one 100k-placement eval end-to-end (the rounds-1/2 metric):
             # the bulk kernel's rate once an eval amortizes per-eval costs
             "single_eval_placements_per_sec": round(giant_rate, 1),
             "single_eval_placed": giant_placed,
-            "single_eval_vs_compiled_stock": round(
-                giant_rate / base_rate_c, 2) if base_rate_c else None,
+            "single_eval_vs_flat_upper_bound": round(
+                giant_rate / base_rate_c, 2) if (have_lib and base_rate_c)
+            else None,
+            "single_eval_vs_realistic": round(
+                giant_rate / base_rate_real, 2) if base_rate_real else None,
             # bin-pack quality: nodes absorbing the same workload (fewer
             # = tighter; stock scores a 2-node random subset, the kernel
             # argmaxes the full cluster)

@@ -37,8 +37,14 @@
 // threads — real OS parallelism, the same optimistic-concurrency shape
 // as stock's worker pool with zero plan conflicts (best case for stock).
 
+#include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -254,6 +260,344 @@ int64_t stock_preempt_evals(int32_t n, const int32_t* cap_cpu,
     }
   }
   if (evictions_out) *evictions_out = evicted_total;
+  return placed_total;
+}
+
+// ---------------------------------------------------------------------------
+// REALISTIC middle tier (round-5 verdict #1).
+//
+// The flat-array tier above is an UPPER BOUND: it pre-resolves feasibility
+// to one byte, sums contiguous int32 alloc lists, and commits by appending
+// two ints.  Real stock pays none of its costs that cheaply.  This tier
+// models, line by line, the costs stock actually pays per candidate and per
+// placement, with the same data-structure SHAPES (hash maps keyed by
+// strings, heap-allocated records chased by pointer, ordered copy-on-write
+// store inserts).  Costs modeled — each tagged with the upstream source of
+// the cost (paths per SURVEY.md §0 protocol; the mount is empty):
+//
+//   [C1] Per-candidate feasibility = one eval-cache lookup keyed by the
+//        node's ComputedClass STRING (scheduler/feasible.go
+//        FeasibilityWrapper.Next: EvalCache map hit per candidate), with
+//        the full constraint chain run on miss: per constraint, a
+//        resolveTarget hash-map get on the node's attribute map
+//        (unordered_map<string,string>) + string compare
+//        (scheduler/feasible.go checkConstraint/resolveTarget).
+//   [C2] BinPackIterator's AllocsFit sums the node's PROPOSED alloc list
+//        — a slice of pointers to separately heap-allocated Allocation
+//        records; per record, resources live behind a per-task map
+//        (structs.AllocatedResources.Tasks[name]) so each entry costs a
+//        pointer chase + a one-entry hash-map lookup
+//        (nomad/structs/funcs.go AllocsFit, structs.go
+//        ComparableResources).  The flat tier's contiguous-int32 walk
+//        under-prices exactly this.
+//   [C3] Per placement, an AllocMetric is CONSTRUCTED: heap object,
+//        string-keyed score map entries per scored candidate
+//        (scheduler/context.go EvalContext.Metrics,
+//        structs.AllocMetric.ScoreNode).
+//   [C4] Per placement, the Allocation record itself is constructed:
+//        36-char UUID string minted, id/job/node/taskgroup strings filled,
+//        resource map populated (scheduler/generic_sched.go
+//        computePlacements).
+//   [C5] Plan apply re-checks AllocsFit per touched node against latest
+//        state (nomad/plan_apply.go evaluateNodePlan — same [C2] walk),
+//        then commits each alloc with TWO ordered-map inserts: the id
+//        table and the (node_id, alloc_id) secondary index — std::map
+//        string inserts standing in for go-memdb's copy-on-write radix
+//        insert, which allocates O(depth) nodes per insert
+//        (nomad/state/state_store.go UpsertPlanResults, go-memdb txn).
+//   [C6] Per-eval bookkeeping: eval record update in an ordered eval
+//        table, plan/result objects built per eval (nomad/worker.go
+//        SubmitPlan, nomad/eval_endpoint.go Ack).
+//
+// Deliberately still GENEROUS — omitted entirely, with their real-system
+// magnitude left to the C1M anchor (BASELINE.md): Raft log append +
+// msgpack encode of every plan, RPC hops between worker and leader, Go GC
+// pressure from all of the above, blocking-query wakeups, and the
+// scheduler's snapshot-wait barrier.  The resulting ladder
+//     flat tier  >=  realistic tier  >=  real system (C1M anchor)
+// brackets stock from both sides; bench.py prints all three.
+//
+// Setup (node attr maps, class strings, pre-existing state) happens
+// OUTSIDE the timed window, exactly like the TPU side's packer build is
+// outside its measured wave; *elapsed_ns_out returns the eval-loop time.
+
+namespace {
+
+struct RAlloc {                       // structs.Allocation stand-in
+  std::string id;                     // 36-char UUID string
+  std::string job_id;
+  std::string node_id;
+  std::string task_group;
+  // AllocatedResources.Tasks[task] -> {cpu, mem}: a real per-task map so
+  // every AllocsFit entry pays the hash lookup stock pays ([C2])
+  std::unordered_map<std::string, std::pair<int64_t, int64_t>> tasks;
+};
+
+struct RMetric {                      // structs.AllocMetric stand-in
+  int32_t nodes_evaluated = 0;
+  int32_t nodes_filtered = 0;
+  int32_t nodes_exhausted = 0;
+  // ScoreMetaData: per scored node, node-id string + named scores
+  std::vector<std::pair<std::string, std::map<std::string, double>>> scores;
+};
+
+inline void mint_uuid(uint64_t* rng, char* out37) {
+  static const char* hex = "0123456789abcdef";
+  uint64_t a = next_rand(rng), b = next_rand(rng);
+  int pos = 0;
+  for (int i = 0; i < 36; i++) {
+    if (i == 8 || i == 13 || i == 18 || i == 23) {
+      out37[i] = '-';
+      continue;
+    }
+    uint64_t* src = (pos < 16) ? &a : &b;
+    out37[i] = hex[(*src >> ((pos % 16) * 4)) & 0xF];
+    pos++;
+  }
+  out37[36] = 0;
+}
+
+}  // namespace
+
+// `zone_evals[z]` evals target zone z (the caller's round-robin split);
+// the cluster state is built ONCE and shared across all zones' eval
+// loops, exactly like stock's one state store serving every eval.
+int64_t stock_place_evals_realistic(
+    int32_t n, const int32_t* cap_cpu, const int32_t* cap_mem,
+    const uint8_t* elig, const int32_t* zone, int32_t n_zones,
+    const int64_t* zone_evals, int32_t ask_cpu, int32_t ask_mem,
+    int64_t per_eval, uint64_t seed, int64_t* elapsed_ns_out,
+    uint8_t* touched_out) {
+  uint64_t rng = seed | 1;
+
+  // ---- untimed setup: the cluster as stock holds it ----
+  // Node attribute maps (fingerprinted attrs; real nodes carry 50-80
+  // entries — we populate 24 so the hash maps have realistic load).
+  std::vector<std::unordered_map<std::string, std::string>> attrs(n);
+  std::vector<std::string> node_id(n), computed_class(n);
+  char buf[64];
+  for (int32_t i = 0; i < n; i++) {
+    mint_uuid(&rng, buf);
+    node_id[i] = buf;
+    auto& m = attrs[i];
+    snprintf(buf, sizeof buf, "dc%d", 1 + i % 3);
+    m["node.datacenter"] = buf;
+    m["kernel.name"] = "linux";
+    snprintf(buf, sizeof buf, "zone%d", zone ? zone[i] : 0);
+    m["attr.storage.topology"] = buf;
+    snprintf(buf, sizeof buf, "r%d", i % 20);
+    m["attr.platform.rack"] = buf;
+    for (int f = 0; f < 20; f++) {            // filler fingerprint attrs
+      snprintf(buf, sizeof buf, "attr.fp.key%d", f);
+      m[buf] = "value";
+    }
+    // ComputedClass: hash of class-relevant fields, rendered as a string
+    // key (structs/node_class.go) — what the eval cache is keyed by
+    uint64_t h = 1469598103934665603ULL;
+    h = (h ^ (uint64_t)cap_cpu[i]) * 1099511628211ULL;
+    h = (h ^ (uint64_t)cap_mem[i]) * 1099511628211ULL;
+    h = (h ^ (uint64_t)(1 + i % 3)) * 1099511628211ULL;
+    h = (h ^ (uint64_t)(zone ? zone[i] : 0)) * 1099511628211ULL;
+    h = (h ^ (uint64_t)(i % 20)) * 1099511628211ULL;
+    snprintf(buf, sizeof buf, "v1:%016llx", (unsigned long long)h);
+    computed_class[i] = buf;
+  }
+  // per-node proposed alloc lists: pointers to heap records ([C2])
+  std::vector<std::vector<RAlloc*>> node_allocs(n);
+  std::vector<int32_t> inplan_cnt(n, 0);
+  // the store ([C5]): ordered id table + (node,alloc) secondary index
+  std::map<std::string, RAlloc*> store_by_id;
+  std::map<std::string, RAlloc*> store_node_index;
+  // eval table ([C6])
+  std::map<std::string, int32_t> eval_table;
+  std::vector<int32_t> order(n);
+  for (int32_t i = 0; i < n; i++) order[i] = i;
+  const std::string want_dc1 = "dc1", want_dc2 = "dc2", want_dc3 = "dc3";
+  std::vector<std::string> zone_strs(n_zones);
+  for (int32_t z = 0; z < n_zones; z++) {
+    snprintf(buf, sizeof buf, "zone%d", z);
+    zone_strs[z] = buf;
+  }
+  const std::string tg_name = "tg";
+
+  // full constraint chain, run once per (eval, computed class) on cache
+  // miss ([C1]): every check is a resolveTarget map get + string
+  // compare.  Node ELIGIBILITY is deliberately NOT part of the chain:
+  // stock checks it in a separate pre-class iterator, and folding a
+  // per-node flag into a per-class cache would let the first classmate
+  // decide for the whole class (code-review r5 finding)
+  auto chain_feasible = [&](int32_t idx,
+                            const std::string& want_zone) -> bool {
+    const auto& m = attrs[idx];
+    auto dc = m.find("node.datacenter");
+    if (dc == m.end()) return false;
+    if (dc->second != want_dc1 && dc->second != want_dc2 &&
+        dc->second != want_dc3)
+      return false;
+    auto k = m.find("kernel.name");
+    if (k == m.end() || k->second != "linux") return false;
+    auto z = m.find("attr.storage.topology");   // CSI topology constraint
+    if (z == m.end() || z->second != want_zone) return false;
+    return true;
+  };
+
+  // AllocsFit with the real walk ([C2]): chase each record pointer, look
+  // the task up in its per-alloc resource map, sum
+  auto allocs_fit = [&](int32_t idx, int32_t extra_asks, int64_t* free_cpu,
+                        int64_t* free_mem) -> bool {
+    int64_t used_cpu = 0, used_mem = 0;
+    for (const RAlloc* a : node_allocs[idx]) {
+      auto it = a->tasks.find(tg_name);
+      if (it != a->tasks.end()) {
+        used_cpu += it->second.first;
+        used_mem += it->second.second;
+      }
+    }
+    for (int32_t k = 0; k < inplan_cnt[idx]; k++) {
+      used_cpu += ask_cpu;
+      used_mem += ask_mem;
+      asm volatile("" : "+r"(used_cpu), "+r"(used_mem));
+    }
+    used_cpu += (int64_t)extra_asks * ask_cpu;
+    used_mem += (int64_t)extra_asks * ask_mem;
+    int64_t fc = cap_cpu[idx] - used_cpu;
+    int64_t fm = cap_mem[idx] - used_mem;
+    if (fc < 0 || fm < 0) return false;
+    *free_cpu = fc;
+    *free_mem = fm;
+    return true;
+  };
+
+  int64_t placed_total = 0;
+  std::vector<int32_t> touched;
+  std::vector<RAlloc*> plan;                    // per-eval plan allocs
+  auto t_start = std::chrono::steady_clock::now();
+
+  for (int32_t zi = 0; zi < n_zones; zi++) {
+  const std::string& want_zone = zone_strs[zi];
+  for (int64_t e = 0; e < zone_evals[zi]; e++) {
+    // [C6] eval dequeue/ack bookkeeping: eval record keyed by id
+    mint_uuid(&rng, buf);
+    std::string eval_id = buf;
+    eval_table[eval_id] = 0;
+    // per-eval feasibility cache keyed by ComputedClass string ([C1]);
+    // Nomad's EvalCache lives on the EvalContext, i.e. per eval
+    std::unordered_map<std::string, bool> eval_cache;
+    // stack.SetNodes: one shuffle per eval
+    for (int32_t i = n - 1; i > 0; i--) {
+      int32_t j = (int32_t)(next_rand(&rng) % (uint64_t)(i + 1));
+      int32_t t = order[i];
+      order[i] = order[j];
+      order[j] = t;
+    }
+    touched.clear();
+    plan.clear();
+
+    for (int64_t p = 0; p < per_eval; p++) {
+      int32_t best = -1;
+      double best_score = -1e300;
+      int32_t seen = 0, filtered = 0, exhausted = 0;
+      RMetric* metric = new RMetric();          // [C3]
+      metric->nodes_evaluated = n;
+      for (int32_t k = 0; k < n; k++) {
+        int32_t idx = order[k];
+        if (!elig[idx]) {            // per-node eligibility, pre-class
+          filtered++;
+          continue;
+        }
+        // [C1] eval-cache hit path: one string-keyed hash lookup
+        auto hit = eval_cache.find(computed_class[idx]);
+        bool feas;
+        if (hit != eval_cache.end()) {
+          feas = hit->second;
+        } else {
+          feas = chain_feasible(idx, want_zone);
+          eval_cache.emplace(computed_class[idx], feas);
+        }
+        if (!feas) {
+          filtered++;
+          continue;
+        }
+        int64_t free_cpu, free_mem;
+        if (!allocs_fit(idx, 1, &free_cpu, &free_mem)) {   // [C2]
+          exhausted++;
+          continue;
+        }
+        double score =
+            (18.0 - 18.0 * std::sqrt((double)free_cpu / cap_cpu[idx])) +
+            (18.0 - 18.0 * std::sqrt((double)free_mem / cap_mem[idx]));
+        score *= 0.5;
+        // [C3] ScoreNode: node-id string + named score entries
+        metric->scores.emplace_back(node_id[idx],
+                                    std::map<std::string, double>{
+                                        {"binpack", score},
+                                        {"normalized", score / 18.0}});
+        seen++;
+        if (score > best_score) {
+          best_score = score;
+          best = idx;
+        }
+        if (seen >= 2) break;                   // LimitIterator(2)
+      }
+      metric->nodes_filtered = filtered;
+      metric->nodes_exhausted = exhausted;
+      if (best >= 0) {
+        // [C4] construct the Allocation record
+        RAlloc* a = new RAlloc();
+        mint_uuid(&rng, buf);
+        a->id = buf;
+        a->job_id = eval_id;                    // one job per eval here
+        a->node_id = node_id[best];
+        a->task_group = tg_name;
+        a->tasks.emplace(tg_name, std::make_pair((int64_t)ask_cpu,
+                                                 (int64_t)ask_mem));
+        plan.push_back(a);
+        if (inplan_cnt[best] == 0) touched.push_back(best);
+        inplan_cnt[best]++;
+      }
+      delete metric;   // metric lifetime = the eval in stock; cost is
+                       // construction ([C3]), modeled above
+    }
+
+    // [C5] plan apply: evaluateNodePlan re-checks AllocsFit per touched
+    // node against latest state, then commits each surviving alloc with
+    // TWO ordered-map inserts (id table + node secondary index) and
+    // appends it to the node's live alloc list (the list future [C2]
+    // walks chase).
+    std::unordered_map<std::string, int32_t> row_of;
+    for (int32_t idx : touched) {
+      int64_t fc, fm;
+      if (allocs_fit(idx, 0, &fc, &fm)) {
+        row_of[node_id[idx]] = idx;
+        if (touched_out) touched_out[idx] = 1;
+      }                                         // else: refuted node —
+      inplan_cnt[idx] = 0;                      // its allocs don't commit
+    }
+    for (RAlloc* a : plan) {
+      auto it = row_of.find(a->node_id);
+      if (it == row_of.end()) {
+        delete a;                               // refuted: dropped
+        continue;
+      }
+      store_by_id.emplace(a->id, a);
+      store_node_index.emplace(a->node_id + "/" + a->id, a);
+      node_allocs[it->second].push_back(a);
+      placed_total++;
+    }
+    eval_table[eval_id] = 2;                    // [C6] eval -> complete
+  }
+  }
+
+  auto t_end = std::chrono::steady_clock::now();
+  if (elapsed_ns_out)
+    *elapsed_ns_out = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                          t_end - t_start)
+                          .count();
+  // teardown happens AFTER the timed window (stock never frees inside
+  // the measured loop either — Go's GC cost is one of the omitted-and-
+  // documented costs above); bench.py calls this in-process, so the
+  // records must not leak across bench configs
+  for (auto& kv : store_by_id) delete kv.second;
   return placed_total;
 }
 
