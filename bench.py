@@ -929,30 +929,15 @@ def run_config_5(args):
             **({"phase_split_s": phases} if phases else {})}
 
 
-def run_bridge(args):
-    """--bridge: the PRODUCTION multi-eval kernel at bench scale through
-    the C++ PJRT bridge (native/pjrt_bridge/bridge.cc) — compile once,
-    then a launch loop with NO Python in it beyond one ctypes call per
-    wave (VERDICT r3 #3).  Reports the bridge's own placements/sec next
-    to the Python-driven pipeline number."""
-    from functools import partial
-
-    import jax
-    import numpy as np
-
+def _build_bench_items(args):
+    """Shared bench-scale batch: the zoned CSI cluster + one BatchItem
+    per eval, identical across --kernel, --bridge, and config 5's job
+    shape (three copies of this block would silently drift — code-review
+    r5)."""
     from nomad_tpu import mock
-    from nomad_tpu.native.bridge import (
-        DEFAULT_PLUGIN, PjrtBridge, bridge_available, export_stablehlo)
-    from nomad_tpu.ops import PlacementEngine
     from nomad_tpu.ops.engine import BatchItem
-    from nomad_tpu.ops.select import place_multi_packed
     from nomad_tpu.scheduler import Harness
     from nomad_tpu.structs import VolumeRequest
-
-    if not bridge_available():
-        return {"metric": "bridge_multi_eval_placements_per_sec",
-                "value": 0.0, "unit": "placements/sec",
-                "error": "bridge or plugin unavailable"}
 
     n_nodes = args.nodes or 50000
     n_evals = args.evals or 384
@@ -976,23 +961,113 @@ def run_bridge(args):
             read_only=True)}
         h.state.upsert_job(job)
         items.append(BatchItem(job=job, tg=tg, count=per_eval))
+    return h, nodes, items, n_nodes, n_evals, per_eval
+
+
+def run_kernel(args):
+    """--kernel: the production multi-eval kernel's device-only rate at
+    bench scale (round-5 verdict #3's published microbench): amortize
+    the launch loop over several back-to-back dispatches with ONE final
+    fetch, so the number is kernel throughput, not tunnel latency."""
+    import jax
+    import numpy as np
+
+    from nomad_tpu.ops import PlacementEngine
+    from nomad_tpu.ops.select import (
+        FILL_K, place_multi_compact_packed_jit, place_multi_packed_jit)
+
+    h, nodes, items, n_nodes, n_evals, per_eval = _build_bench_items(args)
+    snap = h.state.snapshot()
+    eng = PlacementEngine(mesh=False)
+    built = eng.build_multi_inputs(snap, items, seed=13)
+    inp, rs, lanes = built["inp"], built["rs"], built["n_lanes"]
+    compact = built["cand_rows"] is not None
+    if compact:
+        crj = jax.numpy.asarray(built["cand_rows"])
+        cvj = jax.numpy.asarray(built["cand_valid"])
+
+        def launch():
+            return place_multi_compact_packed_jit(inp, crj, cvj, rs, lanes)
+    else:
+        def launch():
+            return place_multi_packed_jit(inp, rs)
+    buf = launch()[0]
+    out = np.asarray(buf)                       # warm (compile + fetch)
+    meta_off = min(FILL_K, rs) if compact else rs
+    placed = int(out[:, meta_off + 12].sum())
+    k = max(args.iters, 1) * 4
+    t0 = time.perf_counter()
+    for _ in range(k):
+        buf = launch()[0]
+    np.asarray(buf)
+    dt = (time.perf_counter() - t0) / k
+    rate = placed / dt if dt > 0 else 0.0
+    base_c = None
+    if _stock_lib() is not None:
+        base_c, _ = stock_zoned_rate_compiled(
+            nodes, cpu=10, mem=10, n_place=placed, per_eval=per_eval)
+    return {"metric": "kernel_only_placements_per_sec",
+            "value": round(rate, 1), "unit": "placements/sec",
+            "wave_s": round(dt, 4), "placed_per_wave": placed,
+            "n_lanes": lanes, "compact": compact, "nodes": n_nodes,
+            **({"vs_flat_upper_bound": round(rate / base_c, 2),
+                "baseline_flat_upper_bound_per_sec": round(base_c, 1)}
+               if base_c else {}),
+            "vs_c1m_anchor": round(rate / C1M_PLACEMENTS_PER_SEC, 2)}
+
+
+def run_bridge(args):
+    """--bridge: the PRODUCTION multi-eval kernel at bench scale through
+    the C++ PJRT bridge (native/pjrt_bridge/bridge.cc) — compile once,
+    then a launch loop with NO Python in it beyond one ctypes call per
+    wave (VERDICT r3 #3).  Reports the bridge's own placements/sec next
+    to the Python-driven pipeline number."""
+    from functools import partial
+
+    import jax
+    import numpy as np
+
+    from nomad_tpu.native.bridge import (
+        DEFAULT_PLUGIN, PjrtBridge, bridge_available, export_stablehlo)
+    from nomad_tpu.ops import PlacementEngine
+    from nomad_tpu.ops.select import (
+        FILL_K, place_multi_compact_packed, place_multi_packed)
+
+    if not bridge_available():
+        return {"metric": "bridge_multi_eval_placements_per_sec",
+                "value": 0.0, "unit": "placements/sec",
+                "error": "bridge or plugin unavailable"}
+
+    h, nodes, items, n_nodes, n_evals, per_eval = _build_bench_items(args)
     snap = h.state.snapshot()
     eng = PlacementEngine(mesh=False)
     built = eng.build_multi_inputs(snap, items, seed=13)
     inp, rs = built["inp"], built["rs"]
+    # the builder emits the compact laned layout for the zoned bench
+    # batch — export THAT kernel (the flat kernel cannot consume the
+    # compact [J', Nc] job-count table; code-review r5)
+    if built["cand_rows"] is not None:
+        kernel = partial(place_multi_compact_packed, round_size=rs,
+                         n_lanes=built["n_lanes"])
+        kargs = (inp, jax.numpy.asarray(built["cand_rows"]),
+                 jax.numpy.asarray(built["cand_valid"]))
+        meta_off = min(FILL_K, rs)
+    else:
+        kernel = partial(place_multi_packed, round_size=rs)
+        kargs = (inp,)
+        meta_off = rs
 
-    kernel = partial(place_multi_packed, round_size=rs)
-    hlo = export_stablehlo(kernel, inp)
+    hlo = export_stablehlo(kernel, *kargs)
     br = PjrtBridge(DEFAULT_PLUGIN)
     try:
         ex = br.compile(hlo)
-        flat = [np.asarray(x) for x in jax.tree_util.tree_leaves(inp)]
+        flat = [np.asarray(x) for x in jax.tree_util.tree_leaves(kargs)]
         # output shapes from the jax reference ONCE (abstract eval)
         shapes = [(tuple(s.shape), np.dtype(s.dtype)) for s in
-                  jax.eval_shape(kernel, inp)]
+                  jax.eval_shape(kernel, *kargs)]
         out = br.execute(ex, flat, shapes)       # warm
         placed_wave = int(
-            (out[0][:, rs:][:, 12]).sum())       # meta placed_total col
+            (out[0][:, meta_off:][:, 12]).sum())  # meta placed_total col
         iters = max(args.iters, 1)
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -1030,6 +1105,10 @@ def main():
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="write a JAX profiler (xprof) trace of the "
                          "benched kernel launches to DIR (SURVEY §6.1)")
+    ap.add_argument("--kernel", action="store_true",
+                    help="kernel-only microbench: the production "
+                         "multi-eval kernel's device rate at bench scale "
+                         "(launch loop amortized, one final fetch)")
     ap.add_argument("--bridge", action="store_true",
                     help="run the production multi-eval kernel at bench "
                          "scale through the C++ PJRT bridge (no Python "
@@ -1052,6 +1131,10 @@ def main():
                   "(view with xprof/tensorboard)", file=sys.stderr)
             return out
         return RUNNERS[c](args)
+
+    if args.kernel:
+        print(json.dumps(run_kernel(args)))
+        return
 
     if args.bridge:
         print(json.dumps(run_bridge(args)))

@@ -81,9 +81,15 @@ def compile_options_bytes() -> bytes:
 
 
 def export_stablehlo(fn, *args) -> bytes:
-    """jit-lower `fn` at `args`' shapes and return StableHLO MLIR text."""
+    """jit-lower `fn` at `args`' shapes and return StableHLO MLIR text.
+
+    keep_unused=True: the bridge caller feeds EVERY leaf of `args` as an
+    execute buffer, but jit's default drops parameters the kernel never
+    reads from the lowered signature — the argument-count mismatch then
+    kills the raw PJRT execute (the compact multi-eval kernel reads only
+    a subset of MultiEvalInputs; debugged round 5)."""
     import jax
-    lowered = jax.jit(fn).lower(*args)
+    lowered = jax.jit(fn, keep_unused=True).lower(*args)
     return lowered.as_text().encode()
 
 

@@ -438,6 +438,144 @@ class TestBatchedWorkerPath:
         assert len(evicted) == 4
 
 
+def build_zoned_cluster(n_nodes=500, n_zones=5, seed=0):
+    """Bench-shaped cluster: per-zone CSI volumes whose topologies pin
+    jobs to provably-disjoint node sets (the compact laned kernel's
+    activation condition)."""
+    from nomad_tpu.structs import CSIVolume
+    rng = random.Random(seed)
+    h = Harness()
+    nodes = []
+    zone_nodes = {z: [] for z in range(n_zones)}
+    for i in range(n_nodes):
+        n = mock.node()
+        n.datacenter = f"dc{1 + i % 3}"
+        n.attributes["storage.topology"] = f"zone{i % n_zones}"
+        n.csi_node_plugins["ebs0"] = True
+        n.resources.cpu = rng.choice([4000, 8000, 16000])
+        n.resources.memory_mb = rng.choice([8192, 16384, 32768])
+        nodes.append(n)
+        zone_nodes[i % n_zones].append(n.id)
+    h.state.upsert_nodes(nodes)
+    for z in range(n_zones):
+        h.state.upsert_csi_volume(CSIVolume(
+            id=f"vol-zone{z}", plugin_id="ebs0",
+            access_mode="multi-node-multi-writer",
+            topology_node_ids=tuple(zone_nodes[z])))
+    return h, nodes
+
+
+def zoned_items(h, n_items, count, n_zones=5):
+    from nomad_tpu.structs import VolumeRequest
+    items = []
+    for i in range(n_items):
+        job = mock.batch_job()
+        job.datacenters = ["dc1", "dc2", "dc3"]
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.tasks[0].resources.cpu = 10
+        tg.tasks[0].resources.memory_mb = 10
+        tg.volumes = {"data": VolumeRequest(
+            name="data", type="csi", source=f"vol-zone{i % n_zones}",
+            read_only=True)}
+        h.state.upsert_job(job)
+        items.append(BatchItem(job=job, tg=tg, count=count))
+    return items
+
+
+class TestCompactLanedKernel:
+    """The compact lane-parallel multi-eval kernel (round-5: signatures
+    with provably-disjoint landscapes run as concurrent lanes over
+    per-signature candidate frames) must be decision- and metric-exact
+    vs the flat sequential schedule.  Single-device engines: the mesh
+    path keeps the flat schedule."""
+
+    def _flat(self, fn):
+        import nomad_tpu.ops.engine as em
+        old = em.MAX_LANES
+        em.MAX_LANES = 1          # width-1 cliques -> flat fallback path
+        try:
+            return fn()
+        finally:
+            em.MAX_LANES = old
+
+    def test_fast_path_engages_on_zoned_batch(self):
+        h, _ = build_zoned_cluster()
+        items = zoned_items(h, 10, 30)
+        eng = PlacementEngine(mesh=False)
+        built = eng.build_multi_inputs(h.state.snapshot(), items, seed=3)
+        assert built["cand_rows"] is not None
+        assert built["n_lanes"] == 5
+        assert built["perm"] is not None
+
+    def test_parity_binpack(self):
+        h, _ = build_zoned_cluster()
+        items = zoned_items(h, 13, 40)
+        snap = h.state.snapshot()
+        d_c = PlacementEngine(mesh=False).place_batch(snap, items, seed=7)
+        d_f = self._flat(
+            lambda: PlacementEngine(mesh=False).place_batch(
+                snap, items, seed=7))
+        for a, b in zip(d_c, d_f):
+            assert np.array_equal(a.picks, b.picks)
+            for ma, mb in zip(a.metrics, b.metrics):
+                assert ma.nodes_filtered == mb.nodes_filtered
+                assert ma.nodes_exhausted == mb.nodes_exhausted
+                assert ma.dimension_exhausted == mb.dimension_exhausted
+                assert ([s.node_id for s in ma.score_meta_data]
+                        == [s.node_id for s in mb.score_meta_data])
+
+    def test_parity_spread_overflow(self):
+        """Spread algorithm fans a round over more distinct nodes than
+        the FILL_K small-buffer prefix: the collect path must detect the
+        overflow and fall back to the device-resident full fills."""
+        from nomad_tpu.ops.select import FILL_K
+        from nomad_tpu.structs import (
+            SCHED_ALGO_SPREAD, SchedulerConfiguration)
+        h, _ = build_zoned_cluster()
+        h.state.set_scheduler_config(SchedulerConfiguration(
+            scheduler_algorithm=SCHED_ALGO_SPREAD))
+        snap = h.state.snapshot()
+        items = zoned_items(h, 6, FILL_K + 26)
+        d_c = PlacementEngine(mesh=False).place_batch(snap, items, seed=5)
+        d_f = self._flat(
+            lambda: PlacementEngine(mesh=False).place_batch(
+                snap, items, seed=5))
+        for a, b in zip(d_c, d_f):
+            assert np.array_equal(a.picks, b.picks)
+        # the spread cap really did fan past the small prefix
+        distinct = {p for a in d_c for p in a.picks.tolist() if p >= 0}
+        assert len(distinct) > FILL_K
+
+    def test_job_count_seeds_respected(self):
+        """A job with live allocs placing again through the compact path
+        must see its existing per-node counts (anti-affinity seeds) —
+        the compact [J', Nc] seed table gathers them onto the frame."""
+        h, nodes = build_zoned_cluster(60, n_zones=2)
+        items = zoned_items(h, 2, 8, n_zones=2)
+        snap = h.state.snapshot()
+        eng = PlacementEngine(mesh=False)
+        first = eng.place_batch(snap, items, seed=3)
+        from nomad_tpu.structs import Resources
+        allocs = []
+        for bd, it in zip(first, items):
+            for p in bd.picks.tolist():
+                if p >= 0:
+                    allocs.append(mock.alloc(
+                        job=it.job, node_id=bd.node_ids[p],
+                        task_group=it.tg.name,
+                        resources=Resources(cpu=10, memory_mb=10),
+                        client_status="running"))
+        h.state.upsert_allocs(allocs)
+        snap2 = h.state.snapshot()
+        d_c = PlacementEngine(mesh=False).place_batch(snap2, items, seed=4)
+        d_f = self._flat(
+            lambda: PlacementEngine(mesh=False).place_batch(
+                snap2, items, seed=4))
+        for a, b in zip(d_c, d_f):
+            assert np.array_equal(a.picks, b.picks)
+
+
 class TestPortSafetyInBatch:
     """Port asks must never ride the coupled-batch skip-fit path: each
     batched scheduler assigns ports from a private NetworkIndex over the
