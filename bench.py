@@ -862,7 +862,7 @@ def run_config_5(args):
     # seed ALL five volume zones with 0: a fully collapsed zone is the
     # exact failure this metric exists to catch and must read as inf,
     # not disappear from the denominator
-    per_zone: Dict[str, int] = {f"zone{z}": 0 for z in range(5)}
+    per_zone: dict = {f"zone{z}": 0 for z in range(5)}
     for nid in tpu_used:
         z = zone_of.get(nid, "?")
         per_zone[z] = per_zone.get(z, 0) + 1

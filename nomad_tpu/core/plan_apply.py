@@ -285,6 +285,11 @@ class PlanApplier:
         # without it two writers to a single-writer volume inside one plan
         # are each checked against the pre-plan claim set and both commit
         plan_claims: Dict[Tuple[str, str], int] = {}
+        # node pinned per single-node volume by THIS plan's accepted
+        # claims (readers included): a later node of the same plan
+        # claiming the same single-node volume elsewhere must refuse
+        # (reference: csi.go single-node access modes; round-5 verdict #7)
+        plan_claim_nodes: Dict[Tuple[str, str], str] = {}
         # Alloc removals whose commit is certain so far: stops/preemptions
         # on nodes with no placements always commit (only placement nodes
         # refute), and a placement node's removals join once it is
@@ -334,7 +339,8 @@ class PlanApplier:
                 plan.node_allocation,
                 key=lambda nid: not (nid in plan.node_update
                                      or nid in plan.node_preemptions))
-            self._eval_nodes(snap, plan, result, skip_fit, plan_claims,
+            self._eval_nodes(snap, plan, result, skip_fit,
+                             (plan_claims, plan_claim_nodes),
                              committed_releases, pending_nodes,
                              final_refused, fit_cleared)
         for node_id in final_refused:
@@ -344,7 +350,7 @@ class PlanApplier:
             result.node_preemptions.pop(node_id, None)
         return result
 
-    def _eval_nodes(self, snap, plan, result, skip_fit, plan_claims,
+    def _eval_nodes(self, snap, plan, result, skip_fit, claim_state,
                     committed_releases, pending_nodes, final_refused,
                     fit_cleared) -> None:
         while pending_nodes:
@@ -355,7 +361,7 @@ class PlanApplier:
                 verdict = self._node_plan_ok(snap, plan, node_id, new_allocs,
                                              skip_fit=skip_fit or
                                              node_id in fit_cleared,
-                                             plan_claims=plan_claims,
+                                             claim_state=claim_state,
                                              released=committed_releases)
                 if verdict == NODE_OK:
                     result.node_allocation[node_id] = new_allocs
@@ -404,6 +410,10 @@ class PlanApplier:
                                                 vreq.source)
                     if vol is None or not vol.schedulable:
                         return False
+                    if vol.single_node():
+                        # node-pinned modes need the per-node path even
+                        # for readers (a block can span nodes)
+                        return False
         return True
 
     @staticmethod
@@ -429,8 +439,9 @@ class PlanApplier:
     def _node_plan_ok(self, snap, plan: Plan, node_id: str,
                       new_allocs: List[Allocation],
                       skip_fit: bool = False,
-                      plan_claims: Optional[Dict] = None,
+                      claim_state: Optional[tuple] = None,
                       released: frozenset = frozenset()) -> int:
+        plan_claims, plan_claim_nodes = claim_state or (None, None)
         node = snap.node_by_id(node_id)
         if node is None:
             return NODE_REFUSED
@@ -472,6 +483,7 @@ class PlanApplier:
             a.id for a in plan.node_preemptions.get(node_id, ()))
         releasing |= {a.id for a in new_allocs}
         local_claims: Dict = {}
+        local_nodes: Dict = {}
         for a in new_allocs:
             tg = a.job.lookup_task_group(a.task_group) \
                 if a.job is not None else None
@@ -486,8 +498,17 @@ class PlanApplier:
                     return NODE_REFUSED      # can never clear in-plan
                 if not vreq.read_only and vol.reader_only():
                     return NODE_REFUSED      # mode mismatch: also final
-                if not vol.claim_ok(vreq.read_only, releasing):
+                if not vol.claim_ok(vreq.read_only, releasing,
+                                    node_id=node_id):
                     return NODE_CLAIM_REFUSED
+                if vol.single_node():
+                    # single-node access modes pin READERS too: a claim
+                    # accepted on another node earlier in THIS plan is
+                    # final (in-plan claims only grow)
+                    pinned = (plan_claim_nodes or {}).get(key, "")
+                    if pinned and pinned != node_id:
+                        return NODE_REFUSED
+                    local_nodes[key] = node_id
                 if not vreq.read_only:
                     # in-plan claims only grow — refusal here is final
                     if (vol.writer_limited()
@@ -499,4 +520,7 @@ class PlanApplier:
         if plan_claims is not None:
             for key, cnt in local_claims.items():
                 plan_claims[key] = plan_claims.get(key, 0) + cnt
+        if plan_claim_nodes is not None:
+            for key, nd in local_nodes.items():
+                plan_claim_nodes.setdefault(key, nd)
         return NODE_OK

@@ -720,7 +720,6 @@ class PlacementEngine:
             scores = b[:, 1].view(np.float32)
             topk_rows = b[:, 2:5]
             topk_scores = b[:, 5:8].view(np.float32)
-            n_feas = b[:, 8]
             n_filt = b[:, 9] - (npad - n)
             n_exh = b[:, 10]
             dim_exh = b[:, 11:14]

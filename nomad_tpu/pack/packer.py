@@ -152,7 +152,11 @@ class ClusterPacker:
         # itself (claims replace the volume object but share the tuple; a
         # topology CHANGE mints a new row — old rows go inert, bounded by
         # volume re-registrations)
-        self._topo_luts: Dict[tuple, List[int]] = {}
+        self._topo_luts: Dict[tuple, list] = {}
+        self._lut_matrix_cache = None
+        # read-only per-version caches for job_context (see there)
+        self._job_ctx_cache: Dict[tuple, tuple] = {}
+        self._zero_count_cache: Dict[tuple, np.ndarray] = {}
         # usage accounting: which allocs are counted in `used`, and where.
         # Alloc store events apply O(1) arithmetic deltas to t.used instead
         # of rescanning a node's alloc list (the alloc list only grows —
@@ -566,38 +570,59 @@ class ClusterPacker:
 
     def _csi_topology_lut(self, vol) -> int:
         """LUT row: is a node-id vocab entry inside `vol`'s accessible
-        topology?  Same grow-in-place discipline as _lut_id."""
-        key = (vol.namespace, vol.id, vol.topology_node_ids)
+        topology?  Same grow-in-place discipline as _lut_id.
+
+        Keyed by (namespace, id) with the topology TUPLE compared by
+        identity-then-equality inside the entry: hashing a 10k-entry
+        node-id tuple on every lookup cost ~0.2ms per eval at bench scale
+        (claims replace the volume object but share the tuple, so the
+        identity check almost always short-circuits).  A topology CHANGE
+        still mints a new row — old rows go inert, bounded by volume
+        re-registrations."""
+        key = (vol.namespace, vol.id)
         v = len(self.interner)
-        hit = self._topo_luts.get(key)
-        if hit is not None:
-            lid, built = hit
-            if built < v:
-                allowed = set(vol.topology_node_ids)
-                ext = np.fromiter(
-                    (self.interner.string(i) in allowed
-                     for i in range(built, v)),
-                    dtype=bool, count=v - built)
-                self._luts[lid] = np.concatenate([self._luts[lid], ext])
-                hit[1] = v
-                self.lut_epoch += 1
-            return lid
+        entries = self._topo_luts.setdefault(key, [])
+        for hit in entries:           # identity-first scan: a volume has
+            lid, built, topo = hit    # few distinct topologies ever, and
+            # a claim update shares the tuple, so `is` usually matches —
+            # an ALTERNATING topology (failover flap) reuses its old row
+            # instead of minting new ones forever (code-review r5)
+            if topo is vol.topology_node_ids \
+                    or topo == vol.topology_node_ids:
+                if built < v:
+                    allowed = set(vol.topology_node_ids)
+                    ext = np.fromiter(
+                        (self.interner.string(i) in allowed
+                         for i in range(built, v)),
+                        dtype=bool, count=v - built)
+                    self._luts[lid] = np.concatenate([self._luts[lid], ext])
+                    hit[1] = v
+                    self.lut_epoch += 1
+                return lid
         allowed = set(vol.topology_node_ids)
         lut = self.interner.build_lut(lambda s: s in allowed)
         lid = len(self._luts)
         self._luts.append(lut)
-        self._topo_luts[key] = [lid, v]
+        entries.append([lid, v, vol.topology_node_ids])
         self.lut_epoch += 1
         return lid
 
     def lut_matrix(self) -> np.ndarray:
-        """[L, V] bool, padded to the current vocab size."""
+        """[L, V] bool, padded to the current vocab size (cached per
+        (epoch, vocab) — rebuilding cost ~0.1ms per eval at bench scale
+        and the matrix is read-only by convention)."""
         v = len(self.interner)
+        cached = self._lut_matrix_cache
+        if cached is not None and cached[0] == (self.lut_epoch, v):
+            return cached[1]
         if not self._luts:
-            return np.zeros((1, max(v, 1)), bool)
+            out = np.zeros((1, max(v, 1)), bool)
+            self._lut_matrix_cache = ((self.lut_epoch, v), out)
+            return out
         out = np.zeros((len(self._luts), max(v, 1)), bool)
         for i, lut in enumerate(self._luts):
             out[i, :len(lut)] = lut
+        self._lut_matrix_cache = ((self.lut_epoch, v), out)
         return out
 
     # --------------------------------------------------------- TG lowering
@@ -652,6 +677,16 @@ class ClusterPacker:
                         crows.append((
                             self.ensure_column("node.unique.id"),
                             DOP_LUT, self._csi_topology_lut(vol)))
+                    if vol is not None:
+                        # single-node access modes attach to ONE node:
+                        # live claims (readers included) pin feasibility
+                        # to it (reference: csi.go single-node modes via
+                        # CSIVolumeChecker; the applier re-checks)
+                        pin = vol.pinned_node()
+                        if pin:
+                            crows.append((
+                                self.ensure_column("node.unique.id"),
+                                DOP_EQ, self.interner.intern(pin)))
             for scope, constraints in (
                     (None, job.constraints),
                     (tg.name, list(tg.constraints)
@@ -754,21 +789,44 @@ class ClusterPacker:
                     ) -> "JobContext":
         """Per-eval dynamic vectors the kernels need beyond static state:
         dc/pool masks and the job's current per-node alloc counts (for
-        anti-affinity and distinct_hosts)."""
-        dc_ids = np.array([self.interner.intern(d) for d in job.datacenters],
-                          np.int32)
-        dc_mask = np.isin(tensors.dc, dc_ids)
-        if job.node_pool in ("", "all"):
-            pool_mask = np.ones(tensors.n, bool)
+        anti-affinity and distinct_hosts).
+
+        The masks and the all-zeros count vector are cached per tensor
+        version and shared READ-ONLY across evals (engine callers copy
+        before mutating): a 384-eval batch over identical datacenters
+        paid 384 `np.isin` passes + 384 zero-fills of [N] — a third of
+        the whole host build at bench scale."""
+        key = (tensors.version, tuple(job.datacenters), job.node_pool)
+        cached = self._job_ctx_cache.get(key)
+        if cached is not None:
+            dc_mask, pool_mask = cached
         else:
-            pool_mask = tensors.pool == self.interner.intern(job.node_pool)
-        job_count = np.zeros(tensors.n, np.int32)
-        for alc in snapshot.allocs_by_job(job.namespace, job.id):
-            if alc.terminal_status():
-                continue
-            row = tensors.id_to_row.get(alc.node_id)
-            if row is not None:
-                job_count[row] += 1
+            dc_ids = np.array(
+                [self.interner.intern(d) for d in job.datacenters],
+                np.int32)
+            dc_mask = np.isin(tensors.dc, dc_ids)
+            if job.node_pool in ("", "all"):
+                pool_mask = np.ones(tensors.n, bool)
+            else:
+                pool_mask = (tensors.pool
+                             == self.interner.intern(job.node_pool))
+            if len(self._job_ctx_cache) > 128:
+                self._job_ctx_cache.clear()
+            self._job_ctx_cache[key] = (dc_mask, pool_mask)
+        live = [alc for alc in snapshot.allocs_by_job(job.namespace, job.id)
+                if not alc.terminal_status()]
+        if not live:
+            zkey = (tensors.version, tensors.n)
+            job_count = self._zero_count_cache.get(zkey)
+            if job_count is None:
+                job_count = np.zeros(tensors.n, np.int32)
+                self._zero_count_cache = {zkey: job_count}
+        else:
+            job_count = np.zeros(tensors.n, np.int32)
+            for alc in live:
+                row = tensors.id_to_row.get(alc.node_id)
+                if row is not None:
+                    job_count[row] += 1
         return JobContext(dc_mask=dc_mask, pool_mask=pool_mask,
                           job_count=job_count)
 

@@ -127,7 +127,6 @@ class SystemScheduler(Scheduler):
             jnp.asarray(ctx.dc_mask), jnp.asarray(ctx.pool_mask),
             jnp.asarray(tgt.con), jnp.asarray(tgt.luts)))   # [G, N]
 
-        node_by_id = {n.id: n for n in nodes}
         for gi, tg in enumerate(job.task_groups):
             metric = AllocMetric(nodes_evaluated=len(nodes))
             placed_or_kept = 0

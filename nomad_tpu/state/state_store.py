@@ -717,7 +717,12 @@ class StateStore:
                         vol, read_allocs=dict(vol.read_allocs),
                         write_allocs=dict(vol.write_allocs))
                     self._fresh_claim_vols.add(key)
-                claims = dict.fromkeys(block.ids, True)
+                # claim value = the claiming alloc's node (single-node
+                # access modes pin on it); same O(count) as the old
+                # fromkeys — the ids list walk was already paid
+                picks = block.picks.tolist()
+                claims = {aid: block.node_table[p]
+                          for aid, p in zip(block.ids, picks)}
                 if vreq.read_only:
                     vol.read_allocs.update(claims)
                 else:
@@ -804,9 +809,9 @@ class StateStore:
                     write_allocs=dict(vol.write_allocs))
                 self._fresh_claim_vols.add(key)
             if vreq.read_only:
-                vol.read_allocs[alloc.id] = True
+                vol.read_allocs[alloc.id] = alloc.node_id
             else:
-                vol.write_allocs[alloc.id] = True
+                vol.write_allocs[alloc.id] = alloc.node_id
             changed[key] = vol
 
     def _release_csi_claims_locked(self, dead_ids: set) -> None:
@@ -820,9 +825,9 @@ class StateStore:
             import dataclasses
             v = dataclasses.replace(
                 vol,
-                read_allocs={k: True for k in vol.read_allocs
+                read_allocs={k: nd for k, nd in vol.read_allocs.items()
                              if k not in dead_ids},
-                write_allocs={k: True for k in vol.write_allocs
+                write_allocs={k: nd for k, nd in vol.write_allocs.items()
                               if k not in dead_ids})
             changed[key] = v
         if changed:
@@ -845,9 +850,9 @@ class StateStore:
             import dataclasses
             v = dataclasses.replace(
                 vol,
-                read_allocs={k: True for k in vol.read_allocs
+                read_allocs={k: nd for k, nd in vol.read_allocs.items()
                              if k != alloc_id},
-                write_allocs={k: True for k in vol.write_allocs
+                write_allocs={k: nd for k, nd in vol.write_allocs.items()
                               if k != alloc_id})
             self._csi_volumes = {**self._csi_volumes,
                                  (namespace, vol_id): v}

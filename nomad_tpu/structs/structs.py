@@ -1200,9 +1200,12 @@ class CSIVolume:
     attachment_mode: str = "file-system"
     # node ids in the volume's accessible topology; empty = all
     topology_node_ids: Tuple[str, ...] = ()
-    # simple claim model: alloc ids holding read/write claims
-    read_allocs: Dict[str, bool] = field(default_factory=dict)
-    write_allocs: Dict[str, bool] = field(default_factory=dict)
+    # claim model: alloc id -> node id of the claiming alloc.  The node
+    # axis is what single-node access modes pin on (reference:
+    # nomad/structs/csi.go access-mode semantics); legacy boolean values
+    # are tolerated as "node unknown" and never pin.
+    read_allocs: Dict[str, str] = field(default_factory=dict)
+    write_allocs: Dict[str, str] = field(default_factory=dict)
     schedulable: bool = True
 
     def writer_limited(self) -> bool:
@@ -1215,16 +1218,49 @@ class CSIVolume:
         return self.access_mode in ("single-node-reader-only",
                                     "multi-node-reader-only")
 
-    def claim_ok(self, read_only: bool, releasing=()) -> bool:
+    def single_node(self) -> bool:
+        """Access modes attaching to at most ONE node — readers included
+        (reference: CSIVolumeAccessModeSingleNode{Writer,ReaderOnly})."""
+        return self.access_mode.startswith("single-node")
+
+    def live_claim_nodes(self, releasing=()) -> set:
+        """Node ids of live claims (read AND write), skipping `releasing`
+        alloc ids and claims whose node is unrecorded."""
+        return {nd
+                for claims in (self.read_allocs, self.write_allocs)
+                for aid, nd in claims.items()
+                if aid not in releasing and isinstance(nd, str) and nd}
+
+    def pinned_node(self) -> str:
+        """The node a single-node volume is attached to, or "" when
+        unclaimed (feasibility pin — scheduler/feasible.go
+        CSIVolumeChecker's node-axis check)."""
+        if not self.single_node():
+            return ""
+        for nd in self.live_claim_nodes():
+            return nd
+        return ""
+
+    def claim_ok(self, read_only: bool, releasing=(),
+                 node_id: str = "") -> bool:
         """`releasing`: alloc ids whose claims are being released by the
         same plan (stops / preemptions / same-id replacements) — without
         the exemption a single-node-writer volume livelocks on job update:
         the replacement is refuted by its predecessor's claim, and the
-        refute also withholds the stop that would release it."""
+        refute also withholds the stop that would release it.
+
+        `node_id`: the node the new claim would attach on; single-node
+        modes refuse any node other than the one live claims (readers
+        included) already pin.  Empty = caller doesn't know the node
+        (legacy call sites) — the pin check is skipped."""
         if not self.schedulable:
             return False
         if not read_only and self.reader_only():
             return False         # write claim against a read-only mode
+        if node_id and self.single_node():
+            live = self.live_claim_nodes(releasing)
+            if live and node_id not in live:
+                return False     # single-node modes pin ALL claims
         if read_only:
             return True
         if self.writer_limited():

@@ -14,6 +14,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== lint selftest (injected undefined name must be caught) =="
+python scripts/lint.py --selftest
+
 echo "== lint =="
 python scripts/lint.py
 

@@ -4,22 +4,311 @@ text-level checks over nomad_tpu/, tests/, bench.py.
 
 Checks:
   - syntax (ast.parse)
+  - UNDEFINED NAMES: pyflakes-class lexical-scope name resolution
+    (two-pass: collect bindings per scope, then resolve every Name load
+    through the function-scope chain + module + builtins; class bodies
+    don't leak into nested scopes; star-imports poison the whole module
+    honestly) — round-5 verdict #9
+  - unused function-local variables (assigned once, never read,
+    non-underscore)
   - unused imports (module scope, names never referenced)
   - stray debug prints in library code (cli/ui/agent/bench/__main__ and
     scripts/ legitimately print)
   - trailing whitespace / tabs
   - lines > 99 chars
+
+`--selftest` lints an injected undefined-name snippet and exits 0 only
+if the checker catches it (the CI stage proving the net has no hole).
 """
 
 from __future__ import annotations
 
 import ast
+import builtins
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 PRINT_OK = {"cli.py", "ui.py", "agent.py", "__main__.py", "bench.py",
             "logging.py", "__graft_entry__.py"}
+
+BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__class__",
+}
+
+
+class _Scope:
+    __slots__ = ("kind", "parent", "bindings", "globals", "nonlocals",
+                 "wild", "loads", "stores", "reads")
+
+    def __init__(self, kind: str, parent: "_Scope | None"):
+        self.kind = kind                 # module | function | class | comp
+        self.parent = parent
+        self.bindings: set = set()
+        self.globals: set = set()
+        self.nonlocals: set = set()
+        self.wild = parent.wild if parent else False   # star-import taint
+        self.loads: list = []            # (name, lineno)
+        self.stores: dict = {}           # name -> [linenos] (simple assigns)
+        self.reads: set = set()          # names loaded in this scope
+
+
+class _ScopeBuilder(ast.NodeVisitor):
+    """Pass 1: build the scope tree, record bindings and loads."""
+
+    def __init__(self):
+        self.module = _Scope("module", None)
+        self.cur = self.module
+        self.scopes = [self.module]
+
+    # -- helpers -----------------------------------------------------
+
+    def _push(self, kind):
+        s = _Scope(kind, self.cur)
+        self.scopes.append(s)
+        self.cur = s
+        return s
+
+    def _pop(self):
+        self.cur = self.cur.parent
+
+    def _bind(self, name):
+        if name in self.cur.globals:
+            self.module.bindings.add(name)
+        else:
+            self.cur.bindings.add(name)
+
+    def _bind_target(self, node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                self._bind(n.id)
+
+    # -- bindings ----------------------------------------------------
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self._bind(a.asname or a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            if a.name == "*":
+                self.cur.wild = True
+                # taint descendants created later via _Scope.__init__;
+                # existing module scope is the usual case
+            else:
+                self._bind(a.asname or a.name)
+
+    def visit_Global(self, node):
+        self.cur.globals.update(node.names)
+
+    def visit_Nonlocal(self, node):
+        self.cur.nonlocals.update(node.names)
+
+    def _visit_func(self, node):
+        self._bind(node.name)
+        for d in node.decorator_list:
+            self.visit(d)
+        a = node.args
+        for dflt in list(a.defaults) + [d for d in a.kw_defaults if d]:
+            self.visit(dflt)
+        s = self._push("function")
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            s.bindings.add(arg.arg)
+            if arg.annotation:
+                self.visit(arg.annotation)
+        if node.returns:
+            self.visit(node.returns)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node):
+        self._bind(node.name)
+        for d in node.decorator_list:
+            self.visit(d)
+        for b in node.bases + node.keywords:
+            self.visit(b.value if isinstance(b, ast.keyword) else b)
+        self._push("class")
+        for stmt in node.body:
+            self.visit(stmt)
+        self._pop()
+
+    def visit_Lambda(self, node):
+        a = node.args
+        for dflt in list(a.defaults) + [d for d in a.kw_defaults if d]:
+            self.visit(dflt)
+        s = self._push("function")
+        for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            s.bindings.add(arg.arg)
+        self.visit(node.body)
+        self._pop()
+
+    def _visit_comp(self, node):
+        # first iterable evaluates in the enclosing scope
+        self.visit(node.generators[0].iter)
+        self._push("comp")               # py3 comprehension scope
+        for i, gen in enumerate(node.generators):
+            self._bind_target(gen.target)
+            if i:
+                self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self._pop()
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self._record_simple_store(t, node.lineno)
+            self._bind_target(t)
+            self.visit(t)
+
+    def _record_simple_store(self, target, lineno):
+        if (isinstance(target, ast.Name)
+                and self.cur.kind == "function"):
+            self.cur.stores.setdefault(target.id, []).append(lineno)
+
+    def visit_AnnAssign(self, node):
+        if node.value:
+            self.visit(node.value)
+        self.visit(node.annotation)
+        self._bind_target(node.target)
+        self.visit(node.target)
+
+    def visit_AugAssign(self, node):
+        self.visit(node.value)
+        # target is read+written: record the load
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                self.cur.loads.append((n.id, n.lineno))
+                self.cur.reads.add(n.id)
+        self._bind_target(node.target)
+        self.visit(node.target)
+
+    def visit_For(self, node):
+        self.visit(node.iter)
+        self._bind_target(node.target)
+        self.visit(node.target)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_withitem(self, node):
+        self.visit(node.context_expr)
+        if node.optional_vars is not None:
+            self._bind_target(node.optional_vars)
+            self.visit(node.optional_vars)
+
+    def visit_ExceptHandler(self, node):
+        if node.type:
+            self.visit(node.type)
+        if node.name:
+            self._bind(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def visit_NamedExpr(self, node):
+        self.visit(node.value)
+        # binds in the nearest non-comprehension scope
+        s = self.cur
+        while s.kind == "comp":
+            s = s.parent
+        for n in ast.walk(node.target):
+            if isinstance(n, ast.Name):
+                s.bindings.add(n.id)
+
+    def visit_MatchAs(self, node):      # match patterns bind names
+        if node.pattern:
+            self.visit(node.pattern)
+        if node.name:
+            self._bind(node.name)
+
+    def visit_MatchStar(self, node):
+        if node.name:
+            self._bind(node.name)
+
+    def visit_MatchMapping(self, node):
+        self.generic_visit(node)
+        if node.rest:
+            self._bind(node.rest)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.cur.loads.append((node.id, node.lineno))
+            self.cur.reads.add(node.id)
+        elif isinstance(node.ctx, ast.Store):
+            self._bind(node.id)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    self.cur.reads.add(n.id)
+
+
+def _resolves(scope: _Scope, name: str, module: _Scope) -> bool:
+    """Lexical resolution: function-scope chain (class bodies skipped for
+    enclosed scopes), then module, then builtins."""
+    if name in BUILTINS:
+        return True
+    s = scope
+    first = True
+    while s is not None:
+        if s.wild:
+            return True
+        if name in s.globals:
+            return name in module.bindings or module.wild
+        if (first or s.kind != "class") and name in s.bindings:
+            return True
+        s = s.parent
+        first = False
+    return False
+
+
+def check_names(tree: ast.Module) -> list:
+    """Undefined-name + unused-local findings: (lineno, message)."""
+    b = _ScopeBuilder()
+    b.visit(tree)
+    out = []
+    # child-scope reads: a local assigned in f but read only by a nested
+    # scope is still used (closures)
+    reads_below: dict = {}
+    for s in b.scopes:
+        p = s.parent
+        while p is not None:
+            reads_below.setdefault(id(p), set()).update(s.reads)
+            p = p.parent
+    for s in b.scopes:
+        for name, lineno in s.loads:
+            if not _resolves(s, name, b.module):
+                out.append((lineno, f"undefined name {name!r}"))
+        if s.kind == "function" and not s.wild:
+            below = reads_below.get(id(s), set())
+            for name, linenos in s.stores.items():
+                if (name.startswith("_") or name in s.reads
+                        or name in below or name in s.globals
+                        or name in s.nonlocals or len(linenos) != 1):
+                    continue
+                out.append((linenos[0], f"unused variable {name!r}"))
+    return out
 
 
 def imported_names(tree: ast.Module):
@@ -41,6 +330,9 @@ def lint_file(path: Path) -> list:
         tree = ast.parse(text)
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    for lineno, msg in check_names(tree):
+        problems.append(f"{path}:{lineno}: {msg}")
 
     used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
     used |= {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
@@ -77,7 +369,41 @@ def lint_file(path: Path) -> list:
     return problems
 
 
+SELFTEST_SNIPPET = """
+import os
+
+def f(x):
+    y = x + os.sep
+    return y + undefined_name_xyz
+
+class C:
+    attr = 1
+
+def g():
+    unused_local = 3
+    return C().attr
+"""
+
+
+def selftest() -> int:
+    """The CI stage proving the checker catches an injected undefined
+    name (and an unused local), and stays quiet on the clean parts."""
+    findings = check_names(ast.parse(SELFTEST_SNIPPET))
+    msgs = [m for _, m in findings]
+    want = ["undefined name 'undefined_name_xyz'",
+            "unused variable 'unused_local'"]
+    missing = [w for w in want if w not in msgs]
+    extra = [m for m in msgs if m not in want]
+    if missing or extra:
+        print(f"lint selftest FAILED: missing={missing} extra={extra}")
+        return 1
+    print("lint selftest ok: injected undefined name caught")
+    return 0
+
+
 def main() -> int:
+    if "--selftest" in sys.argv:
+        return selftest()
     targets = [ROOT / "bench.py", ROOT / "__graft_entry__.py"]
     for pkg in ("nomad_tpu", "tests", "scripts"):
         targets.extend(sorted((ROOT / pkg).rglob("*.py")))

@@ -479,7 +479,6 @@ class TestAuthMethods:
         acl, err = s.resolve_token(tok.secret_id)
         assert acl is not None
         import time as _time
-        tok2 = st.acl_token_by_accessor(tok.accessor_id)
         # simulate expiry by rewinding the expiration to the past
         expired = tok
         expired.expiration_time = _time.time() - 5

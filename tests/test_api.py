@@ -119,7 +119,6 @@ class TestJobsAPI:
     def test_search(self, api, agent):
         wire, job = _wire_batch_job()
         api.jobs.register(wire)
-        resp = agent.server  # ensure registered
         out = api.request("PUT", "/v1/search",
                           body={"Prefix": job.id[:10], "Context": "jobs"})
         assert job.id in out["Matches"]["jobs"]
@@ -264,7 +263,6 @@ class TestScaleAndVolumes:
 
         # node advertising the plugin; job claiming the volume
         s = agent.server
-        node = s.state.nodes()[0] if hasattr(s.state, "nodes") else None
         from nomad_tpu import mock
         n = mock.node()
         n.csi_node_plugins = {"ebs-plugin": True}

@@ -116,5 +116,5 @@ class TestExternalBinaryHarness:
     def test_snapshot_roundtrip_through_binary(self, agent_proc, tmp_path):
         proc, base = agent_proc
         snap = tmp_path / "state.snap"
-        r = cli(base, "operator", "snapshot", "save", str(snap))
+        cli(base, "operator", "snapshot", "save", str(snap))
         assert snap.exists() and snap.stat().st_size > 10

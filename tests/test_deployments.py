@@ -160,7 +160,6 @@ class TestCanaries:
 
     def test_unpromoted_deployment_does_not_roll(self):
         s, v1 = self._setup()
-        dep = s.state.latest_deployment_by_job(v1.namespace, v1.id)
         canaries = [a for a in _live(s, v1) if a.job_version == 1]
         _set_health(s, canaries, healthy=True)
         s.deployments.tick(now=NOW + 101)

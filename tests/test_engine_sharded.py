@@ -57,6 +57,7 @@ class TestShardedEngineParity:
         h.state.upsert_job(job)
         snap = h.state.snapshot()
         sharded, single = engines()
+        assert sharded is not None
         bd_s = sharded.place(snap, job, job.task_groups, None,
                              bulk_api=True, seed=13,
                              block=(tg.name, 2000))
@@ -124,7 +125,6 @@ class TestShardedEngineParity:
         """End-to-end: Harness scheduling through the auto-mesh engine
         produces a valid complete plan (the whole suite also runs on the
         mesh via conftest; this pins the explicit contrast)."""
-        h = build(500)
         sharded, single = engines()
         for eng, h2 in ((sharded, build(500)), (single, build(500))):
             job = mock.job()

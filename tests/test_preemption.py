@@ -49,7 +49,6 @@ class TestPreemption:
         svc = mock.job(priority=100)
         svc.task_groups[0].count = 1
         svc.task_groups[0].tasks[0].resources = Resources(cpu=800, memory_mb=256)
-        cfg = h.state.snapshot().scheduler_config()
         cfg2 = SchedulerConfiguration(
             preemption_config=PreemptionConfig(service_scheduler_enabled=True))
         h.state.set_scheduler_config(cfg2)
@@ -116,7 +115,6 @@ class TestDevicePreemptParity:
 
     def _cluster(self, n_nodes=40, n_low_jobs=3):
         import random
-        rng = random.Random(4)
         h = Harness()
         h.state.set_scheduler_config(SchedulerConfiguration(
             preemption_config=PreemptionConfig(
