@@ -84,6 +84,8 @@ class Router:
 
     def __init__(self, agent) -> None:
         self.agent = agent
+        from nomad_tpu.client.exec_session import ExecSessionRegistry
+        self.exec_sessions = ExecSessionRegistry()
 
     @property
     def server(self):
@@ -1009,11 +1011,54 @@ class Router:
                 raise APIError(404, "alloc not found")
             self._check_ns(acl, a.namespace, cap)
 
+        # ---- interactive exec session endpoints (round-5 verdict #8) --
+        #   GET  allocation/:id/exec/:sid/stream?offset=N   (long-poll)
+        #   POST allocation/:id/exec/:sid/stdin  {"Data"|"Eof"}
+        #   DELETE allocation/:id/exec/:sid
+        if (len(p) >= 4 and p[0] == "allocation" and p[2] == "exec"):
+            import base64 as _b64
+            alloc_id, sid = p[1], p[3]
+            check_alloc_ns(alloc_id, cap="alloc-exec")
+            sess = self.exec_sessions.get(sid)
+            if sess is None or sess.alloc_id != alloc_id:
+                raise APIError(404, "exec session not found")
+            if method == "GET" and p[4:5] == ["stream"]:
+                try:
+                    offset = int((qs.get("offset") or ["0"])[0])
+                    timeout = min(float((qs.get("timeout") or ["25"])[0]),
+                                  55.0)
+                except ValueError as e:
+                    raise APIError(400, f"bad offset/timeout: {e}")
+                data, off, exited, code = sess.wait_output(
+                    offset, timeout=timeout)
+                return {"Data": _b64.b64encode(data).decode(),
+                        "Offset": off, "Exited": exited,
+                        "ExitCode": code}
+            if method in ("PUT", "POST") and p[4:5] == ["stdin"]:
+                if (body or {}).get("Eof"):
+                    sess.stdin_eof()
+                    return {}
+                try:
+                    raw = _b64.b64decode((body or {}).get("Data") or "")
+                except (ValueError, TypeError) as e:
+                    raise APIError(400, f"bad Data: {e}")
+                try:
+                    sess.stdin(raw)
+                except (OSError, ValueError) as e:
+                    raise APIError(400, f"stdin closed: {e}")
+                return {}
+            if method == "DELETE":
+                self.exec_sessions.remove(sid)
+                return {}
+            raise APIError(404, "bad exec session request")
+
         if (method in ("PUT", "POST") and len(p) >= 3
                 and p[0] == "allocation" and p[2] == "exec"):
-            # non-interactive exec (reference: `nomad alloc exec`; the
-            # reference streams over websocket — this returns the
-            # command's combined output in one response)
+            # exec (reference: `nomad alloc exec`).  One-shot by default
+            # (combined output in one response); {"Interactive": true}
+            # opens a streaming SESSION instead — stdout via long-poll,
+            # stdin via POSTs (see the session endpoints above; the
+            # reference streams both over a websocket)
             import base64 as _b64
             alloc_id = p[1]
             check_alloc_ns(alloc_id, cap="alloc-exec")
@@ -1029,13 +1074,23 @@ class Router:
             cmd = (body or {}).get("Cmd") or []
             if not cmd:
                 raise APIError(400, "Cmd required")
-            timeout = min(float((body or {}).get("Timeout") or 30.0),
-                          300.0)
             tr = next((r for r in ar.task_runners
                        if r.task.name == task), None)
             if tr is None or tr.handle is None:
                 raise APIError(404, f"task {task!r} not running")
             from nomad_tpu.client.drivers.base import DriverError
+            if (body or {}).get("Interactive"):
+                from nomad_tpu.client.exec_session import ExecSession
+                try:
+                    stream = tr.driver.open_exec(
+                        tr.handle, [str(c) for c in cmd])
+                except DriverError as e:
+                    raise APIError(400, str(e))
+                sess = ExecSession(stream, alloc_id=alloc_id, task=task)
+                self.exec_sessions.add(sess)
+                return {"SessionId": sess.id}
+            timeout = min(float((body or {}).get("Timeout") or 30.0),
+                          300.0)
             try:
                 out, code = tr.driver.exec_task(
                     tr.handle, [str(c) for c in cmd], timeout=timeout)

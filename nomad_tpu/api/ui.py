@@ -299,10 +299,12 @@ async function viewAlloc(id) {
 }
 
 // -------------------------------------------------------- exec terminal
-// Command-at-a-time terminal over /v1/client/allocation/:id/exec (the
-// reference streams a PTY over websocket; this surface runs one command
-// per submit and appends combined output — same DriverPlugin.ExecTask
-// seam).  The view pauses the 5s auto-refresh so scrollback survives.
+// INTERACTIVE terminal over the exec-session endpoints (the reference
+// streams a PTY over websocket; this surface opens a session —
+// POST {Interactive:true} — then pumps stdout via long-poll GETs on
+// .../exec/:sid/stream while Enter-submitted lines POST to
+// .../exec/:sid/stdin; both directions stream concurrently).  The view
+// pauses the 5s auto-refresh so scrollback survives.
 async function viewExec(id) {
   PAUSE_REFRESH = true;
   const a = await get(`/v1/allocation/${id}?namespace=*`);
@@ -314,11 +316,17 @@ async function viewExec(id) {
          `<div id="term"></div>
           <div style="margin-top:.5rem">
             <select id="termtask">${opts}</select>
-            <input id="termcmd" placeholder="command… (Enter to run)"
-                   autocomplete="off">
+            <input id="termsh" value="/bin/sh" size="8"
+                   title="shell command for the session">
+            <button id="termgo">connect</button>
+            <input id="termcmd" placeholder="stdin… (Enter to send)"
+                   autocomplete="off" disabled>
           </div>`, true);
   const term = document.getElementById('term');
   const input = document.getElementById('termcmd');
+  const b64e = s => btoa(String.fromCharCode(...new TextEncoder().encode(s)));
+  const b64d = s => new TextDecoder().decode(
+    Uint8Array.from(atob(s || ''), c => c.charCodeAt(0)));
   const say = (s, cls2) => {
     const el = document.createElement('div');
     if (cls2) el.className = cls2;
@@ -326,23 +334,52 @@ async function viewExec(id) {
     term.appendChild(el);
     term.scrollTop = term.scrollHeight;
   };
-  say(`connected · tasks: ${tasks.join(', ') || '(none)'}`);
-  input.onkeydown = async ev => {
-    if (ev.key !== 'Enter' || !input.value.trim()) return;
-    const cmdline = input.value;
-    input.value = '';
-    say(`$ ${cmdline}`);
-    try {
-      const out = await post(`/v1/client/allocation/${id}/exec`, {
+  say(`tasks: ${tasks.join(', ') || '(none)'} — pick a task, connect`);
+  let sid = null, alive = false;
+  const base = `/v1/client/allocation/${id}/exec`;
+  async function pump() {
+    let offset = 0;
+    while (alive) {
+      try {
+        const out = await get(`${base}/${sid}/stream?offset=${offset}`);
+        const text = b64d(out.Data);
+        if (text) say(text);
+        offset = out.Offset ?? offset;
+        if (out.Exited) {
+          say(`(session exited ${out.ExitCode ?? '?'})`,
+              out.ExitCode ? 'bad' : 'dim');
+          alive = false; input.disabled = true;
+        }
+      } catch (e) { say(String(e), 'bad'); alive = false; }
+    }
+  }
+  const goBtn = document.getElementById('termgo');
+  goBtn.onclick = async () => {
+    goBtn.disabled = true;     // double-click would leak a session and
+    try {                      // run two pump loops (code-review r5)
+      const out = await post(base, {
         Task: document.getElementById('termtask').value,
-        Cmd: ['/bin/sh', '-c', cmdline]});
-      const text = new TextDecoder().decode(
-        Uint8Array.from(atob(out.Output || ''), c => c.charCodeAt(0)));
-      if (text) say(text);
-      say(`(exit ${out.ExitCode})`, out.ExitCode ? 'bad' : 'dim');
+        Cmd: [document.getElementById('termsh').value, '-i'],
+        Interactive: true});
+      sid = out.SessionId; alive = true;
+      say(`connected (session ${sid.slice(0,8)})`, 'dim');
+      input.disabled = false; input.focus();
+      pump();
+    } catch (e) { say(String(e), 'bad'); goBtn.disabled = false; }
+  };
+  input.onkeydown = async ev => {
+    if (ev.key !== 'Enter' || !alive) return;
+    const line = input.value;
+    input.value = '';
+    say(`> ${line}`, 'dim');
+    try {
+      await post(`${base}/${sid}/stdin`, {Data: b64e(line + '\n')});
     } catch (e) { say(String(e), 'bad'); }
   };
-  input.focus();
+  window.addEventListener('hashchange', () => {
+    alive = false;
+    if (sid) fetch(`${base}/${sid}`, {method: 'DELETE'});
+  }, {once: true});
 }
 
 // ----------------------------------------------------------- node view

@@ -373,18 +373,67 @@ def cmd_alloc_fs(args) -> int:
 
 
 def cmd_alloc_exec(args) -> int:
-    """reference: `nomad alloc exec` (non-interactive form)."""
+    """reference: `nomad alloc exec`.  Default: one-shot, combined
+    output in one response.  `-i`: INTERACTIVE session — stdout streams
+    via long-poll while a reader thread forwards this terminal's stdin
+    (the reference's websocket stream, as chunked long-poll)."""
     import base64
     body = {"Cmd": args.cmd}
     if args.task:
         body["Task"] = args.task
-    out = _client(args).put(
-        f"/v1/client/allocation/{args.alloc_id}/exec", body=body)
-    # raw bytes to stdout: decode-with-replace would corrupt binary
-    # output (e.g. `alloc exec <id> cat binary > out`)
-    sys.stdout.buffer.write(base64.b64decode(out.get("Output", "")))
-    sys.stdout.buffer.flush()
-    return int(out.get("ExitCode", 0))
+    c = _client(args)
+    base = f"/v1/client/allocation/{args.alloc_id}/exec"
+    if not getattr(args, "interactive", False):
+        out = c.put(base, body=body)
+        # raw bytes to stdout: decode-with-replace would corrupt binary
+        # output (e.g. `alloc exec <id> cat binary > out`)
+        sys.stdout.buffer.write(base64.b64decode(out.get("Output", "")))
+        sys.stdout.buffer.flush()
+        return int(out.get("ExitCode", 0))
+
+    import threading
+    body["Interactive"] = True
+    sid = c.put(base, body=body)["SessionId"]
+    done = threading.Event()
+
+    # stdin runs in a DAEMON thread: the main thread must own the
+    # stream loop, or the process hangs in readline() after the remote
+    # session exits (the daemon dies with the process; code-review r5)
+    def pump_stdin():
+        try:
+            while not done.is_set():
+                line = sys.stdin.readline()
+                if line == "":                   # terminal EOF (^D)
+                    c.put(f"{base}/{sid}/stdin", body={"Eof": True})
+                    return
+                c.put(f"{base}/{sid}/stdin", body={
+                    "Data": base64.b64encode(line.encode()).decode()})
+        except Exception:  # noqa: BLE001 - session gone: stop feeding
+            pass
+
+    threading.Thread(target=pump_stdin, daemon=True).start()
+    code = 0
+    try:
+        offset = 0
+        while True:
+            out = c.get(f"{base}/{sid}/stream", offset=offset)
+            data = base64.b64decode(out.get("Data", ""))
+            if data:
+                sys.stdout.buffer.write(data)
+                sys.stdout.buffer.flush()
+            offset = out.get("Offset", offset)
+            if out.get("Exited"):
+                code = int(out.get("ExitCode") or 0)
+                break
+    except (KeyboardInterrupt, BrokenPipeError):
+        code = 130
+    finally:
+        done.set()
+        try:
+            c.delete(f"{base}/{sid}")
+        except Exception:  # noqa: BLE001 - session may have been reaped
+            pass
+    return code
 
 
 def cmd_alloc_restart(args) -> int:
@@ -883,6 +932,9 @@ def build_parser() -> argparse.ArgumentParser:
     alx = alloc.add_parser("exec")
     alx.add_argument("alloc_id")
     alx.add_argument("-task", default="")
+    alx.add_argument("-i", dest="interactive", action="store_true",
+                     help="interactive session: stream output, forward "
+                          "stdin (reference: nomad alloc exec -i)")
     # REMAINDER: the command's own flags (ls -l, sh -c ...) must pass
     # through untouched
     alx.add_argument("cmd", nargs=argparse.REMAINDER)

@@ -81,6 +81,14 @@ class Driver:
         the reference's DriverPlugin.ExecTask (`nomad alloc exec`)."""
         raise DriverError(f"driver {self.name} does not support exec")
 
+    def open_exec(self, handle: TaskHandle, cmd):
+        """Start `cmd` interactively inside the task's context and
+        return an ExecStream (client/exec_session.py) carrying streamed
+        combined output and writable stdin — the streaming form of the
+        reference's ExecTaskStreaming behind `nomad alloc exec -i`."""
+        raise DriverError(
+            f"driver {self.name} does not support interactive exec")
+
     def recover_task(self, handle: TaskHandle) -> bool:
         """Reattach after agent restart. True if the task is still live."""
         return False

@@ -79,6 +79,59 @@ class MockDriver(Driver):
         alloc-exec plumbing without real processes)."""
         return ("exec:" + " ".join(cmd)).encode() + b"\n", 0
 
+    def open_exec(self, handle, cmd):
+        """Fake interactive shell: prompts, echoes each stdin line back
+        as `you said: <line>`, exits 0 on `exit` (tests drive the full
+        bidirectional session plumbing without real processes)."""
+        from nomad_tpu.client.exec_session import ExecStream
+
+        class _FakeShell(ExecStream):
+            def __init__(self):
+                import queue
+                self._out = queue.Queue()
+                self._out.put(b"mock-shell$ ")
+                self._pending = b""
+                self._code = None
+
+            def read(self, max_bytes: int = 4096) -> bytes:
+                import queue
+                while True:
+                    try:
+                        item = self._out.get(timeout=0.5)
+                    except queue.Empty:
+                        if self._code is not None:
+                            return b""
+                        continue
+                    if item is None:
+                        return b""
+                    return item
+
+            def write_stdin(self, data: bytes) -> None:
+                self._pending += data
+                while b"\n" in self._pending:
+                    line, self._pending = self._pending.split(b"\n", 1)
+                    line = line.strip()
+                    if line == b"exit":
+                        self._code = 0
+                        self._out.put(None)
+                    elif line:
+                        self._out.put(b"you said: " + line
+                                      + b"\nmock-shell$ ")
+
+            def close_stdin(self) -> None:
+                if self._code is None:
+                    self._code = 0
+                self._out.put(None)
+
+            def exit_code(self):
+                return self._code
+
+            def terminate(self) -> None:
+                self._code = 137 if self._code is None else self._code
+                self._out.put(None)
+
+        return _FakeShell()
+
     def wait_task(self, handle, timeout=None) -> Optional[TaskResult]:
         mt = self._tasks.get(handle.task_id)
         if mt is None:

@@ -38,6 +38,26 @@ class RawExecDriver(Driver):
         self._procs: Dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
 
+    def open_exec(self, handle, cmd):
+        """Interactive exec: `cmd` spawned in the task's live working
+        directory with piped stdio (the streaming form of exec_task
+        above; same sandbox/pid-reuse guards)."""
+        from nomad_tpu.client.exec_session import PopenExecStream
+        if not self._same_process(handle):
+            raise DriverError("task process not available for exec")
+        try:
+            cwd = os.readlink(f"/proc/{handle.pid}/cwd")
+        except OSError:
+            raise DriverError("task process not available for exec")
+        try:
+            proc = subprocess.Popen(
+                list(cmd), cwd=cwd, stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        except OSError as e:
+            raise DriverError(f"exec failed: {e}")
+        return PopenExecStream(proc)
+
     def capabilities(self) -> DriverCapabilities:
         return DriverCapabilities(send_signals=True, exec_=True)
 

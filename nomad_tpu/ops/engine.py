@@ -1030,6 +1030,14 @@ class PlacementEngine:
             fill_k = min(FILL_K, rs)
         else:
             buf, used_out, _ = place_multi_packed_jit(inp, rs)
+        # start the device->host copy of the result buffer NOW: over the
+        # tunnel the fetch has a ~0.1s fixed latency, and queueing it
+        # behind the compute lets a prefetched batch's transfer ride out
+        # the PREVIOUS batch's host phase instead of blocking collect
+        try:
+            buf.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
         # prep_ns, not a wall t0: a prefetched batch may sit dispatched
         # while the PREVIOUS batch's host phase runs — that gap is not
         # scheduling time and must not inflate AllocMetric latency

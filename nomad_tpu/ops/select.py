@@ -783,9 +783,11 @@ place_multi_packed_jit = jax.jit(place_multi_packed, static_argnums=(1,))
 # stays in a device-resident companion buffer the host fetches only when a
 # round overflows (placed_total > sum of the small prefix).  Water-fill
 # commits in sorted-score order, so the nonzero fills ARE a prefix — a
-# binpack round at bench shape fills 1-3 nodes; FILL_K=64 covers every
-# non-pathological round while cutting the per-wave transfer ~8×.
-FILL_K = 64
+# binpack round at bench shape fills 1-3 nodes; FILL_K=32 covers every
+# non-pathological round while cutting the per-wave transfer ~16× (the
+# tunnel's D2H is latency- AND bandwidth-poor; overflow pays one extra
+# fetch).
+FILL_K = 32
 
 
 def place_multi_compact_packed(inp: MultiEvalInputs, cand_rows, cand_valid,

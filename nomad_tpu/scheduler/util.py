@@ -149,9 +149,11 @@ def free_indexes(existing: List[Allocation], count: int, extra: int = 0,
         i = a.index()
         if i >= 0:
             taken.add(i)
+    need = extra if extra > 0 else count
+    if not taken:                       # fresh job: the common bulk shape
+        return list(range(need))
     out = []
     i = 0
-    need = extra if extra > 0 else count
     while len(out) < need:
         if i not in taken:
             out.append(i)

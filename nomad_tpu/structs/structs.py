@@ -112,15 +112,28 @@ MAX_DYNAMIC_PORT = 32000
 
 def new_ids(count: int) -> List[str]:
     """Batch of UUIDv4-shaped random ids: one urandom syscall + one hex
-    conversion for the whole batch (a 100k-alloc plan mints 100k ids;
-    os.urandom + slicing is ~3x faster than uuid.uuid4())."""
-    h = os.urandom(16 * count).hex()
-    out: List[str] = []
-    append = out.append
-    for i in range(0, 32 * count, 32):
-        s = h[i:i + 32]
-        append(f"{s[:8]}-{s[8:12]}-4{s[13:16]}-{s[16:20]}-{s[20:]}")
-    return out
+    conversion + vectorized dash insertion for the whole batch (a
+    100k-alloc plan mints 100k ids; the per-id f-string assembly this
+    replaces was ~0.15s per 100k wave)."""
+    if count <= 0:
+        return []
+    if count < 32:
+        h = os.urandom(16 * count).hex()
+        return [f"{s[:8]}-{s[8:12]}-4{s[13:16]}-{s[16:20]}-{s[20:]}"
+                for s in (h[i:i + 32] for i in range(0, 32 * count, 32))]
+    import numpy as np
+    v = np.frombuffer(os.urandom(16 * count).hex().encode(),
+                      np.uint8).reshape(count, 32)
+    out = np.empty((count, 36), np.uint8)
+    out[:, 8] = out[:, 13] = out[:, 18] = out[:, 23] = ord("-")
+    out[:, :8] = v[:, :8]
+    out[:, 9:13] = v[:, 8:12]
+    out[:, 14] = ord("4")                      # uuid4 version nibble
+    out[:, 15:18] = v[:, 13:16]
+    out[:, 19:23] = v[:, 16:20]
+    out[:, 24:] = v[:, 20:]
+    return [b.decode("ascii")
+            for b in out.view(f"S36").ravel().tolist()]
 
 
 def new_id() -> str:
