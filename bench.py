@@ -1059,29 +1059,84 @@ def run_bridge(args):
 
     hlo = export_stablehlo(kernel, *kargs)
     br = PjrtBridge(DEFAULT_PLUGIN)
+    handles = []
     try:
         ex = br.compile(hlo)
         flat = [np.asarray(x) for x in jax.tree_util.tree_leaves(kargs)]
-        # output shapes from the jax reference ONCE (abstract eval)
         shapes = [(tuple(s.shape), np.dtype(s.dtype)) for s in
                   jax.eval_shape(kernel, *kargs)]
-        out = br.execute(ex, flat, shapes)       # warm
-        placed_wave = int(
-            (out[0][:, meta_off:][:, 12]).sum())  # meta placed_total col
-        iters = max(args.iters, 1)
+        # PERSISTENT device buffers (round-5 verdict #4): node tensors
+        # upload ONCE; each wave executes on resident handles and
+        # fetches only the compact result buffer — the old per-execute
+        # re-upload of every argument was the 4x gap vs the JAX path
+        handles = [br.upload(a) for a in flat]
+        # used0 is flat-INPUT index 2 on both paths (MultiEvalInputs
+        # field order); the used OUTPUT index differs: compact returns
+        # (buf_small, fills, used), flat returns (buf, used, jc)
+        used0_idx = 2
+        used_out_idx = 2 if built["cand_rows"] is not None else 1
+        outs = br.execute_resident(ex, handles, len(shapes))   # warm
+        buf0 = br.fetch(outs[0], *shapes[0])
+        placed_wave = int(buf0[:, meta_off:][:, 12].sum())
+        iters = max(args.iters, 1) * 4
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = br.execute(ex, flat, shapes)
+            prev = outs
+            outs = br.execute_resident(ex, handles, len(shapes))
+            for h in prev:
+                br.buffer_free(h)
+            buf0 = br.fetch(outs[0], *shapes[0])
         dt = (time.perf_counter() - t0) / iters
         rate = placed_wave / dt if dt > 0 else 0.0
+        for h in outs:
+            br.buffer_free(h)
+        # device-resident STATE CHAIN: wave k+1 starts from wave k's
+        # proposed-usage OUTPUT handle — cluster state never crosses to
+        # the host; placements shrink as capacity fills (the production
+        # Go-worker pattern)
+        chained_placed = []
+        chained_used_cpu = []
+        chain_used = None
+        for _ in range(3):
+            chain = list(handles)
+            if chain_used is not None:
+                chain[used0_idx] = chain_used
+            outs_c = br.execute_resident(ex, chain, len(shapes))
+            if chain_used is not None:
+                br.buffer_free(chain_used)
+            b0 = br.fetch(outs_c[0], *shapes[0])
+            chained_placed.append(int(b0[:, meta_off:][:, 12].sum()))
+            # the used tensor's total is the chain's proof: it grows
+            # wave over wave only if wave k+1 really started from wave
+            # k's device-side output (this fetch is demo-only, not part
+            # of the measured loop)
+            used_np = br.fetch(outs_c[used_out_idx],
+                               *shapes[used_out_idx])
+            chained_used_cpu.append(int(used_np[:, 0].sum()))
+            for oi, h in enumerate(outs_c):
+                if oi != used_out_idx:
+                    br.buffer_free(h)
+            chain_used = outs_c[used_out_idx]    # used rides on device
+        if chain_used is not None:
+            br.buffer_free(chain_used)
         return {"metric": "bridge_multi_eval_placements_per_sec",
                 "value": round(rate, 1), "unit": "placements/sec",
                 "vs_c1m_anchor": round(rate / C1M_PLACEMENTS_PER_SEC, 2),
                 "platform": br.platform(),
                 "placed_per_wave": placed_wave,
-                "wave_s": round(dt, 3), "n_evals": n_evals,
+                "resident_buffers": len(handles),
+                "chained_waves_placed": chained_placed,
+                # strictly increasing = the device-side usage chain is
+                # live (wave k+1 consumed wave k's output handle)
+                "chained_used_cpu_totals": chained_used_cpu,
+                "wave_s": round(dt, 4), "n_evals": n_evals,
                 "nodes": n_nodes}
     finally:
+        for h in handles:
+            try:
+                br.buffer_free(h)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
         br.close()
 
 

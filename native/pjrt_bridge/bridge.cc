@@ -436,4 +436,158 @@ cleanup:
   return rc;
 }
 
+// ---------------------------------------------------------------------------
+// Persistent device buffers (round-5 verdict #4).
+//
+// ntb_execute above re-uploads every argument per call — measured 4×
+// slower than the JAX-driven path at bench scale, because the node
+// tensors (attrs/cap/used: tens of MB) crossed the tunnel every wave.
+// The production worker instead holds its cluster state DEVICE-RESIDENT:
+//   ntb_upload           host array -> retained PJRT_Buffer handle
+//   ntb_execute_resident run with handles; outputs RETAINED as handles
+//                        (nothing crosses to the host)
+//   ntb_fetch            one buffer -> host, dense row-major
+//   ntb_buffer_free      drop a handle
+// A wave then uploads only its per-eval deltas (constraint rows, round
+// schedule — KBs), executes, fetches the compact result buffer, and can
+// chain an output handle (the proposed-usage tensor) straight into the
+// next wave's inputs without the host ever seeing it.
+
+void* ntb_upload(NtbClient* c, int dtype, const int64_t* dims, int ndims,
+                 const void* data, char* err, size_t errlen) {
+  PJRT_Client_BufferFromHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  args.client = c->client;
+  args.data = data;
+  args.type = static_cast<PJRT_Buffer_Type>(dtype);
+  args.dims = dims;
+  args.num_dims = static_cast<size_t>(ndims);
+  args.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  args.device = c->device;
+  if (check(c->api, c->api->PJRT_Client_BufferFromHostBuffer(&args), err,
+            errlen)) {
+    return nullptr;
+  }
+  if (await_event(c->api, args.done_with_host_buffer, err, errlen)) {
+    PJRT_Buffer_Destroy_Args dargs;
+    memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = args.buffer;
+    c->api->PJRT_Buffer_Destroy(&dargs);
+    return nullptr;
+  }
+  return args.buffer;
+}
+
+void ntb_buffer_free(NtbClient* c, void* buf) {
+  if (!c || !buf) return;
+  PJRT_Buffer_Destroy_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = static_cast<PJRT_Buffer*>(buf);
+  c->api->PJRT_Buffer_Destroy(&args);
+}
+
+// Execute with pre-uploaded buffer handles; outputs come back as RETAINED
+// handles in out_bufs (caller frees with ntb_buffer_free or feeds them to
+// a later execute).  Waits for device completion.
+int ntb_execute_resident(NtbClient* c, void* exec, int n_in,
+                         void* const* in_bufs, int n_out, void** out_bufs,
+                         char* err, size_t errlen) {
+  const PJRT_Api* api = c->api;
+  long real = ntb_num_outputs(c, exec, err, errlen);
+  if (real < 0) return -1;
+  if (real != n_out) {
+    set_err(err, errlen, "executable has " + std::to_string(real) +
+                             " outputs, caller provided " +
+                             std::to_string(n_out));
+    return -1;
+  }
+  std::vector<PJRT_Buffer*> ins(n_in);
+  for (int i = 0; i < n_in; i++)
+    ins[i] = static_cast<PJRT_Buffer*>(in_bufs[i]);
+  std::vector<PJRT_Buffer*> outs(n_out, nullptr);
+
+  PJRT_ExecuteOptions opts;
+  memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_Buffer* const* arg_list = ins.data();
+  PJRT_Buffer** out_list = outs.data();
+  PJRT_Event* dev_event = nullptr;
+
+  PJRT_LoadedExecutable_Execute_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  args.executable = static_cast<PJRT_LoadedExecutable*>(exec);
+  args.options = &opts;
+  args.argument_lists = &arg_list;
+  args.num_devices = 1;
+  args.num_args = static_cast<size_t>(n_in);
+  args.output_lists = &out_list;
+  args.device_complete_events = &dev_event;
+  if (check(api, api->PJRT_LoadedExecutable_Execute(&args), err, errlen)) {
+    return -1;
+  }
+  if (await_event(api, dev_event, err, errlen)) {
+    for (PJRT_Buffer* b : outs) {
+      if (b) ntb_buffer_free(c, b);
+    }
+    return -1;
+  }
+  for (int i = 0; i < n_out; i++) out_bufs[i] = outs[i];
+  return 0;
+}
+
+// Fetch one device buffer to host in dense row-major layout.  Returns the
+// byte size, or -1 on error (including dst too small).
+int64_t ntb_fetch(NtbClient* c, void* buf, void* dst, int64_t cap, char* err,
+                  size_t errlen) {
+  const PJRT_Api* api = c->api;
+  PJRT_Buffer_Dimensions_Args dims_args;
+  memset(&dims_args, 0, sizeof(dims_args));
+  dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+  dims_args.buffer = static_cast<PJRT_Buffer*>(buf);
+  if (check(api, api->PJRT_Buffer_Dimensions(&dims_args), err, errlen)) {
+    return -1;
+  }
+  int nd = static_cast<int>(dims_args.num_dims);
+  std::vector<int64_t> m2m(nd);
+  for (int d = 0; d < nd; d++) m2m[d] = nd - 1 - d;
+
+  PJRT_Buffer_MemoryLayout layout;
+  memset(&layout, 0, sizeof(layout));
+  layout.struct_size = PJRT_Buffer_MemoryLayout_STRUCT_SIZE;
+  layout.type = PJRT_Buffer_MemoryLayout_Type_Tiled;
+  layout.tiled.struct_size = PJRT_Buffer_MemoryLayout_Tiled_STRUCT_SIZE;
+  layout.tiled.minor_to_major = m2m.data();
+  layout.tiled.minor_to_major_size = static_cast<size_t>(nd);
+
+  PJRT_Buffer_ToHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  args.src = static_cast<PJRT_Buffer*>(buf);
+  args.host_layout = &layout;
+  if (check(api, api->PJRT_Buffer_ToHostBuffer(&args), err, errlen)) {
+    return -1;
+  }
+  if (static_cast<int64_t>(args.dst_size) > cap) {
+    set_err(err, errlen,
+            "buffer needs " + std::to_string(args.dst_size) + " bytes, " +
+                std::to_string(cap) + " provided");
+    return -1;
+  }
+  int64_t size = static_cast<int64_t>(args.dst_size);
+  args.dst = dst;
+  if (check(api, api->PJRT_Buffer_ToHostBuffer(&args), err, errlen)) {
+    return -1;
+  }
+  if (await_event(api, args.event, err, errlen)) {
+    return -1;
+  }
+  return size;
+}
+
 }  // extern "C"

@@ -1023,12 +1023,15 @@ class Router:
             if sess is None or sess.alloc_id != alloc_id:
                 raise APIError(404, "exec session not found")
             if method == "GET" and p[4:5] == ["stream"]:
+                import math
                 try:
                     offset = int((qs.get("offset") or ["0"])[0])
                     timeout = min(float((qs.get("timeout") or ["25"])[0]),
                                   55.0)
                 except ValueError as e:
                     raise APIError(400, f"bad offset/timeout: {e}")
+                if not math.isfinite(timeout) or timeout < 0:
+                    raise APIError(400, "bad timeout")
                 data, off, exited, code = sess.wait_output(
                     offset, timeout=timeout)
                 return {"Data": _b64.b64encode(data).decode(),

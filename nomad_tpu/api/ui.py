@@ -325,8 +325,11 @@ async function viewExec(id) {
   const term = document.getElementById('term');
   const input = document.getElementById('termcmd');
   const b64e = s => btoa(String.fromCharCode(...new TextEncoder().encode(s)));
-  const b64d = s => new TextDecoder().decode(
-    Uint8Array.from(atob(s || ''), c => c.charCodeAt(0)));
+  // ONE streaming decoder: a multi-byte UTF-8 sequence split across two
+  // long-poll chunks must not decode to replacement chars
+  const dec = new TextDecoder();
+  const b64d = s => dec.decode(
+    Uint8Array.from(atob(s || ''), c => c.charCodeAt(0)), {stream: true});
   const say = (s, cls2) => {
     const el = document.createElement('div');
     if (cls2) el.className = cls2;
@@ -349,8 +352,10 @@ async function viewExec(id) {
           say(`(session exited ${out.ExitCode ?? '?'})`,
               out.ExitCode ? 'bad' : 'dim');
           alive = false; input.disabled = true;
+          goBtn.disabled = false;    // allow a fresh session
         }
-      } catch (e) { say(String(e), 'bad'); alive = false; }
+      } catch (e) { say(String(e), 'bad'); alive = false;
+                    goBtn.disabled = false; }
     }
   }
   const goBtn = document.getElementById('termgo');

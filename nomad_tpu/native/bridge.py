@@ -164,6 +164,23 @@ class PjrtBridge:
             ctypes.POINTER(ctypes.c_int),       # out_elem
             ctypes.POINTER(ctypes.c_int64),     # out_sizes
             ctypes.c_char_p, ctypes.c_size_t]
+        # persistent device buffers (round-5 verdict #4)
+        lib.ntb_upload.restype = ctypes.c_void_p
+        lib.ntb_upload.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.ntb_buffer_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.ntb_execute_resident.restype = ctypes.c_int
+        lib.ntb_execute_resident.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_char_p, ctypes.c_size_t]
+        lib.ntb_fetch.restype = ctypes.c_int64
+        lib.ntb_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t]
         self._lib = lib
         self._err = ctypes.create_string_buffer(4096)
         opts = (options if options is not None
@@ -251,6 +268,55 @@ class PjrtBridge:
                     f"output {i}: got {out_sizes[i]} bytes, "
                     f"expected {o.nbytes}")
         return outs
+
+    # ------------------------------------- persistent device buffers
+    # (round-5 verdict #4: the production worker holds node tensors
+    # DEVICE-RESIDENT and ships only per-wave deltas + the compact
+    # result — ntb_execute's per-call re-upload was the 4× gap vs the
+    # JAX-driven path)
+
+    def upload(self, arr: np.ndarray) -> int:
+        """Upload one host array; returns a retained device-buffer
+        handle (free with buffer_free, or feed to execute_resident)."""
+        a = np.ascontiguousarray(arr)
+        dims = (ctypes.c_int64 * max(a.ndim, 1))(*a.shape)
+        h = self._lib.ntb_upload(
+            self._h, _PJRT_TYPE[a.dtype], dims, a.ndim,
+            a.ctypes.data_as(ctypes.c_void_p), self._err, 4096)
+        if not h:
+            raise BridgeError(f"upload: {self._err.value.decode()}")
+        return h
+
+    def buffer_free(self, buf_h: int) -> None:
+        self._lib.ntb_buffer_free(self._h, buf_h)
+
+    def execute_resident(self, exec_h: int, in_handles: Sequence[int],
+                         n_out: int) -> List[int]:
+        """Execute with device-resident inputs; outputs stay on device
+        and come back as retained handles (chainable into later
+        executes — e.g. the proposed-usage tensor across waves)."""
+        n_in = len(in_handles)
+        ins = (ctypes.c_void_p * max(n_in, 1))(*in_handles)
+        outs = (ctypes.c_void_p * max(n_out, 1))()
+        rc = self._lib.ntb_execute_resident(
+            self._h, exec_h, n_in, ins, n_out, outs, self._err, 4096)
+        if rc != 0:
+            raise BridgeError(
+                f"execute_resident: {self._err.value.decode()}")
+        return [outs[i] for i in range(n_out)]
+
+    def fetch(self, buf_h: int, shape, dtype) -> np.ndarray:
+        """Fetch one device buffer to host (dense row-major)."""
+        out = np.empty(shape, dtype=dtype)
+        size = self._lib.ntb_fetch(
+            self._h, buf_h, out.ctypes.data_as(ctypes.c_void_p),
+            out.nbytes, self._err, 4096)
+        if size < 0:
+            raise BridgeError(f"fetch: {self._err.value.decode()}")
+        if size != out.nbytes:
+            raise BridgeError(
+                f"fetch: got {size} bytes, expected {out.nbytes}")
+        return out
 
     # ------------------------------------------------------------- close
 
