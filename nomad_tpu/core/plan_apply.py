@@ -154,7 +154,8 @@ class PlanApplier:
         # batches) never demote each other (optimistic-concurrency safety
         # exactly as the reference's evaluatePlan, at the reference's own
         # per-node granularity).
-        self.stats = {"fast_path": 0, "full_check": 0, "stale_token": 0}
+        self.stats = {"fast_path": 0, "full_check": 0, "stale_token": 0,
+                      "plans": 0, "plans_refuted": 0}
         # optional (eval_id, token) -> bool gate, wired by the Server to
         # the eval broker: plans from a SUPERSEDED delivery (the eval was
         # redelivered while this worker sat in a device compile) are
@@ -233,7 +234,9 @@ class PlanApplier:
                 # the fence read and the commit: redo with the full check
                 result = self.evaluate_plan(plan, skip_fit=False)
                 self.state.upsert_plan_results(plan, result)
+            self.stats["plans"] += 1
             if result.refuted_nodes:
+                self.stats["plans_refuted"] += 1
                 log("plan", "warn", "plan partially refuted",
                     eval_id=plan.eval_id,
                     refuted=len(result.refuted_nodes))
