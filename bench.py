@@ -982,8 +982,6 @@ def run_networked(args):
     per-batch port index instead of forfeiting it).  Reports evals/sec
     for a wave of dynamic-port evals through the real pipeline plus a
     global (node, port) uniqueness audit."""
-    import time as _t
-
     from nomad_tpu import mock
     from nomad_tpu.core.server import Server
     from nomad_tpu.structs import NetworkResource, Port
@@ -997,7 +995,9 @@ def run_networked(args):
     nodes, _ = _build_bench_cluster(n_nodes)
     s.state.upsert_nodes(nodes)
 
-    def wave(tag, cpu):
+    all_jobs = []
+
+    def wave(cpu):
         jobs, evals = [], []
         for _ in range(n_evals):
             job = mock.batch_job()
@@ -1010,32 +1010,40 @@ def run_networked(args):
                 dynamic_ports=[Port(label="http")])]
             evals.append(s.register_job(job, now=time.time()))
             jobs.append(job)
+        all_jobs.extend(jobs)
         t0 = time.perf_counter()
         s.start_scheduling()
-        deadline = _t.time() + 600
+        deadline = time.time() + 600
         pending = {e.id for e in evals}
-        while pending and _t.time() < deadline:
-            done = {eid for eid in pending
-                    if (s.state.eval_by_id(eid) or evals[0]).status
-                    in ("complete", "failed")}
+        while pending and time.time() < deadline:
+            done = set()
+            for eid in pending:
+                ev = s.state.eval_by_id(eid)
+                if ev is not None and ev.status in ("complete", "failed"):
+                    done.add(eid)
             pending -= done
             if pending:
-                _t.sleep(0.05)
+                time.sleep(0.05)
+        assert not pending, f"{len(pending)} evals never finished"
         dt = time.perf_counter() - t0
         s.stop_scheduling()
         return dt, jobs
 
-    wave("warmup", cpu=1)
-    dt, jobs = wave("measure", cpu=10)
+    wave(cpu=1)                    # warmup (compiles)
+    dt, jobs = wave(cpu=10)
     snap = s.state.snapshot()
     seen = set()
     placed = 0
     collisions = 0
-    for job in jobs:
+    # the audit spans BOTH waves: warmup allocs stay live holding ports,
+    # and a measure-wave index that ignored snapshot allocs is exactly
+    # the bug class this exists to catch (code-review r5)
+    for job in all_jobs:
         for a in snap.allocs_by_job(job.namespace, job.id):
             if a.terminal_status():
                 continue
-            placed += 1
+            if job in jobs:
+                placed += 1
             for port in a.allocated_ports.values():
                 key = (a.node_id, port)
                 if key in seen:
