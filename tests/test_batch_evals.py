@@ -483,6 +483,89 @@ def zoned_items(h, n_items, count, n_zones=5):
     return items
 
 
+class TestSignatureDisjointness:
+    """The structural disjointness prover gates lane parallelism: a
+    FALSE POSITIVE would let two lanes water-fill the same node
+    concurrently and oversubscribe it.  Conservative by construction —
+    prove only what the lowered rows entail."""
+
+    def _luts(self):
+        # rows: 0 = {vocab 0,1}, 1 = {vocab 2,3}, 2 = {vocab 1,2}
+        luts = np.zeros((3, 4), bool)
+        luts[0, [0, 1]] = True
+        luts[1, [2, 3]] = True
+        luts[2, [1, 2]] = True
+        return luts
+
+    def test_proven_disjoint(self):
+        from nomad_tpu.ops.engine import _sig_disjoint
+        from nomad_tpu.pack.packer import DOP_EQ, DOP_LUT
+        luts = self._luts()
+        # EQ/EQ different values on one column
+        assert _sig_disjoint([(5, DOP_EQ, 1)], [(5, DOP_EQ, 2)], luts)
+        # LUT/LUT with empty intersection ({0,1} vs {2,3})
+        assert _sig_disjoint([(7, DOP_LUT, 0)], [(7, DOP_LUT, 1)], luts)
+        # EQ value outside the LUT's set (2 not in {0,1})
+        assert _sig_disjoint([(7, DOP_LUT, 0)], [(7, DOP_EQ, 2)], luts)
+        assert _sig_disjoint([(7, DOP_EQ, 2)], [(7, DOP_LUT, 0)], luts)
+
+    def test_not_proven(self):
+        from nomad_tpu.ops.engine import _sig_disjoint
+        from nomad_tpu.pack.packer import (
+            DOP_EQ, DOP_LUT, DOP_NEQ, DOP_TRUE)
+        luts = self._luts()
+        # same EQ value: same set
+        assert not _sig_disjoint([(5, DOP_EQ, 1)], [(5, DOP_EQ, 1)], luts)
+        # different COLUMNS never prove anything
+        assert not _sig_disjoint([(5, DOP_EQ, 1)], [(6, DOP_EQ, 2)], luts)
+        # overlapping LUTs ({0,1} vs {1,2})
+        assert not _sig_disjoint([(7, DOP_LUT, 0)], [(7, DOP_LUT, 2)],
+                                 luts)
+        # EQ value inside the LUT's set
+        assert not _sig_disjoint([(7, DOP_LUT, 0)], [(7, DOP_EQ, 1)],
+                                 luts)
+        # NEQ / padding rows are ignored (no false proofs from them)
+        assert not _sig_disjoint([(5, DOP_NEQ, 1)], [(5, DOP_NEQ, 2)],
+                                 luts)
+        assert not _sig_disjoint([(0, DOP_TRUE, 0)], [(0, DOP_TRUE, 0)],
+                                 luts)
+        # empty signatures
+        assert not _sig_disjoint([], [(5, DOP_EQ, 1)], luts)
+
+    def test_overlapping_signatures_fall_back_to_flat(self):
+        """Two jobs whose CSI topologies OVERLAP must not lane-split:
+        build_multi_inputs has to keep the flat sequential schedule."""
+        from nomad_tpu.structs import CSIVolume, VolumeRequest
+        h = Harness()
+        nodes = [mock.node() for _ in range(40)]
+        for n in nodes:
+            n.csi_node_plugins["ebs0"] = True
+        h.state.upsert_nodes(nodes)
+        ids = [n.id for n in nodes]
+        h.state.upsert_csi_volume(CSIVolume(
+            id="vol-a", plugin_id="ebs0",
+            topology_node_ids=tuple(ids[:30])))      # overlaps vol-b
+        h.state.upsert_csi_volume(CSIVolume(
+            id="vol-b", plugin_id="ebs0",
+            topology_node_ids=tuple(ids[20:])))
+        items = []
+        for src in ("vol-a", "vol-b"):
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 10
+            tg.volumes = {"data": VolumeRequest(
+                name="data", type="csi", source=src, read_only=True)}
+            h.state.upsert_job(job)
+            items.append(BatchItem(job=job, tg=tg, count=10))
+        eng = PlacementEngine(mesh=False)
+        built = eng.build_multi_inputs(h.state.snapshot(), items, seed=3)
+        assert built["cand_rows"] is None     # no disjointness proof
+        assert built["n_lanes"] == 1
+        # and the batch still places correctly on the flat path
+        d = eng.place_batch(h.state.snapshot(), items, seed=3)
+        assert sum(int((x.picks >= 0).sum()) for x in d) == 20
+
+
 class TestCompactLanedKernel:
     """The compact lane-parallel multi-eval kernel (round-5: signatures
     with provably-disjoint landscapes run as concurrent lanes over
