@@ -100,6 +100,7 @@ class StateStore:
         # tracked the same way: `_fresh_*` holds buckets copied since the
         # last snapshot (private to the head, safe to mutate in place).
         self._alloc_tables_shared = False
+        self._block_tables_shared = False
         self._fresh_node_buckets: set = set()
         self._fresh_job_buckets: set = set()
         # volumes whose claim dicts were copied since the last snapshot
@@ -393,6 +394,19 @@ class StateStore:
             self._alloc_tables_shared = False
         return self._allocs, self._allocs_by_node, self._allocs_by_job
 
+    def _writable_block_tables(self):
+        """The head block registries, COW-copied once if a snapshot may
+        hold them (then mutated in place until the next snapshot) — the
+        same amortized discipline as the alloc tables: a 384-plan wave
+        was paying a fresh copy of all three dicts PER BLOCK."""
+        if self._block_tables_shared:
+            self._alloc_blocks = dict(self._alloc_blocks)
+            self._blocks_by_job = dict(self._blocks_by_job)
+            self._blocks_by_node = dict(self._blocks_by_node)
+            self._block_tables_shared = False
+        return (self._alloc_blocks, self._blocks_by_job,
+                self._blocks_by_node)
+
     def _materialize_block_locked(self, block) -> None:
         """Convert a live alloc block into ordinary per-alloc table rows
         (the cold path: a member alloc is about to be updated, or a full
@@ -416,25 +430,20 @@ class StateStore:
                 fresh_node.add(nid)
             by_node[nid][a.id] = a
             job_bucket[a.id] = a
-        # drop from the COW registries
-        blocks = dict(self._alloc_blocks)
+        # drop from the amortized-COW registries
+        blocks, bj, bn = self._writable_block_tables()
         blocks.pop(block.id, None)
-        self._alloc_blocks = blocks
-        bj = dict(self._blocks_by_job)
         rest = tuple(b for b in bj.get(jkey, ()) if b is not block)
         if rest:
             bj[jkey] = rest
         else:
             bj.pop(jkey, None)
-        self._blocks_by_job = bj
-        bn = dict(self._blocks_by_node)
         for nid in block.node_table:
             restn = tuple(b for b in bn.get(nid, ()) if b is not block)
             if restn:
                 bn[nid] = restn
             else:
                 bn.pop(nid, None)
-        self._blocks_by_node = bn
         self._emit("BlockMaterialized", self._index, block)
 
     def _resolve_block_member_locked(self, alloc_id: str,
@@ -689,16 +698,13 @@ class StateStore:
         block.modify_index = idx
         for nid in block.node_table:
             self._touch_node(nid, origin)
-        self._alloc_blocks = {**self._alloc_blocks, block.id: block}
+        blocks, bj, bn = self._writable_block_tables()
+        blocks[block.id] = block
         tmpl = block.template
         jkey = (tmpl.namespace, tmpl.job_id)
-        bj = dict(self._blocks_by_job)
         bj[jkey] = bj.get(jkey, ()) + (block,)
-        self._blocks_by_job = bj
-        bn = dict(self._blocks_by_node)
         for nid in block.node_table:
             bn[nid] = bn.get(nid, ()) + (block,)
-        self._blocks_by_node = bn
         # CSI claims for the whole block in one dict update per volume
         job = tmpl.job
         tg = job.lookup_task_group(tmpl.task_group) if job else None
@@ -1209,6 +1215,7 @@ class StateStore:
             self._blocks_by_job = {}
             self._blocks_by_node = {}
             self._alloc_tables_shared = False
+            self._block_tables_shared = False
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
             self._fresh_claim_vols = set()
@@ -1291,6 +1298,7 @@ class StateStore:
             # the handed-out tables are frozen from here on: the next
             # alloc write copies before mutating (see _insert_allocs)
             self._alloc_tables_shared = True
+            self._block_tables_shared = True
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
             self._fresh_claim_vols = set()
