@@ -648,16 +648,34 @@ class PlacementEngine:
                 seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
                 extra_mask=extra_mask,
             )
+            fills_full = None
+            slot_k = 0
             if self.mesh is not None:
                 buf, used_dev, job_count_dev = self._sharded(
                     "bulk", round_size, n_rounds)(binp)
+            elif bulk_api:
+                # compact output: FILL_K slots always fetched; full
+                # fills stay device-resident for the rare overflow
+                slot_k = min(FILL_K, round_size)
+                buf, fills_full, used_dev, job_count_dev = \
+                    place_bulk_packed_jit(binp, round_size, n_rounds,
+                                          False, slot_k)
             else:
                 buf, used_dev, job_count_dev = place_bulk_packed_jit(
                     binp, round_size, n_rounds, not bulk_api)
             tg_idx = np.full(p_real, g_idx, np.int32)
             if bulk_api:
+                buf_np = np.asarray(buf)
+                if slot_k:
+                    cnt_small = buf_np[:, :slot_k] & 2047
+                    if not np.array_equal(cnt_small.sum(axis=1),
+                                          buf_np[:, slot_k + 12]):
+                        buf_np = np.concatenate(
+                            [np.asarray(fills_full), buf_np[:, slot_k:]],
+                            axis=1)
+                        slot_k = 0
                 picks, _, meta = _unpack_bulk_compact(
-                    np.asarray(buf), round_size, p_real)
+                    buf_np, round_size, p_real, slot_k=slot_k)
                 if npad != n:
                     # mesh padding rows are statically infeasible; they
                     # must not read as real filtered nodes
