@@ -233,6 +233,22 @@ def _disjoint_cliques(sig_rows, luts, weights):
     return cliques
 
 
+def _resolve_compact_fills(buf_np: np.ndarray, fills_full, slot_k: int):
+    """The compact-output overflow protocol, shared by the single-eval
+    bulk path and collect_batch: the small buffer's fill prefix is
+    complete iff the per-round prefix counts sum to the placed-total
+    meta column; otherwise fetch the device-resident full fills and
+    rebuild the full-layout buffer.  Returns (buf, slot_k) where
+    slot_k == 0 means full layout."""
+    if not slot_k:
+        return buf_np, 0
+    cnt_small = buf_np[:, :slot_k] & 2047
+    if np.array_equal(cnt_small.sum(axis=1), buf_np[:, slot_k + 12]):
+        return buf_np, slot_k
+    full = fills_full() if callable(fills_full) else np.asarray(fills_full)
+    return np.concatenate([full, buf_np[:, slot_k:]], axis=1), 0
+
+
 def _unpack_bulk_compact(buf: np.ndarray, round_size: int, p_real: int,
                          with_scores: bool = False, slot_k: int = 0):
     """Expand the bulk kernel's compact per-round buffer (see
@@ -653,9 +669,13 @@ class PlacementEngine:
             if self.mesh is not None:
                 buf, used_dev, job_count_dev = self._sharded(
                     "bulk", round_size, n_rounds)(binp)
-            elif bulk_api:
+            elif bulk_api and algo != SCHED_ALGO_SPREAD:
                 # compact output: FILL_K slots always fetched; full
-                # fills stay device-resident for the rare overflow
+                # fills stay device-resident for the rare overflow.
+                # The SPREAD algorithm fans every round over ~want
+                # distinct nodes, so its rounds would overflow the
+                # prefix every time and pay two fetches — it keeps the
+                # full layout (code-review r5).
                 slot_k = min(FILL_K, round_size)
                 buf, fills_full, used_dev, job_count_dev = \
                     place_bulk_packed_jit(binp, round_size, n_rounds,
@@ -665,15 +685,8 @@ class PlacementEngine:
                     binp, round_size, n_rounds, not bulk_api)
             tg_idx = np.full(p_real, g_idx, np.int32)
             if bulk_api:
-                buf_np = np.asarray(buf)
-                if slot_k:
-                    cnt_small = buf_np[:, :slot_k] & 2047
-                    if not np.array_equal(cnt_small.sum(axis=1),
-                                          buf_np[:, slot_k + 12]):
-                        buf_np = np.concatenate(
-                            [np.asarray(fills_full), buf_np[:, slot_k:]],
-                            axis=1)
-                        slot_k = 0
+                buf_np, slot_k = _resolve_compact_fills(
+                    np.asarray(buf), fills_full, slot_k)
                 picks, _, meta = _unpack_bulk_compact(
                     buf_np, round_size, p_real, slot_k=slot_k)
                 if npad != n:
@@ -1339,21 +1352,17 @@ class PlacementEngine:
             # laned schedule: reorder rows back to eval-major order so
             # the spans below slice each eval's contiguous rounds
             buf_np = buf_np[pending["perm"]]
-        rs_eff = rs
         fill_k = pending.get("fill_k")
-        if fill_k is not None:
-            # compact-output buffer: the small fill prefix suffices
-            # unless a round filled more than FILL_K distinct nodes —
-            # then (and only then) fetch the device-resident full fills
-            cnt_small = buf_np[:, :fill_k] & 2047
-            placed_col = buf_np[:, fill_k + 12]
-            if np.array_equal(cnt_small.sum(axis=1), placed_col):
-                rs_eff = fill_k
-            else:
-                full = np.asarray(pending["fills_full"])
-                if pending.get("perm") is not None:
-                    full = full[pending["perm"]]
-                buf_np = np.concatenate([full, buf_np[:, fill_k:]], axis=1)
+
+        def _full_fills():
+            full = np.asarray(pending["fills_full"])
+            if pending.get("perm") is not None:
+                full = full[pending["perm"]]
+            return full
+
+        buf_np, slot_eff = _resolve_compact_fills(
+            buf_np, _full_fills, fill_k or 0)
+        rs_eff = slot_eff or rs
 
         dc_counts = self._dc_counts(t)
         elapsed = ((pending["prep_ns"] + time.perf_counter_ns() - t1)

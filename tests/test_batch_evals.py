@@ -630,6 +630,49 @@ class TestCompactLanedKernel:
         distinct = {p for a in d_c for p in a.picks.tolist() if p >= 0}
         assert len(distinct) > FILL_K
 
+    def test_single_eval_bulk_overflow_fallback(self):
+        """The single-eval bulk kernel's compact output must survive a
+        round filling more distinct nodes than the FILL_K prefix (tiny
+        nodes force ~2 allocs each): the engine refetches the resident
+        full fills and the picks match the full-layout run exactly."""
+        import nomad_tpu.ops.engine as em
+        from nomad_tpu.ops.select import FILL_K
+
+        h = Harness()
+        nodes = []
+        for _ in range(FILL_K * 2):
+            n = mock.node()
+            n.resources.cpu = 250          # fits exactly 2 of the asks
+            n.resources.memory_mb = 300
+            nodes.append(n)
+        h.state.upsert_nodes(nodes)
+        job = mock.batch_job()
+        tg = job.task_groups[0]
+        count = FILL_K * 3                 # > FILL_K distinct fills
+        tg.count = count
+        tg.tasks[0].resources.cpu = 100
+        tg.tasks[0].resources.memory_mb = 100
+        h.state.upsert_job(job)
+        snap = h.state.snapshot()
+
+        bd = PlacementEngine(mesh=False).place(
+            snap, job, job.task_groups, None, bulk_api=True, seed=5,
+            block=(tg.name, count))
+        old = em.FILL_K
+        em.FILL_K = 4096                   # full prefix: no overflow
+        try:
+            bd_full = PlacementEngine(mesh=False).place(
+                snap, job, job.task_groups, None, bulk_api=True, seed=5,
+                block=(tg.name, count))
+        finally:
+            em.FILL_K = old
+        assert np.array_equal(bd.picks, bd_full.picks)
+        placed = bd.picks[bd.picks >= 0]
+        assert len(placed) == count
+        assert len(np.unique(placed)) > FILL_K     # really overflowed
+        counts = np.bincount(placed)
+        assert counts.max() <= 2                   # capacity respected
+
     def test_job_count_seeds_respected(self):
         """A job with live allocs placing again through the compact path
         must see its existing per-node counts (anti-affinity seeds) —
