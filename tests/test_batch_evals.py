@@ -642,8 +642,10 @@ class TestCompactLanedKernel:
         nodes = []
         for _ in range(FILL_K * 2):
             n = mock.node()
-            n.resources.cpu = 250          # fits exactly 2 of the asks
-            n.resources.memory_mb = 300
+            # mock nodes reserve cpu=100/mem=256: usable = 200/200,
+            # exactly 2 of the 100/100 asks
+            n.resources.cpu = 300
+            n.resources.memory_mb = 456
             nodes.append(n)
         h.state.upsert_nodes(nodes)
         job = mock.batch_job()
