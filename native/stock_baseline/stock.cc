@@ -59,6 +59,17 @@ static inline uint64_t next_rand(uint64_t* s) {
   return x * 0x2545F4914F6CDD1DULL;
 }
 
+// stack.SetNodes: one Fisher-Yates shuffle per eval (shared by all tiers)
+static inline void shuffle_order(std::vector<int32_t>& order,
+                                 uint64_t* rng) {
+  for (int32_t i = (int32_t)order.size() - 1; i > 0; i--) {
+    int32_t j = (int32_t)(next_rand(rng) % (uint64_t)(i + 1));
+    int32_t t = order[i];
+    order[i] = order[j];
+    order[j] = t;
+  }
+}
+
 // Sequentially process `n_evals` evals of `per_eval` placements each over
 // `n` nodes (one eval worker).  elig[i]: node passed the static
 // feasibility chain.  touched_out (len n, may be null): set to 1 for
@@ -117,12 +128,7 @@ int64_t stock_place_evals(int32_t n, const int32_t* cap_cpu,
 
   for (int64_t e = 0; e < n_evals; e++) {
     // stack.SetNodes: one shuffle per eval
-    for (int32_t i = n - 1; i > 0; i--) {
-      int32_t j = (int32_t)(next_rand(&rng) % (uint64_t)(i + 1));
-      int32_t t = order[i];
-      order[i] = order[j];
-      order[j] = t;
-    }
+    shuffle_order(order, &rng);
     touched.clear();
 
     for (int64_t p = 0; p < per_eval; p++) {
@@ -207,10 +213,7 @@ int64_t stock_preempt_evals(int32_t n, const int32_t* cap_cpu,
   };
 
   for (int64_t e = 0; e < n_evals; e++) {
-    for (int32_t i = n - 1; i > 0; i--) {
-      int32_t j = (int32_t)(next_rand(&rng) % (uint64_t)(i + 1));
-      int32_t t = order[i]; order[i] = order[j]; order[j] = t;
-    }
+    shuffle_order(order, &rng);
     for (int64_t p = 0; p < per_eval; p++) {
       // normal Select first (LimitIterator(2))
       int32_t best = -1; double best_score = -1e300; int32_t seen = 0;
@@ -484,12 +487,7 @@ int64_t stock_place_evals_realistic(
     // Nomad's EvalCache lives on the EvalContext, i.e. per eval
     std::unordered_map<std::string, bool> eval_cache;
     // stack.SetNodes: one shuffle per eval
-    for (int32_t i = n - 1; i > 0; i--) {
-      int32_t j = (int32_t)(next_rand(&rng) % (uint64_t)(i + 1));
-      int32_t t = order[i];
-      order[i] = order[j];
-      order[j] = t;
-    }
+    shuffle_order(order, &rng);
     touched.clear();
     plan.clear();
 

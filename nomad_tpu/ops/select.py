@@ -527,6 +527,30 @@ def _bulk_scan(inp: BulkInputs, round_size: int, n_rounds: int, top_k: int):
         carry0, want_r)
 
 
+
+def pack_round_buffer(rows_p, cnt_p, top_rows, top_sc, n_feas, n_filt,
+                      n_exh, dim_ex, placed):
+    """Shared per-round output assembly for every rounds-based kernel
+    (single-eval bulk, multi-eval flat/compact, and the sharded
+    variants): the packed fill slots (row*2048 + count) and the 16-word
+    meta block — layout documented on place_bulk_packed.  Returns
+    (fills, meta)."""
+    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
+    r = top_rows.shape[0]
+    tk = top_rows.shape[1]
+    meta = jnp.concatenate([
+        jnp.concatenate([top_rows,
+                         jnp.full((r, 3 - tk), -1, jnp.int32)], axis=1),
+        jnp.concatenate([f2i(top_sc),
+                         jnp.zeros((r, 3 - tk), jnp.int32)], axis=1),
+        n_feas[:, None], n_filt[:, None], n_exh[:, None],
+        dim_ex, placed[:, None],
+        jnp.zeros((r, 3), jnp.int32),
+    ], axis=1)
+    return fills, meta
+
+
 def place_bulk_packed(inp: BulkInputs, round_size: int, n_rounds: int,
                       with_scores: bool = False, fill_k: int = 0):
     """Bulk kernel with compact per-round outputs packed into ONE int32
@@ -569,23 +593,17 @@ def place_bulk_packed(inp: BulkInputs, round_size: int, n_rounds: int,
     (used, job_count), outs = _bulk_scan(inp, round_size, n_rounds, top_k)
     (rows_p, cnt_p, sc_p, top_rows, top_sc,
      n_feas, n_filt, n_exh, dim_ex, placed) = outs
-    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-    fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
-    r = top_rows.shape[0]
-    meta = jnp.concatenate([
-        jnp.concatenate([top_rows,
-                         jnp.full((r, 3 - top_k), -1, jnp.int32)], axis=1),
-        jnp.concatenate([f2i(top_sc),
-                         jnp.zeros((r, 3 - top_k), jnp.int32)], axis=1),
-        n_feas[:, None], n_filt[:, None], n_exh[:, None],
-        dim_ex, placed[:, None],
-        jnp.zeros((fills.shape[0], 3), jnp.int32),
-    ], axis=1)
+    fills, meta = pack_round_buffer(rows_p, cnt_p, top_rows, top_sc,
+                                    n_feas, n_filt, n_exh, dim_ex, placed)
     if fill_k:
         buf_small = jnp.concatenate(
             [fills[:, :min(fill_k, round_size)], meta], axis=1)
         return buf_small, fills, used, job_count
-    parts = [fills, f2i(sc_p), meta] if with_scores else [fills, meta]
+    if with_scores:
+        f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+        parts = [fills, f2i(sc_p), meta]
+    else:
+        parts = [fills, meta]
     buf = jnp.concatenate(parts, axis=1)
     return buf, used, job_count
 
@@ -774,18 +792,8 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
         (u_r, a_r, jc_r, req_r, des_r, dh_r, inp.round_want, same_r))
     (rows_p, cnt_p, sc_p, top_rows, top_sc,
      n_feas, n_filt, n_exh, dim_ex, placed) = outs
-    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-    fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
-    r = top_rows.shape[0]
-    meta = jnp.concatenate([
-        jnp.concatenate([top_rows,
-                         jnp.full((r, 3 - top_k), -1, jnp.int32)], axis=1),
-        jnp.concatenate([f2i(top_sc),
-                         jnp.zeros((r, 3 - top_k), jnp.int32)], axis=1),
-        n_feas[:, None], n_filt[:, None], n_exh[:, None],
-        dim_ex, placed[:, None],
-        jnp.zeros((r, 3), jnp.int32),
-    ], axis=1)
+    fills, meta = pack_round_buffer(rows_p, cnt_p, top_rows, top_sc,
+                                    n_feas, n_filt, n_exh, dim_ex, placed)
     buf = jnp.concatenate([fills, meta], axis=1)
     return buf, used, jc
 
@@ -938,18 +946,8 @@ def place_multi_compact_packed(inp: MultiEvalInputs, cand_rows, cand_valid,
     top_rows, top_sc = flat(top_rows), flat(top_sc)
     n_feas, n_filt, n_exh = flat(n_feas), flat(n_filt), flat(n_exh)
     dim_ex, placed = flat(dim_ex), flat(placed)
-    f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-    fills = jnp.where(cnt_p > 0, rows_g * 2048 + cnt_p, 0)
-    r = top_rows.shape[0]
-    meta = jnp.concatenate([
-        jnp.concatenate([top_rows,
-                         jnp.full((r, 3 - top_k), -1, jnp.int32)], axis=1),
-        jnp.concatenate([f2i(top_sc),
-                         jnp.zeros((r, 3 - top_k), jnp.int32)], axis=1),
-        n_feas[:, None], n_filt[:, None], n_exh[:, None],
-        dim_ex, placed[:, None],
-        jnp.zeros((r, 3), jnp.int32),
-    ], axis=1)
+    fills, meta = pack_round_buffer(rows_g, cnt_p, top_rows, top_sc,
+                                    n_feas, n_filt, n_exh, dim_ex, placed)
     buf_small = jnp.concatenate([fills[:, :fill_k], meta], axis=1)
     return buf_small, fills, used
 

@@ -37,6 +37,7 @@ from nomad_tpu.ops.select import (
     bulk_round_metrics,
     bulk_round_scores,
     pack_outputs,
+    pack_round_buffer,
     round_metrics_g,
     round_scores_g,
     scan_statics,
@@ -427,19 +428,9 @@ def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
         assert round_size <= 1024, "packed fill counts support rounds <= 1024"
         (rows_p, cnt_p, sc_p, top_rows, top_sc,
          n_feas, n_filt, n_exh, dim_ex, placed, used, jc) = inner(inp)
-        f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-        fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
-        r = top_rows.shape[0]
-        tk = top_rows.shape[1]
-        meta = jnp.concatenate([
-            jnp.concatenate([top_rows,
-                             jnp.full((r, 3 - tk), -1, jnp.int32)], axis=1),
-            jnp.concatenate([f2i(top_sc),
-                             jnp.zeros((r, 3 - tk), jnp.int32)], axis=1),
-            n_feas[:, None], n_filt[:, None], n_exh[:, None],
-            dim_ex, placed[:, None],
-            jnp.zeros((r, 3), jnp.int32),
-        ], axis=1)
+        fills, meta = pack_round_buffer(
+            rows_p, cnt_p, top_rows, top_sc, n_feas, n_filt, n_exh,
+            dim_ex, placed)
         buf = jnp.concatenate([fills, meta], axis=1)
         return buf, used, jc
 
@@ -481,19 +472,9 @@ def place_bulk_sharded_packed_fn(mesh: Mesh, round_size: int,
         assert round_size <= 1024, "packed fill counts support rounds <= 1024"
         (rows_p, cnt_p, sc_p, top_rows, top_sc,
          n_feas, n_filt, n_exh, dim_ex, placed, used, job_count) = inner(inp)
-        f2i = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
-        fills = jnp.where(cnt_p > 0, rows_p * 2048 + cnt_p, 0)
-        r = top_rows.shape[0]
-        tk = top_rows.shape[1]
-        meta = jnp.concatenate([
-            jnp.concatenate([top_rows,
-                             jnp.full((r, 3 - tk), -1, jnp.int32)], axis=1),
-            jnp.concatenate([f2i(top_sc),
-                             jnp.zeros((r, 3 - tk), jnp.int32)], axis=1),
-            n_feas[:, None], n_filt[:, None], n_exh[:, None],
-            dim_ex, placed[:, None],
-            jnp.zeros((r, 3), jnp.int32),
-        ], axis=1)
+        fills, meta = pack_round_buffer(
+            rows_p, cnt_p, top_rows, top_sc, n_feas, n_filt, n_exh,
+            dim_ex, placed)
         buf = jnp.concatenate([fills, meta], axis=1)
         return buf, used, job_count
 
