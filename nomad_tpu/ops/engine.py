@@ -80,7 +80,9 @@ def _sharded_fn(mesh, kind: str, *shape_args):
             from nomad_tpu.parallel import mesh as pmesh
             builder = {"scan": pmesh.place_sharded_packed_fn,
                        "bulk": pmesh.place_bulk_sharded_packed_fn,
-                       "multi": pmesh.place_multi_sharded_packed_fn}[kind]
+                       "multi": pmesh.place_multi_sharded_packed_fn,
+                       "multi_compact":
+                           pmesh.place_multi_compact_sharded_fn}[kind]
             fn = builder(mesh, *shape_args)
         _SHARDED_FN_CACHE[key] = fn
     return fn
@@ -1052,13 +1054,19 @@ class PlacementEngine:
         inp, rs, aux = built["inp"], built["rs"], built
         fills_full = None
         fill_k = None
-        if self.mesh is not None:
-            buf, used_out, _ = self._sharded("multi", rs)(inp)
-        elif aux["cand_rows"] is not None:
-            buf, fills_full, used_out = place_multi_compact_packed_jit(
-                inp, jnp.asarray(aux["cand_rows"]),
-                jnp.asarray(aux["cand_valid"]), rs, aux["n_lanes"])
+        if aux["cand_rows"] is not None:
+            cr = jnp.asarray(aux["cand_rows"])
+            cv = jnp.asarray(aux["cand_valid"])
+            if self.mesh is not None:
+                buf, fills_full, used_out = self._sharded(
+                    "multi_compact", rs, aux["n_lanes"])(inp, cr, cv)
+            else:
+                buf, fills_full, used_out = \
+                    place_multi_compact_packed_jit(
+                        inp, cr, cv, rs, aux["n_lanes"])
             fill_k = min(FILL_K, rs)
+        elif self.mesh is not None:
+            buf, used_out, _ = self._sharded("multi", rs)(inp)
         else:
             buf, used_out, _ = place_multi_packed_jit(inp, rs)
         # start the device->host copy of the result buffer NOW: over the
@@ -1215,15 +1223,17 @@ class PlacementEngine:
         # different values), each signature gets a lane + a compact
         # candidate frame and the rounds run one-per-lane concurrently:
         # sequential depth drops R → R/L and per-round work drops N → Nc.
-        # Single-device only — the sharded kernels keep the flat schedule
-        # (tests/virtual mesh), as does any batch whose disjointness the
-        # structural prover cannot establish.
+        # On a mesh the frames additionally split by OWNER SHARD
+        # ([S, L, Nc_loc]; parallel/mesh._multi_compact_local) so the
+        # laned fast path composes with node-axis sharding.  Any batch
+        # whose disjointness the structural prover cannot establish keeps
+        # the flat sequential schedule.
         n_real = len(round_g)
         n_lanes = 1
         perm = None
         cand_rows = cand_valid = None
         luts = tgts[-1].luts      # the most complete LUT matrix
-        if self.mesh is None and n_real > 1 and len(static_con) > 1:
+        if n_real > 1 and len(static_con) > 1:
             weights = [0] * len(static_con)
             for r_idx in range(n_real):
                 weights[int(g_static[round_g[r_idx]])] += 1
@@ -1242,13 +1252,33 @@ class PlacementEngine:
                     [static_con[s] for s in clique], luts)
                 rows_l = [np.nonzero(masks[i])[0].astype(np.int32)
                           for i in range(width)]
-                nc = max(max((len(r) for r in rows_l), default=1), 1)
-                nc = ((nc + 2047) // 2048) * 2048
-                cand_rows = np.full((width, nc), npad, np.int32)
-                cand_valid = np.zeros((width, nc), bool)
-                for li, rows in enumerate(rows_l):
-                    cand_rows[li, :len(rows)] = rows
-                    cand_valid[li, :len(rows)] = True
+                if self.mesh is None:
+                    nc = max(max((len(r) for r in rows_l), default=1), 1)
+                    nc = ((nc + 2047) // 2048) * 2048
+                    cand_rows = np.full((width, nc), npad, np.int32)
+                    cand_valid = np.zeros((width, nc), bool)
+                    for li, rows in enumerate(rows_l):
+                        cand_rows[li, :len(rows)] = rows
+                        cand_valid[li, :len(rows)] = True
+                else:
+                    # per-shard frame blocks: shard s holds its slice of
+                    # every lane's candidates (global row ids; padding =
+                    # npad is past every shard's range)
+                    ndev = self._ndev
+                    nloc = npad // ndev
+                    shard_rows = [
+                        [rows[(rows // nloc) == sh] for rows in rows_l]
+                        for sh in range(ndev)]
+                    nc = max(max((len(r) for per in shard_rows
+                                  for r in per), default=1), 1)
+                    nc = ((nc + 511) // 512) * 512
+                    cand_rows = np.full((ndev, width, nc), npad,
+                                        np.int32)
+                    cand_valid = np.zeros((ndev, width, nc), bool)
+                    for sh in range(ndev):
+                        for li, rows in enumerate(shard_rows[sh]):
+                            cand_rows[sh, li, :len(rows)] = rows
+                            cand_valid[sh, li, :len(rows)] = True
                 lane_of = {s: li for li, s in enumerate(clique)}
                 lanes: List[List[int]] = [[] for _ in range(width)]
                 for r_idx in range(n_real):
@@ -1292,16 +1322,20 @@ class PlacementEngine:
         # 76ms gather of mostly zeros per launch at bench scale.
         if cand_rows is not None:
             g_job = np.zeros(g_pad, np.int32)
-            jrows = [np.zeros(nc, np.int32)]
+            jrows = [np.zeros(cand_rows.shape[:-2] + (nc,), np.int32)]
             if jc_nz_idx:
                 for gi, jc_row in zip(jc_nz_idx, jc_nz_rows):
                     li = lane_of[int(g_static[gi])]
-                    idx = cand_rows[li]
+                    idx = cand_rows[..., li, :]    # [nc] or [S, nc]
                     row = np.where(idx < n,
                                    jc_row[np.minimum(idx, n - 1)], 0)
                     g_job[gi] = len(jrows)
                     jrows.append(row.astype(np.int32))
-            jc0 = jnp.asarray(np.stack(jrows))
+            jc0 = np.stack(jrows)
+            if cand_rows.ndim == 3:
+                # sharded seeds: [S, J', Nc_loc] (J' axis second)
+                jc0 = np.moveaxis(jc0, 0, 1)
+            jc0 = jnp.asarray(jc0)
             g_job_dev = jnp.asarray(g_job)
         else:
             jc0 = jnp.zeros((g_pad, npad), jnp.int32)

@@ -228,13 +228,18 @@ def place_sharded_packed_fn(mesh: Mesh):
 
 def _sharded_waterfill(k_i, score, noise, static, want, spread_algo,
                        round_size: int, top_k: int, n_loc: int, offset,
-                       global_rows):
+                       global_rows, frame_commit: bool = False):
     """One sharded water-fill round: local candidates -> two-stage top-k
     over ICI -> replicated fill math -> owner-shard commit counts.
-    Shared by the sharded bulk kernel (fixed task group) and the sharded
-    multi-eval kernel (task group per round).  Returns the compact fill
-    prefix (global rows/counts/scores), local commit counts c_i, the
-    top-k metric slice, and global feasible/filter counts."""
+    Shared by the sharded bulk kernel (fixed task group), the sharded
+    multi-eval kernel (task group per round), and — with
+    `frame_commit=True` — the sharded COMPACT laned kernel, where the
+    local axis is a per-signature candidate FRAME rather than the node
+    shard: commits then scatter back to frame slots (ownership decided
+    by each winner's packed frame index + the global-row range test).
+    Returns the compact fill prefix (global rows/counts/scores), local
+    commit counts c_i (node rows, or frame slots), the top-k metric
+    slice, and global feasible/filter counts."""
     big = jnp.int32(round_size)
     # spread algorithm: cap per-node intake so a round fans out (viable
     # counted over the WHOLE mesh)
@@ -256,8 +261,9 @@ def _sharded_waterfill(k_i, score, noise, static, want, spread_algo,
         jnp.where(loc_nsc > NEG_INF / 2, score[loc_order], NEG_INF),
         k_round[loc_order].astype(jnp.float32),
         global_rows[loc_order].astype(jnp.float32),
-    ])                                                   # [4, kk_loc]
-    allp = jax.lax.all_gather(loc_pack, AXIS, axis=1).reshape(4, -1)
+        loc_order.astype(jnp.float32),       # frame slot on owner shard
+    ])                                                   # [5, kk_loc]
+    allp = jax.lax.all_gather(loc_pack, AXIS, axis=1).reshape(5, -1)
     kk_glob = min(round_size, allp.shape[1])
     g_nsc, g_idx = jax.lax.top_k(allp[0], kk_glob)
     sc_k = jnp.where(g_nsc > NEG_INF / 2, allp[1][g_idx], NEG_INF)
@@ -270,13 +276,25 @@ def _sharded_waterfill(k_i, score, noise, static, want, spread_algo,
     c_sorted = jnp.clip(want - (csum - k_sorted), 0, k_sorted)
     placed_total = jnp.sum(c_sorted)
 
-    # commit: each shard applies the fills for rows it owns
-    mine = (rows_k >= offset) & (rows_k < offset + n_loc)
-    loc_rows = jnp.clip(rows_k - offset, 0, n_loc - 1)
-    c_i = (jnp.zeros(n_loc, jnp.int32)
-           .at[loc_rows].add(
-               jnp.where(mine, c_sorted, 0).astype(jnp.int32),
-               mode="drop"))
+    if frame_commit:
+        # ownership by each winner's ORIGIN shard: the all_gather laid
+        # shards out contiguously, so winner i came from shard
+        # g_idx // kk_loc; its frame slot rides in pack row 4
+        src_shard = g_idx // kk_loc
+        mine = src_shard == jax.lax.axis_index(AXIS)
+        slots = jnp.clip(allp[4][g_idx].astype(jnp.int32), 0, n_loc - 1)
+        c_i = (jnp.zeros(n_loc, jnp.int32)
+               .at[slots].add(
+                   jnp.where(mine, c_sorted, 0).astype(jnp.int32),
+                   mode="drop"))
+    else:
+        # commit: each shard applies the fills for rows it owns
+        mine = (rows_k >= offset) & (rows_k < offset + n_loc)
+        loc_rows = jnp.clip(rows_k - offset, 0, n_loc - 1)
+        c_i = (jnp.zeros(n_loc, jnp.int32)
+               .at[loc_rows].add(
+                   jnp.where(mine, c_sorted, 0).astype(jnp.int32),
+                   mode="drop"))
 
     # compact fill prefix (pad when the whole cluster is smaller than a
     # round)
@@ -433,6 +451,137 @@ def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
             dim_ex, placed)
         buf = jnp.concatenate([fills, meta], axis=1)
         return buf, used, jc
+
+    return jax.jit(f)
+
+
+def _multi_compact_local(inp: MultiEvalInputs, cand_rows, cand_valid,
+                         round_size: int, n_lanes: int, top_k: int):
+    """Per-shard body of the sharded COMPACT laned kernel: the same
+    lane-parallel per-signature-frame design as
+    ops.select.place_multi_compact_packed, with the node axis sharded —
+    each shard holds ITS slice of every lane's candidate frame (the
+    host splits each signature's global candidate rows by owner shard)
+    and rounds resolve with the two-stage _sharded_waterfill in
+    frame-commit mode.  job_count0 carries the per-shard compact seed
+    table [J', Nc_loc]; cand_rows holds GLOBAL row ids (padding points
+    past every shard, so it is never 'mine')."""
+    cand_rows = cand_rows[0]            # [L, Nc_loc] (shard's block)
+    cand_valid = cand_valid[0]
+    jc_seed = inp.job_count0[0]         # [J', Nc_loc]
+    n_loc = inp.attrs.shape[0]
+    offset = jax.lax.axis_index(AXIS) * n_loc
+    nc = cand_rows.shape[1]
+    loc_idx = jnp.clip(cand_rows - offset, 0, n_loc - 1)
+    cap_c = inp.cap[loc_idx]                             # [L, Nc, 3]
+    used0_c = inp.used0[loc_idx]
+    aff_cu = jax.vmap(
+        lambda li: affinity_score(inp.attrs[li], inp.aff, inp.luts)
+    )(loc_idx)                                           # [L, Ua, Nc]
+    aff_any_u = jnp.any(inp.aff[..., 3] != 0, axis=1)
+    noise_c = tiebreak_noise(inp.seed, cand_rows)        # global-row keyed
+    rg = inp.round_g.reshape(-1, n_lanes)
+    a_r = inp.g_aff[rg]
+    jrow_r = inp.g_job[rg]
+    req_r = inp.req[rg]
+    des_r = inp.desired[rg]
+    dh_r = inp.dh_limit[rg]
+    same_r = jnp.concatenate(
+        [jnp.zeros((1, n_lanes), bool), rg[1:] == rg[:-1]], axis=0)
+    want_r = inp.round_want.reshape(-1, n_lanes)
+    n_glob = jax.lax.psum(jnp.int32(n_loc), AXIS)
+    cand_n_glob = jax.lax.psum(
+        jnp.sum(cand_valid, axis=1).astype(jnp.int32), AXIS)   # [L]
+    n_filt = n_glob - cand_n_glob                              # [L]
+
+    scores_l = jax.vmap(
+        partial(round_scores_g, round_size=round_size),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, None))
+    def _fill_one(k_i, score, noise, static, want, spread_algo, grows):
+        return _sharded_waterfill(k_i, score, noise, static, want,
+                                  spread_algo, round_size, top_k, nc, 0,
+                                  grows, frame_commit=True)
+
+    fill_l = jax.vmap(_fill_one, in_axes=(0, 0, 0, 0, 0, None, 0))
+    metrics_l = jax.vmap(round_metrics_g)
+
+    def lane_step(carry, xs):
+        used_c, cur_count = carry        # [L, Nc, 3], [L, Nc]
+        (a, jrow, req, desired, dh_limit, want, same) = xs
+        jc0 = jc_seed[jrow]                              # [L, Nc]
+        aff_sc = jnp.take_along_axis(
+            aff_cu, a[:, None, None], axis=1)[:, 0]
+        job_count = jnp.where(same[:, None], cur_count, jc0)
+        k_i, score = scores_l(cap_c, req, desired, dh_limit, cand_valid,
+                              aff_sc, aff_any_u[a], used_c, job_count,
+                              inp.spread_algo)
+        (rows_p, cnt_p, sc_p, top_rows, top_sc, n_feas, _nf,
+         c_i, placed) = fill_l(k_i, score, noise_c, cand_valid, want,
+                               inp.spread_algo, cand_rows)
+        used_c = used_c + c_i[:, :, None] * req[:, None, :]
+        job_count = job_count + c_i
+        n_exh_l, dim_ex_l = metrics_l(cap_c, req, dh_limit, cand_valid,
+                                      used_c, job_count)
+        n_exh = jax.lax.psum(n_exh_l, AXIS).astype(jnp.int32)
+        dim_ex = jax.lax.psum(dim_ex_l, AXIS).astype(jnp.int32)
+        out = (rows_p, cnt_p, top_rows, top_sc, n_feas, n_filt,
+               n_exh, dim_ex, placed)
+        return (used_c, job_count), out
+
+    carry0 = (used0_c, jnp.zeros((n_lanes, nc), jnp.int32))
+    (used_c, _), outs = jax.lax.scan(
+        lane_step, carry0,
+        (a_r, jrow_r, req_r, des_r, dh_r, want_r, same_r))
+    # scatter the shard's frame slices back to ITS node rows (padding
+    # and foreign rows drop out of range)
+    scatter_idx = jnp.where(cand_valid, cand_rows - offset, n_loc)
+    used = inp.used0.at[scatter_idx.reshape(-1)].set(
+        used_c.reshape(-1, 3), mode="drop")
+    return outs + (used, jnp.zeros(n_loc, jnp.int32))
+
+
+def place_multi_compact_sharded_fn(mesh: Mesh, round_size: int,
+                                   n_lanes: int):
+    """Sharded compact laned multi-eval kernel: same output protocol as
+    ops.select.place_multi_compact_packed — (buf_small [T*L, fk+16],
+    fills_full [T*L, round_size], used) — over the node-sharded mesh."""
+    from nomad_tpu.ops.select import FILL_K
+    spec_n = P(AXIS)
+    in_specs = MultiEvalInputs(
+        attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n, luts=P(),
+        base_mask=P(None, AXIS),
+        con=P(), u_mask=P(), aff=P(), req=P(), desired=P(),
+        dh_limit=P(), g_static=P(), g_aff=P(), g_job=P(),
+        job_count0=P(AXIS, None, None),
+        spread_algo=P(), round_g=P(), round_want=P(), seed=P(),
+    )
+    cand_spec = P(AXIS, None, None)
+    out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                 spec_n, spec_n)
+    inner = jax.shard_map(
+        partial(_multi_compact_local, round_size=round_size,
+                n_lanes=n_lanes, top_k=TOP_K),
+        mesh=mesh, in_specs=(in_specs, cand_spec, cand_spec),
+        out_specs=out_specs, check_vma=False)
+    fill_k = min(FILL_K, round_size)
+
+    def f(inp: MultiEvalInputs, cand_rows, cand_valid):
+        n = inp.attrs.shape[0]
+        assert n < (1 << 20), "packed fill rows support < 2^20 nodes"
+        assert round_size <= 1024, "packed fill counts support rounds <= 1024"
+        (rows_p, cnt_p, top_rows, top_sc, n_feas, n_filt,
+         n_exh, dim_ex, placed, used, _jc) = inner(inp, cand_rows,
+                                                   cand_valid)
+
+        def flat(x):                      # [T, L, ...] -> [T*L, ...]
+            return x.reshape((-1,) + x.shape[2:])
+
+        fills, meta = pack_round_buffer(
+            flat(rows_p), flat(cnt_p), flat(top_rows), flat(top_sc),
+            flat(n_feas), flat(n_filt), flat(n_exh), flat(dim_ex),
+            flat(placed))
+        buf_small = jnp.concatenate([fills[:, :fill_k], meta], axis=1)
+        return buf_small, fills, used
 
     return jax.jit(f)
 

@@ -630,6 +630,29 @@ class TestCompactLanedKernel:
         distinct = {p for a in d_c for p in a.picks.tolist() if p >= 0}
         assert len(distinct) > FILL_K
 
+    def test_mesh_compact_parity(self):
+        """The laned fast path composes with node-axis sharding: the
+        8-virtual-device mesh engine must take the compact path on a
+        zoned batch and decide exactly like the single-device engine
+        (sorted picks per item — the two-stage top-k resolves ties in
+        mesh order, so pick ORDER may differ within a round)."""
+        h, _ = build_zoned_cluster(512)     # mesh-multiple node count
+        items = zoned_items(h, 10, 30)
+        snap = h.state.snapshot()
+        mesh_eng = PlacementEngine()        # auto-mesh (8 devices)
+        assert mesh_eng.mesh is not None
+        built = mesh_eng.build_multi_inputs(snap, items, seed=9)
+        assert built["cand_rows"] is not None, "mesh compact not engaged"
+        assert built["cand_rows"].ndim == 3      # [S, L, Nc_loc]
+        d_mesh = mesh_eng.place_batch(snap, items, seed=9)
+        d_one = PlacementEngine(mesh=False).place_batch(snap, items,
+                                                        seed=9)
+        for a, b in zip(d_mesh, d_one):
+            assert np.array_equal(np.sort(a.picks), np.sort(b.picks))
+            for ma, mb in zip(a.metrics, b.metrics):
+                assert ma.nodes_filtered == mb.nodes_filtered
+                assert ma.nodes_exhausted == mb.nodes_exhausted
+
     def test_single_eval_bulk_overflow_fallback(self):
         """The single-eval bulk kernel's compact output must survive a
         round filling more distinct nodes than the FILL_K prefix (tiny
