@@ -813,9 +813,14 @@ def run_config_5(args):
         # denominator — flat tier above it, C1M anchor below it.  Serial
         # only: this host has one core (os.cpu_count() == 1 — reported
         # as host_cores below), so stock's num_schedulers default here
-        # IS 1, and a threaded emulation on one core can only interleave
-        base_rate_real = stock_zoned_rate_realistic(
-            nodes, cpu=10, mem=10, n_place=n_place, per_eval=per_eval)
+        # IS 1, and a threaded emulation on one core can only interleave.
+        # BEST of two runs: the shared host's noise must never deflate
+        # the denominator (generous-to-stock, like every tier choice)
+        base_rate_real = max(
+            stock_zoned_rate_realistic(
+                nodes, cpu=10, mem=10, n_place=n_place,
+                per_eval=per_eval, seed=3 + i) or 0.0
+            for i in range(2)) or None
     else:
         base_rate_mw = None    # no toolchain: never mislabel the serial
         # interpreted fallback as a 5-worker compiled figure
