@@ -1256,7 +1256,7 @@ def main():
                     help="config 5: eval worker threads")
     ap.add_argument("--batch", type=int, default=0,
                     help="config 5: max evals per device launch")
-    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="write a JAX profiler (xprof) trace of the "
                          "benched kernel launches to DIR (SURVEY §6.1)")
