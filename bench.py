@@ -772,7 +772,6 @@ def run_config_5(args):
     phases = None
     refute_rate = 0.0
     first_jobs = None
-    runs_done = 0
     # best-of sampling, with slow-window mitigation: the shared tunnel's
     # fixed D2H latency triples for minutes at a time; when every sample
     # so far looks like a slow window (wall suggests the latency floor
@@ -781,7 +780,10 @@ def run_config_5(args):
     # cannot be outwaited, only documented (PERF.md §3).
     # the 0.75s good-window threshold is calibrated to the default
     # full scale; smaller shapes just run the plain best-of-iters
-    full_scale = n_nodes >= 50000 and n_place >= 100000
+    # (gate on the REQUESTED total: per-eval rounding leaves n_place
+    # slightly under the ask at the default shape)
+    n_place = n_evals * per_eval
+    full_scale = n_nodes >= 50000 and total_target >= 100000
     extra_budget = max(iters, 4) if full_scale else 0
     i = 0
     while i < iters + extra_budget:
@@ -802,13 +804,11 @@ def run_config_5(args):
             refute_rate = refute_i
             if _PHASES is not None:
                 phases = _PHASES.report()
-        runs_done += 1
         i += 1
         if i >= iters and (not full_scale or dt < 0.75):
             break          # a good-window sample exists; stop
-    iters = runs_done
+    iters = i
     wave_jobs = first_jobs
-    n_place = n_evals * per_eval
     evals_per_sec = n_evals / dt
     tpu_rate = n_place / dt
 
