@@ -519,7 +519,7 @@ class Router:
                          "PluginID": v.plugin_id,
                          "AccessMode": v.access_mode,
                          "Schedulable": v.schedulable,
-                         "ReadAllocs": len(v.read_allocs),
+                         "ReadAllocs": v.n_read_claims(),
                          "WriteAllocs": len(v.write_allocs)}
                         for v in s.state.csi_volumes(
                             None if ns == "*" else ns)]
@@ -534,7 +534,16 @@ class Router:
                 v = s.state.snapshot().csi_volume_by_id(ns, vol_id)
                 if v is None:
                     raise APIError(404, "volume not found")
-                return codec.encode(v)
+                # block claims are an in-memory representation (AllocBlock
+                # holds numpy picks + the full job template) — the wire
+                # form carries their member ids as ordinary read claims,
+                # like the reference's per-alloc claim model
+                import dataclasses
+                wire_reads = dict(v.read_allocs)
+                for b in v.read_blocks.values():
+                    wire_reads.update(dict.fromkeys(b.ids, ""))
+                return codec.encode(dataclasses.replace(
+                    v, read_allocs=wire_reads, read_blocks={}))
             if method in ("PUT", "POST"):
                 from nomad_tpu.structs import CSIVolume
                 wire = (body or {}).get("Volume") or body or {}

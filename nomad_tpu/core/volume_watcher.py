@@ -75,6 +75,44 @@ class VolumeWatcher:
                 self._backoff.pop(key, None)
                 log("volumewatcher", "info", "stale claim released",
                     volume=vol.id, alloc_id=alloc_id)
+            # columnar block claims: every member is live by construction
+            # (any member update materializes the block, migrating its
+            # claims to the per-alloc ledger above), so the only stale
+            # case is a block that vanished from the store entirely —
+            # O(blocks) to check, never O(members).  The detach-before-
+            # release contract holds here too: every member must
+            # unpublish before the block claim drops, with the block as
+            # the backoff unit.
+            for block_id, block in list(vol.read_blocks.items()):
+                if block_id in snap._alloc_blocks:
+                    continue
+                key = (vol.namespace, vol.id, block_id)
+                live_keys.add(key)
+                if self._retry_at.get(key, 0.0) > t:
+                    continue
+                try:
+                    for aid in block.ids:
+                        self.unpublish(vol, aid)
+                except Exception as exc:  # noqa: BLE001 - retry w/ backoff
+                    backoff = min(self._backoff.get(key, 0.5) * 2,
+                                  MAX_BACKOFF_S)
+                    self._backoff[key] = backoff
+                    self._retry_at[key] = t + backoff
+                    self.stats["unpublish_failures"] += 1
+                    log("volumewatcher", "warn",
+                        "block unpublish failed; will retry",
+                        volume=vol.id, block_id=block_id,
+                        retry_in_s=backoff, error=str(exc))
+                    continue
+                self.server.state.release_csi_block_claim(
+                    vol.namespace, vol.id, block_id)
+                self.stats["released"] += 1
+                released += 1
+                self._retry_at.pop(key, None)
+                self._backoff.pop(key, None)
+                log("volumewatcher", "info",
+                    "vanished-block claim released",
+                    volume=vol.id, block_id=block_id)
         # forget backoff state for claims that no longer exist
         for key in list(self._retry_at):
             if key not in live_keys:

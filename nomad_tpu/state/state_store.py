@@ -444,6 +444,35 @@ class StateStore:
                 bn[nid] = restn
             else:
                 bn.pop(nid, None)
+        # migrate the block's COLUMNAR volume claims to per-alloc claims
+        # (now with real node values from the materialized rows) so the
+        # terminal-release and serialization paths only ever see per-alloc
+        # claims.  Same copy-once-per-cycle discipline as the claim dicts.
+        tmpl = block.template
+        tg = (tmpl.job.lookup_task_group(tmpl.task_group)
+              if tmpl.job else None)
+        if tg is not None and tg.volumes:
+            import dataclasses
+            vol_changed = {}
+            for vreq in tg.volumes.values():
+                if vreq.type != "csi" or not vreq.source:
+                    continue
+                key = (tmpl.namespace, vreq.source)
+                vol = self._csi_volumes.get(key)
+                if vol is None or block.id not in vol.read_blocks:
+                    continue
+                if key not in self._fresh_claim_vols:
+                    vol = dataclasses.replace(
+                        vol, read_allocs=dict(vol.read_allocs),
+                        write_allocs=dict(vol.write_allocs),
+                        read_blocks=dict(vol.read_blocks))
+                    self._fresh_claim_vols.add(key)
+                vol.read_blocks.pop(block.id, None)
+                vol.read_allocs.update(
+                    {a.id: a.node_id for a in rows})
+                vol_changed[key] = vol
+            if vol_changed:
+                self._csi_volumes = {**self._csi_volumes, **vol_changed}
         self._emit("BlockMaterialized", self._index, block)
 
     def _resolve_block_member_locked(self, alloc_id: str,
@@ -721,19 +750,25 @@ class StateStore:
                         and key not in self._fresh_claim_vols:
                     vol = dataclasses.replace(
                         vol, read_allocs=dict(vol.read_allocs),
-                        write_allocs=dict(vol.write_allocs))
+                        write_allocs=dict(vol.write_allocs),
+                        read_blocks=dict(vol.read_blocks))
                     self._fresh_claim_vols.add(key)
-                # node values stay EMPTY here: a block only reaches the
-                # columnar commit through _blocks_ok, which demotes
-                # single-node access modes (the only consumers of claim
-                # node values) to the per-node path — and empty never
-                # pins (live_claim_nodes skips it).  fromkeys is ~2x the
-                # zip-over-picks dict build at 100k claims/wave.
-                claims = dict.fromkeys(block.ids, "")
                 if vreq.read_only:
-                    vol.read_allocs.update(claims)
+                    # COLUMNAR claim: one ledger entry for the whole
+                    # block — O(1) per volume per wave, where the old
+                    # per-alloc dict update made every later wave pay a
+                    # copy of the volume's ENTIRE claim history on the
+                    # first touch of each snapshot cycle (measured: the
+                    # commit path degraded ~3x over a 1M-claim session).
+                    # Only read-only multi-node claims reach this branch
+                    # (_blocks_ok demotes the rest), so block claims
+                    # never pin nodes and never count against writers.
+                    vol.read_blocks[block.id] = block
                 else:
-                    vol.write_allocs.update(claims)
+                    # defensive: a hand-built write-claiming block (the
+                    # applier never admits one) keeps exact per-alloc
+                    # writer accounting
+                    vol.write_allocs.update(dict.fromkeys(block.ids, ""))
                 changed_vols[key] = vol
         self._emit("AllocBlock", idx, block)
 
@@ -759,7 +794,8 @@ class StateStore:
                 import dataclasses
                 vol = dataclasses.replace(
                     vol, read_allocs=dict(prev.read_allocs),
-                    write_allocs=dict(prev.write_allocs))
+                    write_allocs=dict(prev.write_allocs),
+                    read_blocks=dict(prev.read_blocks))
             self._csi_volumes = {**self._csi_volumes, key: vol}
             return idx
 
@@ -769,7 +805,7 @@ class StateStore:
             vol = self._csi_volumes.get((namespace, vol_id))
             if vol is None:
                 return "volume not found"
-            if vol.read_allocs or vol.write_allocs:
+            if vol.has_claims():
                 return "volume has active claims"
             self._bump_placement()
             self._volume_seq += 1
@@ -811,9 +847,14 @@ class StateStore:
             # the last snapshot is private to the head and its claim
             # dicts mutate in place
             if key not in changed and key not in self._fresh_claim_vols:
+                # the copy must cover EVERY mutable claim ledger —
+                # omitting read_blocks would alias the prior snapshot's
+                # dict, and a later in-place block-claim write would leak
+                # into snapshots already handed out
                 vol = dataclasses.replace(
                     vol, read_allocs=dict(vol.read_allocs),
-                    write_allocs=dict(vol.write_allocs))
+                    write_allocs=dict(vol.write_allocs),
+                    read_blocks=dict(vol.read_blocks))
                 self._fresh_claim_vols.add(key)
             if vreq.read_only:
                 vol.read_allocs[alloc.id] = alloc.node_id
@@ -840,6 +881,26 @@ class StateStore:
         if changed:
             self._volume_seq += 1
             self._csi_volumes = {**self._csi_volumes, **changed}
+
+    def release_csi_block_claim(self, namespace: str, vol_id: str,
+                                block_id: str) -> int:
+        """Drop a columnar block claim whose block no longer exists in
+        the store (safety reap — normally a block's claims migrate to
+        per-alloc claims at materialization and are released there)."""
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is None or block_id not in vol.read_blocks:
+                return self._index
+            idx = self._bump_placement()
+            self._volume_seq += 1
+            import dataclasses
+            v = dataclasses.replace(
+                vol, read_blocks={k: b for k, b in vol.read_blocks.items()
+                                  if k != block_id})
+            self._csi_volumes = {**self._csi_volumes,
+                                 (namespace, vol_id): v}
+            self._emit("CSIVolume", idx, v)
+            return idx
 
     def release_csi_claim(self, namespace: str, vol_id: str,
                           alloc_id: str) -> int:
@@ -1137,9 +1198,21 @@ class StateStore:
         from nomad_tpu.structs import codec
         with self._lock:
             # columnar blocks flatten for the snapshot document (cold
-            # path); the restored store starts block-free
+            # path); the restored store starts block-free.  Flattening
+            # migrates block claims to per-alloc claims, so volumes
+            # serialize without block references — any LEFTOVER block
+            # claim references a vanished block (the watcher's reap case:
+            # a dead claim) and is dropped rather than serialized.
             for b in list(self._alloc_blocks.values()):
                 self._materialize_block_locked(b)
+            import dataclasses
+            stale_vols = {}
+            for key, v in self._csi_volumes.items():
+                if v.read_blocks:
+                    stale_vols[key] = dataclasses.replace(
+                        v, read_blocks={})
+            if stale_vols:
+                self._csi_volumes = {**self._csi_volumes, **stale_vols}
             allocs = []
             for a in self._allocs.values():
                 slim = a.copy_skip_job()

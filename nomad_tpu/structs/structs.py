@@ -1219,7 +1219,26 @@ class CSIVolume:
     # are tolerated as "node unknown" and never pin.
     read_allocs: Dict[str, str] = field(default_factory=dict)
     write_allocs: Dict[str, str] = field(default_factory=dict)
+    # COLUMNAR claims: block id -> AllocBlock whose every member holds a
+    # read-only claim.  Only read-only claims on multi-node volumes ride
+    # here (PlanApplier._blocks_ok demotes writers and single-node modes
+    # to the per-alloc path), so block claims never pin a node and never
+    # count against writer limits — which keeps a bulk commit O(1) per
+    # volume instead of O(members), and keeps the claim ledger's
+    # copy-on-write cost proportional to BLOCKS, not claim history.  A
+    # block's claims migrate to read_allocs when it materializes
+    # (StateStore._materialize_block_locked), so terminal-release and
+    # snapshot serialization only ever see per-alloc claims.
+    read_blocks: Dict[str, object] = field(default_factory=dict)
     schedulable: bool = True
+
+    def n_read_claims(self) -> int:
+        return (len(self.read_allocs)
+                + sum(len(b.ids) for b in self.read_blocks.values()))
+
+    def has_claims(self) -> bool:
+        return bool(self.read_allocs or self.write_allocs
+                    or self.read_blocks)
 
     def writer_limited(self) -> bool:
         """Access modes permitting at most ONE live writer (reference:
@@ -1238,7 +1257,9 @@ class CSIVolume:
 
     def live_claim_nodes(self, releasing=()) -> set:
         """Node ids of live claims (read AND write), skipping `releasing`
-        alloc ids and claims whose node is unrecorded."""
+        alloc ids and claims whose node is unrecorded.  Block claims are
+        deliberately absent: they exist only on multi-node volumes, whose
+        access modes never pin a node."""
         return {nd
                 for claims in (self.read_allocs, self.write_allocs)
                 for aid, nd in claims.items()

@@ -65,10 +65,16 @@ def check_invariants(s, drained_ids):
             for port in (a.allocated_ports or {}).values():
                 assert (n_id, port) not in seen, (n_id, port)
                 seen.add((n_id, port))
-    # I3: claims ⊆ live allocs
+    # I3: claims ⊆ live allocs (block claims expand to their member ids)
     for vol in snap.csi_volumes():
-        for aid in list(vol.read_allocs) + list(vol.write_allocs):
+        claim_ids = (list(vol.read_allocs) + list(vol.write_allocs)
+                     + [aid for b in vol.read_blocks.values()
+                        for aid in b.ids])
+        for aid in claim_ids:
             assert aid in live_ids, (vol.id, aid)
+        # block claims must reference live blocks
+        for bid in vol.read_blocks:
+            assert bid in snap._alloc_blocks, (vol.id, bid)
     # I4: evals terminal
     for ev in snap.evals():
         assert ev.status in ("complete", "failed", "canceled",
