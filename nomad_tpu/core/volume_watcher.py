@@ -44,6 +44,7 @@ class VolumeWatcher:
         t = now if now is not None else time.time()
         snap = self.server.state.snapshot()
         released = 0
+        converted = 0
         live_keys = set()
         for vol in snap.csi_volumes():
             for alloc_id in list(vol.read_allocs) + list(vol.write_allocs):
@@ -79,43 +80,29 @@ class VolumeWatcher:
             # (any member update materializes the block, migrating its
             # claims to the per-alloc ledger above), so the only stale
             # case is a block that vanished from the store entirely —
-            # O(blocks) to check, never O(members).  The detach-before-
-            # release contract holds here too: every member must
-            # unpublish before the block claim drops, with the block as
-            # the backoff unit.
-            for block_id, block in list(vol.read_blocks.items()):
+            # O(blocks) to check, never O(members).  Conversion, not
+            # release: the members become ordinary per-alloc claims and
+            # the reap loop above unpublishes each INDEPENDENTLY with
+            # per-claim backoff on the next sweep (an all-or-nothing
+            # block unpublish would restart from member zero on every
+            # intermittent failure and might never converge).
+            for block_id in list(vol.read_blocks):
                 if block_id in snap._alloc_blocks:
                     continue
-                key = (vol.namespace, vol.id, block_id)
-                live_keys.add(key)
-                if self._retry_at.get(key, 0.0) > t:
-                    continue
-                try:
-                    for aid in block.ids:
-                        self.unpublish(vol, aid)
-                except Exception as exc:  # noqa: BLE001 - retry w/ backoff
-                    backoff = min(self._backoff.get(key, 0.5) * 2,
-                                  MAX_BACKOFF_S)
-                    self._backoff[key] = backoff
-                    self._retry_at[key] = t + backoff
-                    self.stats["unpublish_failures"] += 1
-                    log("volumewatcher", "warn",
-                        "block unpublish failed; will retry",
-                        volume=vol.id, block_id=block_id,
-                        retry_in_s=backoff, error=str(exc))
-                    continue
-                self.server.state.release_csi_block_claim(
+                self.server.state.convert_csi_block_claim(
                     vol.namespace, vol.id, block_id)
-                self.stats["released"] += 1
-                released += 1
-                self._retry_at.pop(key, None)
-                self._backoff.pop(key, None)
+                converted += 1
                 log("volumewatcher", "info",
-                    "vanished-block claim released",
+                    "vanished-block claim expanded for per-member reap",
                     volume=vol.id, block_id=block_id)
         # forget backoff state for claims that no longer exist
         for key in list(self._retry_at):
             if key not in live_keys:
                 self._retry_at.pop(key, None)
                 self._backoff.pop(key, None)
+        if converted:
+            # the expanded members are per-alloc claims now; reap them
+            # in the same tick so a single sweep still converges when
+            # unpublish succeeds first try
+            return released + self.tick(now=t)
         return released

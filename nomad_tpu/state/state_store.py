@@ -101,8 +101,10 @@ class StateStore:
         # last snapshot (private to the head, safe to mutate in place).
         self._alloc_tables_shared = False
         self._block_tables_shared = False
+        self._eval_tables_shared = False
         self._fresh_node_buckets: set = set()
         self._fresh_job_buckets: set = set()
+        self._fresh_eval_buckets: set = set()
         # volumes whose claim dicts were copied since the last snapshot
         # (private to the head — claims mutate them in place; a busy
         # volume otherwise paid a growing dict copy per PLAN)
@@ -331,11 +333,24 @@ class StateStore:
 
     # --------------------------------------------------------------- evals
 
+    def _writable_eval_tables(self):
+        """The head eval tables, COW-copied once per snapshot cycle then
+        mutated in place (same amortized discipline as the alloc/block
+        tables) — a 384-eval wave's two dozen status flushes were each
+        paying a copy of the ENTIRE eval table, a cost that grew with
+        cluster history."""
+        if self._eval_tables_shared:
+            self._evals = dict(self._evals)
+            self._evals_by_job = dict(self._evals_by_job)
+            self._eval_tables_shared = False
+            self._fresh_eval_buckets = set()
+        return self._evals, self._evals_by_job
+
     def upsert_evals(self, evals: Iterable[Evaluation]) -> int:
         with self._lock:
             idx = self._bump()
-            table = dict(self._evals)
-            by_job = dict(self._evals_by_job)
+            table, by_job = self._writable_eval_tables()
+            fresh = self._fresh_eval_buckets
             inserted = []
             now = _time.time()
             for e in evals:
@@ -348,12 +363,11 @@ class StateStore:
                 e.modify_time = now
                 table[e.id] = e
                 key = (e.namespace, e.job_id)
-                bucket = dict(by_job.get(key, {}))
-                bucket[e.id] = e
-                by_job[key] = bucket
+                if key not in fresh:
+                    by_job[key] = dict(by_job.get(key, {}))
+                    fresh.add(key)
+                by_job[key][e.id] = e
                 inserted.append(e)
-            self._evals = table
-            self._evals_by_job = by_job
             for e in inserted:
                 self._emit("Evaluation", idx, e)
             return idx
@@ -361,17 +375,16 @@ class StateStore:
     def delete_evals(self, eval_ids: Iterable[str]) -> int:
         with self._lock:
             idx = self._bump()
-            table = dict(self._evals)
-            by_job = dict(self._evals_by_job)
+            table, by_job = self._writable_eval_tables()
+            fresh = self._fresh_eval_buckets
             for eid in eval_ids:
                 e = table.pop(eid, None)
                 if e is not None:
                     key = (e.namespace, e.job_id)
-                    bucket = dict(by_job.get(key, {}))
-                    bucket.pop(eid, None)
-                    by_job[key] = bucket
-            self._evals = table
-            self._evals_by_job = by_job
+                    if key not in fresh:
+                        by_job[key] = dict(by_job.get(key, {}))
+                        fresh.add(key)
+                    by_job[key].pop(eid, None)
             return idx
 
     # -------------------------------------------------------------- allocs
@@ -458,15 +471,9 @@ class StateStore:
                 if vreq.type != "csi" or not vreq.source:
                     continue
                 key = (tmpl.namespace, vreq.source)
-                vol = self._csi_volumes.get(key)
+                vol = self._writable_claim_vol(key)
                 if vol is None or block.id not in vol.read_blocks:
                     continue
-                if key not in self._fresh_claim_vols:
-                    vol = dataclasses.replace(
-                        vol, read_allocs=dict(vol.read_allocs),
-                        write_allocs=dict(vol.write_allocs),
-                        read_blocks=dict(vol.read_blocks))
-                    self._fresh_claim_vols.add(key)
                 vol.read_blocks.pop(block.id, None)
                 vol.read_allocs.update(
                     {a.id: a.node_id for a in rows})
@@ -743,16 +750,9 @@ class StateStore:
                 if vreq.type != "csi" or not vreq.source:
                     continue
                 key = (tmpl.namespace, vreq.source)
-                vol = changed_vols.get(key) or self._csi_volumes.get(key)
+                vol = self._writable_claim_vol(key, changed_vols)
                 if vol is None:
                     continue
-                if key not in changed_vols \
-                        and key not in self._fresh_claim_vols:
-                    vol = dataclasses.replace(
-                        vol, read_allocs=dict(vol.read_allocs),
-                        write_allocs=dict(vol.write_allocs),
-                        read_blocks=dict(vol.read_blocks))
-                    self._fresh_claim_vols.add(key)
                 if vreq.read_only:
                     # COLUMNAR claim: one ledger entry for the whole
                     # block — O(1) per volume per wave, where the old
@@ -773,6 +773,32 @@ class StateStore:
         self._emit("AllocBlock", idx, block)
 
     # ----------------------------------------------------------- csi / cfg
+
+    def _writable_claim_vol(self, key, changed=None):
+        """Claim-ledger copy-on-first-touch, the ONE definition all claim
+        mutators share (code-review r5: the hand-rolled copies at four
+        sites are exactly how the read_blocks-omission snapshot leak
+        arose — a future ledger addition must be a one-line change
+        here, not a hunt).  Returns a volume private to the head for
+        this snapshot cycle (claim dicts safe to mutate in place), or
+        None when the volume does not exist.  `changed`: an in-flight
+        accumulator dict (plan commits) consulted before the head table;
+        the caller publishes the returned volume into it / the table."""
+        import dataclasses
+        vol = None
+        if changed is not None:
+            vol = changed.get(key)
+        if vol is None:
+            vol = self._csi_volumes.get(key)
+            if vol is None:
+                return None
+            if key not in self._fresh_claim_vols:
+                vol = dataclasses.replace(
+                    vol, read_allocs=dict(vol.read_allocs),
+                    write_allocs=dict(vol.write_allocs),
+                    read_blocks=dict(vol.read_blocks))
+                self._fresh_claim_vols.add(key)
+        return vol
 
     def delete_deployment(self, dep_id: str) -> int:
         with self._lock:
@@ -839,23 +865,9 @@ class StateStore:
             if vreq.type != "csi" or not vreq.source:
                 continue
             key = (alloc.namespace, vreq.source)
-            vol = changed.get(key) or self._csi_volumes.get(key)
+            vol = self._writable_claim_vol(key, changed)
             if vol is None:
                 continue
-            # copy-on-first-touch per snapshot-write cycle (same
-            # discipline as the alloc buckets): a volume copied since
-            # the last snapshot is private to the head and its claim
-            # dicts mutate in place
-            if key not in changed and key not in self._fresh_claim_vols:
-                # the copy must cover EVERY mutable claim ledger —
-                # omitting read_blocks would alias the prior snapshot's
-                # dict, and a later in-place block-claim write would leak
-                # into snapshots already handed out
-                vol = dataclasses.replace(
-                    vol, read_allocs=dict(vol.read_allocs),
-                    write_allocs=dict(vol.write_allocs),
-                    read_blocks=dict(vol.read_blocks))
-                self._fresh_claim_vols.add(key)
             if vreq.read_only:
                 vol.read_allocs[alloc.id] = alloc.node_id
             else:
@@ -882,25 +894,39 @@ class StateStore:
             self._volume_seq += 1
             self._csi_volumes = {**self._csi_volumes, **changed}
 
-    def release_csi_block_claim(self, namespace: str, vol_id: str,
+    def convert_csi_block_claim(self, namespace: str, vol_id: str,
                                 block_id: str) -> int:
-        """Drop a columnar block claim whose block no longer exists in
-        the store (safety reap — normally a block's claims migrate to
-        per-alloc claims at materialization and are released there)."""
+        """Expand a columnar block claim whose block no longer exists in
+        the store into ordinary per-alloc claims (safety path — normally
+        a block's claims migrate at materialization).  Conversion, not
+        release: each member claim must still go through the volume
+        watcher's unpublish-with-backoff before it drops, and the
+        per-alloc reap retries members INDEPENDENTLY where an
+        all-or-nothing block unpublish would restart from member zero on
+        every failure (code-review r5)."""
         with self._lock:
-            vol = self._csi_volumes.get((namespace, vol_id))
-            if vol is None or block_id not in vol.read_blocks:
-                return self._index
-            idx = self._bump_placement()
-            self._volume_seq += 1
-            import dataclasses
-            v = dataclasses.replace(
-                vol, read_blocks={k: b for k, b in vol.read_blocks.items()
-                                  if k != block_id})
-            self._csi_volumes = {**self._csi_volumes,
-                                 (namespace, vol_id): v}
-            self._emit("CSIVolume", idx, v)
-            return idx
+            return self._convert_block_claim_locked(namespace, vol_id,
+                                                    block_id)
+
+    def _convert_block_claim_locked(self, namespace: str, vol_id: str,
+                                    block_id: str) -> int:
+        vol = self._csi_volumes.get((namespace, vol_id))
+        if vol is None or block_id not in vol.read_blocks:
+            return self._index
+        idx = self._bump_placement()
+        self._volume_seq += 1
+        import dataclasses
+        block = vol.read_blocks[block_id]
+        reads = dict(vol.read_allocs)
+        reads.update(dict.fromkeys(block.ids, ""))
+        v = dataclasses.replace(
+            vol, read_allocs=reads,
+            read_blocks={k: b for k, b in vol.read_blocks.items()
+                         if k != block_id})
+        self._csi_volumes = {**self._csi_volumes, (namespace, vol_id): v}
+        self._fresh_claim_vols.discard((namespace, vol_id))
+        self._emit("CSIVolume", idx, v)
+        return idx
 
     def release_csi_claim(self, namespace: str, vol_id: str,
                           alloc_id: str) -> int:
@@ -1201,18 +1227,16 @@ class StateStore:
             # path); the restored store starts block-free.  Flattening
             # migrates block claims to per-alloc claims, so volumes
             # serialize without block references — any LEFTOVER block
-            # claim references a vanished block (the watcher's reap case:
-            # a dead claim) and is dropped rather than serialized.
+            # claim references a vanished block (the watcher's reap
+            # case) and CONVERTS to per-alloc claims rather than being
+            # dropped: the restored store's volume watcher must still
+            # unpublish each member before releasing (detach-before-
+            # release survives a snapshot/restore cycle)
             for b in list(self._alloc_blocks.values()):
                 self._materialize_block_locked(b)
-            import dataclasses
-            stale_vols = {}
-            for key, v in self._csi_volumes.items():
-                if v.read_blocks:
-                    stale_vols[key] = dataclasses.replace(
-                        v, read_blocks={})
-            if stale_vols:
-                self._csi_volumes = {**self._csi_volumes, **stale_vols}
+            for key, v in list(self._csi_volumes.items()):
+                for bid in list(v.read_blocks):
+                    self._convert_block_claim_locked(key[0], v.id, bid)
             allocs = []
             for a in self._allocs.values():
                 slim = a.copy_skip_job()
@@ -1289,8 +1313,10 @@ class StateStore:
             self._blocks_by_node = {}
             self._alloc_tables_shared = False
             self._block_tables_shared = False
+            self._eval_tables_shared = False
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
+            self._fresh_eval_buckets = set()
             self._fresh_claim_vols = set()
             for d in doc["Allocs"]:
                 a = codec.decode(Allocation, d)
@@ -1372,8 +1398,10 @@ class StateStore:
             # alloc write copies before mutating (see _insert_allocs)
             self._alloc_tables_shared = True
             self._block_tables_shared = True
+            self._eval_tables_shared = True
             self._fresh_node_buckets = set()
             self._fresh_job_buckets = set()
+            self._fresh_eval_buckets = set()
             self._fresh_claim_vols = set()
             return StateSnapshot(
                 placement_fence=self._placement_seq,

@@ -576,10 +576,16 @@ class TestColumnarBlockClaims:
             bj.pop(jkey, None)
             for nid in blk.node_table:
                 bn.pop(nid, None)
+        # the sweep CONVERTS the block claim to per-alloc claims (so
+        # each member rides the unpublish-with-backoff ladder
+        # independently) and, with the default always-succeeding
+        # unpublish, reaps all of them in the same tick
         released = s.volumes.tick(NOW + 1)
-        assert released == 1
+        assert released == 64
         vol2 = s.state.snapshot().csi_volume_by_id("default", "vol-b")
         assert vol2.read_blocks == {}
+        assert vol2.read_allocs == {}
+        assert s.state.delete_csi_volume("default", "vol-b") is None
 
     def test_block_claims_snapshot_isolated_from_per_alloc_cow(self):
         """Mixed per-alloc + block claims in ONE snapshot cycle: the
@@ -655,3 +661,30 @@ class TestColumnarBlockClaims:
             assert doc.get("ReadBlocks") in (None, {})
         finally:
             ag.shutdown()
+
+    def test_vanished_block_claim_survives_snapshot_roundtrip(self):
+        """A vanished-block claim at snapshot-save time must CONVERT to
+        per-alloc claims in the document, not silently drop — the
+        restored store's watcher still owes each member an unpublish
+        before release (detach-before-release survives restore)."""
+        from nomad_tpu.state.state_store import StateStore
+
+        s = Server(dev_mode=True, heartbeat_ttl=1e9)
+        s.establish_leadership()
+        self._place_block(s, count=64)
+        vol = s.state.snapshot().csi_volume_by_id("default", "vol-b")
+        (bid,) = vol.read_blocks
+        member_ids = set(vol.read_blocks[bid].ids)
+        with s.state.locked():
+            blocks, bj, bn = s.state._writable_block_tables()
+            blk = blocks.pop(bid)
+            jkey = (blk.template.namespace, blk.template.job_id)
+            bj.pop(jkey, None)
+            for nid in blk.node_table:
+                bn.pop(nid, None)
+        doc = s.state.snapshot_save()
+        st2 = StateStore()
+        st2.snapshot_restore(doc)
+        v2 = st2.csi_volume_by_id("default", "vol-b")
+        assert v2.read_blocks == {}
+        assert set(v2.read_allocs) == member_ids
