@@ -139,8 +139,21 @@ def new_ids(count: int) -> List[str]:
     return [big[i:i + 36] for i in range(0, 36 * count, 36)]
 
 
+_ID_POOL: List[str] = []
+
+
 def new_id() -> str:
-    return new_ids(1)[0]
+    """Single id from a pre-minted pool (one urandom syscall per 256
+    ids): a wave mints ~4 singles per eval — plan ids, block ids,
+    delivery tokens — and per-call urandom+hex was ~20µs each.  Pop is
+    atomic under the GIL, so concurrent workers never share an id; a
+    torn pool refill at worst wastes entropy, never duplicates."""
+    pool = _ID_POOL
+    while True:
+        try:
+            return pool.pop()     # atomic under the GIL
+        except IndexError:        # empty (or raced empty): refill+retry
+            pool.extend(new_ids(256))
 
 
 # ---------------------------------------------------------------------------
