@@ -789,8 +789,9 @@ def run_config_5(args):
     # dominated), take a few extra samples rather than publish the
     # tunnel's mood as the build's rate.  Capped — a long slow window
     # cannot be outwaited, only documented (PERF.md §3).
-    # the 0.75s good-window threshold is calibrated to the default
-    # full scale; smaller shapes just run the plain best-of-iters
+    # the 0.6s good-window threshold is calibrated to the default
+    # full scale post round-5 host cuts (good windows measure
+    # 0.36-0.51s); smaller shapes just run the plain best-of-iters
     # (gate on the REQUESTED total: per-eval rounding leaves n_place
     # slightly under the ask at the default shape)
     n_place = n_evals * per_eval
@@ -816,7 +817,7 @@ def run_config_5(args):
             if _PHASES is not None:
                 phases = _PHASES.report()
         i += 1
-        if i >= iters and (not full_scale or dt < 0.75):
+        if i >= iters and (not full_scale or dt < 0.6):
             break          # a good-window sample exists; stop
     iters = i
     wave_jobs = first_jobs
