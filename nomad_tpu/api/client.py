@@ -83,6 +83,12 @@ class APIClient:
     def delete(self, path, **params):
         return self.request("DELETE", path, params=params)
 
+    def search(self, prefix: str, context: str = "all") -> Dict:
+        """Prefix search over ids (reference: api/search.go
+        Search.PrefixSearch; backs the CLI's unique-prefix resolution)."""
+        return self.put("/v1/search",
+                        body={"Prefix": prefix, "Context": context})
+
 
 class _Endpoint:
     def __init__(self, client: APIClient) -> None:

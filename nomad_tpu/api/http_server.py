@@ -218,6 +218,13 @@ class Router:
         if acl is None:
             raise APIError(403, err or "permission denied")
         write = method in ("PUT", "POST", "DELETE")
+        if head == "search":
+            # prefix search mutates nothing — it is a READ carried over
+            # PUT/POST for the request body (reference: Search.PrefixSearch
+            # runs under read capabilities); classifying it as a write
+            # would break the CLI's id-prefix resolution for read-only
+            # tokens
+            write = False
         if head == "acl":
             if not acl.is_management():
                 raise APIError(403, "permission denied: management required")

@@ -40,6 +40,28 @@ def _client(args) -> APIClient:
                      token=token, region=region)
 
 
+def _resolve(c: APIClient, context: str, ident: str) -> str:
+    """Unique-id-prefix resolution via /v1/search (reference: every
+    id-taking command accepts a unique prefix — the CLI itself prints
+    8-char ids, so its own output must round-trip).  Full-length ids
+    pass through untouched; an unknown prefix is left for the endpoint's
+    own 404; an ambiguous one is a hard error listing the count."""
+    if not ident or len(ident) >= 36:
+        return ident
+    try:
+        matches = (c.search(ident, context).get("Matches", {})
+                   .get(context, []))
+    except Exception:  # noqa: BLE001 - resolution is best-effort
+        return ident
+    if len(matches) == 1:
+        return matches[0]
+    if len(matches) > 1:
+        raise SystemExit(
+            f"Error: id prefix {ident!r} is ambiguous "
+            f"({len(matches)} matches)")
+    return ident
+
+
 def _out(data) -> None:
     print(json.dumps(data, indent=2, sort_keys=True))
 
@@ -896,7 +918,12 @@ def build_parser() -> argparse.ArgumentParser:
     ns_.set_defaults(fn=cmd_node_status)
     nd = node.add_parser("drain")
     nd.add_argument("node_id")
-    nd.add_argument("-disable", action="store_true")
+    # reference muscle memory: `nomad node drain -enable <id>` — enabling
+    # is this command's default, so the flag is accepted and redundant;
+    # contradictory -enable -disable is a parse error
+    nd_mode = nd.add_mutually_exclusive_group()
+    nd_mode.add_argument("-enable", action="store_true")
+    nd_mode.add_argument("-disable", action="store_true")
     nd.add_argument("-deadline", type=float, default=3600)
     nd.add_argument("-ignore-system", dest="ignore_system",
                     action="store_true")
@@ -1164,10 +1191,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+_RESOLVE_ATTRS = (("node_id", "nodes"), ("alloc_id", "allocs"),
+                  ("eval_id", "evals"), ("deployment_id", "deployment"))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import urllib.error
     args = build_parser().parse_args(argv)
     try:
+        # unique-prefix resolution for every id-taking command, once,
+        # here — the CLI prints 8-char ids and they must round-trip
+        for attr, ctx in _RESOLVE_ATTRS:
+            val = getattr(args, attr, "")
+            if val:
+                setattr(args, attr, _resolve(_client(args), ctx, val))
         return args.fn(args)
     except APIException as e:
         print(f"Error: {e}", file=sys.stderr)
