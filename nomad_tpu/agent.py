@@ -160,7 +160,7 @@ class Agent:
         """Load-bearing series per SURVEY.md §6.5."""
         s = self.server
         snap = s.state.snapshot()
-        return {
+        out = {
             "nomad.broker.total_ready": s.eval_broker.pending_evals(),
             "nomad.broker.acked": s.eval_broker.stats["acked"],
             "nomad.broker.nacked": s.eval_broker.stats["nacked"],
@@ -173,3 +173,14 @@ class Agent:
             "nomad.state.nodes": len(snap.nodes()),
             "nomad.state.jobs": len(snap.jobs()),
         }
+        # wavepipe per-stage wall totals + the overlap gauges that prove
+        # host commit hides under device compute (core/wavepipe.py)
+        timers = getattr(s, "stage_timers", None)
+        if timers is not None:
+            rep = timers.report()
+            for stage, secs in rep["stage_s"].items():
+                out[f"nomad.wavepipe.{stage}_s"] = secs
+            for pair, secs in rep["overlap_s"].items():
+                key = pair.replace("*", "_")
+                out[f"nomad.wavepipe.overlap.{key}_s"] = secs
+        return out

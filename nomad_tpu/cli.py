@@ -55,6 +55,10 @@ def _resolve(c: APIClient, context: str, ident: str) -> str:
         return ident
     if len(matches) == 1:
         return matches[0]
+    if ident in matches:
+        # an exact id that is also a prefix of others (node-1 next to
+        # node-10) resolves to itself, never to an ambiguity error
+        return ident
     if len(matches) > 1:
         raise SystemExit(
             f"Error: id prefix {ident!r} is ambiguous "

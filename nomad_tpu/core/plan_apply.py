@@ -162,6 +162,10 @@ class PlanApplier:
         # rejected instead of double-committing (reference: the EvalToken
         # check at plan submission)
         self.token_check = None
+        # optional wavepipe.StageTimers (wired by the Server): each
+        # apply records one "commit" interval so the pipeline's overlap
+        # of host commit under device compute is measurable
+        self.timers = None
 
     # ------------------------------------------------------------ running
 
@@ -195,6 +199,13 @@ class PlanApplier:
         return nodes
 
     def apply_one(self, pending: PendingPlan) -> None:
+        if self.timers is not None:
+            with self.timers.time("commit"):
+                self._apply_one(pending)
+        else:
+            self._apply_one(pending)
+
+    def _apply_one(self, pending: PendingPlan) -> None:
         plan = pending.plan
         try:
             if (self.token_check is not None and plan.eval_token
@@ -313,13 +324,13 @@ class PlanApplier:
         # admitting one on a credit that may be withheld is the exact bug
         # this accounting exists to prevent.  Plans without volume claims
         # accept every node in pass one — no extra cost.
-        # Columnar blocks: on the full-check path they expand to per-node
-        # lists (AllocsFit needs them); on the fenced fast path a quick
-        # whole-block check (nodes up, volumes schedulable, no write
-        # claims) accepts them WHOLESALE — per-node granularity is only
-        # bought when something actually needs refuting.
-        if plan.alloc_blocks and not skip_fit:
-            plan.expand_blocks()
+        # Columnar blocks stay COLUMNAR on every path (wavepipe): the
+        # fenced fast path accepts them wholesale (_blocks_ok); the
+        # full-check path re-checks per node ON THE PICK ARRAYS
+        # (_eval_blocks: node status, volume schedulability, vectorized
+        # cpu/mem/disk fit from block.demand_by_node) and refutes by
+        # masking rows out of the block — per-alloc materialization only
+        # happens for shapes the arrays cannot express.
         final_refused: List[str] = []
         fit_cleared: set = set()      # claim-deferred nodes already fit-checked
         # live-head claim dicts can mutate in place between snapshots;
@@ -334,10 +345,11 @@ class PlanApplier:
             result.volume_seq = (self.state.volume_seq()
                                  if snap is self.state else None)
             if plan.alloc_blocks:
-                if self._blocks_ok(snap, plan):
+                if skip_fit and self._blocks_ok(snap, plan):
                     result.alloc_blocks = list(plan.alloc_blocks)
                 else:
-                    plan.expand_blocks()    # rare: something needs refuting
+                    self._eval_blocks(snap, plan, result, final_refused,
+                                      skip_fit)
             pending_nodes = sorted(
                 plan.node_allocation,
                 key=lambda nid: not (nid in plan.node_update
@@ -418,6 +430,131 @@ class PlanApplier:
                         # for readers (a block can span nodes)
                         return False
         return True
+
+    @staticmethod
+    def _block_demotes(snap, block, pa_nodes) -> bool:
+        """Shapes whose re-check the columnar path cannot express — the
+        same demotions _blocks_ok applies (ports/devices/networks, write
+        claims, node-pinned volume modes), plus nodes shared with
+        per-alloc placements (their fit must be checked TOGETHER, which
+        only the expanded per-node path does)."""
+        tmpl = block.template
+        if (tmpl.allocated_ports or tmpl.allocated_devices
+                or tmpl.resources.networks):
+            return True
+        if pa_nodes and not pa_nodes.isdisjoint(block.node_table):
+            return True
+        job = tmpl.job
+        tg = job.lookup_task_group(tmpl.task_group) if job else None
+        if tg is not None and tg.volumes:
+            for vreq in tg.volumes.values():
+                if vreq.type != "csi" or not vreq.source:
+                    continue
+                if not vreq.read_only:
+                    return True         # per-alloc writer accounting
+                vol = snap.csi_volume_by_id(tmpl.namespace, vreq.source)
+                if vol is not None and vol.single_node():
+                    return True         # node-pinned modes: per-node path
+        return False
+
+    def _eval_blocks(self, snap, plan: Plan, result: PlanResult,
+                     final_refused: List[str], skip_fit: bool) -> None:
+        """Per-node re-check of columnar blocks ON THE PICK ARRAYS (the
+        wavepipe commit stage): node existence/status, whole-block
+        volume presence + schedulability, and — unless the fence proved
+        it redundant — a cpu/mem/disk fit per touched node, with block
+        demand from `AllocBlock.demand_by_node` and existing usage
+        summed once per node.  Failing nodes refute COLUMNAR: their
+        rows are masked out (`AllocBlock.without_nodes`) and the node
+        ids join `final_refused`; blocks the arrays cannot express
+        expand into node_allocation and ride the per-node loop."""
+        columnar = []
+        pa_nodes = set(plan.node_allocation)
+        for block in list(plan.alloc_blocks):
+            if self._block_demotes(snap, block, pa_nodes):
+                plan.alloc_blocks.remove(block)
+                for a in block.materialize_all():
+                    plan.node_allocation.setdefault(a.node_id,
+                                                    []).append(a)
+            else:
+                columnar.append(block)
+        # expansion may land rows on a columnar block's nodes: demote
+        # those too, to a fixpoint (plans carry O(1) blocks in practice)
+        changed = bool(columnar)
+        while changed:
+            changed = False
+            pa_nodes = set(plan.node_allocation)
+            for block in list(columnar):
+                if pa_nodes and not pa_nodes.isdisjoint(block.node_table):
+                    columnar.remove(block)
+                    for a in block.materialize_all():
+                        plan.node_allocation.setdefault(a.node_id,
+                                                        []).append(a)
+                    changed = True
+        if not columnar:
+            return
+        bad: set = set()
+        # whole-block volume verdicts (uniform across a block's rows:
+        # only read-only multi-node claims reach this path)
+        for b in columnar:
+            tmpl = b.template
+            job = tmpl.job
+            tg = job.lookup_task_group(tmpl.task_group) if job else None
+            if tg is None or not tg.volumes:
+                continue
+            for vreq in tg.volumes.values():
+                if vreq.type != "csi" or not vreq.source:
+                    continue
+                vol = snap.csi_volume_by_id(tmpl.namespace, vreq.source)
+                if vol is None or not vol.schedulable:
+                    bad.update(b.node_table)
+                    break
+        # per-node demand aggregated ACROSS blocks (two blocks on one
+        # node were fit-checked together on the expanded path)
+        total: Dict[str, List[int]] = {}
+        for b in columnar:
+            for nid, (_, cpu, mem, disk) in b.demand_by_node().items():
+                acc = total.get(nid)
+                if acc is None:
+                    total[nid] = [cpu, mem, disk]
+                else:
+                    acc[0] += cpu
+                    acc[1] += mem
+                    acc[2] += disk
+        for nid, (cpu, mem, disk) in total.items():
+            if nid in bad:
+                continue
+            node = snap.node_by_id(nid)
+            if node is None or node.status == "down":
+                bad.add(nid)
+                continue
+            if skip_fit:
+                continue
+            removals = {a.id for a in plan.node_update.get(nid, ())}
+            removals.update(
+                a.id for a in plan.node_preemptions.get(nid, ()))
+            for a in snap.allocs_by_node(nid):
+                if a.terminal_status() or a.id in removals:
+                    continue
+                cpu += a.resources.cpu
+                mem += a.resources.memory_mb
+                disk += a.resources.disk_mb
+            res, rsv = node.resources, node.reserved
+            if (cpu > res.cpu - rsv.cpu
+                    or mem > res.memory_mb - rsv.memory_mb
+                    or disk > res.disk_mb - rsv.disk_mb):
+                bad.add(nid)
+        refused: set = set()
+        for b in columnar:
+            bad_b = bad.intersection(b.node_table)
+            if not bad_b:
+                result.alloc_blocks.append(b)
+                continue
+            refused |= bad_b
+            kept = b.without_nodes(bad_b)
+            if kept is not None:
+                result.alloc_blocks.append(kept)
+        final_refused.extend(sorted(refused))
 
     @staticmethod
     def _carries_host_assigned(plan: Plan) -> bool:

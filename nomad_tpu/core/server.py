@@ -47,6 +47,7 @@ from .stream import EventBroker
 from .heartbeat import HeartbeatTimers, build_node_evals, invalidate_heartbeat
 from .plan_apply import PlanApplier, PlanQueue
 from .volume_watcher import VolumeWatcher
+from .wavepipe import StageTimers
 from .worker import Worker
 
 
@@ -79,6 +80,12 @@ class Server:
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.state, self.plan_queue)
+        # shared per-stage wall-interval timers (core/wavepipe.py): the
+        # workers' WavePipelines record dispatch/device/d2h/materialize,
+        # the applier records commit — one clock, so the device↔commit
+        # overlap is measurable (exported via /v1/metrics, bench.py)
+        self.stage_timers = StageTimers()
+        self.plan_applier.timers = self.stage_timers
         # stale-delivery gate: a worker that held evals past the
         # redelivery deadline (device compile) must not double-commit
         # concurrently with the redelivery's worker

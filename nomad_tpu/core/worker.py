@@ -13,6 +13,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.core.logging import log
+from nomad_tpu.core.wavepipe import WavePipeline
 from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.scheduler import new_scheduler
 from nomad_tpu.structs import Evaluation, Plan, PlanResult, new_id
@@ -50,6 +51,12 @@ class Worker:
         # the scheduler ran with, not a fresh wall-clock read (tests and
         # deterministic replays inject synthetic time)
         self._now: Optional[float] = None
+        # the wave pipeline (core/wavepipe.py): every batched launch
+        # dispatches/collects through it, so wave sequencing, stage
+        # timers, and the refuted-node mask are shared machinery — the
+        # server's StageTimers make the device/commit overlap provable
+        self.pipeline = WavePipeline(
+            server.engine, getattr(server, "stage_timers", None))
         # cross-batch pipeline: a dequeued batch whose kernel launch was
         # dispatched (chained on the previous batch's device-side
         # proposed usage) while the previous batch's host phase ran
@@ -228,11 +235,15 @@ class Worker:
                 batch_id, used_dev = new_id(), None
             items = [BatchItem(job=w[3].job, tg=w[3].tg, count=w[3].count)
                      for _, w in prepared]
-            seed = (zlib.crc32(prepared[0][1][0].id.encode())
-                    & 0xFFFFFFFF) or 1
+            # per-item seeds, the SAME formula GenericScheduler.process
+            # uses at attempt 0: an eval drawing identical tie-break
+            # noise on the batched and solo paths is what makes the
+            # wave pipeline's output bit-identical to serial processing
+            seeds = [(zlib.crc32(w[0].id.encode()) & 0xFFFFFFFF) or 1
+                     for _, w in prepared]
             try:
-                pending = self.server.engine.dispatch_batch(
-                    snapshot, items, seed=seed, used0_dev=used_dev)
+                pending = self.pipeline.dispatch(
+                    snapshot, items, seed=seeds, used0_dev=used_dev)
                 prepared_idx = [i for i, _ in prepared]
                 # the batch now heads into a device wait that may include
                 # a first-time compile: restart the delivery deadlines so
@@ -267,7 +278,7 @@ class Worker:
         self._batch_tokens = {ev.id: token for ev, token in pf["batch"]}
         bds = {}
         if pf["pending"] is not None:
-            decisions = self.server.engine.collect_batch(pf["pending"])
+            decisions = self.pipeline.collect(pf["pending"])
             # the collect may have sat in a first-time device compile for
             # longer than the redelivery deadline: restart the batch's
             # deadlines so the HOST phase doesn't run superseded (plans
@@ -282,17 +293,15 @@ class Worker:
         # through it while this thread runs phase 3.  Chained decisions
         # start from this batch's proposed usage — a superset of what
         # will commit, so they can under-pack but never oversubscribe.
-        if (isinstance(pf["pending"], dict) and bds
+        chain_used = self.pipeline.chain_state(pf["pending"])
+        if (chain_used is not None and bds
                 and len(bds) == len(work) and not self._stop.is_set()):
             nxt = self.server.eval_broker.dequeue_batch(
                 SCHEDULERS_SERVED, max_n, now=t, timeout=0.0)
             if nxt:
                 try:
-                    p = pf["pending"]
                     self._prefetch = self._start_batch(
-                        nxt, t, chain=(batch_id, batch_seq0,
-                                       (p["used"], p["node_version"],
-                                        p["npad"])))
+                        nxt, t, chain=(batch_id, batch_seq0, chain_used))
                 except Exception as e:  # noqa: BLE001 - hand them back
                     log("worker", "warn", "prefetch dispatch failed",
                         worker=self.id, error=repr(e))
@@ -317,13 +326,16 @@ class Worker:
         # groups ride the batch without colliding)
         shared_net: Dict[str, object] = {}
 
+        wave = pf["pending"].wave if pf["pending"] is not None else -1
+
         def submit(i):
             ev, token, sched, prep = work[i]
             try:
-                handles[i] = sched.submit_batched(
-                    ev, prep, bds[i],
-                    coupled_batch=(batch_id, batch_seq0),
-                    net_index_cache=shared_net)
+                with self.pipeline.materialize(wave):
+                    handles[i] = sched.submit_batched(
+                        ev, prep, bds[i],
+                        coupled_batch=(batch_id, batch_seq0),
+                        net_index_cache=shared_net)
             except Exception as e:  # noqa: BLE001 - finalize pass nacks
                 handles[i] = e
 
@@ -356,7 +368,8 @@ class Worker:
                     if isinstance(h, Exception):
                         err = h
                     else:
-                        err = (sched.finalize_batched(ev, h)
+                        err = (sched.finalize_batched(
+                                   ev, h, pipeline=self.pipeline)
                                if h is not None
                                else sched.process(ev))  # solo fallback
                 except Exception as e:  # noqa: BLE001 - nack, don't die

@@ -33,7 +33,8 @@ from .feasibility import constraint_mask, feasible_mask_jit
 from .preempt import Preemptor, preemption_enabled
 from .select import (
     BulkInputs, FILL_K, MultiEvalInputs, PlacementInputs,
-    place_bulk_packed_jit, place_multi_compact_packed_jit,
+    place_bulk_packed_jit, place_multi_chained_jit,
+    place_multi_compact_chained_jit, place_multi_compact_packed_jit,
     place_multi_packed_jit, place_packed_jit)
 
 # Minimum homogeneous batch size before the rounds-based bulk kernel beats
@@ -1020,6 +1021,9 @@ class PlacementEngine:
         Each item is one eval's (job, task group, count) block; rounds
         run sequentially on device so the items' plans see each other's
         proposed usage and cannot refute each other at the applier.
+        `seed` may be a single int (broadcast) or one per item — the
+        worker passes each eval's solo-path seed so batched picks match
+        the serial path tie-for-tie.
         Returns one BulkDecisions per item (None when the cluster is
         empty).  Preemption is NOT attempted here — a caller seeing
         failed picks with preemption enabled should fall back to the
@@ -1028,7 +1032,8 @@ class PlacementEngine:
         return self.collect_batch(pending)
 
     def dispatch_batch(self, snapshot, items: Sequence[BatchItem],
-                       seed: int = 0, used0_dev=None):
+                       seed: int = 0, used0_dev=None,
+                       masked_node_ids=None):
         """Asynchronous half of place_batch: pack + LAUNCH the kernel and
         return a pending handle (kernel dispatch does not block; the
         device computes while the host does other work — collect_batch
@@ -1044,14 +1049,25 @@ class PlacementEngine:
         version/padding guard matters: a node-table rebuild (membership
         or attribute change) remaps rows, and per-node usage applied to
         remapped rows would credit load to the wrong nodes — on any
-        mismatch the chain falls back to the packer-synced tensor."""
+        mismatch the chain falls back to the packer-synced tensor.
+        Accepted chains launch through the DONATED-usage jit variants
+        (select.place_multi_chained): the previous wave's buffer is dead
+        once consumed, so XLA reuses its allocation in place.
+
+        `masked_node_ids`: node ids excluded from this launch's
+        eligibility — the wave pipeline's refute-repair input
+        (core/wavepipe.py): a chained launch's usage buffer predates the
+        foreign write that refuted these nodes, so masking is the only
+        way the kernel can avoid re-picking them."""
         if not items:
             return None
         built = self.build_multi_inputs(snapshot, items, seed=seed,
-                                        used0_dev=used0_dev)
+                                        used0_dev=used0_dev,
+                                        masked_node_ids=masked_node_ids)
         if isinstance(built, tuple):
             return built                 # empty-cluster sentinel
         inp, rs, aux = built["inp"], built["rs"], built
+        chained = aux.get("chained", False)
         fills_full = None
         fill_k = None
         if aux["cand_rows"] is not None:
@@ -1060,6 +1076,11 @@ class PlacementEngine:
             if self.mesh is not None:
                 buf, fills_full, used_out = self._sharded(
                     "multi_compact", rs, aux["n_lanes"])(inp, cr, cv)
+            elif chained:
+                buf, fills_full, used_out = \
+                    place_multi_compact_chained_jit(
+                        inp.used0, inp._replace(used0=None), cr, cv,
+                        rs, aux["n_lanes"])
             else:
                 buf, fills_full, used_out = \
                     place_multi_compact_packed_jit(
@@ -1067,6 +1088,9 @@ class PlacementEngine:
             fill_k = min(FILL_K, rs)
         elif self.mesh is not None:
             buf, used_out, _ = self._sharded("multi", rs)(inp)
+        elif chained:
+            buf, used_out, _ = place_multi_chained_jit(
+                inp.used0, inp._replace(used0=None), rs)
         else:
             buf, used_out, _ = place_multi_packed_jit(inp, rs)
         # start the device->host copy of the result buffer NOW: over the
@@ -1089,13 +1113,20 @@ class PlacementEngine:
                 "prep_ns": time.perf_counter_ns() - aux["t0"]}
 
     def build_multi_inputs(self, snapshot, items: Sequence[BatchItem],
-                           seed: int = 0, used0_dev=None):
+                           seed: int = 0, used0_dev=None,
+                           masked_node_ids=None):
         """Host half of dispatch_batch: pack + lower a multi-eval batch
         into MultiEvalInputs WITHOUT launching.  Exposed so non-JAX
         launchers (the C++ PJRT bridge, bench --bridge) can export the
         exact production kernel + inputs at any scale.  Returns a dict
-        {inp, rs, spans, counts, t, ctxs, n, npad, t0} or the
-        empty-cluster sentinel tuple."""
+        {inp, rs, spans, counts, t, ctxs, n, npad, t0, chained} or the
+        empty-cluster sentinel tuple.
+
+        `masked_node_ids` (wavepipe refute-repair): these nodes are
+        dropped from the launch's eligibility — ANDed into the device
+        elig tensor for the flat/sharded kernels and into the host-side
+        signature masks the compact candidate frames are built from, so
+        both kernel layouts honor the mask identically."""
         t = self.packer.update(snapshot)
         n = t.n
         if n == 0:
@@ -1108,12 +1139,38 @@ class PlacementEngine:
             arr, chain_ver, chain_npad = used0_dev
             if chain_ver == t.version and chain_npad == npad:
                 used0 = arr
+        chained = used0 is not None
         if used0 is None:
             used0 = self._used_device(t)
+        # refuted-node mask: host bool overlay ANDed into eligibility
+        # (one tiny upload; the node tensor caches stay untouched)
+        elig_dev = dev["elig"]
+        node_ok = None
+        if masked_node_ids:
+            rows = np.array([t.id_to_row[nid] for nid in masked_node_ids
+                             if nid in t.id_to_row], np.int64)
+            if rows.size:
+                node_ok = np.ones(npad, bool)
+                node_ok[rows] = False
+                elig_dev = elig_dev & jnp.asarray(node_ok)
         algo = snapshot.scheduler_config().scheduler_algorithm
 
         G = len(items)
         g_pad = _pad_pow2(G, lo=1)
+        # per-item tie-break seeds (select.MultiEvalInputs.seed): a
+        # scalar broadcasts (legacy callers / bench); the worker passes
+        # one seed per eval — the SAME value the eval's solo launch
+        # would use — so batched and solo paths draw identical noise
+        # and the wave pipeline's serial/pipelined parity is exact
+        if np.ndim(seed) == 0:
+            seed_g = np.full(g_pad, int(seed) & 0xFFFFFFFF, np.uint32)
+        else:
+            seeds = [int(s) & 0xFFFFFFFF for s in seed]
+            if len(seeds) != G:
+                raise ValueError(
+                    f"per-item seeds: got {len(seeds)} for {G} items")
+            seed_g = np.zeros(g_pad, np.uint32)
+            seed_g[:G] = seeds
         tgts = []
         ctxs = []
         for it in items:
@@ -1250,6 +1307,10 @@ class PlacementEngine:
                     t.attrs, t.elig,
                     [mask_np[static_mi[s]] for s in clique],
                     [static_con[s] for s in clique], luts)
+                if node_ok is not None:
+                    # the frame IS the static mask on the compact path:
+                    # refuted nodes leave the candidate set here
+                    masks = masks & node_ok[:n][None, :]
                 rows_l = [np.nonzero(masks[i])[0].astype(np.int32)
                           for i in range(width)]
                 if self.mesh is None:
@@ -1350,7 +1411,7 @@ class PlacementEngine:
 
         inp = MultiEvalInputs(
             attrs=dev["attrs"], cap=dev["cap"], used0=used0,
-            elig=dev["elig"], luts=luts_dev, base_mask=base_mask,
+            elig=elig_dev, luts=luts_dev, base_mask=base_mask,
             con=jnp.asarray(con), u_mask=jnp.asarray(u_mask),
             aff=jnp.asarray(aff),
             req=jnp.asarray(req), desired=jnp.asarray(desired),
@@ -1361,11 +1422,11 @@ class PlacementEngine:
             spread_algo=jnp.asarray(algo == SCHED_ALGO_SPREAD),
             round_g=jnp.asarray(np.array(round_g, np.int32)),
             round_want=jnp.asarray(np.array(round_want, np.int32)),
-            seed=jnp.asarray(seed & 0xFFFFFFFF, jnp.uint32),
+            seed=jnp.asarray(seed_g),
         )
         return {"inp": inp, "rs": rs, "spans": spans, "counts": counts,
                 "t": t, "ctxs": ctxs, "n": n, "npad": npad, "t0": t0,
-                "n_lanes": n_lanes, "perm": perm,
+                "n_lanes": n_lanes, "perm": perm, "chained": chained,
                 "cand_rows": cand_rows, "cand_valid": cand_valid}
 
     def collect_batch(self, pending) -> List[Optional[BulkDecisions]]:

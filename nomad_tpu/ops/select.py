@@ -713,7 +713,21 @@ class MultiEvalInputs(NamedTuple):
     # ceil(c / round_size) consecutive rounds; padding rounds want=0)
     round_g: jnp.ndarray     # [R] int32
     round_want: jnp.ndarray  # [R] int32
+    # PER-ITEM tie-break seeds, [G] uint32 (a scalar broadcasts): each
+    # eval's rounds draw the SAME noise its solo-path launch would — the
+    # wave pipeline's serial/pipelined parity depends on it (a single
+    # wave-wide seed made batched picks diverge from the solo path on
+    # every exact score tie)
     seed: jnp.ndarray = jnp.uint32(0)
+
+
+def round_seeds(seed, rg):
+    """Per-round seed values from the per-item [G] seed vector gathered
+    by the round schedule (a scalar seed broadcasts to every round)."""
+    seed = jnp.asarray(seed, jnp.uint32)
+    if seed.ndim == 0:
+        return jnp.broadcast_to(seed, rg.shape)
+    return seed[rg]
 
 
 def place_multi_packed(inp: MultiEvalInputs, round_size: int):
@@ -755,14 +769,19 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
     # their job_count0 row)
     same_r = jnp.concatenate([jnp.zeros(1, bool),
                               jobs_r[1:] == jobs_r[:-1]])
-    noise = tiebreak_noise(inp.seed, jnp.arange(n))
+    seed_r = round_seeds(inp.seed, rg)
+    rows_all = jnp.arange(n)
 
     def round_step(carry, xs):
         used, cur_count = carry
-        (u, a, jc0_row, req, desired, dh_limit, want, same) = xs
+        (u, a, jc0_row, req, desired, dh_limit, want, same, sd) = xs
         static = static_u[u]          # [N]; U is tiny — cheap gather
         aff_sc = aff_u[a]
         aff_any = aff_any_u[a]
+        # per-item noise (elementwise hash — no [R, N] pre-gather): the
+        # round draws its EVAL's tie-break stream, matching what the
+        # solo bulk kernel computes for the same eval id
+        noise = tiebreak_noise(sd, rows_all)
         job_count = jnp.where(same, cur_count, jc0_row)
         k_i, score = round_scores_g(
             inp.cap, req, desired, dh_limit, static,
@@ -789,7 +808,8 @@ def place_multi_packed(inp: MultiEvalInputs, round_size: int):
     carry0 = (inp.used0, inp.job_count0[0])
     (used, jc), outs = jax.lax.scan(
         round_step, carry0,
-        (u_r, a_r, jc_r, req_r, des_r, dh_r, inp.round_want, same_r))
+        (u_r, a_r, jc_r, req_r, des_r, dh_r, inp.round_want, same_r,
+         seed_r))
     (rows_p, cnt_p, sc_p, top_rows, top_sc,
      n_feas, n_filt, n_exh, dim_ex, placed) = outs
     fills, meta = pack_round_buffer(rows_p, cnt_p, top_rows, top_sc,
@@ -861,9 +881,9 @@ def place_multi_compact_packed(inp: MultiEvalInputs, cand_rows, cand_valid,
         lambda a: affinity_score(inp.attrs[a], inp.aff, inp.luts)
     )(cand_rows)                                       # [L, Ua, Nc]
     aff_any_u = jnp.any(inp.aff[..., 3] != 0, axis=1)  # [Ua]
-    noise_c = tiebreak_noise(inp.seed, cand_rows)      # [L, Nc]
 
     rg = inp.round_g.reshape(-1, n_lanes)              # [T, L]
+    seed_r = round_seeds(inp.seed, rg)                 # [T, L]
     a_r = inp.g_aff[rg]
     # job-count seeds are the COMPACT [J', Nc] table the engine built
     # (row 0 = zeros for fresh jobs, one row per job with live allocs,
@@ -893,11 +913,14 @@ def place_multi_compact_packed(inp: MultiEvalInputs, cand_rows, cand_valid,
 
     def lane_step(carry, xs):
         used_c, cur_count = carry        # [L, Nc, 3], [L, Nc]
-        (a, jrow, req, desired, dh_limit, want, same) = xs
+        (a, jrow, req, desired, dh_limit, want, same, sd) = xs
         jc0 = inp.job_count0[jrow]                     # [L, Nc] tiny gather
         aff_sc = jnp.take_along_axis(
             aff_cu, a[:, None, None], axis=1)[:, 0]    # [L, Nc]
         aff_any = aff_any_u[a]
+        # per-item noise, global-row keyed (solo-path parity — see
+        # MultiEvalInputs.seed); one elementwise hash per lane per step
+        noise_c = jax.vmap(tiebreak_noise)(sd, cand_rows)   # [L, Nc]
         job_count = jnp.where(same[:, None], cur_count, jc0)
         k_i, score = scores_l(cap_c, req, desired, dh_limit, cand_valid,
                               aff_sc, aff_any, used_c, job_count,
@@ -930,7 +953,7 @@ def place_multi_compact_packed(inp: MultiEvalInputs, cand_rows, cand_valid,
     carry0 = (used0_c, jnp.zeros((n_lanes, nc), jnp.int32))
     (used_c, _), outs = jax.lax.scan(
         lane_step, carry0,
-        (a_r, jrow_r, req_r, des_r, dh_r, want_r, same_r))
+        (a_r, jrow_r, req_r, des_r, dh_r, want_r, same_r, seed_r))
     (rows_g, cnt_p, top_rows, top_sc,
      n_feas, n_filt, n_exh, dim_ex, placed) = outs
 
@@ -954,3 +977,39 @@ def place_multi_compact_packed(inp: MultiEvalInputs, cand_rows, cand_valid,
 
 place_multi_compact_packed_jit = jax.jit(place_multi_compact_packed,
                                          static_argnums=(3, 4))
+
+
+# ---------------------------------------------------------------------------
+# Chained-wave launches with DONATED usage buffers (core/wavepipe.py).
+#
+# A wave-pipelined worker chains wave k+1's launch on wave k's
+# proposed-usage OUTPUT; once consumed, wave k's buffer is dead — donating
+# it lets XLA reuse the [N, 3] allocation in place instead of holding two
+# usage tensors live per chained step.  The donated argument is SEPARATE
+# from the input bundle (donation is per jit argument, and donating the
+# whole MultiEvalInputs would invalidate the engine's cached node
+# tensors); callers pass `inp` with `used0=None` so the dead buffer is
+# not also referenced through the pytree.  Only the engine's chain path
+# uses these — the first wave's usage comes from the engine's device
+# cache, which must never be donated.
+# ---------------------------------------------------------------------------
+
+def place_multi_chained(used0, inp: MultiEvalInputs, round_size: int):
+    return place_multi_packed(inp._replace(used0=used0), round_size)
+
+
+place_multi_chained_jit = jax.jit(place_multi_chained,
+                                  static_argnums=(2,),
+                                  donate_argnums=(0,))
+
+
+def place_multi_compact_chained(used0, inp: MultiEvalInputs, cand_rows,
+                                cand_valid, round_size: int, n_lanes: int):
+    return place_multi_compact_packed(inp._replace(used0=used0),
+                                      cand_rows, cand_valid,
+                                      round_size, n_lanes)
+
+
+place_multi_compact_chained_jit = jax.jit(place_multi_compact_chained,
+                                          static_argnums=(4, 5),
+                                          donate_argnums=(0,))

@@ -24,6 +24,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in newer releases (and
+# renamed check_rep -> check_vma); the container's baked-in jax may
+# predate the move — resolve once here so every sharded kernel builder
+# works on both vintages
+try:
+    shard_map = jax.shard_map
+except AttributeError:                      # pragma: no cover - old jax
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
+_pcast = getattr(jax.lax, "pcast", None)
+
+
+def pcast_varying(x, axes):
+    """`jax.lax.pcast(x, axes, to="varying")` where available; identity
+    on jax vintages without it — every shard_map here runs with varying
+    -manifestation checks off (check_vma/check_rep False), so the cast
+    is purely a tracker annotation and safe to skip."""
+    if _pcast is None:
+        return x
+    return _pcast(x, axes, to="varying")
+
 from nomad_tpu.ops.feasibility import constraint_mask
 from nomad_tpu.ops.scoring import affinity_score
 from nomad_tpu.ops.select import (
@@ -40,6 +65,7 @@ from nomad_tpu.ops.select import (
     pack_round_buffer,
     round_metrics_g,
     round_scores_g,
+    round_seeds,
     scan_statics,
     step_scores,
     tiebreak_noise,
@@ -150,8 +176,8 @@ def _place_local(inp: PlacementInputs) -> PlacementOutputs:
     # replicated carries become device-varying once updated with values
     # derived from collectives; pcast the initial values to match
     carry0 = (inp.used0, inp.job_count0,
-              jax.lax.pcast(inp.sp_counts0, (AXIS,), to="varying"),
-              jax.lax.pcast(inp.pd_counts0, (AXIS,), to="varying"))
+              pcast_varying(inp.sp_counts0, (AXIS,)),
+              pcast_varying(inp.pd_counts0, (AXIS,)))
     (used, job_count, _, _), outs = jax.lax.scan(
         step, carry0, (inp.tg_idx, inp.prev_row, inp.active))
     return PlacementOutputs(
@@ -186,7 +212,7 @@ def place_sharded_fn(mesh: Mesh):
     # check_vma=False: the per-placement outputs are identical on every
     # shard by construction (derived from all_gather + psum), but the
     # varying-axes checker cannot infer that through the scan.
-    f = jax.shard_map(_place_local, mesh=mesh,
+    f = shard_map(_place_local, mesh=mesh,
                       in_specs=(in_specs,), out_specs=out_specs,
                       check_vma=False)
     return jax.jit(f)
@@ -213,7 +239,7 @@ def place_sharded_packed_fn(mesh: Mesh):
         n_feasible=P(), n_filtered=P(), n_exhausted=P(), dim_exhausted=P(),
         used=spec_n, job_count=spec_n,
     )
-    inner = jax.shard_map(_place_local, mesh=mesh,
+    inner = shard_map(_place_local, mesh=mesh,
                           in_specs=(in_specs,), out_specs=out_specs,
                           check_vma=False)
 
@@ -378,7 +404,6 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
                 & inp.elig[None, :] & inp.base_mask[inp.u_mask])
     aff_u = affinity_score(inp.attrs, inp.aff, inp.luts)
     aff_any_u = jnp.any(inp.aff[..., 3] != 0, axis=1)
-    noise = tiebreak_noise(inp.seed, global_rows)
     rg = inp.round_g
     u_r = inp.g_static[rg]
     a_r = inp.g_aff[rg]
@@ -389,11 +414,16 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
     jobs_r = inp.g_job[rg]
     same_r = jnp.concatenate([jnp.zeros(1, bool),
                               jobs_r[1:] == jobs_r[:-1]])
+    seed_r = round_seeds(inp.seed, rg)
 
     def round_step(carry, xs):
         used, cur_count = carry
-        (u, a, jc0_row, req, desired, dh_limit, want, same) = xs
+        (u, a, jc0_row, req, desired, dh_limit, want, same, sd) = xs
         static = static_u[u]
+        # per-item noise over GLOBAL rows: identical for a given row on
+        # every shard AND identical to the solo bulk launch for the same
+        # eval id (wavepipe serial/pipelined parity)
+        noise = tiebreak_noise(sd, global_rows)
         job_count = jnp.where(same, cur_count, jc0_row)
         k_i, score = round_scores_g(
             inp.cap, req, desired, dh_limit, static,
@@ -416,7 +446,8 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
     carry0 = (inp.used0, inp.job_count0[0])
     (used, jc), outs = jax.lax.scan(
         round_step, carry0,
-        (u_r, a_r, jc_r, req_r, des_r, dh_r, inp.round_want, same_r))
+        (u_r, a_r, jc_r, req_r, des_r, dh_r, inp.round_want, same_r,
+         seed_r))
     return outs + (used, jc)
 
 
@@ -435,7 +466,7 @@ def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
     out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
                  spec_n, spec_n)
     top_k = TOP_K
-    inner = jax.shard_map(
+    inner = shard_map(
         partial(_multi_local, round_size=round_size, top_k=top_k),
         mesh=mesh, in_specs=(in_specs,), out_specs=out_specs,
         check_vma=False)
@@ -479,8 +510,8 @@ def _multi_compact_local(inp: MultiEvalInputs, cand_rows, cand_valid,
         lambda li: affinity_score(inp.attrs[li], inp.aff, inp.luts)
     )(loc_idx)                                           # [L, Ua, Nc]
     aff_any_u = jnp.any(inp.aff[..., 3] != 0, axis=1)
-    noise_c = tiebreak_noise(inp.seed, cand_rows)        # global-row keyed
     rg = inp.round_g.reshape(-1, n_lanes)
+    seed_r = round_seeds(inp.seed, rg)                   # [T, L]
     a_r = inp.g_aff[rg]
     jrow_r = inp.g_job[rg]
     req_r = inp.req[rg]
@@ -507,10 +538,12 @@ def _multi_compact_local(inp: MultiEvalInputs, cand_rows, cand_valid,
 
     def lane_step(carry, xs):
         used_c, cur_count = carry        # [L, Nc, 3], [L, Nc]
-        (a, jrow, req, desired, dh_limit, want, same) = xs
+        (a, jrow, req, desired, dh_limit, want, same, sd) = xs
         jc0 = jc_seed[jrow]                              # [L, Nc]
         aff_sc = jnp.take_along_axis(
             aff_cu, a[:, None, None], axis=1)[:, 0]
+        # per-item noise, global-row keyed (solo-path parity)
+        noise_c = jax.vmap(tiebreak_noise)(sd, cand_rows)
         job_count = jnp.where(same[:, None], cur_count, jc0)
         k_i, score = scores_l(cap_c, req, desired, dh_limit, cand_valid,
                               aff_sc, aff_any_u[a], used_c, job_count,
@@ -531,7 +564,7 @@ def _multi_compact_local(inp: MultiEvalInputs, cand_rows, cand_valid,
     carry0 = (used0_c, jnp.zeros((n_lanes, nc), jnp.int32))
     (used_c, _), outs = jax.lax.scan(
         lane_step, carry0,
-        (a_r, jrow_r, req_r, des_r, dh_r, want_r, same_r))
+        (a_r, jrow_r, req_r, des_r, dh_r, want_r, same_r, seed_r))
     # scatter the shard's frame slices back to ITS node rows (padding
     # and foreign rows drop out of range)
     scatter_idx = jnp.where(cand_valid, cand_rows - offset, n_loc)
@@ -558,7 +591,7 @@ def place_multi_compact_sharded_fn(mesh: Mesh, round_size: int,
     cand_spec = P(AXIS, None, None)
     out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(),
                  spec_n, spec_n)
-    inner = jax.shard_map(
+    inner = shard_map(
         partial(_multi_compact_local, round_size=round_size,
                 n_lanes=n_lanes, top_k=TOP_K),
         mesh=mesh, in_specs=(in_specs, cand_spec, cand_spec),
@@ -605,7 +638,7 @@ def place_bulk_sharded_packed_fn(mesh: Mesh, round_size: int,
     out_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(),
                  spec_n, spec_n)
     top_k = TOP_K
-    inner = jax.shard_map(
+    inner = shard_map(
         partial(_bulk_local, round_size=round_size, n_rounds=n_rounds,
                 top_k=top_k),
         mesh=mesh, in_specs=(in_specs,), out_specs=out_specs,

@@ -28,6 +28,7 @@ from nomad_tpu.structs import (
     NetworkIndex,
     Plan,
     PlanAnnotations,
+    TRIGGER_PLAN_REFUTE,
     TRIGGER_QUEUED_ALLOCS,
     new_id,
     new_ids,
@@ -241,10 +242,15 @@ class GenericScheduler(Scheduler):
             return ("sync", (plan, result, refreshed, err))
         return ("pending", (plan, submit(plan)))
 
-    def finalize_batched(self, evaluation: Evaluation, handle
-                         ) -> Optional[Exception]:
-        """Phase 2b: collect the applier's verdict and finish the eval —
-        falling back to the full process() retry loop on partial commit."""
+    def finalize_batched(self, evaluation: Evaluation, handle,
+                         pipeline=None) -> Optional[Exception]:
+        """Phase 2b: collect the applier's verdict and finish the eval.
+        On partial commit, the wavepipe refute-repair path
+        (_repair_refuted) masks the refuted nodes into the pipeline and
+        re-queues ONLY the refuted rows as a fresh eval for a later wave
+        — the committed remainder stays committed and the wave is never
+        re-run.  Without a pipeline (solo/sync callers) the original
+        full process() retry loop runs instead."""
         kind, payload = handle
         if kind == "done":
             return None
@@ -258,8 +264,15 @@ class GenericScheduler(Scheduler):
             self._update_eval_status(evaluation, "failed", str(err))
             return err
         if result is not None:
-            full, _, _ = result.full_commit(plan)
+            full, expected, actual = result.full_commit(plan)
             if not full:
+                if (pipeline is not None and result.refuted_nodes
+                        and plan.alloc_blocks
+                        and not plan.node_allocation
+                        and evaluation.triggered_by != TRIGGER_PLAN_REFUTE):
+                    return self._repair_refuted(
+                        evaluation, plan, result, expected - actual,
+                        pipeline)
                 # partial commit: some nodes were refuted against newer
                 # state — re-run the normal retry loop, which reconciles
                 # the committed remainder on a fresh snapshot
@@ -271,6 +284,37 @@ class GenericScheduler(Scheduler):
                     self.state = refreshed_state
                 return self.process(evaluation)
         self._finalize(evaluation)
+        return None
+
+    def _repair_refuted(self, evaluation: Evaluation, plan: Plan,
+                        result, missing: int, pipeline
+                        ) -> Optional[Exception]:
+        """Refute-repair (core/wavepipe.py): the applier refuted rows of
+        this eval's block against newer state.  Instead of re-running
+        the whole device launch, (1) the refuted nodes join the
+        pipeline's mask so subsequent CHAINED dispatches — whose usage
+        buffers predate the refuting write — cannot re-pick them, and
+        (2) a fresh pending eval re-places only the `missing` rows in a
+        later wave (its reconcile counts the committed remainder, so
+        nothing double-commits).  Repair evals that refute AGAIN fall
+        back to the normal retry loop (the TRIGGER_PLAN_REFUTE guard in
+        finalize_batched) — the repair never recurses."""
+        pipeline.note_refuted(result.refuted_nodes)
+        tg_name = plan.alloc_blocks[0].template.task_group
+        self.queued_allocs[tg_name] = (
+            self.queued_allocs.get(tg_name, 0) + missing)
+        follow = Evaluation(
+            namespace=evaluation.namespace,
+            priority=evaluation.priority,
+            type=evaluation.type,
+            triggered_by=TRIGGER_PLAN_REFUTE,
+            job_id=evaluation.job_id,
+            previous_eval=evaluation.id,
+        )
+        self.planner.create_eval(follow)
+        self._update_eval_status(
+            evaluation, EVAL_STATUS_COMPLETE,
+            f"{missing} refuted placement(s) re-queued as {follow.id}")
         return None
 
     def process_batched(self, evaluation: Evaluation, prep, bd,

@@ -465,21 +465,23 @@ class StateStore:
         tg = (tmpl.job.lookup_task_group(tmpl.task_group)
               if tmpl.job else None)
         if tg is not None and tg.volumes:
-            import dataclasses
             vol_changed = {}
             for vreq in tg.volumes.values():
                 if vreq.type != "csi" or not vreq.source:
                     continue
                 key = (tmpl.namespace, vreq.source)
-                vol = self._writable_claim_vol(key)
+                # vol_changed as the accumulator: duplicate-source vreqs
+                # reuse the same head-private copy; the helper itself
+                # publishes any fresh copy before marking it, so the
+                # continue below can never strand a snapshot-shared
+                # volume behind a marked key (ADVICE r5)
+                vol = self._writable_claim_vol(key, vol_changed)
                 if vol is None or block.id not in vol.read_blocks:
                     continue
                 vol.read_blocks.pop(block.id, None)
                 vol.read_allocs.update(
                     {a.id: a.node_id for a in rows})
                 vol_changed[key] = vol
-            if vol_changed:
-                self._csi_volumes = {**self._csi_volumes, **vol_changed}
         self._emit("BlockMaterialized", self._index, block)
 
     def _resolve_block_member_locked(self, alloc_id: str,
@@ -797,6 +799,16 @@ class StateStore:
                     vol, read_allocs=dict(vol.read_allocs),
                     write_allocs=dict(vol.write_allocs),
                     read_blocks=dict(vol.read_blocks))
+                # publish the copy NOW, before marking it fresh: a caller
+                # that drops the returned copy on a continue/early-return
+                # (ADVICE r5: _materialize_block_locked's
+                # block-not-claimed case) would otherwise leave the
+                # snapshot-shared volume at the head while later claim
+                # writers skip the copy and mutate the shared dicts in
+                # place — the exact snapshot-isolation leak the fresh set
+                # exists to prevent.  Callers' changed_vols merges are
+                # now idempotent re-publishes of the same object.
+                self._csi_volumes = {**self._csi_volumes, key: vol}
                 self._fresh_claim_vols.add(key)
         return vol
 
@@ -1228,15 +1240,27 @@ class StateStore:
             # migrates block claims to per-alloc claims, so volumes
             # serialize without block references — any LEFTOVER block
             # claim references a vanished block (the watcher's reap
-            # case) and CONVERTS to per-alloc claims rather than being
-            # dropped: the restored store's volume watcher must still
-            # unpublish each member before releasing (detach-before-
-            # release survives a snapshot/restore cycle)
+            # case) and CONVERTS to per-alloc claims ON THE SERIALIZED
+            # DOCUMENT ONLY rather than being dropped: the restored
+            # store's volume watcher must still unpublish each member
+            # before releasing (detach-before-release survives a
+            # snapshot/restore cycle).  Converting on the document
+            # (ADVICE r5) keeps the save read-mostly: mutating live
+            # state here bumped the placement index + _volume_seq and
+            # emitted CSIVolume events, which could spuriously
+            # invalidate concurrent plan commits' volume_seq fences.
             for b in list(self._alloc_blocks.values()):
                 self._materialize_block_locked(b)
-            for key, v in list(self._csi_volumes.items()):
-                for bid in list(v.read_blocks):
-                    self._convert_block_claim_locked(key[0], v.id, bid)
+            vols_doc = []
+            for v in self._csi_volumes.values():
+                if v.read_blocks:
+                    import dataclasses
+                    reads = dict(v.read_allocs)
+                    for blk in v.read_blocks.values():
+                        reads.update(dict.fromkeys(blk.ids, ""))
+                    v = dataclasses.replace(v, read_allocs=reads,
+                                            read_blocks={})
+                vols_doc.append(codec.encode(v))
             allocs = []
             for a in self._allocs.values():
                 slim = a.copy_skip_job()
@@ -1276,8 +1300,7 @@ class StateStore:
                     for r in self._acl_binding_rules.values()],
                 "Variables": [codec.encode(v)
                               for v in self._variables.values()],
-                "CSIVolumes": [codec.encode(v)
-                               for v in self._csi_volumes.values()],
+                "CSIVolumes": vols_doc,
                 "Services": [codec.encode(r)
                              for r in self._services.values()],
                 "SchedulerConfig": codec.encode(self._scheduler_config),

@@ -76,6 +76,49 @@ class AllocBlock:
         """allocs per node_table row (for vectorized usage scatters)."""
         return np.bincount(self.picks, minlength=len(self.node_table))
 
+    def demand_by_node(self) -> Dict[str, tuple]:
+        """{node_id: (count, cpu, mem_mb, disk_mb)} demanded by this
+        block — the plan applier's columnar fit-check input.  O(unique
+        nodes) host work; no per-alloc objects exist."""
+        counts = self.node_counts().tolist()
+        r = self.template.resources
+        return {nid: (c, c * r.cpu, c * r.memory_mb, c * r.disk_mb)
+                for nid, c in zip(self.node_table, counts) if c}
+
+    def without_nodes(self, bad_node_ids) -> Optional["AllocBlock"]:
+        """A new block with every row placed on `bad_node_ids` dropped —
+        the applier's COLUMNAR per-node refute: the surviving rows stay
+        an array-form block (no materialization) while the refuted rows
+        simply never commit.  Returns None when nothing survives.
+
+        The surviving rows keep the original per-round metrics list and
+        round size; after compaction a row's `i // round_size` metric
+        index can shift to a neighboring round's (shared, diagnostic)
+        metric — acceptable drift for the rare partial-refute path, the
+        same class of sharing the round metrics already are."""
+        bad_rows = np.array(
+            [i for i, nid in enumerate(self.node_table)
+             if nid in bad_node_ids], np.int64)
+        if not bad_rows.size:
+            return self
+        keep = ~np.isin(self.picks, bad_rows)
+        if not keep.any():
+            return None
+        import itertools
+        sel = keep.tolist()
+        uniq, inv = np.unique(self.picks[keep], return_inverse=True)
+        return AllocBlock(
+            id=self.id,
+            template=self.template,
+            ids=list(itertools.compress(self.ids, sel)),
+            name_prefix=self.name_prefix,
+            indexes=list(itertools.compress(self.indexes, sel)),
+            picks=inv.astype(np.int32),
+            node_table=[self.node_table[int(r)] for r in uniq],
+            metrics=list(self.metrics),
+            round_size=self.round_size,
+        )
+
     def index_of(self, alloc_id: str) -> Optional[int]:
         if self._id_index is None:
             self._id_index = {aid: i for i, aid in enumerate(self.ids)}

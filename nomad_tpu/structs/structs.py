@@ -76,6 +76,10 @@ TRIGGER_RECONNECT = "reconnect"
 TRIGGER_QUEUED_ALLOCS = "queued-allocs"
 TRIGGER_RETRY_FAILED_ALLOC = "retry-failed-alloc"
 TRIGGER_SCHEDULED = "scheduled"
+# wavepipe refute-repair: a fresh eval re-places rows the applier
+# refuted out of an already-dispatched wave (scheduler/generic.py
+# _repair_refuted) instead of re-running the wave's device launch
+TRIGGER_PLAN_REFUTE = "plan-refute-repair"
 
 # Constraint operands (reference: structs.go ConstraintX consts).
 OP_EQ = "="

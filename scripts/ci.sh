@@ -18,7 +18,15 @@ echo "== lint selftest (injected undefined name must be caught) =="
 python scripts/lint.py --selftest
 
 echo "== lint =="
+# covers every file under nomad_tpu/ (core/wavepipe.py included),
+# tests/, scripts/, bench.py
 python scripts/lint.py
+
+echo "== wavepipe fast smoke (pipelined engine, CPU mesh) =="
+# the async dispatch/collect path first and fast: a regression in the
+# wave pipeline (chained launches, refute-repair, columnar commit)
+# fails tier-1 here in seconds instead of deep in the full suite
+python -m pytest tests/test_wavepipe.py -q -m 'not slow'
 
 echo "== tests (8-virtual-device CPU mesh) =="
 python -m pytest tests/ -q

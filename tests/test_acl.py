@@ -11,6 +11,16 @@ from nomad_tpu.api.client import APIClient, APIException
 from nomad_tpu.core import Server
 from nomad_tpu.structs import codec
 
+try:                                  # the image may lack the optional
+    import cryptography  # noqa: F401 - AEAD/RSA dep (gated, not assumed)
+    HAS_CRYPTO = True
+except ModuleNotFoundError:
+    HAS_CRYPTO = False
+
+requires_crypto = pytest.mark.skipif(
+    not HAS_CRYPTO, reason="cryptography not installed in this image")
+
+
 HCL_POLICY = '''
 namespace "default" { policy = "write" }
 namespace "ops-*"   { capabilities = ["read-job", "list-jobs"] }
@@ -362,6 +372,7 @@ class TestAuthMethods:
                        now=now)
         assert tok.policies == ["deploy-x"]
 
+    @requires_crypto
     def test_rs256_via_cryptography(self):
         import base64 as b64
         import json as j

@@ -15,6 +15,16 @@ from nomad_tpu.core.cluster import ClusterServer, RemoteRPC
 from nomad_tpu.core.membership import Gossip
 from nomad_tpu.core.raft import NotLeaderError, RaftNode
 
+try:                                  # the image may lack the optional
+    import cryptography  # noqa: F401 - AEAD/RSA dep (gated, not assumed)
+    HAS_CRYPTO = True
+except ModuleNotFoundError:
+    HAS_CRYPTO = False
+
+requires_crypto = pytest.mark.skipif(
+    not HAS_CRYPTO, reason="cryptography not installed in this image")
+
+
 FAST = dict(heartbeat_interval=0.04, election_timeout=(0.15, 0.3))
 
 
@@ -447,6 +457,7 @@ class TestClusterServer:
                    for s in rest)
 
 
+@requires_crypto
 class TestEncryptedCluster:
     def test_encrypted_cluster_forms_and_schedules(self):
         """A cluster with the `encrypt` key set must elect, forward

@@ -10,6 +10,15 @@ import pytest
 from nomad_tpu import mock
 from nomad_tpu.core import wire
 
+try:                                  # the image may lack the optional
+    import cryptography  # noqa: F401 - AEAD/RSA dep (gated, not assumed)
+    HAS_CRYPTO = True
+except ModuleNotFoundError:
+    HAS_CRYPTO = False
+
+requires_crypto = pytest.mark.skipif(
+    not HAS_CRYPTO, reason="cryptography not installed in this image")
+
 
 @pytest.fixture(autouse=True)
 def _reset_key():
@@ -61,6 +70,7 @@ class TestCodec:
             wire.unpackb(evil)
 
 
+@requires_crypto
 class TestFrameAuth:
     def test_encrypted_roundtrip(self):
         wire.set_key("cluster-secret")
@@ -240,6 +250,7 @@ class TestRPCAllowlist:
         finally:
             s.shutdown()
 
+    @requires_crypto
     def test_unauthenticated_peer_rejected(self):
         """With a cluster key set, a keyless frame gets no reply."""
         from nomad_tpu.core.membership import Gossip
