@@ -45,6 +45,7 @@ import json
 import math
 import os
 import random
+import statistics
 import subprocess
 import sys
 import time
@@ -798,7 +799,8 @@ def run_config_5(args):
     full_scale = n_nodes >= 50000 and total_target >= 100000
     extra_budget = max(iters, 4) if full_scale else 0
     stages = None
-    i = 0
+    wave_dts = []          # EVERY measured wave, for the (median, best)
+    i = 0                  # pair (round-5 verdict #2: symmetric sampling)
     while i < iters + extra_budget:
         s.plan_queue.latencies.clear()
         s.plan_applier.stats.update(plans=0, plans_refuted=0)
@@ -807,6 +809,7 @@ def run_config_5(args):
             _PHASES.reset()
         dt_i, jobs_i = run_wave(n_evals, per_eval, cpu=10, mem=10,
                                 tag=f"measure{i}")
+        wave_dts.append(dt_i)
         q_i = s.plan_queue.latency_quantiles((0.5, 0.99))
         ast = s.plan_applier.stats
         refute_i = (ast["plans_refuted"] / ast["plans"]
@@ -846,17 +849,26 @@ def run_config_5(args):
         # only: this host has one core (os.cpu_count() == 1 — reported
         # as host_cores below), so stock's num_schedulers default here
         # IS 1, and a threaded emulation on one core can only interleave.
-        # BEST of two runs: the shared host's noise must never deflate
-        # the denominator (generous-to-stock, like every tier choice)
-        base_rate_real = max(
-            stock_zoned_rate_realistic(
-                nodes, cpu=10, mem=10, n_place=n_place,
-                per_eval=per_eval, seed=3 + i) or 0.0
-            for i in range(2)) or None
+        # SYMMETRIC sampling (round-6, verdict #2): the realistic tier
+        # takes exactly as many samples as the TPU side took measured
+        # waves, and BOTH sides report (median, best) — "best window for
+        # me, best-of-2 for you" is not a protocol.  The leading ratio
+        # stays best-vs-best (generous to stock: its best is kept, and
+        # ours pays the same tunnel noise its samples don't have).
+        real_samples = [r for r in
+                        (stock_zoned_rate_realistic(
+                            nodes, cpu=10, mem=10, n_place=n_place,
+                            per_eval=per_eval, seed=3 + k)
+                         for k in range(max(len(wave_dts), 1)))
+                        if r]
+        base_rate_real = max(real_samples) if real_samples else None
+        base_rate_real_median = (statistics.median(real_samples)
+                                 if real_samples else None)
     else:
         base_rate_mw = None    # no toolchain: never mislabel the serial
         # interpreted fallback as a 5-worker compiled figure
         base_rate_real = None
+        base_rate_real_median = None
     base_sample_py = min(n_place, 300)
     base_rate_py = stock_baseline_rate(nodes, cpu=10, mem=10,
                                        n_place=base_sample_py)
@@ -968,12 +980,23 @@ def run_config_5(args):
     # bound, the interpreted tier and the C1M anchor bracket from below
     vs_real = (round(tpu_rate / base_rate_real, 2)
                if base_rate_real else None)
+    # symmetric (median, best) pairs over the SAME sample depth (the
+    # realistic tier sampled len(wave_dts) times above): `value` stays
+    # the best wave for cross-round continuity; the median shows what a
+    # typical window looks like on both sides
+    value_median = n_evals / statistics.median(wave_dts)
     return {"metric": "northstar_50knodes_100kallocs_evals_per_sec",
             "value": round(evals_per_sec, 2), "unit": "evals/sec",
+            "value_best": round(evals_per_sec, 2),
+            "value_median": round(value_median, 2),
+            "bench_samples": len(wave_dts),
             **({"vs_baseline": vs_real,
                 "vs_baseline_realistic": vs_real,
                 "baseline_realistic_stock_per_sec":
                     round(base_rate_real, 1),
+                "baseline_realistic_best": round(base_rate_real, 1),
+                "baseline_realistic_median":
+                    round(base_rate_real_median, 1),
                 "baseline_realistic_stock_evals_per_sec":
                     round(base_rate_real / per_eval, 3)}
                if base_rate_real else

@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from nomad_tpu.core.logging import log
+
 from nomad_tpu.structs import (
     ALLOC_CLIENT_COMPLETE,
     ALLOC_CLIENT_FAILED,
@@ -175,7 +177,17 @@ class AllocRunner:
                          name=f"alloc-{self.alloc.id[:8]}").start()
 
     def _supervise(self) -> None:
-        """Leader-kill + sibling-failure semantics + health watching."""
+        """Leader-kill + sibling-failure semantics + health watching.
+        Daemon-thread entry: an escape from the watch loop must not kill
+        the supervisor silently (tasks would run unsupervised and the
+        deployment health would never settle)."""
+        try:
+            self._watch_tasks()
+        except Exception as exc:  # noqa: BLE001 - daemon thread
+            log("client", "warn", "alloc supervisor died",
+                alloc=self.alloc.id, error=repr(exc))
+
+    def _watch_tasks(self) -> None:
         tg = self._tg()
         min_healthy = 10.0
         if tg is not None and tg.update is not None:

@@ -181,8 +181,13 @@ class ExecSessionRegistry:
     def _sweep(self) -> None:
         while True:
             time.sleep(self.REAP_INTERVAL_S)
-            with self._lock:
-                self._reap_locked()
+            # the reaper daemon thread must survive a terminate() racing
+            # a session's shell process going away mid-reap
+            try:
+                with self._lock:
+                    self._reap_locked()
+            except Exception:  # noqa: BLE001 - keep the sweeper alive
+                pass
 
     def add(self, session: ExecSession) -> str:
         with self._lock:

@@ -106,7 +106,7 @@ class DeploymentWatcher:
                 and all(not s.desired_canaries or s.auto_promote
                         for s in updated.task_groups.values())
                 and self._canaries_healthy(updated, allocs)):
-            self._promote_locked(updated, None, now)
+            self._do_promote(updated, None, now)
             return
 
         if self._complete(updated):
@@ -259,9 +259,9 @@ class DeploymentWatcher:
         updated = dep.copy()
         if not self._canaries_healthy(updated, allocs, groups):
             return "canaries are not healthy"
-        return self._promote_locked(updated, groups, t)
+        return self._do_promote(updated, groups, t)
 
-    def _promote_locked(self, updated: Deployment,
+    def _do_promote(self, updated: Deployment,
                         groups: Optional[List[str]], now: float
                         ) -> Optional[str]:
         hit = False

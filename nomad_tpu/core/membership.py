@@ -206,16 +206,22 @@ class Gossip:
 
     def _serve(self, conn: socket.socket) -> None:
         from . import wire
-        with conn:
-            msg = recv_msg(conn, timeout=2.0,
-                           tag=wire.channel_tag("serf", "req", self.addr))
-            if msg is None:
-                return
-            if msg.get("type") in ("ping", "sync"):
-                self._merge(msg.get("members", []))
-                reply(conn, {"type": "ack",
-                             "members": self._wire_members()},
-                      tag=wire.channel_tag("serf", "rep", self.addr))
+        # per-connection daemon thread: a peer vanishing mid-exchange or
+        # a malformed frame must not leave a silent corpse
+        try:
+            with conn:
+                msg = recv_msg(conn, timeout=2.0,
+                               tag=wire.channel_tag("serf", "req",
+                                                    self.addr))
+                if msg is None:
+                    return
+                if msg.get("type") in ("ping", "sync"):
+                    self._merge(msg.get("members", []))
+                    reply(conn, {"type": "ack",
+                                 "members": self._wire_members()},
+                          tag=wire.channel_tag("serf", "rep", self.addr))
+        except Exception as exc:  # noqa: BLE001 - daemon thread
+            log("serf", "debug", "gossip serve failed", error=repr(exc))
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval):

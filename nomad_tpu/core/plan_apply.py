@@ -183,10 +183,17 @@ class PlanApplier:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            pending = self.queue.dequeue(timeout=0.1)
-            if pending is None:
-                continue
-            self.apply_one(pending)
+            # apply_one responds errors to the submitter; an exception
+            # escaping the dequeue/timer path would silently kill THE
+            # serialization point of the whole system — log and continue
+            try:
+                pending = self.queue.dequeue(timeout=0.1)
+                if pending is None:
+                    continue
+                self.apply_one(pending)
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                log("plan", "warn", "applier iteration failed",
+                    error=repr(exc))
 
     # ------------------------------------------------------------- apply
 

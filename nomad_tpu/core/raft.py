@@ -423,10 +423,15 @@ class RaftNode:
         threads = []
 
         def ask(addr):
-            results.append(send_msg(addr, {
-                "type": "vote_req", "term": term, "cand": self.name,
-                "last_idx": last_idx, "last_term": last_term},
-                timeout=0.5, channel="raft"))
+            # vote-collector daemon thread: a transport failure is just
+            # a missing vote, never a dead thread
+            try:
+                results.append(send_msg(addr, {
+                    "type": "vote_req", "term": term, "cand": self.name,
+                    "last_idx": last_idx, "last_term": last_term},
+                    timeout=0.5, channel="raft"))
+            except Exception:  # noqa: BLE001 - count as no vote
+                results.append(None)
 
         for addr in peers.values():
             t = threading.Thread(target=ask, daemon=True, args=(addr,))
@@ -680,23 +685,29 @@ class RaftNode:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         """Serve a connection until the peer closes it: replicators hold
-        one persistent connection and pump many messages through it."""
+        one persistent connection and pump many messages through it.
+        Daemon thread: a handler blowing up mid-exchange must drop the
+        connection (the replicator reconnects), not die silently."""
         req_tag = wire.channel_tag("raft", "req", self.addr)
         rep_tag = wire.channel_tag("raft", "rep", self.addr)
-        with conn:
-            while not self._stop.is_set():
-                msg = recv_msg(conn, timeout=10.0, tag=req_tag)
-                if msg is None:
-                    return
-                handler = {"vote_req": self._on_vote_req,
-                           "append": self._on_append,
-                           "snap": self._on_snap}.get(msg.get("type"))
-                if handler is None:
-                    return
-                resp = handler(msg)
-                if resp is None:
-                    return
-                reply(conn, resp, tag=rep_tag)
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    msg = recv_msg(conn, timeout=10.0, tag=req_tag)
+                    if msg is None:
+                        return
+                    handler = {"vote_req": self._on_vote_req,
+                               "append": self._on_append,
+                               "snap": self._on_snap}.get(msg.get("type"))
+                    if handler is None:
+                        return
+                    resp = handler(msg)
+                    if resp is None:
+                        return
+                    reply(conn, resp, tag=rep_tag)
+        except Exception as exc:  # noqa: BLE001 - daemon thread
+            log("raft", "debug", "conn serve failed", node=self.name,
+                error=repr(exc))
 
     def _on_vote_req(self, m: dict) -> dict:
         with self._lock:

@@ -241,7 +241,10 @@ class WavePipeline:
         t_ready = None
         if buf is not None:
             try:
-                buf.block_until_ready()
+                # the pipeline's ONE deliberate sync point: collect()
+                # exists to pay this wait, after the successor wave has
+                # already been dispatched
+                buf.block_until_ready()   # analyze: ok purity
                 t_ready = time.perf_counter()
             except (AttributeError, RuntimeError):
                 pass

@@ -92,7 +92,14 @@ class Worker:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            self.run_once(timeout=0.1)
+            # run_once nacks scheduler failures itself; anything escaping
+            # it (broker dequeue, settle) must not kill the worker thread
+            # silently — log and keep serving the queue
+            try:
+                self.run_once(timeout=0.1)
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                log("worker", "warn", "worker iteration failed",
+                    worker=self.id, error=repr(exc))
 
     # ------------------------------------------------------------- steps
 

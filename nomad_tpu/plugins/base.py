@@ -200,13 +200,18 @@ def launch_plugin(cmd, socket_dir: str, timeout: float = 60.0,
         raise PluginError(f"plugin launch failed: {e}") from e
 
     def _drain(stream, label):
-        for raw in stream:
-            line = raw.decode(errors="replace").rstrip()
-            if line:
-                if label == "stderr":
-                    err_tail.append(line)
-                _log("plugins", "debug", f"plugin {label}",
-                     cmd=cmd[-1], line=line)
+        # drain daemon thread: the pipe closing mid-read at plugin
+        # shutdown is normal, not a reason to die with a traceback
+        try:
+            for raw in stream:
+                line = raw.decode(errors="replace").rstrip()
+                if line:
+                    if label == "stderr":
+                        err_tail.append(line)
+                    _log("plugins", "debug", f"plugin {label}",
+                         cmd=cmd[-1], line=line)
+        except (OSError, ValueError):
+            pass
 
     drain_t = threading.Thread(target=_drain,
                                args=(proc.stderr, "stderr"),

@@ -392,7 +392,7 @@ class StateStore:
     def upsert_allocs(self, allocs: Iterable[Allocation]) -> int:
         with self._lock:
             idx = self._bump_placement()
-            self._insert_allocs(allocs, idx)
+            self._insert_allocs_locked(allocs, idx)
             return idx
 
     def _writable_alloc_tables(self):
@@ -501,7 +501,7 @@ class StateStore:
                 return True
         return False
 
-    def _insert_allocs(self, allocs: Iterable[Allocation], idx: int,
+    def _insert_allocs_locked(self, allocs: Iterable[Allocation], idx: int,
                        copy: bool = True,
                        origin: Optional[str] = None) -> None:
         table, by_node, by_job = self._writable_alloc_tables()
@@ -594,7 +594,7 @@ class StateStore:
                 a.task_states = _copy.deepcopy(u.task_states)
                 a.modify_time = u.modify_time
                 merged.append(a)
-            self._insert_allocs(merged, idx)
+            self._insert_allocs_locked(merged, idx)
             return idx
 
     def update_alloc_desired_transition(self, alloc_ids: Iterable[str],
@@ -618,7 +618,7 @@ class StateStore:
                     force_reschedule=transition.force_reschedule,
                     no_shutdown_delay=transition.no_shutdown_delay)
                 merged.append(a)
-            self._insert_allocs(merged, idx, copy=False)
+            self._insert_allocs_locked(merged, idx, copy=False)
             return idx
 
     # --------------------------------------------------------- deployments
@@ -686,7 +686,7 @@ class StateStore:
             # the submitted pointers directly).
             origin = (plan.coupled_batch[0]
                       if plan.coupled_batch is not None else None)
-            self._insert_allocs(allocs, idx, copy=False, origin=origin)
+            self._insert_allocs_locked(allocs, idx, copy=False, origin=origin)
             # CSI claims ride the plan commit (reference: the client's
             # claim RPC; the applier's claim_ok re-check reads these).
             # Released when the alloc goes terminal.  Changed volumes
@@ -1418,7 +1418,7 @@ class StateStore:
     def snapshot(self) -> "StateSnapshot":
         with self._lock:
             # the handed-out tables are frozen from here on: the next
-            # alloc write copies before mutating (see _insert_allocs)
+            # alloc write copies before mutating (see _insert_allocs_locked)
             self._alloc_tables_shared = True
             self._block_tables_shared = True
             self._eval_tables_shared = True
@@ -1451,7 +1451,7 @@ class StateStore:
     # convenience pass-throughs (read the live head; schedulers must use
     # snapshot() for consistency).  dict.get is atomic under the GIL, but
     # anything ITERATING a bucket must hold the lock: alloc buckets copied
-    # since the last snapshot are mutated in place by _insert_allocs.
+    # since the last snapshot are mutated in place by _insert_allocs_locked.
     def node_by_id(self, node_id: str) -> Optional[Node]:
         return self._nodes.get(node_id)
 

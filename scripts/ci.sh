@@ -1,16 +1,21 @@
 #!/usr/bin/env bash
 # CI pipeline — the single command that reproduces CI locally
-# (reference: .github/workflows/test-core.yaml).  Three stages:
-#   lint   — scripts/lint.py (AST checks: syntax, unused imports, stray
-#            prints, whitespace; no external linters required)
-#   test   — the full pytest suite on the 8-virtual-device CPU mesh
-#            (tests/conftest.py forces JAX_PLATFORMS=cpu +
-#            xla_force_host_platform_device_count=8, so the sharded
-#            kernels run everywhere)
-#   smoke  — bench.py at reduced scale on the CPU backend: the whole
-#            broker -> batched-worker -> plan-queue -> applier pipeline
-#            must place every alloc (the run asserts completeness
-#            internally; a scheduling regression fails the run)
+# (reference: .github/workflows/test-core.yaml).  Stages:
+#   lint     — scripts/lint.py (AST checks: syntax, unused imports,
+#              stray prints, whitespace; no external linters required)
+#   analyze  — scripts/analyze.py: the project-invariant passes (lock
+#              discipline, COW/snapshot isolation, JAX purity/donation,
+#              thread hygiene); selftest first (each pass must catch
+#              its injected violation), then a repo-wide clean run
+#   test     — the full pytest suite on the 8-virtual-device CPU mesh
+#              (tests/conftest.py forces JAX_PLATFORMS=cpu +
+#              xla_force_host_platform_device_count=8, so the sharded
+#              kernels run everywhere)
+#   smoke    — bench.py at reduced scale on the CPU backend: the whole
+#              broker -> batched-worker -> plan-queue -> applier
+#              pipeline must place every alloc (the run asserts
+#              completeness internally; a scheduling regression fails
+#              the run)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -21,6 +26,12 @@ echo "== lint =="
 # covers every file under nomad_tpu/ (core/wavepipe.py included),
 # tests/, scripts/, bench.py
 python scripts/lint.py
+
+echo "== analyze selftest (each pass must catch its injected violation) =="
+python scripts/analyze.py --selftest
+
+echo "== analyze (project invariants: lock/cow/purity/thread) =="
+python scripts/analyze.py
 
 echo "== wavepipe fast smoke (pipelined engine, CPU mesh) =="
 # the async dispatch/collect path first and fast: a regression in the

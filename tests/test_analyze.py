@@ -1,0 +1,318 @@
+"""Invariant-analyzer coverage (scripts/analyze.py).
+
+Each pass gets positive fixtures (the exact bug class it exists to
+catch, including the pre-fix shape of the round-5
+`_materialize_block_locked` snapshot leak) and negative fixtures (the
+blessed shapes the codebase actually uses — `with self._lock:` scopes,
+`_writable_*` copies, rebound donated buffers).  Plus: suppression
+comments silence exactly their pass, the selftest is green, and the
+WHOLE repo is violation-free (the same gate CI runs).
+"""
+
+import importlib.util
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "analyze", ROOT / "scripts" / "analyze.py")
+analyze = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(analyze)
+
+
+def findings(text, passes):
+    return analyze.analyze_source(text, passes=passes)
+
+
+def msgs(text, passes):
+    return [f[3] for f in findings(text, passes)]
+
+
+# ---------------------------------------------------------- pass A: lock
+
+LOCK_BAD = '''
+class StateStore:
+    def broken_entry(self, x):
+        self._insert_thing_locked(x)
+
+    def broken_helper(self, key):
+        return self._writable_claim_vol(key)
+'''
+
+LOCK_GOOD = '''
+class StateStore:
+    def upsert(self, x):
+        with self._lock:
+            self._insert_thing_locked(x)
+
+    def _merge_locked(self, x):
+        self._insert_thing_locked(x)
+
+    def _writable_tables(self):
+        return self._insert_thing_locked(None)
+
+    def via_alias(self, x):
+        lk = self._lock
+        with lk:
+            self._insert_thing_locked(x)
+
+    def under_condition(self, x):
+        with self._cv:
+            self._insert_thing_locked(x)
+'''
+
+
+def test_lock_flags_unlocked_callers():
+    got = findings(LOCK_BAD, ("lock",))
+    assert len(got) == 2, got
+    assert all("outside" in m for m in msgs(LOCK_BAD, ("lock",)))
+
+
+def test_lock_accepts_locked_scopes_and_aliases():
+    assert findings(LOCK_GOOD, ("lock",)) == []
+
+
+# ----------------------------------------------------------- pass B: cow
+
+# the EXACT pre-fix shape of the round-5 `_materialize_block_locked`
+# snapshot-isolation leak: a claim-vol fetched straight out of the
+# shared table, then mutated in place (ADVICE.md round-5 medium)
+COW_LEAK = '''
+class StateStore:
+    def _materialize_block_locked(self, block):
+        key = (block.namespace, block.source)
+        vol = self._csi_volumes.get(key)
+        if vol is None or block.id not in vol.read_blocks:
+            return
+        vol.read_blocks.pop(block.id, None)
+        vol.read_allocs.update({a: "" for a in block.ids})
+'''
+
+COW_SHALLOW = '''
+class StateStore:
+    def _release_locked(self, key, aid):
+        import dataclasses
+        vol = self._csi_volumes.get(key)
+        v = dataclasses.replace(vol)
+        v.modify_index = 7
+        v.read_allocs.pop(aid, None)
+'''
+
+COW_DIRECT = '''
+class StateStore:
+    def delete_volume(self, key):
+        self._csi_volumes.pop(key, None)
+
+    def set_volume(self, key, vol):
+        self._csi_volumes[key] = vol
+'''
+
+COW_GOOD = '''
+class StateStore:
+    def _claim_ok_locked(self, key, alloc):
+        vol = self._writable_claim_vol(key)
+        if vol is None:
+            return
+        vol.read_allocs[alloc.id] = alloc.node_id
+        vol.read_blocks.pop(alloc.id, None)
+
+    def snapshot_restore(self, doc):
+        self._csi_volumes = {}
+        for key, vol in doc.items():
+            self._csi_volumes[key] = vol
+
+    def fresh_local(self):
+        acc = {}
+        acc["k"] = 1
+        acc.update({"j": 2})
+        return acc
+'''
+
+
+def test_cow_catches_the_materialize_block_leak():
+    got = findings(COW_LEAK, ("cow",))
+    assert len(got) == 2, got
+    assert all("_writable_" in m for m in msgs(COW_LEAK, ("cow",)))
+
+
+def test_cow_catches_shallow_replace_inner_mutation():
+    got = findings(COW_SHALLOW, ("cow",))
+    # scalar attribute write on the fresh outer object is fine; the
+    # inner-dict pop is the leak
+    assert len(got) == 1, got
+    assert "replace" in got[0][3]
+
+
+def test_cow_catches_direct_table_writes():
+    got = findings(COW_DIRECT, ("cow",))
+    assert len(got) == 2, got
+
+
+def test_cow_accepts_writable_copies_and_fresh_rebinds():
+    assert findings(COW_GOOD, ("cow",)) == []
+
+
+# -------------------------------------------------------- pass C: purity
+
+PURITY_BAD = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(used, cap):
+    free = cap - used
+    total = np.asarray(free)
+    return jnp.sum(free) + float(total.sum())
+
+
+kernel_jit = jax.jit(kernel, donate_argnums=(0,))
+
+
+def host_loop(used, cap):
+    out = kernel_jit(used, cap)
+    best = jnp.argmax(out)
+    stale = used + 1
+    return best, stale
+
+
+def collect(buf):
+    buf.block_until_ready()
+    return buf
+'''
+
+PURITY_GOOD = '''
+import jax
+import jax.numpy as jnp
+
+
+def kernel(used, cap):
+    free = cap - used
+    scale = float(1e-3)
+    return jnp.where(free > 0, free, 0).sum() * scale
+
+
+kernel_jit = jax.jit(kernel, donate_argnums=(0,))
+
+
+def host_loop(used, cap):
+    out = kernel_jit(used, cap)
+    used = out
+    return used
+
+
+def host_branches(used, cap, chained):
+    if chained:
+        out = kernel_jit(used, cap)
+    else:
+        out = used.copy()
+    return out
+'''
+
+
+def test_purity_flags_sync_eager_and_donated_reuse():
+    got = msgs(PURITY_BAD, ("purity",))
+    assert len(got) == 5, got
+    assert any("np.asarray" in m for m in got)
+    assert any("float()" in m for m in got)
+    assert any("eager jnp.argmax" in m for m in got)
+    assert any("DONATED" in m for m in got)
+    assert any("block_until_ready" in m for m in got)
+
+
+def test_purity_accepts_jit_jnp_rebinds_and_exclusive_branches():
+    # jnp inside the traced kernel, float() on a constant, a donated
+    # buffer rebound before its next read, and a read in the if-arm
+    # that did NOT donate: all clean
+    assert findings(PURITY_GOOD, ("purity",)) == []
+
+
+# -------------------------------------------------------- pass D: thread
+
+THREAD_BAD = '''
+import threading
+
+
+class ClusterServer:
+    def _on_raft_leader(self):
+        self.establish_leadership()
+
+    def start(self):
+        RaftNode(on_leader=self._on_raft_leader)
+'''
+
+THREAD_GOOD = '''
+import threading
+
+
+class ClusterServer:
+    def _on_raft_leader(self):
+        try:
+            self.establish_leadership()
+        except Exception:
+            self.revoke_leadership()
+
+    def _guarded_loop(self):
+        while True:
+            try:
+                self.tick()
+            except Exception:
+                pass
+
+    def start(self):
+        RaftNode(on_leader=self._on_raft_leader)
+        threading.Thread(target=self._guarded_loop).start()
+'''
+
+
+def test_thread_flags_unguarded_daemon_callbacks():
+    got = findings(THREAD_BAD, ("thread",))
+    assert len(got) == 1, got
+    assert "_on_raft_leader" in got[0][3]
+
+
+def test_thread_accepts_guarded_targets():
+    assert findings(THREAD_GOOD, ("thread",)) == []
+
+
+# ---------------------------------------------------------- suppression
+
+def test_suppression_silences_only_its_pass():
+    suppressed = THREAD_BAD.replace(
+        "def _on_raft_leader(self):",
+        "def _on_raft_leader(self):  # analyze: ok thread")
+    assert findings(suppressed, ("thread",)) == []
+    # the wrong pass name does NOT silence it
+    wrong = THREAD_BAD.replace(
+        "def _on_raft_leader(self):",
+        "def _on_raft_leader(self):  # analyze: ok cow")
+    assert len(findings(wrong, ("thread",))) == 1
+    # the wildcard silences everything on the line
+    wild = THREAD_BAD.replace(
+        "def _on_raft_leader(self):",
+        "def _on_raft_leader(self):  # analyze: ok *")
+    assert findings(wild, ("thread",)) == []
+
+
+def test_suppression_is_per_line():
+    two = COW_DIRECT  # two violations on two different lines
+    one_off = two.replace(
+        "self._csi_volumes.pop(key, None)",
+        "self._csi_volumes.pop(key, None)  # analyze: ok cow")
+    got = findings(one_off, ("cow",))
+    assert len(got) == 1, got
+
+
+# ----------------------------------------------------- selftest + repo
+
+def test_selftest_green():
+    assert analyze.selftest() == 0
+
+
+def test_repo_is_violation_free():
+    """The same gate scripts/ci.sh runs: every pass over its scoped
+    files, zero findings.  A true positive introduced by a future PR
+    fails HERE with the file:line in the assertion message."""
+    got = analyze.analyze_repo()
+    assert got == [], "\n".join(
+        f"{p}:{ln}: [{name}] {m}" for p, ln, name, m in got)

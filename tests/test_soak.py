@@ -1,5 +1,11 @@
 """Multi-wave soak: the whole pipeline under sustained churn.
 
+Two tests: mixed-churn global invariants (I1-I5 below), and a
+wave-scale TIMING guard — per-wave materialize/commit host time must
+stay flat as committed state accumulates (a COW/snapshot-isolation
+regression that re-copies ever-growing tables per write shows up here
+as superlinear growth long before it shows up as a wrong answer).
+
 The per-feature suites pin individual behaviors; this drives the REAL
 server loop (broker → batched workers → plan queue → serialized
 applier) through several waves of mixed work — zoned CSI jobs riding
@@ -170,4 +176,62 @@ def test_soak_mixed_churn():
     by_id = {nid: i for i, nid in enumerate(t2.node_ids)}
     order = [by_id[nid] for nid in t.node_ids]
     assert np.array_equal(t.used, t2.used[order])
+    s.shutdown()
+
+
+def test_soak_wave_timing_stays_flat():
+    """N identical waves through the real batched pipeline; the host
+    materialize+commit time of the LAST waves must stay within 2x of
+    the FIRST waves (VERDICT next-round #8: per-wave cost must not grow
+    with accumulated cluster state).  Medians over 3-wave windows so a
+    single scheduler hiccup on a shared host cannot flip the verdict;
+    a small absolute floor keeps sub-millisecond noise out of the
+    ratio."""
+    import statistics
+    import time
+
+    rng = random.Random(11)
+    s = Server(dev_mode=True, eval_batch=64, heartbeat_ttl=1e9)
+    s.establish_leadership()
+    for i in range(80):
+        n = mock.node()
+        n.datacenter = f"dc{1 + i % 3}"
+        n.resources.cpu = rng.choice([8000, 16000])
+        n.resources.memory_mb = 32768
+        s.register_node(n, now=NOW)
+
+    def wave(now, cpu):
+        # several jobs at once so the broker batches them and the
+        # pipeline's materialize stage (not the single-eval path) runs
+        for _ in range(6):
+            job = mock.batch_job()
+            job.datacenters = ["dc1", "dc2", "dc3"]
+            tg = job.task_groups[0]
+            tg.count = 40
+            tg.tasks[0].resources.cpu = cpu
+            tg.tasks[0].resources.memory_mb = 4
+            s.register_job(job, now=now)
+        s.stage_timers.reset()
+        t0 = time.perf_counter()
+        s.process_all(now=now)
+        wall = time.perf_counter() - t0
+        totals = s.stage_timers.totals()
+        host = totals.get("materialize", 0.0) + totals.get("commit", 0.0)
+        assert totals.get("materialize", 0.0) > 0.0, totals
+        assert totals.get("commit", 0.0) > 0.0, totals
+        return host, wall
+
+    n_waves = 9
+    now = NOW
+    wave(now, cpu=1)                       # warmup: compiles excluded
+    host_times = []
+    for w in range(n_waves):
+        now += 10
+        host_times.append(wave(now, cpu=1)[0])
+    first = statistics.median(host_times[:3])
+    last = statistics.median(host_times[-3:])
+    # flat within 2x, with a 10ms absolute floor for timer noise
+    assert last <= max(2.0 * first, first + 0.010), (
+        f"per-wave materialize/commit grew {first:.4f}s -> {last:.4f}s "
+        f"over {n_waves} waves: {[round(t, 4) for t in host_times]}")
     s.shutdown()
