@@ -32,7 +32,9 @@ class Agent:
                  encrypt: str = "",
                  region: str = "global",
                  join_wan: Optional[List[str]] = None,
-                 join_wan_token: str = "") -> None:
+                 join_wan_token: str = "",
+                 transport: str = "tcp",
+                 clock: str = "wall") -> None:
         # cluster shared secret: encrypt + authenticate every server-plane
         # wire frame (raft/gossip/RPC) — core/wire.py.  The key is
         # process-global (one cluster per process): set_key raises on a
@@ -60,6 +62,13 @@ class Agent:
             import tempfile
             data_dir = tempfile.mkdtemp(prefix="nomad-tpu-agent-")
         self.data_dir = data_dir
+        # cluster-plane seams (agent_config server { transport, clock }):
+        # "sim"/"virtual" put this agent's whole server plane on the
+        # process-shared SimNetwork/VirtualClock — fault injection by
+        # config, not by test-only monkeypatching.  Transport/Clock
+        # instances pass through for embedding scenarios directly.
+        from nomad_tpu.chaos import resolve_clock, resolve_transport
+        self.clock = resolve_clock(clock)
         cluster_mode = bool(server_name or join or bootstrap_expect > 1)
         if cluster_mode:
             # multi-server: raft-replicated state + gossip membership
@@ -76,17 +85,23 @@ class Agent:
                     raise ValueError(
                         f"-join expects host:port, got {s!r}")
                 seeds.append((host, int(port)))
+            name = server_name or f"server-{uuid.uuid4().hex[:8]}"
+            self.transport = resolve_transport(transport, node_name=name,
+                                               clock=self.clock)
             self.server = ClusterServer(
-                server_name or f"server-{uuid.uuid4().hex[:8]}",
+                name,
                 rpc_port=rpc_port, raft_port=raft_port, serf_port=serf_port,
                 join=seeds, data_dir=data_dir,
                 bootstrap_expect=bootstrap_expect,
                 num_workers=num_workers, heartbeat_ttl=heartbeat_ttl,
-                acl_enabled=acl_enabled)
+                acl_enabled=acl_enabled,
+                transport=self.transport, clock=self.clock)
         else:
+            self.transport = resolve_transport(transport, node_name="agent",
+                                               clock=self.clock)
             self.server = Server(num_workers=num_workers, dev_mode=False,
                                  heartbeat_ttl=heartbeat_ttl,
-                                 acl_enabled=acl_enabled)
+                                 acl_enabled=acl_enabled, clock=self.clock)
         self.clients: List[Client] = []
         if client_enabled:
             if cluster_mode:
@@ -94,7 +109,10 @@ class Agent:
                 # mid-election): clients go through the TCP RPC, which
                 # forwards writes to the leader and retries transitions
                 from .core.cluster import RemoteRPC
-                rpc = RemoteRPC([self.server.rpc.addr])
+                # same transport as the server plane: under "sim" the
+                # clients' RPC frames ride the simulated fabric too
+                rpc = RemoteRPC([self.server.rpc.addr],
+                                transport=self.transport)
             else:
                 rpc = InProcessRPC(self.server)
             import os

@@ -11,6 +11,8 @@ Supported shape (a practical subset of the reference's):
       num_schedulers = 2
       heartbeat_ttl  = "30s"
       acl_enabled    = false
+      transport      = "tcp"      # or "sim"  (nomad_tpu/chaos/)
+      clock          = "wall"     # or "virtual"
     }
     client {
       enabled    = true
@@ -50,6 +52,13 @@ class AgentConfig:
     # every server-plane wire frame (reference: the serf `encrypt`
     # gossip key); empty = plaintext (dev)
     encrypt: str = ""
+    # cluster-plane seams (nomad_tpu/chaos/): "tcp" speaks real sockets
+    # on the wall clock (production default); "sim"/"virtual" route the
+    # same wire frames through the in-process SimNetwork/VirtualClock
+    # so fault-injection scenarios are a config choice, not a
+    # test-only monkeypatch
+    transport: str = "tcp"
+    clock: str = "wall"
 
     def merge(self, other: "AgentConfig",
               set_fields: set) -> "AgentConfig":
@@ -64,7 +73,7 @@ class AgentConfig:
 _BLOCK_KEYS = {
     "ports": {"http"},
     "server": {"enabled", "num_schedulers", "heartbeat_ttl",
-               "acl_enabled"},
+               "acl_enabled", "transport", "clock"},
     "client": {"enabled", "count", "node_class", "datacenter"},
     "acl": {"enabled"},
 }
@@ -119,6 +128,20 @@ def parse_agent_config(src: str):
                         parse_duration(body["heartbeat_ttl"], 30.0))
                 if "acl_enabled" in body:
                     put("acl_enabled", bool(body["acl_enabled"]))
+                if "transport" in body:
+                    v = str(body["transport"])
+                    if v not in ("tcp", "sim"):
+                        raise ValueError(
+                            f"server transport must be 'tcp' or 'sim', "
+                            f"got {v!r}")
+                    put("transport", v)
+                if "clock" in body:
+                    v = str(body["clock"])
+                    if v not in ("wall", "virtual"):
+                        raise ValueError(
+                            f"server clock must be 'wall' or 'virtual', "
+                            f"got {v!r}")
+                    put("clock", v)
             elif node.type == "client":
                 if "enabled" in body:
                     put("client_enabled", bool(body["enabled"]))

@@ -295,7 +295,9 @@ class Router:
         head = p[0] if p else ""
         if head == "jobs":
             if method == "GET":
-                self._block(qs)
+                self._block(qs, result_index=lambda: max(
+                    (j.modify_index for j in s.state.snapshot().jobs()
+                     if j.namespace == ns or ns == "*"), default=0))
                 snap = s.state.snapshot()
                 out = [_stub(j) for j in snap.jobs()
                        if j.namespace == ns or ns == "*"]
@@ -340,7 +342,9 @@ class Router:
                 return fed.regions()
         elif head == "nodes":
             if method == "GET":
-                self._block(qs)
+                self._block(qs, result_index=lambda: max(
+                    (n.modify_index
+                     for n in s.state.snapshot().nodes()), default=0))
                 return sorted((_node_stub(n)
                                for n in s.state.snapshot().nodes()),
                               key=lambda n: n["ID"])
@@ -1266,14 +1270,35 @@ class Router:
                 return v
             _time.sleep(0.02)
 
-    def _block(self, qs: Dict[str, List[str]]) -> None:
-        """Minimal blocking-query support (reference: blockingRPC)."""
+    def _block(self, qs: Dict[str, List[str]],
+               result_index=None) -> None:
+        """Minimal blocking-query support (reference: blockingRPC).
+        With `result_index` — a callable returning the watched result
+        set's max modify index — the wait re-arms until THAT passes the
+        caller's index: a write to an unrelated table must not wake a
+        jobs watcher with an unchanged jobs list (the reference blocks
+        on the queried table's index, not the global one).  A deletion
+        can't raise the result's max index, so pure-removal changes ride
+        the wait timeout; blocking clients re-poll on timeout anyway."""
         idx = qs.get("index")
         if not idx:
             return
-        wait = float((qs.get("wait") or ["5"])[0])
-        self.server.state.wait_for_index(int(idx[0]) + 1,
-                                         timeout=min(wait, 30.0))
+        n = int(idx[0])
+        wait = min(float((qs.get("wait") or ["5"])[0]), 30.0)
+        state = self.server.state
+        if result_index is None:
+            state.wait_for_index(n + 1, timeout=wait)
+            return
+        import time as _time
+        deadline = _time.time() + wait
+        while result_index() <= n:
+            remaining = deadline - _time.time()
+            if remaining <= 0:
+                return
+            # wake on the next store write, re-check the RESULT's index
+            # (1s re-arm slice bounds the unrelated-write wakeup churn)
+            state.wait_for_index(state.latest_index() + 1,
+                                 timeout=min(remaining, 1.0))
 
     def _plan(self, job: Job, diff: bool) -> Dict[str, Any]:
         """Dry-run the scheduler on a snapshot with a no-op planner

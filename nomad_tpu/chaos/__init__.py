@@ -1,0 +1,50 @@
+"""Deterministic simulation & fault injection for the cluster plane
+(reference technique: FoundationDB's simulator + Jepsen-style fault
+schedules; TigerBeetle's VOPR is the same idea).
+
+The cluster layer (core/raft.py, core/membership.py, core/cluster.py,
+core/server.py) is written against two seams this package owns:
+
+  - `chaos.clock.Clock`       — time source (monotonic/time/sleep/wait).
+    `SystemClock` is the wall clock; `VirtualClock` is advanced
+    explicitly by a scenario driver, so a 5-minute soak runs in seconds
+    and timeouts fire deterministically.
+  - `chaos.transport.Transport` — message transport.  `TCPTransport` is
+    the production length-prefixed-msgpack-over-TCP path (core/wire.py
+    framing, optional AES-GCM); `SimTransport` routes the same wire
+    payloads through an in-memory `SimNetwork` with seeded, schedulable
+    faults: partitions (bidirectional or asymmetric), per-link drop
+    probability, added latency, reordering, and endpoint crash/restart.
+
+On top of the seams:
+
+  - `chaos.trace`      — canonical event traces (same seed => identical
+    bytes) + canonical state-store fingerprints for replay checks.
+  - `chaos.invariants` — cluster safety checks (single leader per term,
+    committed log prefix consistency, no deposed-leader plan commit,
+    membership/leadership convergence, alloc coherence).
+  - `chaos.scenarios`  — named, seeded fault schedules executed against
+    real `ClusterServer`s (import directly: `nomad_tpu.chaos.scenarios`;
+    it pulls in the cluster layer, which this package root must not).
+
+This package sits BELOW the cluster layer: core/raft.py and friends
+import `chaos.clock` / `chaos.transport` (the seams), never the other
+way around; only `chaos.scenarios` looks upward at core/cluster.py.
+"""
+
+from .clock import Clock, SystemClock, VirtualClock, resolve_clock
+from .transport import (
+    Connection,
+    Listener,
+    SimNetwork,
+    SimTransport,
+    TCPTransport,
+    Transport,
+    resolve_transport,
+)
+
+__all__ = [
+    "Clock", "SystemClock", "VirtualClock", "resolve_clock",
+    "Connection", "Listener", "Transport", "TCPTransport",
+    "SimNetwork", "SimTransport", "resolve_transport",
+]

@@ -1,0 +1,198 @@
+"""Clock abstraction: wall clock vs. scenario-driven virtual time.
+
+Every timer in the cluster plane (raft election timeouts, gossip probe
+intervals, heartbeat TTLs, server ticks) reads time and blocks through a
+`Clock` so a fault-injection scenario can own the timeline: with a
+`VirtualClock`, `advance()` is the only thing that makes time pass, a
+5-minute soak runs in however long the scheduler work itself takes, and
+"wait 30s for the TTL to expire" is one method call instead of 30 real
+seconds.
+
+Design constraints honored here:
+
+  - Threads block in `wait(event, timeout)` / `sleep()`; with a virtual
+    clock they are parked on one Condition that `advance()` notifies.
+    A small REAL-time backstop re-check (`_BACKSTOP_S`) covers stop
+    events set by code that doesn't know about the clock — bounded
+    staleness, never a hang.
+  - `register(cond)` lets other virtual-time waiters (the simulated
+    transport's delivery queues) be poked on every advance.
+  - `close()` releases every sleeper (scenario teardown): a daemon
+    thread parked in virtual `sleep()` must not outlive its scenario.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+# real-time re-check period for virtual waits: covers events set by
+# clock-unaware code and keeps a frozen timeline from hanging threads
+_BACKSTOP_S = 0.05
+
+
+class Clock:
+    """Time source interface.  `monotonic`/`time` mirror the `time`
+    module; `wait` is `event.wait(timeout)` in clock-time; `sleep` is
+    `time.sleep` in clock-time."""
+
+    kind = "abstract"
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def time(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def wait(self, event: threading.Event, timeout: float) -> bool:
+        raise NotImplementedError
+
+    # virtual-clock integration points; no-ops on real clocks so callers
+    # never need an isinstance check
+    def register(self, cond: threading.Condition) -> None:
+        pass
+
+    def unregister(self, cond: threading.Condition) -> None:
+        pass
+
+
+class SystemClock(Clock):
+    """Pass-through to the wall clock — the production default."""
+
+    kind = "wall"
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def time(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    def wait(self, event: threading.Event, timeout: float) -> bool:
+        return event.wait(timeout)
+
+
+class VirtualClock(Clock):
+    """Discrete virtual time: `advance(dt)` is the only way time moves.
+
+    `time()` is anchored to the wall-clock epoch at construction so
+    epoch-based bookkeeping (ACL expiry, heartbeat deadlines) stays in a
+    plausible range, but advances only with the virtual timeline."""
+
+    kind = "virtual"
+
+    def __init__(self, start: float = 0.0,
+                 epoch: Optional[float] = None) -> None:
+        self._now = float(start)
+        self._epoch = time.time() if epoch is None else float(epoch)
+        self._cv = threading.Condition()
+        self._closed = False
+        self._extern: list = []          # Conditions to poke on advance
+        self._extern_lock = threading.Lock()
+
+    # ------------------------------------------------------------- reads
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def time(self) -> float:
+        return self._epoch + self._now
+
+    # ----------------------------------------------------------- waiting
+
+    def sleep(self, seconds: float) -> None:
+        deadline = self._now + max(0.0, seconds)
+        with self._cv:
+            while self._now < deadline and not self._closed:
+                self._cv.wait(_BACKSTOP_S)
+
+    def wait(self, event: threading.Event, timeout: float) -> bool:
+        deadline = self._now + max(0.0, timeout)
+        with self._cv:
+            while (not event.is_set() and self._now < deadline
+                   and not self._closed):
+                self._cv.wait(_BACKSTOP_S)
+        return event.is_set()
+
+    # ----------------------------------------------------------- driving
+
+    def advance(self, dt: float) -> float:
+        """Move virtual time forward and wake every waiter (sleepers,
+        event waits, and registered external conditions like simulated
+        connection inboxes).  Returns the new now."""
+        with self._cv:
+            self._now += max(0.0, dt)
+            now = self._now
+            self._cv.notify_all()
+        with self._extern_lock:
+            conds = list(self._extern)
+        for c in conds:
+            with c:
+                c.notify_all()
+        return now
+
+    def close(self) -> None:
+        """Release every sleeper (scenario teardown).  Waits return as
+        if their deadline passed; daemon threads then observe their stop
+        events and exit."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        with self._extern_lock:
+            conds = list(self._extern)
+        for c in conds:
+            with c:
+                c.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ------------------------------------------------- external waiters
+
+    def register(self, cond: threading.Condition) -> None:
+        with self._extern_lock:
+            if cond not in self._extern:
+                self._extern.append(cond)
+
+    def unregister(self, cond: threading.Condition) -> None:
+        with self._extern_lock:
+            try:
+                self._extern.remove(cond)
+            except ValueError:
+                pass
+
+
+# ------------------------------------------------------------ config glue
+
+_shared_virtual: Optional[VirtualClock] = None
+_shared_lock = threading.Lock()
+
+
+def shared_virtual_clock() -> VirtualClock:
+    """Process-global VirtualClock for config-selected virtual time: all
+    in-process agents of one simulated cluster must share a timeline,
+    exactly like they share one wire key (core/wire.py)."""
+    global _shared_virtual
+    with _shared_lock:
+        if _shared_virtual is None or _shared_virtual.closed:
+            _shared_virtual = VirtualClock()
+        return _shared_virtual
+
+
+def resolve_clock(spec) -> Clock:
+    """Agent-config knob -> Clock.  `spec` is a Clock (passed through),
+    or one of "wall" / "virtual"."""
+    if isinstance(spec, Clock):
+        return spec
+    if spec in (None, "", "wall", "system"):
+        return SystemClock()
+    if spec == "virtual":
+        return shared_virtual_clock()
+    raise ValueError(f"unknown clock {spec!r} (expected 'wall'/'virtual')")

@@ -126,7 +126,9 @@ def cmd_agent(args) -> int:
                   region=(getattr(args, "agent_region", "")
                           or cfg.region or "global"),
                   join_wan=getattr(args, "join_wan", []) or [],
-                  join_wan_token=getattr(args, "join_wan_token", ""))
+                  join_wan_token=getattr(args, "join_wan_token", ""),
+                  transport=cfg.transport,
+                  clock=cfg.clock)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")

@@ -29,17 +29,22 @@ replication fires the local watch), writes are forwarded.
 
 from __future__ import annotations
 
-import socket
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.chaos.clock import Clock, SystemClock
+from nomad_tpu.chaos.transport import (
+    Connection,
+    TCPTransport,
+    Transport,
+)
 from nomad_tpu.state import StateStore
 
 from . import wire
 from .logging import log
 from .membership import Gossip, Member
-from .raft import NotLeaderError, RaftNode, recv_msg, reply, send_msg
+from .raft import NotLeaderError, RaftNode
 from .server import Server
 
 # Every StateStore mutation that must replicate.  A name here turns the
@@ -124,71 +129,68 @@ class RPCServer:
     forwarded to the leader transparently."""
 
     def __init__(self, cluster: "ClusterServer",
-                 bind: Tuple[str, int] = ("127.0.0.1", 0)) -> None:
+                 bind: Tuple[str, int] = ("127.0.0.1", 0),
+                 transport: Optional[Transport] = None) -> None:
         self.cluster = cluster
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(bind)
-        self._sock.listen(128)
-        self.addr = self._sock.getsockname()
+        self.transport = transport if transport is not None \
+            else TCPTransport()
+        self._listener = self.transport.listen(tuple(bind), "rpc")
+        self.addr = self._listener.addr
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="rpc-listen")
+                                        name=f"rpc-listen-{self.cluster.name}")
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        # shutdown() BEFORE close(): close() does not wake a thread
-        # already blocked in accept() — the in-flight syscall keeps the
-        # file description alive and would accept (and serve!) one more
-        # connection after "close"
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # listener close wakes the accept loop (the TCP implementation
+        # shuts the socket down before closing so an in-flight accept
+        # cannot serve one more connection after "close")
+        self._listener.close()
         if self._thread:
             self._thread.join(timeout=2)
 
     def _loop(self) -> None:
+        backoff = 0.05
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn = self._listener.accept()
             except OSError:
-                # transient (e.g. EMFILE) must not kill RPC serving
+                # transient (e.g. EMFILE) must not kill RPC serving;
+                # capped exponential backoff, not a fixed busy loop
                 if self._stop.is_set():
                     return
-                time.sleep(0.05)
+                self.cluster.clock.wait(self._stop, backoff)
+                backoff = min(backoff * 2, 1.0)
                 continue
+            backoff = 0.05
             if self._stop.is_set():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                conn.close()
                 return
             threading.Thread(target=self._serve, daemon=True,
+                             name=f"rpc-serve-{self.cluster.name}",
                              args=(conn,)).start()
 
-    def _serve(self, conn: socket.socket) -> None:
-        from . import wire
-        req_tag = wire.channel_tag("rpc", "req", self.addr)
-        rep_tag = wire.channel_tag("rpc", "rep", self.addr)
-        with conn:
-            msg = recv_msg(conn, timeout=30.0, tag=req_tag)
+    def _serve(self, conn: Connection) -> None:
+        def answer(resp: dict) -> None:
+            try:
+                conn.send(resp)
+            except OSError:
+                pass                # caller vanished; it will retry
+
+        try:
+            msg = conn.recv(timeout=30.0)
             if msg is None:
                 return
             method = msg.get("method", "")
             if self.cluster._stopping.is_set():
                 # shutting down: refuse with a retryable redirect rather
                 # than executing against a dying server
-                reply(conn, {"ok": False, "not_leader": True,
-                             "leader_rpc": None}, tag=rep_tag)
+                answer({"ok": False, "not_leader": True,
+                        "leader_rpc": None})
                 return
             args = msg.get("args", ())
             kwargs = msg.get("kwargs", {})
@@ -196,21 +198,25 @@ class RPCServer:
                 if msg.get("fwd") and not self.cluster.is_leader():
                     # one-hop rule: a forwarded request landing on another
                     # non-leader bounces back instead of chaining hops
-                    reply(conn, {"ok": False, "not_leader": True,
-                                 "leader_rpc":
-                                     self.cluster.leader_rpc_addr()},
-                          tag=rep_tag)
+                    answer({"ok": False, "not_leader": True,
+                            "leader_rpc": self.cluster.leader_rpc_addr()})
                     return
                 result = self.cluster.rpc_call(method, args, kwargs)
-                reply(conn, {"ok": True, "result": result}, tag=rep_tag)
-            except NotLeaderError as e:
-                reply(conn, {"ok": False, "not_leader": True,
-                             "leader_rpc": self.cluster.leader_rpc_addr()},
-                      tag=rep_tag)
+                answer({"ok": True, "result": result})
+            except NotLeaderError:
+                answer({"ok": False, "not_leader": True,
+                        "leader_rpc": self.cluster.leader_rpc_addr()})
             except Exception as e:  # noqa: BLE001 - surface to the caller
-                reply(conn, {"ok": False,
-                             "error": f"[{self.cluster.name}] {e!r}"},
-                      tag=rep_tag)
+                answer({"ok": False,
+                        "error": f"[{self.cluster.name}] {e!r}"})
+        except Exception as exc:  # noqa: BLE001 - daemon thread
+            # a recv/answer failure outside the dispatch net (caller
+            # vanished mid-frame, transport torn down by a chaos crash)
+            # must not kill the serve thread silently
+            log("rpc", "debug", "serve failed",
+                server=self.cluster.name, error=repr(exc))
+        finally:
+            conn.close()
 
 
 class RemoteRPC:
@@ -218,8 +224,11 @@ class RemoteRPC:
     TCP to any server with automatic leader-redirect and server failover
     (reference: client/rpc.go + client/servers pool)."""
 
-    def __init__(self, servers: List[Tuple[str, int]]) -> None:
+    def __init__(self, servers: List[Tuple[str, int]],
+                 transport: Optional[Transport] = None) -> None:
         self.servers = [tuple(a) for a in servers]
+        self.transport = transport if transport is not None \
+            else TCPTransport()
         self._preferred = 0
 
     def call(self, method: str, *args, timeout: float = 35.0,
@@ -229,9 +238,9 @@ class RemoteRPC:
             order = (self.servers[self._preferred:]
                      + self.servers[:self._preferred])
             for i, addr in enumerate(order):
-                r = send_msg(tuple(addr), {"method": method, "args": args,
-                                           "kwargs": kwargs},
-                             timeout=timeout)
+                r = self.transport.request(
+                    tuple(addr), {"method": method, "args": args,
+                                  "kwargs": kwargs}, timeout=timeout)
                 if r is None:
                     last_err = f"no response from {addr}"
                     continue
@@ -303,11 +312,26 @@ class ClusterServer(Server):
                  bootstrap_expect: int = 1,
                  heartbeat_interval: Optional[float] = None,
                  election_timeout: Optional[Tuple[float, float]] = None,
+                 transport: Optional[Transport] = None,
+                 clock: Optional[Clock] = None,
                  **server_kwargs) -> None:
         self.name = name
+        # one transport + one clock for every plane of this server
+        # (raft, serf, rpc, the Server's tick timers): chaos scenarios
+        # inject SimTransport + VirtualClock here via agent config or
+        # directly; production defaults are TCP + wall clock
+        self.transport = transport if transport is not None \
+            else TCPTransport()
+        # follower->leader write-forward RPC timeout, in CLOCK seconds.
+        # A knob (not a literal in _forward) because under a VirtualClock
+        # 35 virtual seconds is most of a chaos scenario's converge
+        # budget — one dropped reply would wedge a workload op for the
+        # whole run; scenarios dial this down to a few virtual seconds
+        self.forward_timeout = 35.0
         self._local_state = StateStore()
         proxy = ReplicatedState(self._local_state)
-        super().__init__(dev_mode=False, state=proxy, **server_kwargs)
+        super().__init__(dev_mode=False, state=proxy, clock=clock,
+                         **server_kwargs)
         self.autopilot_grace = autopilot_grace
 
         raft_kwargs = {}
@@ -324,18 +348,23 @@ class ClusterServer(Server):
             on_follower=self.revoke_leadership,
             data_dir=data_dir,
             bootstrap_expect=bootstrap_expect,
+            transport=self.transport,
+            clock=self.clock,
             **raft_kwargs)
         proxy.raft = self.raft
         proxy.forward = self._forward
 
-        self.rpc = RPCServer(self, (host, rpc_port))
+        self.rpc = RPCServer(self, (host, rpc_port),
+                             transport=self.transport)
         # server-level endpoint methods forward to the leader when called
         # on a follower (HTTP API / local CLI against any server)
         self._wrap_forwarding()
         self.gossip = Gossip(
             name, (host, serf_port),
             meta={"raft": self.raft.addr, "rpc": self.rpc.addr},
-            on_change=self._on_members_changed)
+            on_change=self._on_members_changed,
+            transport=self.transport,
+            clock=self.clock)
         self._join_seeds = list(join or [])
         self._autopilot_thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
@@ -398,14 +427,25 @@ class ClusterServer(Server):
             except NotLeaderError:
                 # lost (or not yet committed) leadership mid-callback:
                 # loop re-checks is_leader and either retries or gives up
-                time.sleep(0.05)
+                self.clock.sleep(0.05)
             except Exception as exc:  # noqa: BLE001 - abdicate, not die
                 log("cluster", "warn", "establish_leadership failed",
                     server=self.name, error=repr(exc))
-                time.sleep(0.05)
+                self.clock.sleep(0.05)
         # no longer leader (or establishment kept failing): make the
         # local leader-only machinery consistent with follower state
         self.revoke_leadership()
+
+    def establish_leadership(self) -> None:
+        """Leadership barrier before establishment (reference: the raft
+        Barrier in leaderLoop): every entry this leadership inherited
+        must be APPLIED locally before the broker restores pending evals
+        from a state snapshot — otherwise a re-run eval can schedule
+        against state that predates an already-committed plan and place
+        a duplicate alloc."""
+        if not self.raft.barrier(timeout=10.0):
+            raise NotLeaderError(self.raft.leader_hint())
+        super().establish_leadership()
 
     def is_leader(self) -> bool:
         return self.raft.is_leader()
@@ -472,9 +512,10 @@ class ClusterServer(Server):
         addr = self.leader_rpc_addr()
         if addr is None:
             raise NotLeaderError(None)
-        r = send_msg(tuple(addr), {"method": method, "args": args,
-                                   "kwargs": kwargs, "fwd": True},
-                     timeout=35.0)
+        r = self.transport.request(
+            tuple(addr), {"method": method, "args": args,
+                          "kwargs": kwargs, "fwd": True},
+            timeout=self.forward_timeout)
         if r is None:
             raise ConnectionError(f"leader {addr} unreachable")
         if r.get("ok"):
@@ -500,12 +541,12 @@ class ClusterServer(Server):
         (see raft.py docstring), so every server reaps for itself behind
         the same quorum guard — membership converges without tombstone
         gossip."""
-        while not self._stopping.wait(1.0):
+        while not self.clock.wait(self._stopping, 1.0):
             # a reap hiccup (socket teardown race at shutdown, a peer
             # vanishing mid-removal) must not kill autopilot for the
             # server's whole lifetime — log and try again next tick
             try:
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 with self.gossip._lock:
                     members = list(self.gossip.members.values())
                     alive = sum(1 for m in members

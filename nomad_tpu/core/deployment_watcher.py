@@ -22,7 +22,6 @@ deadline bookkeeping is wall-clock based, like the heartbeat timers.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 from nomad_tpu.core.logging import log
@@ -61,7 +60,7 @@ class DeploymentWatcher:
     # ---------------------------------------------------------------- tick
 
     def tick(self, now: Optional[float] = None) -> None:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         snap = self.server.state.snapshot()
         for dep in snap.deployments():
             if dep.status != DEPLOYMENT_STATUS_RUNNING:
@@ -247,7 +246,7 @@ class DeploymentWatcher:
                 now: Optional[float] = None) -> Optional[str]:
         """reference: Deployment.Promote RPC.  Returns an error string or
         None."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         dep = self.server.state.deployment_by_id(dep_id)
         if dep is None:
             return "deployment not found"
@@ -279,7 +278,7 @@ class DeploymentWatcher:
         return None
 
     def fail(self, dep_id: str, now: Optional[float] = None) -> Optional[str]:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         dep = self.server.state.deployment_by_id(dep_id)
         if dep is None:
             return "deployment not found"
@@ -304,5 +303,5 @@ class DeploymentWatcher:
             updated.status_description = DESC_RESUMED
         self.server.state.upsert_deployment(updated)
         if not pause:
-            self._create_eval(updated, now if now is not None else time.time())
+            self._create_eval(updated, now if now is not None else self.server.clock.time())
         return None

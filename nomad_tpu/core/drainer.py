@@ -21,7 +21,6 @@ Orchestrates node drains end to end:
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.core.logging import log
@@ -46,7 +45,7 @@ class NodeDrainer:
                    now: Optional[float] = None) -> None:
         """Start (or cancel, with strategy=None) a drain.
         reference: Node.UpdateDrain RPC."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         if strategy is not None:
             # own copy: stamping force_deadline on the caller's object
             # would leak into reuses of the same strategy (and into
@@ -67,7 +66,7 @@ class NodeDrainer:
     # --------------------------------------------------------------- tick
 
     def tick(self, now: Optional[float] = None) -> None:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         snap = self.server.state.snapshot()
         for node in snap.nodes():
             if node.drain is not None:

@@ -12,21 +12,26 @@ bumping its incarnation — straight SWIM, minus the indirect-probe round
 (loopback/LAN links don't partition one-way often enough to pay for it;
 the reference's memberlist does implement it).
 
-Transport: the same length-prefixed msgpack framing as raft.py (see
-core.wire — data-only, optional HMAC frame auth), TCP.
+Transport and clock are injected seams (chaos/transport.py,
+chaos/clock.py): by default the same length-prefixed msgpack framing as
+raft.py over TCP and the wall clock; chaos scenarios swap in
+SimTransport + VirtualClock so suspicion timeouts and probe rounds run
+in seeded virtual time.  Member.status_time is stamped from the
+injected clock for exactly that reason — a `time.monotonic()` default
+would make suspicion deadlines wall-bound and nondeterministic.
 """
 
 from __future__ import annotations
 
 import random
-import socket
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Tuple
 
+from nomad_tpu.chaos.clock import Clock, SystemClock
+from nomad_tpu.chaos.transport import Connection, TCPTransport, Transport
+
 from .logging import log
-from .raft import recv_msg, reply, send_msg
 
 ALIVE = "alive"
 SUSPECT = "suspect"
@@ -46,7 +51,10 @@ class Member:
     meta: Dict[str, object] = field(default_factory=dict)
     incarnation: int = 0
     status: str = ALIVE
-    status_time: float = field(default_factory=time.monotonic)
+    # stamped by the OWNING Gossip's injected clock (never a wall-clock
+    # default_factory: suspicion timeouts must be deterministic under a
+    # VirtualClock)
+    status_time: float = 0.0
 
     def to_wire(self) -> dict:
         return {"name": self.name, "addr": tuple(self.addr),
@@ -61,24 +69,28 @@ class Gossip:
                  meta: Optional[Dict[str, object]] = None,
                  on_change: Optional[Callable[[Dict[str, Member]], None]] = None,
                  probe_interval: float = PROBE_INTERVAL,
-                 suspect_timeout: float = SUSPECT_TIMEOUT) -> None:
+                 suspect_timeout: float = SUSPECT_TIMEOUT,
+                 transport: Optional[Transport] = None,
+                 clock: Optional[Clock] = None) -> None:
         self.name = name
         self.meta = meta or {}
         self.on_change = on_change
         self.probe_interval = probe_interval
         self.suspect_timeout = suspect_timeout
+        self.transport = transport if transport is not None \
+            else TCPTransport()
+        self.clock = clock if clock is not None else SystemClock()
         self._incarnation = 0
+        self._probe_round = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._threads = []
 
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(bind)
-        self._sock.listen(16)
-        self.addr = self._sock.getsockname()
+        self._listener = self.transport.listen(tuple(bind), "serf")
+        self.addr = self._listener.addr
         self.members: Dict[str, Member] = {
-            name: Member(name=name, addr=self.addr, meta=self.meta)}
+            name: Member(name=name, addr=self.addr, meta=self.meta,
+                         status_time=self.clock.monotonic())}
 
     # ------------------------------------------------------------ control
 
@@ -92,23 +104,17 @@ class Gossip:
 
     def stop(self) -> None:
         self._stop.set()
-        # shutdown() BEFORE close(): close() does not wake a thread
-        # already blocked in accept() (see cluster.RPCServer.stop)
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # listener close wakes the accept loop (the TCP implementation
+        # shuts the socket down before closing — see TCPListener.close)
+        self._listener.close()
         for t in self._threads:
             t.join(timeout=2)
 
     def join(self, seed: Tuple[str, int]) -> bool:
         """Push-pull state sync with any existing member."""
-        r = send_msg(seed, {"type": "sync", "members": self._wire_members()},
-                     timeout=2.0, channel="serf")
+        r = self.transport.request(
+            seed, {"type": "sync", "members": self._wire_members()},
+            timeout=2.0, channel="serf")
         if r is None:
             return False
         self._merge(r.get("members", []))
@@ -120,12 +126,13 @@ class Gossip:
             me = self.members[self.name]
             me.status = LEFT
             me.incarnation += 1
-            wire = self._wire_members()
+            wire_members = self._wire_members()
             peers = [m for m in self.members.values()
                      if m.name != self.name and m.status == ALIVE]
         for m in peers:
-            send_msg(m.addr, {"type": "sync", "members": wire}, timeout=0.5,
-                     channel="serf")
+            self.transport.request(
+                m.addr, {"type": "sync", "members": wire_members},
+                timeout=0.5, channel="serf")
 
     def alive_members(self) -> Dict[str, Member]:
         with self._lock:
@@ -161,7 +168,8 @@ class Gossip:
                 if cur is None:
                     self.members[nm] = Member(
                         name=nm, addr=tuple(w["addr"]), meta=w["meta"],
-                        incarnation=w["inc"], status=w["status"])
+                        incarnation=w["inc"], status=w["status"],
+                        status_time=self.clock.monotonic())
                     changed = True
                     continue
                 newer = (w["inc"], _PRECEDENCE[w["status"]]) > \
@@ -173,7 +181,7 @@ class Gossip:
                     cur.status = w["status"]
                     cur.meta = w["meta"]
                     cur.addr = tuple(w["addr"])
-                    cur.status_time = time.monotonic()
+                    cur.status_time = self.clock.monotonic()
         if changed:
             self._notify()
 
@@ -185,57 +193,71 @@ class Gossip:
                 log("gossip", "error", "on_change failed", error=str(exc))
 
     def _listen_loop(self) -> None:
+        backoff = 0.05
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn = self._listener.accept()
             except OSError:
                 # transient (e.g. EMFILE) must not silence the member
-                # permanently — it would be declared dead while healthy
+                # permanently — it would be declared dead while healthy.
+                # Capped exponential backoff: a fixed retry under a
+                # persistent fault is a busy loop
                 if self._stop.is_set():
                     return
-                time.sleep(0.05)
+                self.clock.wait(self._stop, backoff)
+                backoff = min(backoff * 2, 1.0)
                 continue
+            backoff = 0.05
             if self._stop.is_set():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                conn.close()
                 return
             threading.Thread(target=self._serve, daemon=True,
+                             name=f"gossip-serve-{self.name}",
                              args=(conn,)).start()
 
-    def _serve(self, conn: socket.socket) -> None:
-        from . import wire
+    def _serve(self, conn: Connection) -> None:
         # per-connection daemon thread: a peer vanishing mid-exchange or
         # a malformed frame must not leave a silent corpse
         try:
-            with conn:
-                msg = recv_msg(conn, timeout=2.0,
-                               tag=wire.channel_tag("serf", "req",
-                                                    self.addr))
-                if msg is None:
-                    return
-                if msg.get("type") in ("ping", "sync"):
-                    self._merge(msg.get("members", []))
-                    reply(conn, {"type": "ack",
-                                 "members": self._wire_members()},
-                          tag=wire.channel_tag("serf", "rep", self.addr))
+            msg = conn.recv(timeout=2.0)
+            if msg is None:
+                return
+            if msg.get("type") in ("ping", "sync"):
+                self._merge(msg.get("members", []))
+                try:
+                    conn.send({"type": "ack",
+                               "members": self._wire_members()})
+                except OSError:
+                    pass            # peer gone; nothing to ack
         except Exception as exc:  # noqa: BLE001 - daemon thread
             log("serf", "debug", "gossip serve failed", error=repr(exc))
+        finally:
+            conn.close()
 
     def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval):
+        while not self.clock.wait(self._stop, self.probe_interval):
             with self._lock:
                 candidates = [m for m in self.members.values()
                               if m.name != self.name
                               and m.status in (ALIVE, SUSPECT)]
+                dead = [m for m in self.members.values()
+                        if m.name != self.name and m.status == DEAD]
+            # gossip-to-the-dead (reference: memberlist
+            # GossipToTheDeadTime): without an occasional probe of dead
+            # members, a healed partition never re-converges — nobody
+            # contacts the dead side, so it never gets the gossip that
+            # lets it refute its own death.  LEFT members stay left.
+            self._probe_round += 1
+            if dead and (not candidates or self._probe_round % 3 == 0):
+                candidates = candidates + dead
             if not candidates:
                 continue
             target = random.choice(candidates)
-            r = send_msg(target.addr,
-                         {"type": "ping", "members": self._wire_members()},
-                         timeout=0.5, channel="serf")
-            now = time.monotonic()
+            r = self.transport.request(
+                target.addr,
+                {"type": "ping", "members": self._wire_members()},
+                timeout=0.5, channel="serf")
+            now = self.clock.monotonic()
             if r is not None:
                 self._merge(r.get("members", []))
                 revived = False

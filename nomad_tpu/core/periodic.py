@@ -128,14 +128,14 @@ class PeriodicDispatch:
         self._tracked[key] = spec
         if key not in self._next:
             self._next[key] = spec.next(
-                now if now is not None else time.time())
+                now if now is not None else self.server.clock.time())
 
     def remove(self, namespace: str, job_id: str) -> None:
         self._tracked.pop((namespace, job_id), None)
         self._next.pop((namespace, job_id), None)
 
     def tick(self, now: Optional[float] = None) -> List[Job]:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         launched: List[Job] = []
         for key, spec in list(self._tracked.items()):
             nxt = self._next.get(key)
@@ -150,7 +150,7 @@ class PeriodicDispatch:
     def force_run(self, namespace: str, job_id: str,
                   now: Optional[float] = None) -> Optional[Job]:
         """reference: PeriodicDispatch.ForceRun / `nomad job periodic force`"""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         job = self.server.state.job_by_id(namespace, job_id)
         if job is None or job.periodic is None:
             return None
@@ -195,7 +195,7 @@ def dispatch_job(server, namespace: str, job_id: str,
                  now: Optional[float] = None) -> Tuple[Optional[Job], str]:
     """Dispatch a parameterized job (reference: Job.Dispatch RPC).
     Returns (child, error)."""
-    t = now if now is not None else time.time()
+    t = now if now is not None else server.clock.time()
     meta = meta or {}
     parent = server.state.job_by_id(namespace, job_id)
     if parent is None:

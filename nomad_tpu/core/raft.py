@@ -9,13 +9,16 @@ commit on majority match, FSM apply in log order, and snapshot
 install for lagging followers (log compaction via the state store's
 snapshot_save/snapshot_restore).
 
-Transport is length-prefixed msgpack over loopback/LAN TCP via
-core.wire — DATA ONLY (no pickle on any socket: a reachable port must
-never yield code execution), with optional AES-GCM frame encryption
-from the cluster shared secret (`encrypt` agent option; the reference
-likewise runs msgpack-RPC between servers with optional mTLS).  One
-short-lived connection per message keeps the failure model trivial: any
-socket error is a lost message, and Raft is built on lost messages.
+Transport and clock are INJECTED seams (chaos/transport.py,
+chaos/clock.py): the default is length-prefixed msgpack over
+loopback/LAN TCP via core.wire — DATA ONLY (no pickle on any socket: a
+reachable port must never yield code execution), with optional AES-GCM
+frame encryption from the cluster shared secret (`encrypt` agent
+option; the reference likewise runs msgpack-RPC between servers with
+optional mTLS) — and the wall clock; chaos scenarios swap in
+SimTransport + VirtualClock to run seeded partitions/loss/flaps in
+virtual time.  Any transport error is a lost message, and Raft is
+built on lost messages.
 
 Durable files (log/meta on local disk) use pickle — the trust boundary
 is the socket, not the node's own data_dir.
@@ -34,9 +37,16 @@ import random
 import socket
 import struct
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu.chaos.clock import Clock, SystemClock
+from nomad_tpu.chaos.transport import (
+    Connection,
+    TCPTransport,
+    Transport,
+    recv_frame,
+)
 
 from . import wire
 from .logging import log
@@ -65,47 +75,26 @@ class Entry:
     cmd: bytes
 
 
+# Back-compat shims: the cluster layer now speaks through an injected
+# chaos.transport.Transport; these keep the historical one-shot TCP
+# helpers working for external callers (tests, tools).
+_DEFAULT_TCP = TCPTransport()
+
+
 def send_msg(addr: Tuple[str, int], msg: dict, timeout: float = 1.0,
              channel: str = "rpc") -> Optional[dict]:
-    """One-shot request/response; None on any failure.
-    Encoding happens OUTSIDE the net of swallowed errors: an
-    unencodable payload is a local programming error and must raise,
-    not masquerade as a dead server.  `channel` binds the encrypted
-    frame to the destination plane+listener (wire.channel_tag)."""
-    frame = wire.encode_frame(msg, tag=wire.channel_tag(channel, "req", addr))
-    try:
-        with socket.create_connection(addr, timeout=timeout) as s:
-            s.sendall(frame)
-            return recv_msg(s, timeout,
-                            tag=wire.channel_tag(channel, "rep", addr))
-    except (OSError, ValueError, EOFError):
-        return None
+    """One-shot TCP request/response; None on any failure.  Encoding
+    errors still raise (a local programming error must not masquerade
+    as a dead server — see Transport.request)."""
+    return _DEFAULT_TCP.request(tuple(addr), msg, timeout=timeout,
+                                channel=channel)
 
 
 def recv_msg(sock: socket.socket, timeout: float = 5.0,
              tag: bytes = b"") -> Optional[dict]:
-    sock.settimeout(timeout)
-    try:
-        hdr = _recv_exact(sock, 4)
-        if hdr is None:
-            return None
-        (n,) = struct.unpack(">I", hdr)
-        body = _recv_exact(sock, n)
-        if body is None:
-            return None
-        return wire.decode_body(body, tag=tag)
-    except (OSError, ValueError, TypeError, EOFError):
-        return None
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
+    """Read one length-prefixed frame off a raw socket (back-compat
+    alias of chaos.transport.recv_frame)."""
+    return recv_frame(sock, timeout, tag=tag)
 
 
 def reply(sock: socket.socket, msg: dict, tag: bytes = b"") -> None:
@@ -135,8 +124,16 @@ class RaftNode:
                  heartbeat_interval: float = HEARTBEAT_INTERVAL,
                  election_timeout: Tuple[float, float] = ELECTION_TIMEOUT,
                  bootstrap_expect: int = 1,
+                 transport: Optional[Transport] = None,
+                 clock: Optional[Clock] = None,
                  ) -> None:
         self.name = name
+        # injected seams (chaos/): every timer reads `clock`, every
+        # frame rides `transport` — the fault-injection scenarios swap
+        # both; production defaults are wall clock + TCP
+        self.transport = transport if transport is not None \
+            else TCPTransport()
+        self.clock = clock if clock is not None else SystemClock()
         self.fsm_apply = fsm_apply
         self.fsm_snapshot = fsm_snapshot
         self.fsm_restore = fsm_restore
@@ -173,10 +170,22 @@ class RaftNode:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
 
+        # chaos observers (scenario hooks; None in production).
+        # append_observer fires under the lock when THIS node creates an
+        # entry as leader; fsm_observer fires as entries reach the FSM —
+        # together they let chaos/invariants.py prove nothing committed
+        # came from a deposed leader without reading logs.
+        self.append_observer: Optional[Callable[[Entry], None]] = None
+        self.fsm_observer: Optional[Callable[[Entry], None]] = None
+        # fires with (snap_index, snap_term) when a lagging follower
+        # catches up via snapshot install: the observed per-entry apply
+        # stream legitimately jumps over the installed range
+        self.install_observer: Optional[Callable[[int, int], None]] = None
+
         self._lock = threading.RLock()
         self._apply_cv = threading.Condition(self._lock)
         self._waiters: Dict[int, list] = {}   # index -> [event, result, term]
-        self._last_contact = time.monotonic()
+        self._last_contact = self.clock.monotonic()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # one long-lived replicator thread per peer, kicked by an event on
@@ -186,11 +195,8 @@ class RaftNode:
         self._peer_ack: Dict[str, float] = {}   # last response, any kind
         self._lease_start = 0.0
 
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(bind)
-        self._sock.listen(64)
-        self.addr = self._sock.getsockname()
+        self._listener = self.transport.listen(tuple(bind), "raft")
+        self.addr = self._listener.addr
 
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
@@ -216,16 +222,9 @@ class RaftNode:
             if self.role == LEADER:
                 self._become_follower(self.term, None)
             self.role = FOLLOWER
-        # shutdown() BEFORE close(): close() does not wake a thread
-        # already blocked in accept() (see cluster.RPCServer.stop)
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # listener close wakes the accept loop (the TCP implementation
+        # shuts the socket down before closing — see TCPListener.close)
+        self._listener.close()
         with self._apply_cv:
             self._apply_cv.notify_all()
         for t in self._threads:
@@ -285,6 +284,7 @@ class RaftNode:
             entry = Entry(term=self.term, index=index, cmd=cmd)
             self.log.append(entry)
             self._persist_entry(entry)
+            self._observe_append(entry)
             waiter = [threading.Event(), None, self.term]
             self._waiters[index] = waiter
             single = not self.peers
@@ -293,11 +293,14 @@ class RaftNode:
                 self._apply_cv.notify_all()
         if not single:
             self._replicate_once()
-        if not waiter[0].wait(timeout):
+        # clock-time wait: under a VirtualClock the commit timeout is
+        # virtual too, so a partitioned leader's doomed apply resolves in
+        # simulated seconds, not wall seconds
+        if not self.clock.wait(waiter[0], timeout):
             with self._lock:
                 self._waiters.pop(index, None)
                 e = self._entry_at(index)
-                now_m = time.monotonic()
+                now_m = self.clock.monotonic()
                 acks = {n: round(now_m - self._peer_ack.get(n, 0.0), 2)
                         for n in self.peers}
                 detail = (f"index {index}: node={self.name}"
@@ -320,7 +323,36 @@ class RaftNode:
             raise waiter[1]
         return waiter[1]
 
+    def barrier(self, timeout: float = 10.0) -> bool:
+        """Block until the FSM has applied every entry currently in the
+        log (reference: the raft Barrier leaderLoop issues before
+        establishLeadership).  A new leader inherits committed entries
+        it has not yet applied locally; reading or restoring from state
+        before they land would schedule against a stale snapshot (e.g.
+        re-running an eval whose plan already committed — the classic
+        double-placement).  Returns False on timeout or shutdown."""
+        deadline = self.clock.monotonic() + timeout
+        with self._apply_cv:
+            target = self._last_index()
+            while (self.last_applied < target
+                   and not self._stop.is_set()
+                   and self.clock.monotonic() < deadline):
+                # real-time backstop re-check (chaos/clock contract):
+                # applies notify _apply_cv; the slice only bounds
+                # staleness of the stop/deadline checks
+                self._apply_cv.wait(0.05)
+            return self.last_applied >= target
+
     # ------------------------------------------------------------ internals
+
+    def _observe_append(self, entry: Entry) -> None:
+        """Leader-side append hook for chaos invariants; an observer
+        bug must never break consensus."""
+        if self.append_observer is not None:
+            try:
+                self.append_observer(entry)
+            except Exception:  # noqa: BLE001 - observer is test-side
+                pass
 
     def _last_index(self) -> int:
         return self.log[-1].index if self.log else self.snap_index
@@ -362,18 +394,19 @@ class RaftNode:
                     self._waiters.pop(idx, None)
             if self.on_follower:
                 cb = self.on_follower
-                threading.Thread(target=cb, daemon=True).start()
+                threading.Thread(target=cb, daemon=True,
+                                 name=f"raft-onfollower-{self.name}").start()
 
     def _tick_loop(self) -> None:
         while not self._stop.is_set():
             if self.role == LEADER:
                 self._check_lease()
                 self._replicate_once()
-                self._stop.wait(self.heartbeat_interval)
+                self.clock.wait(self._stop, self.heartbeat_interval)
                 continue
             timeout = random.uniform(*self.election_timeout)
-            self._stop.wait(0.02)
-            if (time.monotonic() - self._last_contact) >= timeout:
+            self.clock.wait(self._stop, 0.02)
+            if (self.clock.monotonic() - self._last_contact) >= timeout:
                 self._run_election()
 
     def _check_lease(self) -> None:
@@ -382,7 +415,7 @@ class RaftNode:
         as a stale leader (its applies would only time out anyway, and a
         deaf-but-alive node must rejoin via a fresh election)."""
         lease = self.election_timeout[1] * 4
-        now = time.monotonic()
+        now = self.clock.monotonic()
         with self._lock:
             if self.role != LEADER or not self.peers:
                 return
@@ -395,7 +428,7 @@ class RaftNode:
                 log("raft", "warn", "leader lease lost; stepping down",
                     name=self.name, term=self.term)
                 self._become_follower(self.term, None)
-                self._last_contact = time.monotonic()
+                self._last_contact = self.clock.monotonic()
 
     def _run_election(self) -> None:
         with self._lock:
@@ -407,7 +440,7 @@ class RaftNode:
             # shrinks it below the original bootstrap_expect.
             if (self.term == 0 and self._last_index() == 0
                     and len(self.peers) + 1 < self.bootstrap_expect):
-                self._last_contact = time.monotonic()
+                self._last_contact = self.clock.monotonic()
                 return
             self.role = CANDIDATE
             self.term += 1
@@ -416,7 +449,7 @@ class RaftNode:
             term = self.term
             last_idx, last_term = self._last_index(), self._last_term()
             peers = dict(self.peers)
-            self._last_contact = time.monotonic()
+            self._last_contact = self.clock.monotonic()
         votes = 1
         needed = (len(peers) + 1) // 2 + 1
         results = []
@@ -426,15 +459,16 @@ class RaftNode:
             # vote-collector daemon thread: a transport failure is just
             # a missing vote, never a dead thread
             try:
-                results.append(send_msg(addr, {
+                results.append(self.transport.request(addr, {
                     "type": "vote_req", "term": term, "cand": self.name,
                     "last_idx": last_idx, "last_term": last_term},
                     timeout=0.5, channel="raft"))
             except Exception:  # noqa: BLE001 - count as no vote
                 results.append(None)
 
-        for addr in peers.values():
-            t = threading.Thread(target=ask, daemon=True, args=(addr,))
+        for peer_name, addr in peers.items():
+            t = threading.Thread(target=ask, daemon=True, args=(addr,),
+                                 name=f"raft-vote-{self.name}->{peer_name}")
             t.start()
             threads.append(t)
         for t in threads:
@@ -457,7 +491,7 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader_name = self.name
-        self._lease_start = time.monotonic()
+        self._lease_start = self.clock.monotonic()
         nxt = self._last_index() + 1
         for n in self.peers:
             self.next_index[n] = nxt
@@ -468,13 +502,15 @@ class RaftNode:
         noop = Entry(term=self.term, index=nxt, cmd=b"")
         self.log.append(noop)
         self._persist_entry(noop)
+        self._observe_append(noop)
         if not self.peers:
             self.commit_index = noop.index
             self._apply_cv.notify_all()
         log("raft", "info", "leadership won", name=self.name, term=self.term)
         if self.on_leader:
             cb = self.on_leader
-            threading.Thread(target=cb, daemon=True).start()
+            threading.Thread(target=cb, daemon=True,
+                             name=f"raft-onleader-{self.name}").start()
 
     def _replicate_once(self) -> None:
         """Kick every per-peer replicator."""
@@ -487,7 +523,7 @@ class RaftNode:
         """Long-lived replication pump for one peer: sends on apply-kick
         or heartbeat timeout over ONE persistent connection (reconnect on
         error), exits when the peer is removed."""
-        sock: Optional[socket.socket] = None
+        conn: Optional[Connection] = None
         try:
             while not self._stop.is_set():
                 with self._lock:
@@ -498,73 +534,58 @@ class RaftNode:
                     is_leader = self.role == LEADER
                 if is_leader:
                     try:
-                        sock = self._replicate_to(name, addr, sock)
+                        conn = self._replicate_to(name, addr, conn)
                     except Exception as exc:  # noqa: BLE001 - pump must live
                         log("raft", "error", "replicate failed",
                             peer=name, error=str(exc))
-                        try:
-                            if sock is not None:
-                                sock.close()
-                        except OSError:
-                            pass
-                        sock = None
+                        if conn is not None:
+                            conn.close()
+                        conn = None
                 if kick is None:
                     return
-                kick.wait(self.heartbeat_interval)
+                self.clock.wait(kick, self.heartbeat_interval)
                 kick.clear()
         except BaseException as exc:  # noqa: BLE001 - must never die silent
             log("raft", "error", "replicator died",
                 peer=name, error=repr(exc))
             raise
         finally:
-            if sock is not None:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+            if conn is not None:
+                conn.close()
 
-    def _peer_roundtrip(self, sock: Optional[socket.socket],
+    def _peer_roundtrip(self, conn: Optional[Connection],
                         addr: Tuple[str, int], msg: dict,
-                        ) -> Tuple[Optional[socket.socket], Optional[dict]]:
-        """Send one framed message over the persistent peer connection,
-        reconnecting once on failure.  Returns (socket, response)."""
+                        ) -> Tuple[Optional[Connection], Optional[dict]]:
+        """Send one message over the persistent peer connection,
+        reconnecting once on failure.  Returns (connection, response).
+        Connection.send re-encodes per attempt (fresh nonce — a
+        byte-identical resend would trip the receiver's replay guard)
+        and raises on a failed send, so a dead pipe triggers the
+        immediate reconnect here instead of a silent recv timeout on a
+        request that never left."""
         for attempt in range(2):
-            if sock is None:
+            if conn is None:
                 try:
-                    sock = socket.create_connection(addr, timeout=1.0)
+                    conn = self.transport.dial(addr, "raft", timeout=1.0)
                 except OSError:
                     return None, None
-            # encode per attempt (fresh nonce — a byte-identical resend
-            # would trip the receiver's replay guard), and OUTSIDE the
-            # try: an unencodable payload must raise, not look like a
-            # dead peer
-            frame = wire.encode_frame(
-                msg, tag=wire.channel_tag("raft", "req", addr))
             try:
-                # raising send (NOT reply(), which swallows OSError):
-                # a failed send must trigger the immediate reconnect
-                # below, not a silent 2s recv timeout on a request that
-                # never left
-                sock.sendall(frame)
-                r = recv_msg(sock, timeout=2.0,
-                             tag=wire.channel_tag("raft", "rep", addr))
+                conn.send(msg)
+                r = conn.recv(timeout=2.0)
                 if r is not None:
-                    return sock, r
+                    return conn, r
             except (OSError, ValueError):
                 pass
-            try:
-                sock.close()
-            except OSError:
-                pass
-            sock = None
+            conn.close()
+            conn = None
         return None, None
 
     def _replicate_to(self, name: str, addr: Tuple[str, int],
-                      sock: Optional[socket.socket] = None,
-                      ) -> Optional[socket.socket]:
+                      conn: Optional[Connection] = None,
+                      ) -> Optional[Connection]:
         with self._lock:
             if self.role != LEADER:
-                return sock
+                return conn
             nxt = self.next_index.get(name, self._last_index() + 1)
             if nxt <= self.snap_index:
                 # follower is behind the compacted prefix: serve from the
@@ -593,17 +614,17 @@ class RaftNode:
                            "prev_term": prev_term, "entries": ents,
                            "commit": self.commit_index}
         if msg is None:
-            return sock
-        sock, r = self._peer_roundtrip(sock, addr, msg)
+            return conn
+        conn, r = self._peer_roundtrip(conn, addr, msg)
         if r is None:
-            return sock
-        self._peer_ack[name] = time.monotonic()
+            return conn
+        self._peer_ack[name] = self.clock.monotonic()
         with self._lock:
             if r.get("term", 0) > self.term:
                 self._become_follower(r["term"], None)
-                return sock
+                return conn
             if self.role != LEADER:
-                return sock
+                return conn
             if msg["type"] == "snap":
                 self.next_index[name] = msg["last_idx"] + 1
                 self.match_index[name] = msg["last_idx"]
@@ -616,7 +637,7 @@ class RaftNode:
                 hint = r.get("hint")
                 self.next_index[name] = max(
                     1, hint if hint else self.next_index.get(name, 2) - 1)
-        return sock
+        return conn
 
     def _tail_append_msg(self, nxt: int) -> Optional[dict]:
         """Append msg for a follower behind the compaction point, built
@@ -663,51 +684,56 @@ class RaftNode:
     # ------------------------------------------------------------- serving
 
     def _listen_loop(self) -> None:
+        backoff = 0.05
         while not self._stop.is_set():
             try:
-                conn, _ = self._sock.accept()
+                conn = self._listener.accept()
             except OSError:
                 # transient failure (e.g. EMFILE) must NOT make the node
                 # deaf — a deaf node never hears higher terms and lingers
-                # as a stale leader forever
+                # as a stale leader forever.  Capped exponential backoff:
+                # under a persistent fault (fd exhaustion) a fixed 50ms
+                # retry is a busy loop that worsens the pressure
                 if self._stop.is_set():
                     return
-                time.sleep(0.05)
+                self.clock.wait(self._stop, backoff)
+                backoff = min(backoff * 2, 1.0)
                 continue
+            backoff = 0.05
             if self._stop.is_set():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                conn.close()
                 return
             threading.Thread(target=self._serve_conn, daemon=True,
+                             name=f"raft-serve-{self.name}",
                              args=(conn,)).start()
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _serve_conn(self, conn: Connection) -> None:
         """Serve a connection until the peer closes it: replicators hold
         one persistent connection and pump many messages through it.
         Daemon thread: a handler blowing up mid-exchange must drop the
         connection (the replicator reconnects), not die silently."""
-        req_tag = wire.channel_tag("raft", "req", self.addr)
-        rep_tag = wire.channel_tag("raft", "rep", self.addr)
         try:
-            with conn:
-                while not self._stop.is_set():
-                    msg = recv_msg(conn, timeout=10.0, tag=req_tag)
-                    if msg is None:
-                        return
-                    handler = {"vote_req": self._on_vote_req,
-                               "append": self._on_append,
-                               "snap": self._on_snap}.get(msg.get("type"))
-                    if handler is None:
-                        return
-                    resp = handler(msg)
-                    if resp is None:
-                        return
-                    reply(conn, resp, tag=rep_tag)
+            while not self._stop.is_set():
+                msg = conn.recv(timeout=10.0)
+                if msg is None:
+                    return
+                handler = {"vote_req": self._on_vote_req,
+                           "append": self._on_append,
+                           "snap": self._on_snap}.get(msg.get("type"))
+                if handler is None:
+                    return
+                resp = handler(msg)
+                if resp is None:
+                    return
+                try:
+                    conn.send(resp)
+                except OSError:
+                    return          # peer vanished mid-reply; it retries
         except Exception as exc:  # noqa: BLE001 - daemon thread
             log("raft", "debug", "conn serve failed", node=self.name,
                 error=repr(exc))
+        finally:
+            conn.close()
 
     def _on_vote_req(self, m: dict) -> dict:
         with self._lock:
@@ -722,14 +748,14 @@ class RaftNode:
                     granted = True
                     self.voted_for = m["cand"]
                     self._persist_meta()
-                    self._last_contact = time.monotonic()
+                    self._last_contact = self.clock.monotonic()
             return {"term": self.term, "granted": granted}
 
     def _on_append(self, m: dict) -> dict:
         with self._lock:
             if m["term"] < self.term:
                 return {"term": self.term, "ok": False}
-            self._last_contact = time.monotonic()
+            self._last_contact = self.clock.monotonic()
             if m["term"] > self.term or self.role != FOLLOWER:
                 self._become_follower(m["term"], m["leader"])
             self.leader_name = m["leader"]
@@ -774,7 +800,7 @@ class RaftNode:
         with self._lock:
             if m["term"] < self.term:
                 return {"term": self.term}
-            self._last_contact = time.monotonic()
+            self._last_contact = self.clock.monotonic()
             self._become_follower(m["term"], m["leader"])
             if m["last_idx"] <= self.last_applied:
                 return {"term": self.term}
@@ -788,6 +814,11 @@ class RaftNode:
             self.commit_index = max(self.commit_index, m["last_idx"])
             self.last_applied = m["last_idx"]
             self._persist_log()
+            if self.install_observer is not None:
+                try:
+                    self.install_observer(m["last_idx"], m["last_term"])
+                except Exception:  # noqa: BLE001 - observer is test-side
+                    pass
             return {"term": self.term}
 
     # --------------------------------------------------------------- apply
@@ -809,6 +840,11 @@ class RaftNode:
                     batch.append(e)
                     self.last_applied = idx
             for e in batch:
+                if self.fsm_observer is not None:
+                    try:
+                        self.fsm_observer(e)
+                    except Exception:  # noqa: BLE001 - observer is test-side
+                        pass
                 if not e.cmd:          # leadership no-op barrier
                     continue
                 try:

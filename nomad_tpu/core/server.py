@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import random
 import threading
-import time
 from typing import Dict, Iterable, List, Optional
+
+from nomad_tpu.chaos.clock import Clock, SystemClock
 
 from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.state import StateStore
@@ -58,7 +59,13 @@ class Server:
                  acl_enabled: bool = False,
                  state: Optional[StateStore] = None,
                  eval_batch: int = 64,
-                 nack_timeout: Optional[float] = None) -> None:
+                 nack_timeout: Optional[float] = None,
+                 clock: Optional[Clock] = None) -> None:
+        # injected timebase (chaos/clock.py): every endpoint default
+        # `now`, heartbeat deadline, and the tick loop read this clock,
+        # so a chaos scenario's VirtualClock owns the whole server's
+        # timeline; production default is the wall clock
+        self.clock = clock if clock is not None else SystemClock()
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -127,9 +134,18 @@ class Server:
         self.blocked_evals.set_enabled(True)
         self.plan_queue.set_enabled(True)
         snap = self.state.snapshot()
-        now = time.time()
+        now = self.clock.time()
+        # restored evals must not schedule against state older than this
+        # restore point: floor their wait index (worker waitForIndex) at
+        # the snapshot we restored from, so an eval whose plan already
+        # committed under the previous leadership re-runs with that plan
+        # visible instead of double-placing
+        floor = self.state.latest_index()
         for ev in snap.evals():
             if ev.status == EVAL_STATUS_PENDING:
+                if (ev.modify_index or 0) < floor:
+                    ev = ev.copy()
+                    ev.modify_index = floor
                 self.eval_broker.enqueue(ev, now=now)
             elif ev.status == EVAL_STATUS_BLOCKED:
                 if not self.blocked_evals.block(ev):
@@ -174,7 +190,7 @@ class Server:
         self._tick_stop = threading.Event()
 
         def tick_loop():
-            while not self._tick_stop.wait(tick_interval):
+            while not self.clock.wait(self._tick_stop, tick_interval):
                 # a tick must never kill the loop: leadership can move
                 # between tick()'s _leader check and a forwarded write
                 # (NotLeaderError), and any other transient failure will
@@ -232,7 +248,7 @@ class Server:
         """reference: Job.Register RPC — upsert + eval create + enqueue.
         Periodic and parameterized PARENTS are never scheduled directly:
         they get no eval; the dispatcher launches child jobs."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         if job.periodic is not None and job.periodic.enabled:
             # validate the cron spec BEFORE persisting: a bad spec must
             # reject the registration, not leave an untracked parent
@@ -290,7 +306,7 @@ class Server:
         from nomad_tpu.structs import ACL_TOKEN_TYPE_MANAGEMENT, ACLToken
         token = ACLToken(name="Bootstrap Token",
                          type=ACL_TOKEN_TYPE_MANAGEMENT,
-                         global_=True, create_time=time.time())
+                         global_=True, create_time=self.clock.time())
         # the exists-check and insert are one atomic store op: concurrent
         # bootstrap requests must not each mint a management token
         if not self.state.bootstrap_acl_token(token):
@@ -367,7 +383,7 @@ class Server:
         token = self.state.acl_token_by_secret(secret_id)
         if token is None:
             return None, "ACL token not found"
-        if token.expired(time.time()):
+        if token.expired(self.clock.time()):
             return None, "ACL token expired"
         if token.is_management():
             return management_acl(), ""
@@ -405,7 +421,7 @@ class Server:
         # heartbeat timers must track the RESTORED node set: restored
         # nodes get a fresh TTL (their clients re-heartbeat or expire);
         # timers for nodes absent from the snapshot are dropped
-        now = time.time()
+        now = self.clock.time()
         self.heartbeats = HeartbeatTimers(ttl=self.heartbeats.ttl)
         for n in self.state.snapshot().nodes():
             if n.status == "ready":
@@ -415,7 +431,7 @@ class Server:
     def deregister_job(self, namespace: str, job_id: str,
                        purge: bool = False,
                        now: Optional[float] = None) -> Optional[Evaluation]:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         job = self.state.job_by_id(namespace, job_id)
         if job is None:
             return None
@@ -439,19 +455,27 @@ class Server:
     # ------------------------------------------------------ node endpoint
 
     def register_node(self, node: Node, now: Optional[float] = None) -> None:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         if not node.region or node.region == "global":
             node.region = self.region
         self.state.upsert_node(node)
         self.heartbeats.reset(node.id, t)
 
     def heartbeat_node(self, node_id: str, now: Optional[float] = None) -> None:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         self.heartbeats.reset(node_id, t)
+        # a heartbeat from a node the server expired brings it back
+        # (reference: the client keeps beating while the server thought
+        # it dead — UpdateStatus ready re-evaluates its jobs and lets
+        # blocked placements land on the recovered capacity).  Without
+        # this a single missed-TTL flap marks a live client down forever.
+        node = self.state.node_by_id(node_id)
+        if node is not None and node.status == "down":
+            self.update_node_status(node_id, "ready", now=t)
 
     def update_node_status(self, node_id: str, status: str,
                            now: Optional[float] = None) -> List[Evaluation]:
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         node = self.state.node_by_id(node_id)
         self.state.update_node_status(node_id, status)
         evals: List[Evaluation] = []
@@ -475,7 +499,7 @@ class Server:
                                         now: Optional[float] = None) -> None:
         """Flag allocs for migration and re-evaluate their jobs
         (reference: Alloc.UpdateDesiredTransition RPC)."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         self.state.update_alloc_desired_transition(alloc_ids, transition)
         evals: List[Evaluation] = []
         seen = set()
@@ -514,7 +538,7 @@ class Server:
         """reference: Node.UpdateAlloc — merge client statuses, then create
         evals for terminal allocs so the scheduler reacts (reschedule on
         failure, next periodic/batch bookkeeping on completion)."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         updates = list(updates)
         self.state.update_allocs_from_client(updates)
         evals: List[Evaluation] = []
@@ -552,7 +576,7 @@ class Server:
         evals = list(evals)
         if not evals:
             return
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         # an eval TRANSITIONING to failed (scheduler retry exhaustion,
         # delivery limit) gets a delayed follow-up so its job is not
         # stranded until the next state change (reference: leader.go
@@ -621,7 +645,7 @@ class Server:
     def tick(self, now: Optional[float] = None) -> None:
         """Periodic leader duties: broker delayed-eval promotion + nack
         timeouts, heartbeat expiry."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         if not self._leader:
             # followers carry no timers/queues; their copies of these
             # duties belong to the leader (reference: leaderLoop)
@@ -654,7 +678,7 @@ class Server:
                     ) -> int:
         """dev_mode: drain the broker with worker 0 until empty.  Returns
         the number of evals processed."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.clock.time()
         n = 0
         while n < limit:
             handled = self.workers[0].run_once(timeout=0.0, now=t)

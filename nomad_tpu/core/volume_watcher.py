@@ -18,7 +18,6 @@ CSI plugin RPCs in.
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Dict, Optional, Tuple
 
 from .logging import log
@@ -41,7 +40,7 @@ class VolumeWatcher:
     def tick(self, now: Optional[float] = None) -> int:
         """One sweep: release claims held by terminal or vanished allocs.
         Returns the number of claims released this pass."""
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         snap = self.server.state.snapshot()
         released = 0
         converted = 0

@@ -9,7 +9,6 @@ seam for production (the Harness is the test implementation).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.core.logging import log
@@ -86,7 +85,7 @@ class Worker:
         if pf is not None:
             # give the undrained batch's evals back immediately instead
             # of stranding them until the nack timeout
-            t = time.time()
+            t = self.server.clock.time()
             for ev, token in pf["batch"]:
                 self.server.eval_broker.nack(ev.id, token, now=t)
 
@@ -112,7 +111,7 @@ class Worker:
         if batch_n and batch_n > 1:
             return self.run_batch(batch_n, timeout=timeout, now=now)
         broker = self.server.eval_broker
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         evaluation, token = broker.dequeue(SCHEDULERS_SERVED, now=t,
                                            timeout=timeout)
         if evaluation is None:
@@ -159,7 +158,7 @@ class Worker:
         host phase runs — the device computes batch k+1 while the host
         materializes and commits batch k."""
         broker = self.server.eval_broker
-        t = now if now is not None else time.time()
+        t = now if now is not None else self.server.clock.time()
         pf = self._prefetch
         self._prefetch = None
         if pf is None:
@@ -257,7 +256,7 @@ class Worker:
                 # the broker doesn't redeliver mid-launch
                 self.server.eval_broker.extend_outstanding(
                     [(ev.id, token) for ev, token in batch],
-                    now=time.time())
+                    now=self.server.clock.time())
             except Exception as e:  # noqa: BLE001 - solo fallback
                 log("worker", "warn", "batch launch failed; going solo",
                     worker=self.id, error=str(e))
@@ -292,7 +291,7 @@ class Worker:
             # from a superseded delivery are rejected at the applier)
             self.server.eval_broker.extend_outstanding(
                 [(ev.id, token) for ev, token in pf["batch"]],
-                now=time.time())
+                now=self.server.clock.time())
             bds = {i: d for i, d in zip(pf["prepared_idx"], decisions)}
 
         # cross-batch prefetch: with this batch fully coupled and more

@@ -636,6 +636,92 @@ class StateStore:
 
     # ------------------------------------------------------- plan results
 
+    def _refute_replayed_placements_locked(self, result) -> None:
+        """Name-slot refute at the FSM boundary (same family as the
+        applier's columnar re-check): a plan computed by a leader that
+        was deposed mid-flight can still COMMIT from its log after the
+        entries it raced — the write-failed-but-committed shape — and
+        the scheduler's retry of the same eval then lands the same
+        placements twice.  A placement whose (job, group, name,
+        job_version) slot is already held by a live alloc this plan
+        does not stop is exactly that replay: mask it.  Deterministic
+        across replicas — every FSM applies the same log prefix before
+        this index, so all see the same live slots.  System-family jobs
+        are exempt (their allocs legitimately share name index [0]
+        across nodes; their uniqueness key is the node, and the
+        per-node fit re-check covers them)."""
+        touched = set()
+        for node_allocs in result.node_update.values():
+            touched.update(a.id for a in node_allocs)
+        for node_allocs in result.node_preemptions.values():
+            touched.update(a.id for a in node_allocs)
+
+        live_cache: Dict[Tuple[str, str], Dict[Tuple, str]] = {}
+
+        def live_slots(ns: str, job_id: str) -> Dict[Tuple, str]:
+            key = (ns, job_id)
+            slots = live_cache.get(key)
+            if slots is not None:
+                return slots
+            slots = {}
+            for a in self._allocs_by_job.get(key, {}).values():
+                if (a.id in touched or a.desired_status != "run"
+                        or a.client_terminal_status()):
+                    continue
+                slots[(a.task_group, a.name, a.job_version)] = a.id
+            for b in self._blocks_by_job.get(key, ()):
+                tmpl = b.template
+                for i, bid in zip(b.indexes, b.ids):
+                    if bid in touched:
+                        continue
+                    slots[(tmpl.task_group, f"{b.name_prefix}{i}]",
+                           tmpl.job_version)] = bid
+            live_cache[key] = slots
+            return slots
+
+        def system_family(job) -> bool:
+            return job is not None and job.type in ("system", "sysbatch")
+
+        for nid, node_allocs in list(result.node_allocation.items()):
+            keep = []
+            for a in node_allocs:
+                if not system_family(a.job):
+                    holder = live_slots(a.namespace, a.job_id).get(
+                        (a.task_group, a.name, a.job_version))
+                    if holder is not None and holder != a.id:
+                        continue              # replayed slot — refute
+                keep.append(a)
+            if len(keep) != len(node_allocs):
+                result.node_allocation[nid] = keep
+
+        if result.alloc_blocks:
+            kept_blocks = []
+            for block in result.alloc_blocks:
+                tmpl = block.template
+                if system_family(tmpl.job):
+                    kept_blocks.append(block)
+                    continue
+                slots = live_slots(tmpl.namespace, tmpl.job_id)
+                colliding = {
+                    j for j, (i, bid) in enumerate(
+                        zip(block.indexes, block.ids))
+                    if slots.get((tmpl.task_group,
+                                  f"{block.name_prefix}{i}]",
+                                  tmpl.job_version)) not in (None, bid)}
+                if not colliding:
+                    kept_blocks.append(block)
+                    continue
+                if len(colliding) == len(block.ids):
+                    continue                  # whole block is a replay
+                # partial replay (rare): keep the surviving rows as
+                # ordinary placements so claims/events stay uniform
+                rows = block.materialize_all()
+                for j, row in enumerate(rows):
+                    if j not in colliding:
+                        result.node_allocation.setdefault(
+                            row.node_id, []).append(row)
+            result.alloc_blocks = kept_blocks
+
     def upsert_plan_results(self, plan: Plan, result: PlanResult,
                             expected_placement_seq: Optional[int] = None,
                             expected_nodes: Optional[Tuple] = None
@@ -670,6 +756,7 @@ class StateStore:
                     # deletion) landed after the applier's guarded claim
                     # checks — redo them against current state
                     return -1
+            self._refute_replayed_placements_locked(result)
             idx = self._bump_placement()
             allocs: List[Allocation] = []
             for node_allocs in result.node_update.values():

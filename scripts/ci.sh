@@ -39,8 +39,17 @@ echo "== wavepipe fast smoke (pipelined engine, CPU mesh) =="
 # fails tier-1 here in seconds instead of deep in the full suite
 python -m pytest tests/test_wavepipe.py -q -m 'not slow'
 
-echo "== tests (8-virtual-device CPU mesh) =="
-python -m pytest tests/ -q
+echo "== tests (8-virtual-device CPU mesh, tier-1: not slow) =="
+python -m pytest tests/ -q -m 'not slow'
+
+echo "== chaos (seeded fault-injection scenarios on the virtual clock) =="
+# the full chaos suite: every scenario in tests/test_chaos.py with its
+# pinned seed (partition / split-brain / flap storm / lossy raft /
+# heartbeat expiry), the seed-determinism double-run, and the
+# trace-replay check — plus the wall-clock cluster tests the virtual-
+# clock scenarios superseded in tier-1
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_cluster.py -q -m slow
 
 echo "== bench smoke (CPU backend, reduced scale) =="
 JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \

@@ -20,3 +20,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second wall-clock test; excluded from tier-1 "
+        "(pytest -m 'not slow') and run by the dedicated CI stages "
+        "(scripts/ci.sh chaos stage, or -m slow)")

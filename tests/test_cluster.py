@@ -401,6 +401,12 @@ def cluster_leader(servers):
 
 
 class TestClusterServer:
+    # slow: multi-second wall-clock runs over real TCP.  The same
+    # behaviors run in virtual time in tests/test_chaos.py (workload
+    # forwarding + replication in every scenario; failover-keeps-
+    # scheduling is the leader_partition scenario) — ci.sh's chaos
+    # stage executes both this class and the chaos suite.
+    @pytest.mark.slow
     def test_replicated_scheduling_with_forwarding(self, trio):
         leader = wait_for(lambda: cluster_leader(trio), msg="leader")
         follower = next(s for s in trio if s is not leader)
@@ -426,6 +432,7 @@ class TestClusterServer:
         l_allocs = leader.state.allocs_by_job("default", job.id)
         assert {a.id for a in f_allocs} == {a.id for a in l_allocs}
 
+    @pytest.mark.slow
     def test_leader_failover_keeps_scheduling(self, trio):
         leader = wait_for(lambda: cluster_leader(trio), msg="leader")
         rpc = RemoteRPC([s.rpc.addr for s in trio])
