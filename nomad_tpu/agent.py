@@ -34,7 +34,18 @@ class Agent:
                  join_wan: Optional[List[str]] = None,
                  join_wan_token: str = "",
                  transport: str = "tcp",
-                 clock: str = "wall") -> None:
+                 clock: str = "wall",
+                 log_level: str = "") -> None:
+        # producer-side log gate (agent_config log_level): records below
+        # this level never reach the ring or its subscribers.  Only set
+        # when explicitly configured — the process-wide ring default
+        # ("trace") must survive embedded/test agents
+        if log_level:
+            from nomad_tpu.core.logging import LEVELS, RING
+            if log_level not in LEVELS:
+                raise ValueError(f"unknown log_level {log_level!r} "
+                                 f"(expected one of {sorted(LEVELS)})")
+            RING.min_level = log_level
         # cluster shared secret: encrypt + authenticate every server-plane
         # wire frame (raft/gossip/RPC) — core/wire.py.  The key is
         # process-global (one cluster per process): set_key raises on a
@@ -168,16 +179,49 @@ class Agent:
             "uptime_s": round(time.time() - self._started_at, 1),
             "state_index": s.state.latest_index(),
             "broker": dict(s.eval_broker.stats),
-            "workers": [w.stats for w in s.workers],
+            "workers": [dict(w.stats) for w in s.workers],
             "plan_queue_depth_peak": s.plan_queue.stats["depth_peak"],
             "clients": len(self.clients),
             "threads": threading.active_count(),
         }
 
-    def metrics(self) -> Dict:
-        """Load-bearing series per SURVEY.md §6.5."""
+    def _refresh_gauges(self) -> None:
+        """Point-in-time gauges the registry cannot accumulate itself.
+        State sizes come from `state.counts()` — NOT a snapshot; a
+        Prometheus-style 1s scrape must not COW-mark every store table
+        on the hot path."""
+        from nomad_tpu.core.telemetry import REGISTRY
         s = self.server
-        snap = s.state.snapshot()
+        REGISTRY.set_gauge("nomad.broker.total_ready",
+                           s.eval_broker.pending_evals())
+        REGISTRY.set_gauge("nomad.blocked_evals.total_blocked",
+                           s.blocked_evals.num_blocked())
+        REGISTRY.set_gauge("nomad.plan.queue_depth", s.plan_queue.depth())
+        REGISTRY.set_gauge("nomad.plan.queue_depth_peak",
+                           s.plan_queue.stats["depth_peak"])
+        counts = s.state.counts()
+        REGISTRY.set_gauge("nomad.state.nodes", counts["nodes"])
+        REGISTRY.set_gauge("nomad.state.jobs", counts["jobs"])
+        REGISTRY.set_gauge("nomad.state.evals", counts["evals"])
+        timers = getattr(s, "stage_timers", None)
+        if timers is not None:
+            rep = timers.report()
+            for pair, secs in rep["overlap_s"].items():
+                key = pair.replace("*", "_")
+                REGISTRY.set_gauge(f"nomad.wavepipe.overlap.{key}_s",
+                                   secs)
+
+    def metrics(self, format: str = ""):
+        """Load-bearing series per SURVEY.md §6.5.  Default: a flat JSON
+        dict (legacy keys + registry counters/gauges and histogram
+        p50/p95/p99 summaries).  `format="prometheus"` renders the full
+        registry as text exposition instead."""
+        from nomad_tpu.core.telemetry import REGISTRY
+        s = self.server
+        self._refresh_gauges()
+        if format == "prometheus":
+            return REGISTRY.prometheus()
+        counts = s.state.counts()
         out = {
             "nomad.broker.total_ready": s.eval_broker.pending_evals(),
             "nomad.broker.acked": s.eval_broker.stats["acked"],
@@ -188,8 +232,8 @@ class Agent:
             "nomad.plan.queue_depth": s.plan_queue.depth(),
             "nomad.worker.invoked":
                 sum(w.stats["invoked"] for w in s.workers),
-            "nomad.state.nodes": len(snap.nodes()),
-            "nomad.state.jobs": len(snap.jobs()),
+            "nomad.state.nodes": counts["nodes"],
+            "nomad.state.jobs": counts["jobs"],
         }
         # wavepipe per-stage wall totals + the overlap gauges that prove
         # host commit hides under device compute (core/wavepipe.py)
@@ -201,4 +245,14 @@ class Agent:
             for pair, secs in rep["overlap_s"].items():
                 key = pair.replace("*", "_")
                 out[f"nomad.wavepipe.overlap.{key}_s"] = secs
+        # registry series: counters/gauges flat, histograms as
+        # name.{p50,p95,p99,sum,count} (legacy keys above win on clash)
+        snap = REGISTRY.snapshot()
+        for name, v in snap["counters"].items():
+            out.setdefault(name, v)
+        for name, v in snap["gauges"].items():
+            out.setdefault(name, v)
+        for name, h in snap["histograms"].items():
+            for k in ("p50", "p95", "p99", "sum", "count"):
+                out.setdefault(f"{name}.{k}", h[k])
         return out

@@ -4,7 +4,7 @@ config_parse.go — the HCL agent config plane of SURVEY §6.6a).
 Supported shape (a practical subset of the reference's):
 
     bind_addr = "127.0.0.1"
-    log_level = "debug"
+    log_level = "debug"     # producer-side LogRing min_level gate
     ports { http = 4646 }
     server {
       enabled        = true
@@ -97,7 +97,13 @@ def parse_agent_config(src: str):
             if node.name == "bind_addr":
                 put("bind_addr", str(v))
             elif node.name == "log_level":
-                put("log_level", str(v).lower())
+                level = str(v).lower()
+                from nomad_tpu.core.logging import LEVELS
+                if level not in LEVELS:
+                    raise ValueError(
+                        f"log_level must be one of {sorted(LEVELS)}, "
+                        f"got {level!r}")
+                put("log_level", level)
             elif node.name == "encrypt":
                 put("encrypt", str(v))
             elif node.name == "region":

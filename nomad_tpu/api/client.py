@@ -74,6 +74,22 @@ class APIClient:
                 msg = str(e)
             raise APIException(e.code, msg) from None
 
+    def request_text(self, path: str,
+                     params: Optional[Dict[str, Any]] = None) -> str:
+        """GET returning the raw response body as text (the Prometheus
+        exposition format is not JSON)."""
+        params = dict(params or {})
+        params.setdefault("namespace", self.namespace)
+        url = (f"{self.address}{path}?"
+               f"{urllib.parse.urlencode(params, doseq=True)}")
+        headers = {"X-Nomad-Token": self.token} if self.token else {}
+        req = urllib.request.Request(url, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            raise APIException(e.code, str(e)) from None
+
     def get(self, path, **params):
         return self.request("GET", path, params=params)
 
@@ -270,6 +286,11 @@ class Operator(_Endpoint):
     def snapshot_restore(self, doc: Dict) -> Dict:
         return self.c.put("/v1/operator/snapshot", body=doc)
 
+    def debug(self) -> Dict:
+        """The `operator debug` bundle: stats + metrics + traces +
+        log tail + threads in one document."""
+        return self.c.get("/v1/operator/debug")
+
 
 class System(_Endpoint):
     def gc(self) -> Dict:
@@ -283,8 +304,21 @@ class Agent(_Endpoint):
     def members(self) -> Dict:
         return self.c.get("/v1/agent/members")
 
-    def metrics(self) -> Dict:
+    def metrics(self, format: str = ""):
+        """JSON metric dict; `format="prometheus"` returns the text
+        exposition instead."""
+        if format == "prometheus":
+            return self.c.request_text("/v1/metrics",
+                                       params={"format": "prometheus"})
         return self.c.get("/v1/metrics")
+
+    def traces(self) -> List[Dict]:
+        """Recent eval-lifecycle trace summaries."""
+        return self.c.get("/v1/traces")
+
+    def trace(self, trace_id: str) -> Dict:
+        """One trace's full span tree."""
+        return self.c.get(f"/v1/trace/{trace_id}")
 
 
 class Volumes(_Endpoint):

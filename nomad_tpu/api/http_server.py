@@ -39,6 +39,13 @@ class APIError(Exception):
         self.status = status
 
 
+class TextResponse(str):
+    """A handler return value rendered as text/plain instead of JSON
+    (the Prometheus exposition format is not JSON)."""
+
+    content_type = "text/plain; version=0.0.4; charset=utf-8"
+
+
 def _decode_job(wire: Dict, ns: str) -> Job:
     """Wire Job -> struct; an ABSENT Namespace falls back to the request's
     ?namespace= (the decoder's default-namespace output can't distinguish
@@ -271,7 +278,8 @@ class Router:
                 raise APIError(403,
                                "permission denied: management required")
             return acl
-        if head in ("agent", "metrics", "status", "event"):
+        if head in ("agent", "metrics", "status", "event",
+                    "traces", "trace"):
             if not acl.allow_agent_read():
                 raise APIError(403, "permission denied: agent policy")
             return acl
@@ -477,13 +485,20 @@ class Router:
                         "Voter": True})
                 return {"Servers": servers}
             if p[1:2] == ["debug"] and method == "GET":
-                # debug bundle (reference: `nomad operator debug` capture)
+                # debug bundle (reference: `nomad operator debug`
+                # capture): stats + metrics + prometheus exposition +
+                # recent traces/spans + LogRing tail + threads, one doc
                 import sys as _sys
                 import threading as _threading
                 from nomad_tpu.core.logging import RING
+                from nomad_tpu.core.telemetry import TRACER
                 return {
                     "Stats": self.agent.stats(),
                     "Metrics": self.agent.metrics(),
+                    "Prometheus": self.agent.metrics(
+                        format="prometheus"),
+                    "Traces": TRACER.traces()[-100:],
+                    "Spans": TRACER.spans()[-500:],
                     "SchedulerConfig": codec.encode(
                         s.state.snapshot().scheduler_config()),
                     "Logs": RING.tail(500),
@@ -621,7 +636,20 @@ class Router:
                         for m in s.gossip.members_snapshot().values()]}
                 return {"Members": [{"Name": "local", "Status": "alive"}]}
         elif head == "metrics":
-            return self.agent.metrics()
+            fmt = (qs.get("format") or [""])[0]
+            out = self.agent.metrics(format=fmt)
+            return TextResponse(out) if fmt == "prometheus" else out
+        elif head == "traces":
+            from nomad_tpu.core.telemetry import TRACER
+            return TRACER.traces()
+        elif head == "trace":
+            from nomad_tpu.core.telemetry import TRACER
+            if len(p) < 2 or not p[1]:
+                raise APIError(404, "trace id required")
+            spans = TRACER.trace(p[1])
+            if not spans:
+                raise APIError(404, "trace not found")
+            return {"TraceID": p[1], "Spans": spans}
         elif head == "search":
             if method in ("PUT", "POST"):
                 return self._search(body or {}, ns)
@@ -1408,9 +1436,14 @@ class HTTPAPIServer:
 
             def _respond(self, status: int, payload: Any,
                          index: Optional[int] = None) -> None:
-                data = json.dumps(payload).encode()
+                if isinstance(payload, TextResponse):
+                    data = str(payload).encode()
+                    ctype = payload.content_type
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-Nomad-Index", str(
                     index if index is not None

@@ -222,7 +222,8 @@ class ScenarioResult:
     def __init__(self, name: str, seed: int, trace: Trace,
                  violations: List[str], fingerprint: str,
                  schedule: List[Dict], converged: bool,
-                 failed_ops: List[str], snapshot=None) -> None:
+                 failed_ops: List[str], snapshot=None,
+                 spans: Optional[List[Dict]] = None) -> None:
         self.name = name
         self.seed = seed
         self.trace = trace
@@ -232,6 +233,17 @@ class ScenarioResult:
         self.converged = converged
         self.failed_ops = failed_ops
         self.snapshot = snapshot       # final state snapshot (forensics)
+        # eval-lifecycle telemetry spans captured during the run
+        # (core/telemetry.py): scenario tests assert on TRACE SHAPE —
+        # which lifecycle stages each eval passed through — on top of
+        # the state/log invariants
+        self.spans = spans if spans is not None else []
+
+    def span_names(self, trace_id: Optional[str] = None) -> List[str]:
+        """Distinct span names seen (optionally for one trace), sorted —
+        the scenario-level trace-shape assertion helper."""
+        return sorted({s["Name"] for s in self.spans
+                       if trace_id is None or s["TraceID"] == trace_id})
 
     @property
     def ok(self) -> bool:
@@ -299,6 +311,12 @@ class ScenarioRunner:
 
         clock = VirtualClock()
         trace = Trace()
+        # telemetry hook: spans recorded during this run stamp VIRTUAL
+        # time (ClusterServer construction rebinds the process telemetry
+        # clock to `clock`); reset first so the captured span set belongs
+        # to this run alone
+        from nomad_tpu.core import telemetry
+        telemetry.TRACER.reset()
         net = SimNetwork(clock=clock, seed=self.seed, trace=trace)
         # the canonical trace IS the schedule (+ terminal verdicts):
         # recorded up front, before execution can interleave anything
@@ -694,7 +712,8 @@ class ScenarioRunner:
             trace.record(duration, "fingerprint", sha256=fingerprint)
             return ScenarioResult(
                 self.name, self.seed, trace, viol, fingerprint,
-                schedule, final_ok, failed_ops, snapshot=snap)
+                schedule, final_ok, failed_ops, snapshot=snap,
+                spans=telemetry.TRACER.spans())
         finally:
             wl_stop.set()
             # keep the timeline moving while servers tear down: leave

@@ -128,7 +128,8 @@ def cmd_agent(args) -> int:
                   join_wan=getattr(args, "join_wan", []) or [],
                   join_wan_token=getattr(args, "join_wan_token", ""),
                   transport=cfg.transport,
-                  clock=cfg.clock)
+                  clock=cfg.clock,
+                  log_level=cfg.log_level)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")
@@ -767,9 +768,33 @@ def cmd_operator_debug(args) -> int:
             json.dump(bundle, f, indent=2)
         print(f"debug bundle written to {args.output} "
               f"({len(bundle.get('Logs', []))} log records, "
+              f"{len(bundle.get('Traces', []))} traces, "
               f"{len(bundle.get('Threads', []))} threads)")
     else:
         _out(bundle)
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """reference: `nomad operator metrics [-format prometheus]`."""
+    c = _client(args)
+    if args.format == "prometheus":
+        sys.stdout.write(c.agent.metrics(format="prometheus"))
+        return 0
+    _out(c.agent.metrics())
+    return 0
+
+
+def cmd_trace_list(args) -> int:
+    for t in _client(args).agent.traces():
+        dur = t.get("End", 0) - t.get("Start", 0)
+        print(f"{t['TraceID'][:8]}  {t.get('Root', '') or '-':<10} "
+              f"{t['Spans']:>3} span(s)  {dur * 1000:8.2f}ms")
+    return 0
+
+
+def cmd_trace_status(args) -> int:
+    _out(_client(args).agent.trace(args.trace_id))
     return 0
 
 
@@ -1192,13 +1217,30 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["trace", "debug", "info", "warn", "error"])
     mon.set_defaults(fn=cmd_monitor)
 
+    met = sub.add_parser("metrics", help="agent metrics")
+    met.add_argument("-format", default="json",
+                     choices=["json", "prometheus"])
+    met.set_defaults(fn=cmd_metrics)
+
+    trc = sub.add_parser("trace",
+                         help="eval-lifecycle traces").add_subparsers(
+        dest="trace_cmd", required=True)
+    trl = trc.add_parser("list")
+    trl.set_defaults(fn=cmd_trace_list)
+    trs = trc.add_parser("status")
+    trs.add_argument("trace_id")
+    trs.set_defaults(fn=cmd_trace_status)
+
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)
     return p
 
 
 _RESOLVE_ATTRS = (("node_id", "nodes"), ("alloc_id", "allocs"),
-                  ("eval_id", "evals"), ("deployment_id", "deployment"))
+                  ("eval_id", "evals"), ("deployment_id", "deployment"),
+                  # trace ids ARE eval ids (stamped at the FSM boundary),
+                  # so eval-prefix search resolves them too
+                  ("trace_id", "evals"))
 
 
 def main(argv: Optional[List[str]] = None) -> int:

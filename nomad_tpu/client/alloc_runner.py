@@ -15,6 +15,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu.core.logging import log
+from nomad_tpu.core.telemetry import REGISTRY, TRACER, span_id
 
 from nomad_tpu.structs import (
     ALLOC_CLIENT_COMPLETE,
@@ -63,6 +64,11 @@ class AllocRunner:
         self._done = threading.Event()
         self._destroyed = False
         self.health: Optional[bool] = None
+        # eval-lifecycle trace (core/telemetry.py): run() stamps the
+        # start; the first transition to client_status=running records
+        # the alloc-start span that closes the server->client span tree
+        self._trace_t0: Optional[float] = None
+        self._run_span_done = False
         self._build_runners()
 
     def _fetch_identities(self, alloc_id: str) -> Dict:
@@ -127,6 +133,7 @@ class AllocRunner:
         with self._lock:
             self.alloc.task_states[runner.task.name] = runner.state
             terminal = self._recompute_status()
+        self._maybe_record_run_span()
         if self.on_update:
             self.on_update(self)
         if terminal:
@@ -164,7 +171,24 @@ class AllocRunner:
 
     # ------------------------------------------------------------- run
 
+    def _maybe_record_run_span(self) -> None:
+        """First transition to running closes the trace's client leg:
+        span `client.alloc_start` = runner start -> tasks running,
+        parented under the plan-apply span that committed the alloc."""
+        if (self._run_span_done or not self.alloc.trace_id
+                or self.alloc.client_status != ALLOC_CLIENT_RUNNING):
+            return
+        self._run_span_done = True
+        t1 = TRACER.clock.monotonic()
+        t0 = self._trace_t0 if self._trace_t0 is not None else t1
+        TRACER.record("client.alloc_start", self.alloc.trace_id, t0, t1,
+                      parent=span_id(self.alloc.trace_id, "plan.apply"),
+                      alloc_id=self.alloc.id, node_id=self.alloc.node_id,
+                      task_group=self.alloc.task_group)
+        REGISTRY.observe("nomad.client.alloc_start_s", t1 - t0)
+
     def run(self) -> None:
+        self._trace_t0 = TRACER.clock.monotonic()
         if self._done.is_set():
             # failed during build (e.g. missing driver): ship the terminal
             # status instead of starting anything

@@ -443,9 +443,11 @@ class ClusterServer(Server):
         from a state snapshot — otherwise a re-run eval can schedule
         against state that predates an already-committed plan and place
         a duplicate alloc."""
-        if not self.raft.barrier(timeout=10.0):
-            raise NotLeaderError(self.raft.leader_hint())
-        super().establish_leadership()
+        from nomad_tpu.core.telemetry import REGISTRY
+        with REGISTRY.time("nomad.leadership.establish_s"):
+            if not self.raft.barrier(timeout=10.0):
+                raise NotLeaderError(self.raft.leader_hint())
+            super().establish_leadership()
 
     def is_leader(self) -> bool:
         return self.raft.is_leader()

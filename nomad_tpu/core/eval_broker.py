@@ -19,6 +19,7 @@ import itertools
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.core.telemetry import REGISTRY, TRACER, StatCounters, span_id
 from nomad_tpu.structs import Evaluation, new_id
 
 DEFAULT_NACK_TIMEOUT = 60.0
@@ -56,8 +57,15 @@ class EvalBroker:
         # applier fast path.  (reference contrast: nomad's num_schedulers
         # workers dequeue blindly and resolve collisions at plan apply.)
         self.partition_of = None
-        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0,
-                      "nacked": 0, "failed": 0}
+        self.stats = StatCounters("nomad.broker", (
+            "enqueued", "dequeued", "acked", "nacked", "failed"))
+        # telemetry bookkeeping (core/telemetry.py), both guarded by
+        # self._lock: when each eval last became READY (feeds the
+        # enqueue->dequeue wait histogram + broker.wait span), and each
+        # traced eval's FIRST enqueue stamp (feeds the root `eval` span
+        # recorded at ack / delivery-limit failure)
+        self._ready_t: Dict[str, float] = {}
+        self._trace_t0: Dict[str, Tuple[str, float]] = {}
 
     # ------------------------------------------------------------ control
 
@@ -71,6 +79,8 @@ class EvalBroker:
                 self._delayed.clear()
                 self._outstanding.clear()
                 self._dequeues.clear()
+                self._ready_t.clear()
+                self._trace_t0.clear()
             self._cv.notify_all()
 
     @property
@@ -83,7 +93,10 @@ class EvalBroker:
         with self._lock:
             if not self._enabled:
                 return
-            self.stats["enqueued"] += 1
+            self.stats.inc("enqueued")
+            if evaluation.trace_id and evaluation.id not in self._trace_t0:
+                self._trace_t0[evaluation.id] = (
+                    evaluation.trace_id, TRACER.clock.monotonic())
             if evaluation.wait_until and evaluation.wait_until > now:
                 heapq.heappush(self._delayed,
                                (evaluation.wait_until, next(self._seq),
@@ -93,6 +106,7 @@ class EvalBroker:
             self._cv.notify()
 
     def _enqueue_locked(self, evaluation: Evaluation) -> None:
+        self._ready_t.setdefault(evaluation.id, TRACER.clock.monotonic())
         key = (evaluation.namespace, evaluation.job_id)
         if key in self._in_flight_jobs:
             self._pending_by_job.setdefault(key, []).append(evaluation)
@@ -187,7 +201,15 @@ class EvalBroker:
         self._outstanding[ev.id] = (token, now + self.nack_timeout, ev)
         self._dequeues[ev.id] = self._dequeues.get(ev.id, 0) + 1
         self._in_flight_jobs.add((ev.namespace, ev.job_id))
-        self.stats["dequeued"] += 1
+        self.stats.inc("dequeued")
+        t1 = TRACER.clock.monotonic()
+        t0 = self._ready_t.pop(ev.id, t1)
+        REGISTRY.observe("nomad.broker.wait_s", t1 - t0)
+        if ev.trace_id:
+            TRACER.record("broker.wait", ev.trace_id, t0, t1,
+                          parent=span_id(ev.trace_id, "eval"),
+                          eval_id=ev.id,
+                          attempt=self._dequeues[ev.id])
         return token
 
     def _pop_ready_locked(self, schedulers: List[str]) -> Optional[Evaluation]:
@@ -221,9 +243,21 @@ class EvalBroker:
             ev = rec[2]
             del self._outstanding[eval_id]
             self._dequeues.pop(eval_id, None)
-            self.stats["acked"] += 1
+            self.stats.inc("acked")
+            self._finish_trace_locked(ev, "ack")
             self._release_job_locked((ev.namespace, ev.job_id))
             return None
+
+    def _finish_trace_locked(self, ev: Evaluation, outcome: str) -> None:
+        """Close the eval's ROOT span: its delivery cycle ended (acked or
+        failed out).  Nacked redeliveries keep the root open."""
+        rec = self._trace_t0.pop(ev.id, None)
+        if rec is None:
+            return
+        tid, t0 = rec
+        TRACER.record("eval", tid, t0, TRACER.clock.monotonic(),
+                      eval_id=ev.id, job_id=ev.job_id, type=ev.type,
+                      triggered_by=ev.triggered_by, outcome=outcome)
 
     def _release_job_locked(self, key: Tuple[str, str]) -> None:
         """Job no longer has an eval in flight (acked, failed, or expired):
@@ -244,11 +278,12 @@ class EvalBroker:
                 return "token mismatch"
             ev = rec[2]
             del self._outstanding[eval_id]
-            self.stats["nacked"] += 1
+            self.stats.inc("nacked")
             key = (ev.namespace, ev.job_id)
             if self._dequeues.get(eval_id, 0) >= self.delivery_limit:
                 self._failed.append(ev)
-                self.stats["failed"] += 1
+                self.stats.inc("failed")
+                self._finish_trace_locked(ev, "failed")
                 self._dequeues.pop(eval_id, None)
                 # waiters for this job must not strand behind a failed eval
                 self._release_job_locked(key)
@@ -278,7 +313,8 @@ class EvalBroker:
             key = (ev.namespace, ev.job_id)
             if self._dequeues.get(eid, 0) >= self.delivery_limit:
                 self._failed.append(ev)
-                self.stats["failed"] += 1
+                self.stats.inc("failed")
+                self._finish_trace_locked(ev, "failed")
                 self._release_job_locked(key)
             else:
                 self._in_flight_jobs.discard(key)

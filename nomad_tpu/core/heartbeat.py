@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List
 
+from nomad_tpu.core.telemetry import REGISTRY
 from nomad_tpu.structs import (
     Evaluation,
     NODE_STATUS_DOWN,
@@ -29,6 +30,7 @@ class HeartbeatTimers:
         """Node registered or heartbeated."""
         with self._lock:
             self._deadlines[node_id] = now + self.ttl
+        REGISTRY.inc("nomad.heartbeat.resets")
 
     def remove(self, node_id: str) -> None:
         with self._lock:
@@ -43,7 +45,9 @@ class HeartbeatTimers:
             out = [nid for nid, dl in self._deadlines.items() if dl <= now]
             for nid in out:
                 del self._deadlines[nid]
-            return out
+        if out:
+            REGISTRY.inc("nomad.heartbeat.expired", len(out))
+        return out
 
 
 def build_node_evals(snap, node_id: str) -> List[Evaluation]:

@@ -3,7 +3,14 @@
 
 `log(component, level, msg, **fields)` appends to a process-wide ring that
 `/v1/agent/monitor` streams and `operator debug` bundles.  Deliberately
-tiny: no handlers/formatters, one producer API, lock-protected ring."""
+tiny: no handlers/formatters, one producer API, lock-protected ring.
+
+Loss is COUNTED, never silent (core/telemetry.py registry series
+`nomad.logring.dropped{reason=trim|subscriber}`): the wrap-trim discards
+the oldest quarter of the ring, and a full subscriber queue sheds the
+record for that subscriber only.  `min_level` is the producer-side gate,
+set from agent_config's `log_level` (records below it never touch the
+lock — the ack log sits on the eval hot path)."""
 
 from __future__ import annotations
 
@@ -11,6 +18,8 @@ import queue
 import threading
 import time
 from typing import Dict, List, Optional
+
+from nomad_tpu.core.telemetry import REGISTRY
 
 LEVELS = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
 
@@ -32,16 +41,20 @@ class LogRing:
                "component": component, "msg": msg}
         if fields:
             rec.update(fields)
+        trimmed = 0
         with self._lock:
             self._buf.append(rec)
             if len(self._buf) > self._size:
-                del self._buf[:self._size // 4]
+                trimmed = self._size // 4
+                del self._buf[:trimmed]
             subs = list(self._subs)
+        if trimmed:
+            REGISTRY.inc("nomad.logring.dropped", trimmed, reason="trim")
         for q in subs:
             try:
                 q.put_nowait(rec)
             except queue.Full:
-                pass
+                REGISTRY.inc("nomad.logring.dropped", reason="subscriber")
 
     def tail(self, n: int = 200,
              min_level: str = "trace") -> List[Dict]:

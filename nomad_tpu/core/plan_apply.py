@@ -16,12 +16,18 @@ import contextlib
 import heapq
 import itertools
 import threading
-import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.chaos.clock import Clock, SystemClock
 from nomad_tpu.core.logging import log
+from nomad_tpu.core.telemetry import (
+    REGISTRY,
+    TRACER,
+    StatCounters,
+    span_id,
+)
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import (
     Allocation,
@@ -61,7 +67,8 @@ class PendingPlan:
         self.result = result
         self.error = error
         if self.queue is not None and self.enqueue_t:
-            self.queue.record_latency(time.perf_counter() - self.enqueue_t)
+            self.queue.record_latency(
+                self.queue.clock.monotonic() - self.enqueue_t)
         self.done.set()
 
     def wait(self, timeout: float = 30.0
@@ -80,7 +87,11 @@ class PlanQueue:
         self._enabled = False
         self._seq = itertools.count()
         self._heap: List[Tuple[int, int, PendingPlan]] = []
-        self.stats = {"depth_peak": 0, "submitted": 0}
+        # queue-latency timebase, replaced by the Server with its
+        # injected clock so virtual-time runs measure virtual waits
+        self.clock: Clock = SystemClock()
+        self.stats = StatCounters("nomad.plan.queue",
+                                  ("depth_peak", "submitted"))
         # ring of recent enqueue->respond latencies (seconds); feeds the
         # /v1/metrics p50/p99 gauges and the bench's p99 measurement
         self.latencies: deque = deque(maxlen=16384)
@@ -112,15 +123,15 @@ class PlanQueue:
                 p = PendingPlan(plan)
                 p.respond(None, RuntimeError("plan queue disabled"))
                 return p
-            pending = PendingPlan(plan, enqueue_t=time.perf_counter(),
+            pending = PendingPlan(plan, enqueue_t=self.clock.monotonic(),
                                   queue=self)
             heapq.heappush(self._heap,
                            (-plan.priority, next(self._seq), pending))
             self.stats["depth_peak"] = max(self.stats["depth_peak"],
                                            len(self._heap))
-            self.stats["submitted"] += 1
             self._cv.notify()
-            return pending
+        self.stats.inc("submitted")
+        return pending
 
     def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
         with self._cv:
@@ -154,8 +165,11 @@ class PlanApplier:
         # batches) never demote each other (optimistic-concurrency safety
         # exactly as the reference's evaluatePlan, at the reference's own
         # per-node granularity).
-        self.stats = {"fast_path": 0, "full_check": 0, "stale_token": 0,
-                      "plans": 0, "plans_refuted": 0}
+        self.stats = StatCounters("nomad.plan", (
+            "fast_path", "full_check", "stale_token",
+            "plans", "plans_refuted"))
+        # queue-wait/apply timebase (Server injects its clock)
+        self.clock: Clock = SystemClock()
         # optional (eval_id, token) -> bool gate, wired by the Server to
         # the eval broker: plans from a SUPERSEDED delivery (the eval was
         # redelivered while this worker sat in a device compile) are
@@ -206,11 +220,32 @@ class PlanApplier:
         return nodes
 
     def apply_one(self, pending: PendingPlan) -> None:
+        plan = pending.plan
+        t0 = self.clock.monotonic()
+        if pending.enqueue_t:
+            wait = max(0.0, t0 - pending.enqueue_t)
+            REGISTRY.observe("nomad.plan.queue_wait_s", wait)
+            if plan.trace_id:
+                TRACER.record("plan.queue_wait", plan.trace_id,
+                              t0 - wait, t0,
+                              parent=span_id(plan.trace_id,
+                                             "worker.schedule"),
+                              eval_id=plan.eval_id)
         if self.timers is not None:
             with self.timers.time("commit"):
                 self._apply_one(pending)
         else:
             self._apply_one(pending)
+        t1 = self.clock.monotonic()
+        REGISTRY.observe("nomad.plan.apply_s", t1 - t0)
+        if plan.trace_id:
+            TRACER.record("plan.apply", plan.trace_id, t0, t1,
+                          parent=span_id(plan.trace_id, "worker.schedule"),
+                          eval_id=plan.eval_id,
+                          error=type(pending.error).__name__
+                          if pending.error is not None else "",
+                          refuted=len(pending.result.refuted_nodes)
+                          if pending.result is not None else 0)
 
     def _apply_one(self, pending: PendingPlan) -> None:
         plan = pending.plan
@@ -218,7 +253,7 @@ class PlanApplier:
             if (self.token_check is not None and plan.eval_token
                     and not self.token_check(plan.eval_id,
                                              plan.eval_token)):
-                self.stats["stale_token"] += 1
+                self.stats.inc("stale_token")
                 pending.respond(None, StaleDeliveryError(
                     f"eval {plan.eval_id} was redelivered; this "
                     "worker's delivery is superseded"))
@@ -242,6 +277,7 @@ class PlanApplier:
                     touched, seq0, bid, own_chain_ok=False)
             result = self.evaluate_plan(plan, skip_fit=fast,
                                         fenced_first=fenced_first)
+            self._stamp_trace(plan, result)
             idx = self.state.upsert_plan_results(
                 plan, result,
                 expected_nodes=(touched, seq0, bid,
@@ -251,10 +287,13 @@ class PlanApplier:
                 # a foreign write landed on one of the plan's nodes between
                 # the fence read and the commit: redo with the full check
                 result = self.evaluate_plan(plan, skip_fit=False)
+                self._stamp_trace(plan, result)
                 self.state.upsert_plan_results(plan, result)
-            self.stats["plans"] += 1
+            self.stats.inc("plans")
             if result.refuted_nodes:
-                self.stats["plans_refuted"] += 1
+                self.stats.inc("plans_refuted")
+                REGISTRY.inc("nomad.plan.refuted_nodes",
+                             len(result.refuted_nodes))
                 log("plan", "warn", "plan partially refuted",
                     eval_id=plan.eval_id,
                     refuted=len(result.refuted_nodes))
@@ -262,6 +301,22 @@ class PlanApplier:
             pending.respond(result, None)
         except Exception as e:  # noqa: BLE001
             pending.respond(None, e)
+
+    @staticmethod
+    def _stamp_trace(plan: Plan, result: PlanResult) -> None:
+        """Carry the eval's trace onto every alloc this commit creates:
+        the client's alloc runner closes the span tree with the
+        alloc-start span (block rows inherit via their template)."""
+        if not plan.trace_id:
+            return
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                if not a.trace_id:
+                    a.trace_id = plan.trace_id
+        for block in result.alloc_blocks:
+            tmpl = getattr(block, "template", None)
+            if tmpl is not None and not tmpl.trace_id:
+                tmpl.trace_id = plan.trace_id
 
     def evaluate_plan(self, plan: Plan, skip_fit: bool = False,
                       fenced_first: bool = False) -> PlanResult:
@@ -301,7 +356,7 @@ class PlanApplier:
             deployment=plan.deployment,
             deployment_updates=plan.deployment_updates,
         )
-        self.stats["fast_path" if skip_fit else "full_check"] += 1
+        self.stats.inc("fast_path" if skip_fit else "full_check")
         # write claims accumulated by ALREADY-ACCEPTED nodes of THIS plan:
         # without it two writers to a single-writer volume inside one plan
         # are each checked against the pre-plan claim set and both commit

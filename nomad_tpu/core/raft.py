@@ -50,6 +50,7 @@ from nomad_tpu.chaos.transport import (
 
 from . import wire
 from .logging import log
+from .telemetry import REGISTRY
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -277,6 +278,7 @@ class RaftNode:
     def apply(self, cmd: bytes, timeout: float = 10.0):
         """Replicate one command; returns the local FSM result after the
         entry commits.  Raises NotLeaderError on non-leaders."""
+        t_start = self.clock.monotonic()
         with self._lock:
             if self.role != LEADER or self._stop.is_set():
                 raise NotLeaderError(self.leader_name)
@@ -291,6 +293,10 @@ class RaftNode:
             if single:
                 self.commit_index = index
                 self._apply_cv.notify_all()
+        # append latency: local log append + persist (the lock section);
+        # commit latency below additionally covers replication + quorum
+        REGISTRY.observe("nomad.raft.append_s",
+                         self.clock.monotonic() - t_start)
         if not single:
             self._replicate_once()
         # clock-time wait: under a VirtualClock the commit timeout is
@@ -317,6 +323,8 @@ class RaftNode:
                           f" repl_alive="
                           f"{ {n: t.is_alive() for n, t in self._peer_threads.items()} }")
             raise TimeoutError(f"raft apply timed out at {detail}")
+        REGISTRY.observe("nomad.raft.commit_s",
+                         self.clock.monotonic() - t_start)
         if isinstance(waiter[1], _Dropped):
             raise NotLeaderError(self.leader_name)
         if isinstance(waiter[1], Exception):
@@ -374,6 +382,8 @@ class RaftNode:
 
     def _become_follower(self, term: int, leader: Optional[str]) -> None:
         was_leader = self.role == LEADER
+        if was_leader:
+            REGISTRY.inc("nomad.raft.leadership_lost", node=self.name)
         self.role = FOLLOWER
         if term > self.term:
             self.term = term
@@ -489,6 +499,7 @@ class RaftNode:
                 self._become_leader()
 
     def _become_leader(self) -> None:
+        REGISTRY.inc("nomad.raft.leadership_transitions", node=self.name)
         self.role = LEADER
         self.leader_name = self.name
         self._lease_start = self.clock.monotonic()

@@ -38,6 +38,7 @@ from nomad_tpu.structs import (
     new_id,
 )
 
+from . import telemetry
 from .logging import log
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
@@ -66,6 +67,11 @@ class Server:
         # so a chaos scenario's VirtualClock owns the whole server's
         # timeline; production default is the wall clock
         self.clock = clock if clock is not None else SystemClock()
+        # process telemetry rides the same injected clock (telemetry is
+        # process-global like logging.RING; all in-process agents of one
+        # simulated cluster share a clock already, so last-write wins is
+        # benign)
+        telemetry.configure(self.clock)
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -87,6 +93,9 @@ class Server:
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.state, self.plan_queue)
+        # plan queue-wait / apply latencies measure on the injected clock
+        self.plan_queue.clock = self.clock
+        self.plan_applier.clock = self.clock
         # shared per-stage wall-interval timers (core/wavepipe.py): the
         # workers' WavePipelines record dispatch/device/d2h/materialize,
         # the applier records commit — one clock, so the device↔commit
@@ -126,6 +135,7 @@ class Server:
         queue, blocked evals; restore pending evals from state."""
         self._leader = True
         log("server", "info", "leadership established")
+        telemetry.REGISTRY.inc("nomad.server.leadership_transitions")
         # workload-identity signing secret: minted once per cluster
         # (first-writer-wins in the store; replicated + snapshotted)
         if not self.state.identity_secret():
@@ -170,6 +180,7 @@ class Server:
             return
         self._leader = False
         log("server", "info", "leadership revoked")
+        telemetry.REGISTRY.inc("nomad.server.leadership_revocations")
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self.plan_queue.set_enabled(False)
@@ -577,6 +588,12 @@ class Server:
         if not evals:
             return
         t = now if now is not None else self.clock.time()
+        # trace-context origin: every eval entering the FSM gets a trace
+        # id here (its own id — deterministic and join-friendly); evals
+        # minted by other evals (follow-ups, blocked) inherit instead
+        for ev in evals:
+            if not ev.trace_id:
+                ev.trace_id = ev.id
         # an eval TRANSITIONING to failed (scheduler retry exhaustion,
         # delivery limit) gets a delayed follow-up so its job is not
         # stranded until the next state change (reference: leader.go

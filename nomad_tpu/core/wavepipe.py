@@ -45,6 +45,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from nomad_tpu.core.telemetry import REGISTRY
+
 # stage names, in pipeline order.  "device" = kernel execution after the
 # dispatch returns (async); "d2h" = result fetch + host-side expansion;
 # "materialize" = plan construction from picks; "commit" = the applier's
@@ -94,6 +96,10 @@ class StageTimers:
             if ring is None:
                 self._ring[stage] = ring = deque(maxlen=_RING)
             ring.append((wave, t0, t1))
+        # per-stage latency distribution on the process registry
+        # (core/telemetry.py): the interval ring above keeps proving the
+        # overlap; the histogram adds p50/p95/p99 to /v1/metrics
+        REGISTRY.observe(f"nomad.wavepipe.{stage}_s", t1 - t0)
 
     @contextmanager
     def time(self, stage: str, wave: int = -1):

@@ -12,6 +12,12 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.core.logging import log
+from nomad_tpu.core.telemetry import (
+    REGISTRY,
+    TRACER,
+    StatCounters,
+    span_id,
+)
 from nomad_tpu.core.wavepipe import WavePipeline
 from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.scheduler import new_scheduler
@@ -35,7 +41,14 @@ class Worker:
         self.id = worker_id
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.stats = {"invoked": 0, "acked": 0, "nacked": 0}
+        self.stats = StatCounters("nomad.worker",
+                                  ("invoked", "acked", "nacked"))
+        # telemetry (core/telemetry.py): per-eval schedule-start stamps
+        # (dequeue -> settle feeds the schedule histogram + span) and the
+        # trace id each in-flight eval carries, so submitted plans join
+        # their eval's span tree
+        self._sched_t0: Dict[str, float] = {}
+        self._batch_trace: Dict[str, str] = {}
         # set per-eval by process():
         self._snapshot = None
         self._snapshot_seq: Optional[int] = None
@@ -87,6 +100,7 @@ class Worker:
             # of stranding them until the nack timeout
             t = self.server.clock.time()
             for ev, token in pf["batch"]:
+                self._sched_t0.pop(ev.id, None)
                 self.server.eval_broker.nack(ev.id, token, now=t)
 
     def _run(self) -> None:
@@ -118,6 +132,8 @@ class Worker:
             return 0
         self._eval_token = token
         self._batch_tokens = {evaluation.id: token}
+        self._batch_trace = {evaluation.id: evaluation.trace_id}
+        self._sched_t0[evaluation.id] = TRACER.clock.monotonic()
         try:
             err = self._invoke(evaluation, t)
         except Exception as e:  # noqa: BLE001 - a scheduler bug must nack,
@@ -128,15 +144,27 @@ class Worker:
     def _settle(self, evaluation: Evaluation, token: str,
                 err: Optional[Exception], t: float) -> None:
         broker = self.server.eval_broker
+        # schedule duration = dequeue -> settle, per scheduler type: the
+        # batched path's span covers its share of the shared device wait
+        # too (that IS this eval's schedule latency)
+        t1 = TRACER.clock.monotonic()
+        t0 = self._sched_t0.pop(evaluation.id, t1)
+        REGISTRY.observe("nomad.worker.schedule_s", t1 - t0,
+                         type=evaluation.type)
+        if evaluation.trace_id:
+            TRACER.record("worker.schedule", evaluation.trace_id, t0, t1,
+                          parent=span_id(evaluation.trace_id, "eval"),
+                          worker=self.id, type=evaluation.type,
+                          outcome="ack" if err is None else "nack")
         if err is None:
             broker.ack(evaluation.id, token)
-            self.stats["acked"] += 1
+            self.stats.inc("acked")
             log("worker", "debug", "eval acked", worker=self.id,
                 eval_id=evaluation.id, job_id=evaluation.job_id,
                 type=evaluation.type)
         else:
             broker.nack(evaluation.id, token, now=t)
-            self.stats["nacked"] += 1
+            self.stats.inc("nacked")
             log("worker", "warn", "eval nacked", worker=self.id,
                 eval_id=evaluation.id, job_id=evaluation.job_id,
                 error=str(err))
@@ -206,9 +234,11 @@ class Worker:
         snapshot, batch_seq0 = state.snapshot_and_placement_seq()
 
         # phase 1: build schedulers, reconcile batch-eligible evals
+        t0m = TRACER.clock.monotonic()
         work = []          # (ev, token, sched_or_None, prep_or_err)
         for ev, token in batch:
-            self.stats["invoked"] += 1
+            self.stats.inc("invoked")
+            self._sched_t0.setdefault(ev.id, t0m)
             if ev.type == "_core":
                 kwargs = {"now": t, "store": state}
             else:
@@ -282,6 +312,7 @@ class Worker:
         self.server.eval_broker.extend_outstanding(
             [(ev.id, token) for ev, token in pf["batch"]], now=t)
         self._batch_tokens = {ev.id: token for ev, token in pf["batch"]}
+        self._batch_trace = {ev.id: ev.trace_id for ev, _ in pf["batch"]}
         bds = {}
         if pf["pending"] is not None:
             decisions = self.pipeline.collect(pf["pending"])
@@ -408,7 +439,7 @@ class Worker:
             state.wait_for_index(evaluation.modify_index, timeout=5.0)
         self._snapshot, self._snapshot_seq = \
             state.snapshot_and_placement_seq()
-        self.stats["invoked"] += 1
+        self.stats.inc("invoked")
         if evaluation.type == "_core":
             kwargs = {"now": now, "store": state}
         else:
@@ -433,6 +464,8 @@ class Worker:
         scheduler's view mid-batch."""
         plan.snapshot_index = self._snapshot.index if self._snapshot else 0
         plan.eval_token = self._batch_tokens.get(plan.eval_id, "")
+        if not plan.trace_id:
+            plan.trace_id = self._batch_trace.get(plan.eval_id, "")
         pending = self.server.plan_queue.enqueue(plan)
         # the applier thread evaluates + commits; in single-threaded test
         # mode the server applies inline

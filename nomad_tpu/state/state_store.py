@@ -146,6 +146,14 @@ class StateStore:
         with self._lock:
             return self._placement_seq
 
+    def counts(self) -> Dict[str, int]:
+        """Cheap table sizes for the metrics scrape path: a 1s
+        Prometheus scrape must not pay snapshot (COW-marking) cost just
+        to count nodes and jobs."""
+        with self._lock:
+            return {"nodes": len(self._nodes), "jobs": len(self._jobs),
+                    "evals": len(self._evals)}
+
     def _bump(self) -> int:
         self._index += 1
         self._index_cv.notify_all()

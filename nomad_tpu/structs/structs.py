@@ -699,6 +699,10 @@ class Allocation:
     id: str = field(default_factory=new_id)
     namespace: str = "default"
     eval_id: str = ""
+    # eval-lifecycle trace this alloc belongs to (core/telemetry.py):
+    # stamped by the plan applier at commit so the client's alloc runner
+    # can close the span tree with the alloc-start span
+    trace_id: str = ""
     name: str = ""            # job.name[index]
     node_id: str = ""
     node_name: str = ""
@@ -838,6 +842,10 @@ class Evaluation:
     failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
     annotate_plan: bool = False
     snapshot_index: int = 0
+    # cross-component trace id (core/telemetry.py): stamped once at the
+    # FSM boundary (Server.apply_eval_update) and inherited by every
+    # follow-up/blocked eval, plan, and alloc this eval produces
+    trace_id: str = ""
     create_index: int = 0
     modify_index: int = 0
     create_time: float = 0.0
@@ -886,6 +894,7 @@ class Evaluation:
             escaped_computed_class=escaped,
             quota_limit_reached=quota,
             failed_tg_allocs=dict(failed_tg_allocs or {}),
+            trace_id=self.trace_id,
         )
 
     def create_failed_follow_up_eval(self, wait_until: float) -> "Evaluation":
@@ -898,6 +907,7 @@ class Evaluation:
             status=EVAL_STATUS_PENDING,
             wait_until=wait_until,
             previous_eval=self.id,
+            trace_id=self.trace_id,
         )
 
 
@@ -966,6 +976,10 @@ class Plan:
 
     eval_id: str = ""
     eval_token: str = ""
+    # trace context inherited from the eval (core/telemetry.py): the
+    # applier's queue-wait/apply spans and the committed allocs join the
+    # eval's span tree through it
+    trace_id: str = ""
     priority: int = 50
     all_at_once: bool = False
     job: Optional[Job] = None

@@ -888,6 +888,19 @@ class StateStore:
     def broken_helper(self, key):
         vol = self._writable_claim_vol(key)   # VIOLATION: no lock
         return vol
+
+
+class MetricsRegistry:
+    # the telemetry registry's locked paths (core/telemetry.py): the
+    # histogram mutator is *_locked and every caller must hold the
+    # registry lock — a bare call is exactly the unsynchronized
+    # stats-dict increment this PR removed from broker/worker
+    def observe(self, key, value):
+        with self._lock:
+            self._observe_locked(key, value)  # ok: under the lock
+
+    def broken_observe(self, key, value):
+        self._observe_locked(key, value)      # VIOLATION: no lock
 '''
 
 SELFTEST_COW = '''
@@ -996,7 +1009,7 @@ def selftest() -> int:
                   f"mentions {must_contain!r}: {got}")
             ok = False
 
-    expect("lock", SELFTEST_LOCK, 2, "outside")
+    expect("lock", SELFTEST_LOCK, 3, "outside")
     expect("cow", SELFTEST_COW, 4, "_writable_")
     expect("purity", SELFTEST_PURITY, 5, "DONATED")
     expect("thread", SELFTEST_THREAD, 1, "_on_raft_leader")
@@ -1007,7 +1020,7 @@ def selftest() -> int:
     expect("thread", suppressed, 0)
     if ok:
         print("analyze selftest ok: every pass caught its injected "
-              "violation (lock=2 cow=4 purity=5 thread=1, suppression "
+              "violation (lock=3 cow=4 purity=5 thread=1, suppression "
               "honored)")
         return 0
     return 1

@@ -42,6 +42,62 @@ python -m pytest tests/test_wavepipe.py -q -m 'not slow'
 echo "== tests (8-virtual-device CPU mesh, tier-1: not slow) =="
 python -m pytest tests/ -q -m 'not slow'
 
+echo "== telemetry smoke (dev agent: prometheus scrape + trace fetch) =="
+# boot a real dev agent over HTTP, run one job, validate the prometheus
+# exposition grammar, and fetch the job's eval trace — the end-to-end
+# observability contract (core/telemetry.py) in one pass
+JAX_PLATFORMS=cpu python - <<'EOF'
+import re
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.structs import codec
+
+agent = Agent(num_clients=1, num_workers=1, heartbeat_ttl=3600).start()
+api = APIClient(address=agent.address)
+try:
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].config = {"run_for_s": 120}
+    eval_id = api.jobs.register(codec.encode(job))["EvalID"]
+    assert eval_id, "register returned no eval"
+
+    want = {"eval", "broker.wait", "worker.schedule",
+            "plan.queue_wait", "plan.apply", "client.alloc_start"}
+    deadline = time.time() + 30
+    names = set()
+    while time.time() < deadline and not want <= names:
+        try:
+            names = {s["Name"] for s in api.agent.trace(eval_id)["Spans"]}
+        except Exception:
+            pass
+        time.sleep(0.2)
+    assert want <= names, f"trace incomplete: {sorted(names)}"
+
+    text = api.agent.metrics(format="prometheus")
+    type_re = re.compile(
+        r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)$")
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})?'
+        r' -?[0-9]+(\.[0-9]+)?([eE][-+][0-9]+)?$')
+    n = 0
+    for line in text.strip().splitlines():
+        ok = (type_re.match(line) if line.startswith("#")
+              else sample_re.match(line))
+        assert ok, f"bad exposition line: {line!r}"
+        n += 1
+    for fam in ("nomad_broker_wait_seconds_bucket",
+                "nomad_worker_schedule_seconds_p99",
+                "nomad_plan_apply_seconds_sum"):
+        assert fam in text, f"missing family {fam}"
+    print(f"telemetry smoke ok: {n} exposition lines, trace {eval_id[:8]}"
+          f" spans={sorted(names)}")
+finally:
+    agent.shutdown()
+EOF
+
 echo "== chaos (seeded fault-injection scenarios on the virtual clock) =="
 # the full chaos suite: every scenario in tests/test_chaos.py with its
 # pinned seed (partition / split-brain / flap storm / lossy raft /

@@ -378,6 +378,33 @@ def test_scenario_invariants(name):
 
 
 @pytest.mark.slow
+def test_scenario_captures_eval_trace_shape():
+    """The telemetry hook (core/telemetry.py): a scenario run captures
+    the eval-lifecycle spans its workload produced, so chaos tests can
+    assert on TRACE SHAPE — which stages each eval passed through — on
+    top of the state/log invariants.  Under faults an eval may be
+    mid-flight at capture time, so the assertion is over the whole run's
+    span set, with per-trace parent links still consistent."""
+    name = "leader_partition"
+    r = _run(name, SEEDS[name])
+    names = r.span_names()
+    assert {"eval", "broker.wait", "worker.schedule",
+            "plan.apply"} <= set(names), names
+    by_trace = {}
+    for sp in r.spans:
+        by_trace.setdefault(sp["TraceID"], []).append(sp)
+    assert by_trace
+    for spans in by_trace.values():
+        ids = {sp["SpanID"] for sp in spans}
+        for sp in spans:
+            # a parent either resolves in-trace or is the root marker of
+            # a span still open at capture (the eval span ends at ack)
+            assert sp["ParentID"] == "" or sp["ParentID"] in ids \
+                or sp["ParentID"].endswith("-eval") \
+                or sp["ParentID"].endswith("-worker.schedule"), sp
+
+
+@pytest.mark.slow
 def test_seed_determinism_full_run():
     """Two full executions with one seed produce byte-identical
     canonical traces and the same state fingerprint."""
