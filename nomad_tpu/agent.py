@@ -203,6 +203,11 @@ class Agent:
         REGISTRY.set_gauge("nomad.state.nodes", counts["nodes"])
         REGISTRY.set_gauge("nomad.state.jobs", counts["jobs"])
         REGISTRY.set_gauge("nomad.state.evals", counts["evals"])
+        # scheduling-quality gauges from the store's incremental ledger
+        # (O(nodes in use); no COW-marking snapshot) — scrape-time
+        # refresh so the series is current even between plan commits
+        from nomad_tpu.core.plan_apply import publish_quality
+        publish_quality(s.state)
         timers = getattr(s, "stage_timers", None)
         if timers is not None:
             rep = timers.report()

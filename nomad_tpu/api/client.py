@@ -174,6 +174,12 @@ class Jobs(_Endpoint):
                           body={"Target": {"Group": group},
                                 "Count": count})
 
+    def placement_failures(self, job_id: str) -> Dict:
+        """The "why pending" rollup: the newest blocked eval's per-task-
+        group NodesEvaluated/Filtered/DimensionExhausted breakdown."""
+        jid = urllib.parse.quote(job_id, safe="")
+        return self.c.get(f"/v1/job/{jid}/placement-failures")
+
 
 class Nodes(_Endpoint):
     def list(self) -> List[Dict]:
@@ -245,6 +251,11 @@ class Evaluations(_Endpoint):
 
     def allocations(self, eval_id: str) -> List[Dict]:
         return self.c.get(f"/v1/evaluation/{eval_id}/allocations")
+
+    def explain(self, eval_id: str) -> Dict:
+        """The eval's placement-decision record: per-task-group score
+        tables, filter/exhaustion breakdowns, and the blocked cause."""
+        return self.c.get(f"/v1/eval/{eval_id}/explain")
 
 
 class Deployments(_Endpoint):

@@ -244,7 +244,7 @@ class Router:
                 raise APIError(403, "permission denied: management required")
             return acl
         if head in ("jobs", "job", "allocations", "allocation",
-                    "evaluations", "evaluation", "deployments",
+                    "evaluations", "evaluation", "eval", "deployments",
                     "deployment", "search", "services", "service",
                     "volumes", "volume"):
             cap = "submit-job" if write else "read-job"
@@ -430,8 +430,8 @@ class Router:
                 self._block(qs)
                 return [codec.encode(e) for e in s.state.snapshot().evals()
                         if e.namespace == ns or ns == "*"]
-        elif head == "evaluation":
-            eid = p[1]
+        elif head in ("evaluation", "eval"):
+            eid = p[1] if len(p) > 1 else ""
             ev = s.state.eval_by_id(eid)
             if ev is None:
                 raise APIError(404, "eval not found")
@@ -441,6 +441,15 @@ class Router:
                 return [codec.encode(a) for a in
                         snap.allocs_by_job(ev.namespace, ev.job_id)
                         if a.eval_id == eid]
+            if len(p) > 2 and p[2] == "explain":
+                # /v1/eval/<id>/explain — the placement-explainability
+                # surface: decision-ring record when this server still
+                # holds it, else synthesized from the stored eval's
+                # failure rollups (core/explain.py)
+                from nomad_tpu.core.explain import explain_doc
+                get_dec = getattr(s.state, "eval_decision", None)
+                dec = get_dec(eid) if get_dec is not None else None
+                return explain_doc(ev, dec)
             return codec.encode(ev)
         elif head == "deployments":
             if method == "GET":
@@ -699,6 +708,12 @@ class Router:
             if sub == "deployments":
                 return [codec.encode(d) for d in snap.deployments()
                         if d.namespace == ns and d.job_id == job_id]
+            if sub == "placement-failures":
+                # "why pending": the newest blocked eval's per-TG
+                # NodesEvaluated/Filtered/DimensionExhausted rollups
+                from nomad_tpu.core.explain import placement_failures_doc
+                return placement_failures_doc(
+                    job_id, ns, snap.evals_by_job(ns, job_id))
         if method == "DELETE":
             purge = (qs.get("purge") or ["false"])[0] == "true"
             ev = s.deregister_job(ns, job_id, purge=purge)

@@ -173,6 +173,21 @@ def cmd_job_status(args) -> int:
             print(f"  {a['ID'][:8]}  {a.get('NodeID', '')[:8]}  "
                   f"{a.get('TaskGroup', '')}  "
                   f"{a.get('DesiredStatus', '')}/{a.get('ClientStatus', '')}")
+    try:
+        failures = c.jobs.placement_failures(args.job_id)
+    except APIException:
+        failures = None      # older server without the endpoint
+    if failures and failures.get("TaskGroups"):
+        print("\nPlacement Failures:")
+        for name, tg in sorted(failures["TaskGroups"].items()):
+            print(f"  Task Group {name!r}: {tg.get('Failed', 0)} "
+                  "unplaced")
+            _print_metric_rollup(tg, indent="    ")
+            if tg.get("Cause"):
+                print(f"    Why pending: {tg['Cause']}")
+        if failures.get("Blocked"):
+            print(f"  Evaluation {failures.get('EvalID', '')[:8]} is "
+                  "blocked waiting for capacity")
     return 0
 
 
@@ -376,7 +391,22 @@ def cmd_node_eligibility(args) -> int:
 
 
 def cmd_alloc_status(args) -> int:
-    _out(_client(args).allocations.info(args.alloc_id))
+    info = _client(args).allocations.info(args.alloc_id)
+    _out(info)
+    if getattr(args, "verbose", False):
+        # the winning node's score breakdown (the kernel's top-k table
+        # travels on every alloc's AllocMetric — ops/engine.py)
+        m = info.get("Metrics") or {}
+        print("\nPlacement Metrics:")
+        _print_metric_rollup(m)
+        if m.get("AllocationTimeNS"):
+            print("  Allocation Time = "
+                  f"{m['AllocationTimeNS'] / 1e6:.3f}ms")
+        rows = m.get("ScoreMetaData") or []
+        if rows:
+            print("  Score breakdown (top candidates, * = placed here):")
+            _print_score_table(rows, winner=info.get("NodeID", ""),
+                               indent="    ")
     return 0
 
 
@@ -493,6 +523,76 @@ def cmd_eval_list(args) -> int:
 
 def cmd_eval_status(args) -> int:
     _out(_client(args).evaluations.info(args.eval_id))
+    return 0
+
+
+def _print_metric_rollup(m: dict, indent: str = "  ") -> None:
+    """NodesEvaluated/Filtered/Exhausted breakdown of one encoded
+    AllocMetric (the SURVEY §4.5 eval-status contract)."""
+    print(f"{indent}Nodes Evaluated = {m.get('NodesEvaluated', 0)}")
+    print(f"{indent}Nodes Filtered  = {m.get('NodesFiltered', 0)}")
+    print(f"{indent}Nodes Exhausted = {m.get('NodesExhausted', 0)}")
+    for key, label in (("DimensionExhausted", "Dimensions Exhausted"),
+                       ("ConstraintFiltered", "Constraints Filtered"),
+                       ("ClassFiltered", "Classes Filtered"),
+                       ("ClassExhausted", "Classes Exhausted")):
+        d = m.get(key)
+        if d:
+            inner = ", ".join(f"{k}: {v}" for k, v in sorted(d.items()))
+            print(f"{indent}{label} = {inner}")
+    if m.get("QuotaExhausted"):
+        print(f"{indent}Quota Exhausted = "
+              f"{', '.join(m['QuotaExhausted'])}")
+
+
+def _print_score_table(rows, winner: str = "", indent: str = "  ") -> None:
+    print(f"{indent}{'':1}{'Node':<36} {'Score':>10}")
+    for r in rows:
+        nid = r.get("NodeID", "")
+        mark = "*" if winner and nid == winner else " "
+        extra = ""
+        scores = r.get("Scores") or {}
+        if len(scores) > 1 or (scores and "final" not in scores):
+            extra = "  " + ", ".join(f"{k}={v:.4f}"
+                                     for k, v in sorted(scores.items()))
+        print(f"{indent}{mark}{nid[:36]:<36} "
+              f"{r.get('NormScore', 0):>10.4f}{extra}")
+
+
+def cmd_eval_explain(args) -> int:
+    """Human-readable placement decision for one eval: per-task-group
+    score tables plus the filter/exhaustion breakdown that names the
+    blocking dimension of a pending job."""
+    doc = _client(args).evaluations.explain(args.eval_id)
+    print(f"ID           = {doc.get('EvalID', '')[:8]}")
+    print(f"Job          = {doc.get('JobID', '')}")
+    print(f"Namespace    = {doc.get('Namespace', '')}")
+    print(f"Type         = {doc.get('Type', '')}")
+    print(f"Triggered By = {doc.get('TriggeredBy', '')}")
+    print(f"Status       = {doc.get('Status', '')}")
+    if doc.get("StatusDescription"):
+        print(f"Description  = {doc['StatusDescription']}")
+    if doc.get("BlockedEval"):
+        print(f"Blocked Eval = {doc['BlockedEval'][:8]}")
+    if doc.get("BlockedCause"):
+        print(f"Cause        = {doc['BlockedCause']}")
+    for name, tg in sorted((doc.get("TaskGroups") or {}).items()):
+        head = (f"{tg.get('Placed', 0)} placed, "
+                f"{tg.get('Failed', 0)} failed")
+        if tg.get("Preempted"):
+            head += f", {tg['Preempted']} preempted"
+        print(f"\nTask Group {name!r} ({head})")
+        m = tg.get("Metric")
+        if m:
+            _print_metric_rollup(m)
+        if tg.get("Cause"):
+            print(f"  Why pending     : {tg['Cause']}")
+        if tg.get("PreemptedAllocs"):
+            short = ", ".join(a[:8] for a in tg["PreemptedAllocs"])
+            print(f"  Preempted Allocs: {short}")
+        if tg.get("ScoreTable"):
+            print("  Top candidates:")
+            _print_score_table(tg["ScoreTable"], indent="    ")
     return 0
 
 
@@ -970,6 +1070,8 @@ def build_parser() -> argparse.ArgumentParser:
         dest="alloc_cmd", required=True)
     als = alloc.add_parser("status")
     als.add_argument("alloc_id")
+    als.add_argument("-verbose", action="store_true",
+                     help="show the placement score breakdown")
     als.set_defaults(fn=cmd_alloc_status)
     alst = alloc.add_parser("stop")
     alst.add_argument("alloc_id")
@@ -1012,6 +1114,11 @@ def build_parser() -> argparse.ArgumentParser:
     evs = ev.add_parser("status")
     evs.add_argument("eval_id")
     evs.set_defaults(fn=cmd_eval_status)
+    evx = ev.add_parser("explain",
+                        help="why an eval placed (or failed to place) "
+                             "where it did")
+    evx.add_argument("eval_id")
+    evx.set_defaults(fn=cmd_eval_explain)
 
     dep = sub.add_parser("deployment",
                          help="deployment commands").add_subparsers(

@@ -1,0 +1,237 @@
+"""Placement explainability: eval decision records and "why pending"
+rollups (reference: the eval-status/placement-metrics contract of
+`nomad eval status` / `nomad job status` — SURVEY.md §4.5).
+
+The device scoring path already materializes `AllocMetric` + top-k
+`NodeScoreMeta` per placement (ops/engine.py); this module joins that
+already-captured data into queryable artifacts:
+
+  - `build_decision` — assembled by the schedulers at submit time from
+    the per-task-group stats they tracked while materializing the plan;
+    committed to the state store's bounded decision ring.
+  - `blocked_cause` / `failure_rollup` — the NodesEvaluated /
+    ClassFiltered / DimensionExhausted rollups that tell an operator
+    WHICH dimension or constraint blocked a pending job.
+  - `explain_doc` — the wire document behind `/v1/eval/<id>/explain`,
+    synthesized from the stored eval's `failed_tg_allocs` when the
+    decision ring no longer holds the record (restart, follower).
+
+Capture is cheap by construction: every input here is host-resident
+already (the engine interns `score_meta_data` per bulk round; no extra
+device→host pulls happen on this path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from nomad_tpu.structs import (
+    AllocMetric,
+    EvalDecision,
+    Evaluation,
+    NodeScoreMeta,
+    TGDecision,
+    codec,
+)
+
+# preempted-alloc ids kept per task group on a decision record (the full
+# victim set lives on the preempting allocs; this is a debugging sample)
+MAX_PREEMPTED_IDS = 16
+
+
+def failure_rollup(metric: AllocMetric) -> str:
+    """One-line human cause from an AllocMetric failure rollup, most
+    actionable reason first: exhausted dimensions (capacity exists but is
+    consumed) beat constraint/class filters (capacity never qualified)."""
+    parts: List[str] = []
+    for dim, n in sorted(metric.dimension_exhausted.items()):
+        parts.append(f"dimension {dim!r} exhausted on {n} node(s)")
+    if metric.nodes_exhausted and not metric.dimension_exhausted:
+        parts.append(f"{metric.nodes_exhausted} node(s) exhausted")
+    for reason, n in sorted(metric.constraint_filtered.items()):
+        parts.append(f"constraint {reason!r} filtered {n} node(s)")
+    for klass, n in sorted(metric.class_filtered.items()):
+        parts.append(f"class {klass!r} filtered {n} node(s)")
+    for quota in metric.quota_exhausted:
+        parts.append(f"quota {quota!r} exhausted")
+    if not parts and metric.nodes_filtered:
+        parts.append(f"{metric.nodes_filtered} of {metric.nodes_evaluated}"
+                     " node(s) filtered")
+    if not parts:
+        if metric.nodes_evaluated == 0:
+            parts.append("no nodes were eligible for evaluation")
+        else:
+            parts.append("placement failed on all candidate nodes")
+    return "; ".join(parts)
+
+
+def blocked_cause(failed_tg_allocs: Dict[str, AllocMetric]) -> str:
+    """Summarize a blocked eval's `failed_tg_allocs` across task groups."""
+    if not failed_tg_allocs:
+        return ""
+    return "; ".join(f"{tg}: {failure_rollup(m)}"
+                     for tg, m in sorted(failed_tg_allocs.items()))
+
+
+def build_decision(evaluation: Evaluation,
+                   tg_stats: Dict[str, dict],
+                   now: float = 0.0,
+                   snapshot_index: int = 0) -> EvalDecision:
+    """Join the scheduler's per-task-group materialization stats
+    (`tg_stats`: name -> {placed, desired, preempted, preempted_ids,
+    metric, score_meta}) with the eval's failure rollups into one
+    decision record.  `evaluation` is the final (status-stamped) copy."""
+    tgs: Dict[str, TGDecision] = {}
+    for name, st in tg_stats.items():
+        tgs[name] = TGDecision(
+            task_group=name,
+            desired=st.get("desired", 0),
+            placed=st.get("placed", 0),
+            preempted=st.get("preempted", 0),
+            preempted_allocs=list(st.get("preempted_ids",
+                                         ()))[:MAX_PREEMPTED_IDS],
+            metric=st.get("metric"),
+            score_meta=list(st.get("score_meta", ())),
+        )
+    for name, metric in evaluation.failed_tg_allocs.items():
+        d = tgs.get(name)
+        if d is None:
+            tgs[name] = d = TGDecision(task_group=name)
+        d.failed = metric.coalesced_failures + 1
+        d.desired = max(d.desired, d.placed + d.failed)
+        # the failure rollup wins the metric slot: it carries the
+        # filter/exhaustion breakdown an operator debugs with; the
+        # winners' top-k stays in score_meta
+        d.metric = metric
+    for d in tgs.values():
+        d.desired = max(d.desired, d.placed + d.failed)
+    return EvalDecision(
+        eval_id=evaluation.id,
+        trace_id=evaluation.trace_id,
+        namespace=evaluation.namespace,
+        job_id=evaluation.job_id,
+        job_type=evaluation.type,
+        triggered_by=evaluation.triggered_by,
+        status=evaluation.status,
+        status_description=evaluation.status_description,
+        blocked_eval=evaluation.blocked_eval,
+        blocked_cause=blocked_cause(evaluation.failed_tg_allocs),
+        task_groups=tgs,
+        snapshot_index=snapshot_index,
+        create_time=now,
+    )
+
+
+def record_decision(planner, evaluation: Evaluation,
+                    tg_stats: Dict[str, dict], now: float = 0.0,
+                    snapshot_index: int = 0) -> None:
+    """Commit an eval's decision record through the planner seam
+    alongside its terminal status update.  Observability only: a planner
+    without the seam (dry-run planners) is skipped and a capture failure
+    must never fail the eval."""
+    rec = getattr(planner, "record_decision", None)
+    if rec is None:
+        return
+    try:
+        rec(build_decision(evaluation, tg_stats, now=now,
+                           snapshot_index=snapshot_index))
+    except Exception:  # noqa: BLE001 - never fail scheduling on capture
+        pass
+
+
+def _score_rows(meta: List[NodeScoreMeta]) -> List[Dict]:
+    return [{"NodeID": m.node_id,
+             "Scores": dict(m.scores),
+             "NormScore": m.norm_score} for m in meta]
+
+
+def _tg_doc(d: TGDecision) -> Dict:
+    out: Dict = {
+        "TaskGroup": d.task_group,
+        "Desired": d.desired,
+        "Placed": d.placed,
+        "Failed": d.failed,
+        "Preempted": d.preempted,
+    }
+    if d.preempted_allocs:
+        out["PreemptedAllocs"] = list(d.preempted_allocs)
+    if d.metric is not None:
+        out["Metric"] = codec.encode(d.metric)
+        if d.failed:
+            out["Cause"] = failure_rollup(d.metric)
+    if d.score_meta:
+        out["ScoreTable"] = _score_rows(d.score_meta)
+    elif d.metric is not None and d.metric.score_meta_data:
+        out["ScoreTable"] = _score_rows(d.metric.score_meta_data)
+    return out
+
+
+def explain_doc(evaluation: Evaluation,
+                decision: Optional[EvalDecision]) -> Dict:
+    """The `/v1/eval/<id>/explain` wire document.  Prefers the decision
+    ring's record; falls back to a record synthesized from the stored
+    eval's `failed_tg_allocs` (survives restarts and follower reads —
+    the failure rollups ride raft on the eval itself)."""
+    if decision is None:
+        decision = build_decision(evaluation, {},
+                                  now=evaluation.modify_time,
+                                  snapshot_index=evaluation.snapshot_index)
+        from_ring = False
+    else:
+        from_ring = True
+    return {
+        "EvalID": evaluation.id,
+        "TraceID": evaluation.trace_id,
+        "Namespace": evaluation.namespace,
+        "JobID": evaluation.job_id,
+        "Type": evaluation.type,
+        "TriggeredBy": evaluation.triggered_by,
+        "Status": evaluation.status,
+        "StatusDescription": evaluation.status_description,
+        "BlockedEval": evaluation.blocked_eval or decision.blocked_eval,
+        "BlockedCause": decision.blocked_cause
+        or blocked_cause(evaluation.failed_tg_allocs),
+        "DecisionRecorded": from_ring,
+        "SnapshotIndex": decision.snapshot_index,
+        "TaskGroups": {name: _tg_doc(d)
+                       for name, d in sorted(decision.task_groups.items())},
+    }
+
+
+def placement_failures_doc(job_id: str, namespace: str,
+                           evals: List[Evaluation]) -> Dict:
+    """The `/v1/job/<id>/placement-failures` wire document: the newest
+    blocked eval's per-task-group failure rollups (falling back to the
+    newest eval carrying `failed_tg_allocs` — a job can fail placement
+    without blocking, e.g. queued-allocs re-evals)."""
+    blocked = [e for e in evals if e.status == "blocked"]
+    pool = blocked or [e for e in evals if e.failed_tg_allocs]
+    if not pool:
+        return {"JobID": job_id, "Namespace": namespace,
+                "Blocked": False, "TaskGroups": {}}
+    ev = max(pool, key=lambda e: e.modify_index)
+    tgs = {}
+    for name, m in sorted(ev.failed_tg_allocs.items()):
+        tgs[name] = {
+            "Failed": m.coalesced_failures + 1,
+            "NodesEvaluated": m.nodes_evaluated,
+            "NodesFiltered": m.nodes_filtered,
+            "NodesExhausted": m.nodes_exhausted,
+            "NodesInPool": m.nodes_in_pool,
+            "NodesAvailable": dict(m.nodes_available),
+            "DimensionExhausted": dict(m.dimension_exhausted),
+            "ConstraintFiltered": dict(m.constraint_filtered),
+            "ClassFiltered": dict(m.class_filtered),
+            "ClassExhausted": dict(m.class_exhausted),
+            "QuotaExhausted": list(m.quota_exhausted),
+            "Cause": failure_rollup(m),
+        }
+    return {
+        "JobID": job_id,
+        "Namespace": namespace,
+        "Blocked": bool(blocked),
+        "EvalID": ev.id,
+        "BlockedSince": ev.create_time,
+        "Cause": blocked_cause(ev.failed_tg_allocs),
+        "TaskGroups": tgs,
+    }

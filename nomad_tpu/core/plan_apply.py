@@ -50,6 +50,28 @@ class StaleDeliveryError(Exception):
 _NULL_GUARD = contextlib.nullcontext()
 
 
+def publish_quality(state, registry=REGISTRY) -> None:
+    """Feed the live scheduling-quality gauges (the runtime counterpart
+    of bench.py's quality_* keys) from the store's incremental ledger:
+    nodes-in-use, per-zone alloc balance, and mean bin-pack fill per
+    dimension.  Called throttled from the plan applier after commits and
+    from the agent's metrics scrape."""
+    summary = getattr(state, "quality_summary", None)
+    if summary is None:
+        return
+    q = summary()
+    registry.set_gauge("nomad.quality.nodes_in_use", q["nodes_in_use"])
+    registry.set_gauge("nomad.quality.zone_allocs_max",
+                       q["zone_allocs_max"])
+    registry.set_gauge("nomad.quality.zone_allocs_min",
+                       q["zone_allocs_min"])
+    registry.set_gauge("nomad.quality.zone_balance_max_over_min",
+                       round(q["zone_balance_max_over_min"], 6))
+    for dim in ("cpu", "memory", "disk"):
+        registry.set_gauge("nomad.quality.binpack_fill",
+                           round(q[f"fill_{dim}"], 6), dimension=dim)
+
+
 @dataclass
 class PendingPlan:
     plan: Plan
@@ -180,6 +202,11 @@ class PlanApplier:
         # apply records one "commit" interval so the pipeline's overlap
         # of host commit under device compute is measurable
         self.timers = None
+        # scheduling-quality gauge refresh, throttled: the summary walk
+        # is O(nodes in use), so a 100-plan/s wave refreshes once per
+        # interval instead of per plan (PERF.md §11: soak budget)
+        self.quality_interval = 1.0
+        self._quality_next = 0.0
 
     # ------------------------------------------------------------ running
 
@@ -297,6 +324,14 @@ class PlanApplier:
                 log("plan", "warn", "plan partially refuted",
                     eval_id=plan.eval_id,
                     refuted=len(result.refuted_nodes))
+            if result.node_preemptions:
+                REGISTRY.inc("nomad.quality.preemptions",
+                             sum(len(v) for v in
+                                 result.node_preemptions.values()))
+            now = self.clock.monotonic()
+            if now >= self._quality_next:
+                self._quality_next = now + self.quality_interval
+                publish_quality(self.state)
             result.alloc_index = self.state.latest_index()
             pending.respond(result, None)
         except Exception as e:  # noqa: BLE001

@@ -82,8 +82,17 @@ def _expand(topic: str, index: int, payload) -> List[Event]:
     if isinstance(payload, (str, tuple)):
         key = payload if isinstance(payload, str) else payload[-1]
         return [Event(topic, f"{topic}Deregistered", key, index, None)]
-    return [Event(topic, _TYPE_BY_TOPIC[topic],
-                  getattr(payload, "id", ""), index, payload)]
+    events = [Event(topic, _TYPE_BY_TOPIC[topic],
+                    getattr(payload, "id", ""), index, payload)]
+    if topic == "Evaluation" and getattr(payload, "status", "") == "blocked":
+        # a blocked eval IS a placement failure: operators watching
+        # /v1/event/stream see it live, keyed by job id so a watcher can
+        # filter to its job.  The payload (the eval) carries the
+        # failed_tg_allocs rollups that explain WHY it is pending.
+        # Derived here so replay from the buffer reproduces it too.
+        events.append(Event("PlacementFailure", "PlacementFailure",
+                            getattr(payload, "job_id", ""), index, payload))
+    return events
 
 
 class Subscription:

@@ -509,5 +509,13 @@ class Worker:
         # cancels duplicates)
         self._apply_or_defer(evaluation)
 
+    def record_decision(self, decision) -> None:
+        """EvalDecision seam (core/explain.py): ride the local store's
+        bounded decision ring.  Node-local observability — never raft-
+        replicated (ReplicatedState serves non-mutation attrs locally)."""
+        rec = getattr(self.server.state, "record_eval_decision", None)
+        if rec is not None:
+            rec(decision)
+
     def serves_plan(self) -> bool:
         return True

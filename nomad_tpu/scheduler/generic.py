@@ -81,6 +81,10 @@ class GenericScheduler(Scheduler):
                              else MAX_SERVICE_ATTEMPTS)
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.queued_allocs: Dict[str, int] = {}
+        # decision-record capture (core/explain.py): per-TG placed
+        # counts, the winning metric/top-k, and preemption choices —
+        # all host-resident already, so capture costs dict writes only
+        self._tg_stats: Dict[str, dict] = {}
 
     # ------------------------------------------------------------- process
 
@@ -127,6 +131,30 @@ class GenericScheduler(Scheduler):
         e.queued_allocations = dict(self.queued_allocs)
         e.failed_tg_allocs = dict(self.failed_tg_allocs)
         self.planner.update_eval(e)
+        if status in (EVAL_STATUS_COMPLETE, "failed"):
+            from nomad_tpu.core.explain import record_decision
+            record_decision(self.planner, e, self._tg_stats, now=self.now,
+                            snapshot_index=getattr(self.state, "index", 0))
+
+    def _note_placed(self, tg_name: str, metric: AllocMetric, n: int = 1,
+                     evictions=()) -> None:
+        """Decision-record capture for successful placements: counts,
+        the first (representative) metric + its interned top-k table,
+        and a bounded sample of preemption victims."""
+        st = self._tg_stats.get(tg_name)
+        if st is None:
+            self._tg_stats[tg_name] = st = {
+                "placed": 0, "preempted": 0, "preempted_ids": [],
+                "metric": None, "score_meta": ()}
+        st["placed"] += n
+        if st["metric"] is None:
+            st["metric"] = metric
+            st["score_meta"] = metric.score_meta_data
+        if evictions:
+            st["preempted"] += len(evictions)
+            ids = st["preempted_ids"]
+            if len(ids) < 16:
+                ids.extend(v.id for v in evictions[:16 - len(ids)])
 
     # ------------------------------------------------------- batched path
 
@@ -228,6 +256,7 @@ class GenericScheduler(Scheduler):
             return None
         self.failed_tg_allocs = {}
         self.queued_allocs = {tg.name: 0 for tg in job.task_groups}
+        self._tg_stats = {}
         plan = Plan(eval_id=evaluation.id, priority=evaluation.priority,
                     job=job, coupled_batch=coupled_batch)
         self._materialize_bulk(plan, job, prep.places, bd, evaluation,
@@ -348,6 +377,7 @@ class GenericScheduler(Scheduler):
 
         self.failed_tg_allocs = {}
         self.queued_allocs = {tg.name: 0 for tg in job.task_groups} if job else {}
+        self._tg_stats = {}
 
         # ---- stops ----
         for s in results.stop:
@@ -573,6 +603,7 @@ class GenericScheduler(Scheduler):
                     append_reschedule_tracker(alloc, p.previous_alloc, self.now)
                     alloc.desired_description = ALLOC_RESCHEDULED
             plan.append_alloc(alloc)
+            self._note_placed(tg.name, d.metric, evictions=d.evictions)
 
     def _net_index(self, node_id: str, cache: Dict[str, NetworkIndex],
                    victim_ids) -> NetworkIndex:
@@ -866,6 +897,7 @@ class GenericScheduler(Scheduler):
                 ids_ok = ids
                 idx_ok = list(indexes)
                 picks_ok = picks
+            self._note_placed(tg.name, metrics[0], n=n_ok)
             # block-local node table: unique picked rows only (hundreds),
             # never the full cluster table
             uniq, inv = np.unique(picks_ok, return_inverse=True)
@@ -883,6 +915,9 @@ class GenericScheduler(Scheduler):
             return
 
         picks_l = bd.picks.tolist()
+        placed_n = 0          # decision-record capture, noted ONCE below
+        victims_sample: List = []
+        victims_n = 0
         for i in range(count):
             p = places[i] if block is None else None
             pick = picks_l[i]
@@ -931,6 +966,9 @@ class GenericScheduler(Scheduler):
                 for victim in ev:
                     plan.append_preempted_alloc(victim, alloc.id)
                 d2["preempted_allocations"] = [v.id for v in ev]
+                victims_n += len(ev)
+                if len(victims_sample) < 16:
+                    victims_sample.extend(ev[:16 - len(victims_sample)])
             if p is not None and p.canary and results.deployment is not None:
                 dstate = results.deployment.task_groups.get(tg.name)
                 if dstate is not None:
@@ -950,6 +988,13 @@ class GenericScheduler(Scheduler):
                 if last_list is None:
                     node_alloc[nid] = last_list = []
                 last_list.append(alloc)
+            placed_n += 1
+        if placed_n:
+            self._note_placed(tg.name, metrics[0], n=placed_n,
+                              evictions=victims_sample)
+            if victims_n > len(victims_sample):
+                self._tg_stats[tg.name]["preempted"] += (
+                    victims_n - len(victims_sample))
 
     def _record_failure_shared(self, tg_name: str, metric: AllocMetric,
                                copied: bool = False) -> None:

@@ -47,6 +47,8 @@ class SystemScheduler(Scheduler):
         self.engine = _engine(engine, state)
         self.now = now if now is not None else time.time()
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        # decision-record capture (core/explain.py)
+        self._tg_stats: Dict[str, dict] = {}
 
     def process(self, evaluation: Evaluation) -> Optional[Exception]:
         state = self.state
@@ -58,6 +60,7 @@ class SystemScheduler(Scheduler):
         plan = Plan(eval_id=evaluation.id, priority=evaluation.priority,
                     job=job)
         self.failed_tg_allocs = {}
+        self._tg_stats = {}
 
         live = [a for a in allocs if not a.terminal_status()]
         if stopped:
@@ -204,6 +207,13 @@ class SystemScheduler(Scheduler):
             if metric.nodes_exhausted or (placed_or_kept == 0
                                           and metric.nodes_filtered == len(nodes)):
                 self.failed_tg_allocs[tg.name] = metric
+            if placed_or_kept:
+                # decision record: a system group's "desired" is its
+                # eligible-node count; selection is trivial so there is
+                # no top-k table, just the rollup
+                self._tg_stats[tg.name] = {
+                    "placed": placed_or_kept, "desired": len(nodes),
+                    "metric": metric}
 
     def _submit(self, plan: Plan, evaluation: Evaluation):
         if not plan.is_no_op():
@@ -227,6 +237,9 @@ class SystemScheduler(Scheduler):
         e.status_description = desc
         e.failed_tg_allocs = dict(self.failed_tg_allocs)
         self.planner.update_eval(e)
+        from nomad_tpu.core.explain import record_decision
+        record_decision(self.planner, e, self._tg_stats, now=self.now,
+                        snapshot_index=getattr(self.state, "index", 0))
 
 
 def new_system_scheduler(state, planner, **kwargs) -> SystemScheduler:

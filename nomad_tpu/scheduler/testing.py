@@ -37,6 +37,7 @@ class Harness:
         self.evals: List[Evaluation] = []          # update_eval calls
         self.create_evals: List[Evaluation] = []
         self.reblock_evals: List[Evaluation] = []
+        self.decisions: List = []                  # record_decision calls
         self._lock = threading.Lock()
         # When set, submit_plan only records the plan without applying it
         # (the `nomad job plan` dry-run / annotation path).
@@ -73,6 +74,11 @@ class Harness:
     def reblock_eval(self, evaluation: Evaluation) -> None:
         with self._lock:
             self.reblock_evals.append(evaluation)
+
+    def record_decision(self, decision) -> None:
+        with self._lock:
+            self.decisions.append(decision)
+        self.state.record_eval_decision(decision)
 
     def serves_plan(self) -> bool:
         return True

@@ -132,6 +132,31 @@ class StateStore:
         # a volume write lands between evaluate and commit that the
         # per-NODE fence cannot see
         self._volume_seq = 0
+        # bounded ring of per-eval decision records (core/explain.py):
+        # newest-wins by eval id, oldest evicted past the cap.
+        # Observability only — node-local, never raft-replicated or
+        # snapshotted (the failure rollups that must survive restarts
+        # ride the Evaluation itself)
+        from collections import OrderedDict
+        self._eval_decisions: "OrderedDict[str, object]" = OrderedDict()
+        self._eval_decision_cap = 512
+        # incremental live-allocation ledger: node id -> [count, cpu,
+        # mem_mb, disk_mb, fill_cpu, fill_mem, fill_disk, zone, zcount]
+        # summed over NON-TERMINAL allocs.  The WRITE path only mutates
+        # the first four ints and marks the node dirty (O(1), no float
+        # math — the 100k-alloc plan insert must not pay it); a row's
+        # standing zone/fill contributions ([4:]) reconcile LAZILY at
+        # quality_summary() time, O(nodes dirtied since the last read).
+        # The summary itself is then O(zones): a 1s scrape or per-commit
+        # refresh never walks the cluster (50k in-use nodes measured
+        # ~200ms per full walk; the soak budget is 2% — PERF.md §11).
+        # Observability only; drift-tolerant on the rare paths the
+        # aggregates can't see (node deleted/re-typed under live
+        # allocs) and rebuilt exactly on snapshot restore.
+        self._node_live: Dict[str, List] = {}
+        self._live_dirty: set = set()
+        self._zone_live: Dict[str, int] = {}   # datacenter -> live allocs
+        self._fill_sums = [0.0, 0.0, 0.0]      # clamped fill fractions
         # listeners for state-change events (event broker seam, SURVEY §6.5)
         self._listeners: List[Callable[[str, int, object], None]] = []
 
@@ -153,6 +178,128 @@ class StateStore:
         with self._lock:
             return {"nodes": len(self._nodes), "jobs": len(self._jobs),
                     "evals": len(self._evals)}
+
+    # ----------------------------------------------- decisions / quality
+
+    def record_eval_decision(self, decision) -> None:
+        """Retain an EvalDecision in the bounded ring (newest wins)."""
+        with self._lock:
+            ring = self._eval_decisions
+            ring.pop(decision.eval_id, None)
+            ring[decision.eval_id] = decision
+            while len(ring) > self._eval_decision_cap:
+                ring.popitem(last=False)
+
+    def eval_decision(self, eval_id: str):
+        with self._lock:
+            return self._eval_decisions.get(eval_id)
+
+    def eval_decisions(self, namespace: Optional[str] = None,
+                       job_id: Optional[str] = None) -> List:
+        """Recent decision records, oldest first, optionally filtered."""
+        with self._lock:
+            out = list(self._eval_decisions.values())
+        if namespace is not None:
+            out = [d for d in out if d.namespace == namespace]
+        if job_id is not None:
+            out = [d for d in out if d.job_id == job_id]
+        return out
+
+    def _live_add_locked(self, node_id: str, d: int, cpu: int, mem: int,
+                         disk: int) -> None:
+        """Apply one delta to the live-allocation ledger (lock held).
+        Int adds + a set add only — the zone/fill aggregate math is
+        deferred to _live_flush_locked so the alloc-insert hot path
+        never pays it.  Rows that reach count<=0 are retired (and their
+        standing contributions reversed) at the next flush."""
+        row = self._node_live.get(node_id)
+        if row is None:
+            self._node_live[node_id] = row = [0, 0, 0, 0,
+                                              0.0, 0.0, 0.0, None, 0]
+        row[0] += d
+        row[1] += cpu
+        row[2] += mem
+        row[3] += disk
+        self._live_dirty.add(node_id)
+
+    def _live_flush_locked(self) -> None:
+        """Reconcile dirty ledger rows into the zone/fill aggregates:
+        retire each row's standing contributions, re-add them from the
+        current counts, and drop emptied rows.  O(nodes dirtied since
+        the last flush) — after a bulk plan that is O(unique touched
+        nodes), never O(cluster)."""
+        dirty = self._live_dirty
+        if not dirty:
+            return
+        live = self._node_live
+        nodes = self._nodes
+        zl = self._zone_live
+        fs = self._fill_sums
+        for nid in dirty:
+            row = live.get(nid)
+            if row is None:
+                continue
+            # retire the standing contributions
+            fs[0] -= row[4]
+            fs[1] -= row[5]
+            fs[2] -= row[6]
+            if row[7] is not None:
+                left = zl.get(row[7], 0) - row[8]
+                if left > 0:
+                    zl[row[7]] = left
+                else:
+                    zl.pop(row[7], None)
+            row[4] = row[5] = row[6] = 0.0
+            row[7] = None
+            row[8] = 0
+            if row[0] <= 0:
+                live.pop(nid)
+                continue
+            node = nodes.get(nid)
+            if node is None:
+                continue        # unknown node: counted in nodes_in_use only
+            res, rsv = node.resources, node.reserved
+            avail = res.cpu - rsv.cpu
+            if avail > 0:
+                row[4] = min(row[1] / avail, 1.0)
+            avail = res.memory_mb - rsv.memory_mb
+            if avail > 0:
+                row[5] = min(row[2] / avail, 1.0)
+            avail = res.disk_mb - rsv.disk_mb
+            if avail > 0:
+                row[6] = min(row[3] / avail, 1.0)
+            fs[0] += row[4]
+            fs[1] += row[5]
+            fs[2] += row[6]
+            z = node.datacenter
+            zl[z] = zl.get(z, 0) + row[0]
+            row[7] = z
+            row[8] = row[0]
+        dirty.clear()
+
+    def quality_summary(self) -> Dict[str, float]:
+        """Scheduling-quality snapshot from the incremental aggregates
+        (the runtime counterpart of bench.py's `quality_nodes_used_tpu`
+        and `quality_zone_balance_max_over_min`): nodes-in-use, per-zone
+        alloc balance, and mean bin-pack fill per dimension over in-use
+        nodes.  O(dirty nodes + zones) — cheap by construction; safe
+        per commit and per scrape at any cluster size."""
+        with self._lock:
+            self._live_flush_locked()
+            in_use = len(self._node_live)
+            zvals = list(self._zone_live.values())
+            fills = list(self._fill_sums)
+        zmax = max(zvals, default=0)
+        zmin = min(zvals, default=0)
+        return {
+            "nodes_in_use": in_use,
+            "zone_allocs_max": zmax,
+            "zone_allocs_min": zmin,
+            "zone_balance_max_over_min": (zmax / zmin) if zmin else 0.0,
+            "fill_cpu": max(fills[0], 0.0) / in_use if in_use else 0.0,
+            "fill_memory": max(fills[1], 0.0) / in_use if in_use else 0.0,
+            "fill_disk": max(fills[2], 0.0) / in_use if in_use else 0.0,
+        }
 
     def _bump(self) -> int:
         self._index += 1
@@ -524,6 +671,8 @@ class StateStore:
         table_get = table.get
         inserted = []
         ins_append = inserted.append
+        dead: set = set()
+        live_add = self._live_add_locked
         for a in allocs:
             aid = a.id
             prev = table_get(aid)
@@ -542,6 +691,19 @@ class StateStore:
                 a.job = prev.job
             table[aid] = a
             nid = a.node_id
+            # live-allocation ledger (quality gauges): retire the
+            # predecessor's contribution, add the successor's — covers
+            # terminal transitions and node moves in one delta pair
+            if prev is not None and prev.node_id \
+                    and not prev.terminal_status():
+                r = prev.resources
+                live_add(prev.node_id, -1, -r.cpu, -r.memory_mb,
+                         -r.disk_mb)
+            if a.terminal_status():
+                dead.add(aid)
+            elif nid:
+                r = a.resources
+                live_add(nid, 1, r.cpu, r.memory_mb, r.disk_mb)
             if prev is not None and prev.node_id and prev.node_id != nid:
                 pnid = prev.node_id
                 if pnid not in fresh_node:
@@ -564,7 +726,6 @@ class StateStore:
         # terminal allocs lose their service registrations server-side
         # (reference: state store deletes registrations on terminal alloc
         # upserts — covers clients that died before deregistering)
-        dead = {a.id for a in inserted if a.terminal_status()}
         if dead and any(r.alloc_id in dead
                         for r in self._services.values()):
             self._services = {k: r for k, r in self._services.items()
@@ -831,6 +992,10 @@ class StateStore:
         block.modify_index = idx
         for nid in block.node_table:
             self._touch_node(nid, origin)
+        # live-allocation ledger: whole-block demand in O(unique nodes)
+        # (rows retire per alloc later — materialization keeps liveness)
+        for nid, (cnt, cpu, mem, disk) in block.demand_by_node().items():
+            self._live_add_locked(nid, cnt, cpu, mem, disk)
         blocks, bj, bn = self._writable_block_tables()
         blocks[block.id] = block
         tmpl = block.template
@@ -1436,6 +1601,10 @@ class StateStore:
             self._fresh_job_buckets = set()
             self._fresh_eval_buckets = set()
             self._fresh_claim_vols = set()
+            self._node_live = {}
+            self._live_dirty = set()
+            self._zone_live = {}
+            self._fill_sums = [0.0, 0.0, 0.0]
             for d in doc["Allocs"]:
                 a = codec.decode(Allocation, d)
                 a.job = self._job_versions.get(
@@ -1444,6 +1613,10 @@ class StateStore:
                 self._allocs[a.id] = a
                 if a.node_id:
                     self._allocs_by_node.setdefault(a.node_id, {})[a.id] = a
+                    if not a.terminal_status():
+                        r = a.resources
+                        self._live_add_locked(a.node_id, 1, r.cpu,
+                                              r.memory_mb, r.disk_mb)
                 self._allocs_by_job.setdefault(
                     (a.namespace, a.job_id), {})[a.id] = a
             self._evals_by_job = {}

@@ -912,6 +912,60 @@ class Evaluation:
 
 
 # ---------------------------------------------------------------------------
+# Eval decision records (placement explainability)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TGDecision:
+    """One task group's slice of an eval's placement decision: how many
+    placements were attempted/placed/failed, the AllocMetric rollup that
+    explains the failures (NodesEvaluated/Filtered/Exhausted with the
+    per-reason breakdowns), the winning top-k score table, and the
+    preemption choices made on its behalf."""
+
+    task_group: str = ""
+    desired: int = 0
+    placed: int = 0
+    failed: int = 0
+    preempted: int = 0
+    # bounded sample of evicted alloc ids (the full victim set is on the
+    # preempting allocs themselves)
+    preempted_allocs: List[str] = field(default_factory=list)
+    # failure rollup when any placement failed, else the placed rollup
+    metric: Optional[AllocMetric] = None
+    # top-k score table of the WINNING launch (placed placements) —
+    # kept separate from `metric` so a partially-failed group shows both
+    # the winners' scores and the failures' exhaustion breakdown
+    score_meta: List[NodeScoreMeta] = field(default_factory=list)
+
+
+@dataclass
+class EvalDecision:
+    """Per-eval decision record (the explainability artifact behind
+    `/v1/eval/<id>/explain` and `nomad eval explain`): everything the
+    scheduler already knew at submit time about WHY it placed where it
+    placed — joined from the device kernels' AllocMetric/NodeScoreMeta
+    output, the blocked-eval cause, and the preemption choices.  Kept in
+    a size-bounded ring in the state store; observability-only (never
+    raft-replicated or snapshotted)."""
+
+    eval_id: str = ""
+    trace_id: str = ""
+    namespace: str = "default"
+    job_id: str = ""
+    job_type: str = ""
+    triggered_by: str = ""
+    status: str = ""                 # final eval status
+    status_description: str = ""
+    blocked_eval: str = ""           # id of the blocked eval, if created
+    blocked_cause: str = ""          # human summary of the blocking reason
+    task_groups: Dict[str, TGDecision] = field(default_factory=dict)
+    snapshot_index: int = 0
+    create_time: float = 0.0
+
+
+# ---------------------------------------------------------------------------
 # Deployment
 # ---------------------------------------------------------------------------
 

@@ -92,6 +92,32 @@ try:
                 "nomad_worker_schedule_seconds_p99",
                 "nomad_plan_apply_seconds_sum"):
         assert fam in text, f"missing family {fam}"
+
+    # placement explainability: an unplaceable job must explain WHICH
+    # dimension blocked it via /v1/eval/<id>/explain, and the quality
+    # gauges must ride the same exposition (ISSUE 5)
+    huge = mock.batch_job()
+    huge.task_groups[0].count = 1
+    huge.task_groups[0].tasks[0].resources.memory_mb = 1 << 24
+    huge_eval = api.jobs.register(codec.encode(huge))["EvalID"]
+    deadline = time.time() + 30
+    doc = {}
+    while time.time() < deadline and not doc.get("BlockedEval"):
+        doc = api.evaluations.explain(huge_eval)
+        time.sleep(0.2)
+    assert doc.get("BlockedEval"), f"never blocked: {doc}"
+    tg = doc["TaskGroups"][huge.task_groups[0].name]
+    assert tg["Metric"]["DimensionExhausted"].get("memory"), doc
+    assert "memory" in tg["Cause"], doc
+    pf = api.jobs.placement_failures(huge.id)
+    assert pf["Blocked"] and "memory" in pf["Cause"], pf
+    text = api.agent.metrics(format="prometheus")
+    for fam in ("nomad_quality_nodes_in_use",
+                "nomad_quality_zone_balance_max_over_min",
+                "nomad_quality_binpack_fill"):
+        assert fam in text, f"missing quality family {fam}"
+    print(f"explain smoke ok: eval {huge_eval[:8]} blocked on "
+          f"{sorted(tg['Metric']['DimensionExhausted'])}")
     print(f"telemetry smoke ok: {n} exposition lines, trace {eval_id[:8]}"
           f" spans={sorted(names)}")
 finally:

@@ -405,6 +405,27 @@ def test_scenario_captures_eval_trace_shape():
 
 
 @pytest.mark.slow
+def test_quality_gauges_survive_leader_failover():
+    """The scheduling-quality gauges (core/plan_apply.publish_quality)
+    keep flowing after a leader failover: the NEW leader's applier
+    publishes `nomad.quality.*` from ITS OWN store's incremental
+    ledger, so the series never goes stale when leadership moves.  The
+    registry is reset first so only THIS run's commits — which include
+    post-partition scheduling on the new leader (the scenario's
+    job-landed invariant) — can satisfy the assertion."""
+    from nomad_tpu.core.telemetry import REGISTRY
+    REGISTRY.reset()
+    name = "leader_partition"
+    r = _fresh(name, SEEDS[name])
+    assert not r.violations, r.violations
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert "nomad.quality.nodes_in_use" in gauges, sorted(gauges)[:30]
+    assert "nomad.quality.binpack_fill{dimension=memory}" in gauges
+    # the workload's jobs landed, so the ledger saw live allocs
+    assert gauges["nomad.quality.nodes_in_use"] >= 1
+
+
+@pytest.mark.slow
 def test_seed_determinism_full_run():
     """Two full executions with one seed produce byte-identical
     canonical traces and the same state fingerprint."""
