@@ -695,7 +695,14 @@ def run_config_5(args):
                heartbeat_ttl=1e9,
                # first-time kernel compiles (~40-90s over the tunnel)
                # must not trip eval redelivery mid-warmup
-               nack_timeout=600.0)
+               nack_timeout=600.0,
+               # pluggable device executor (ops/executor.py): the REAL
+               # eval-driven path rides retained buffer handles — no
+               # --bridge side-channel needed for the resident chain
+               device_executor=(args.executor or "jax"))
+    # --resident off: the A/B lever for PERF.md §12 — every wave
+    # re-syncs used0 from the packer through the host (no chaining)
+    s.executor.chain_enabled = (args.resident != "off")
     s.establish_leadership()
     nodes, vols = _build_bench_cluster(n_nodes)
     s.state.upsert_nodes(nodes)
@@ -933,6 +940,10 @@ def run_config_5(args):
     sus_waves = 3
     sus_dt = None
     sus_stages = None
+    # executor residency over the sustained (steady-state) section:
+    # chained launches / total launches is the BENCH_r06 before/after
+    # axis the device-resident executor exists to move
+    ex0 = dict(s.executor.stats)
     for _ in range(2):
         # wavepipe stage timers per sustained run: the winning run's
         # report carries the overlap gauges that PROVE wave k+1's device
@@ -945,6 +956,13 @@ def run_config_5(args):
             sus_stages = s.stage_timers.report()
     sus_evals_per_sec = sus_waves * n_evals / sus_dt
     sus_rate = sus_waves * n_place / sus_dt
+    ex1 = dict(s.executor.stats)
+    ex_waves = ex1["dispatches"] - ex0["dispatches"]
+    ex_resident = ex1["resident_waves"] - ex0["resident_waves"]
+    resident_hit = ex_resident / ex_waves if ex_waves else 0.0
+    h2d_per_wave = ((ex1["upload_bytes"] - ex0["upload_bytes"]) / ex_waves
+                    if ex_waves else 0.0)
+    executor_backend = s.executor.name
 
     # placement QUALITY over the full workload on both sides: bin-pack
     # quality = how few nodes absorb the same placements (fewer ->
@@ -1012,6 +1030,12 @@ def run_config_5(args):
             "n_evals": n_evals, "placements_per_eval": per_eval,
             "runs": iters, "workers": n_workers,
             "plan_refute_rate": round(refute_rate, 4),
+            # device-resident executor (ops/executor.py): backend +
+            # steady-state chain residency over the sustained section
+            "executor_backend": executor_backend,
+            "resident_chain_hit_rate": round(resident_hit, 4),
+            "h2d_bytes_per_wave": round(h2d_per_wave, 1),
+            "executor_invalidations": ex1["invalidations"],
             **({"baseline_flat_upper_bound_per_sec": round(base_rate_c, 1),
                 "vs_baseline_flat_upper_bound":
                     round(tpu_rate / base_rate_c, 2)}
@@ -1380,6 +1404,14 @@ def main():
     ap.add_argument("--batch", type=int, default=0,
                     help="config 5: max evals per device launch")
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--executor", choices=("jax", "bridge"), default="jax",
+                    help="config 5: device-executor backend for the "
+                         "worker loop (ops/executor.py); 'bridge' errors "
+                         "when the native build/plugin is absent")
+    ap.add_argument("--resident", choices=("on", "off"), default="on",
+                    help="config 5: retain the device-resident usage "
+                         "chain across waves (off = host round-trip "
+                         "every wave; the PERF.md §12 A/B lever)")
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="write a JAX profiler (xprof) trace of the "
                          "benched kernel launches to DIR (SURVEY §6.1)")

@@ -7,12 +7,13 @@ Supported shape (a practical subset of the reference's):
     log_level = "debug"     # producer-side LogRing min_level gate
     ports { http = 4646 }
     server {
-      enabled        = true
-      num_schedulers = 2
-      heartbeat_ttl  = "30s"
-      acl_enabled    = false
-      transport      = "tcp"      # or "sim"  (nomad_tpu/chaos/)
-      clock          = "wall"     # or "virtual"
+      enabled         = true
+      num_schedulers  = 2
+      heartbeat_ttl   = "30s"
+      acl_enabled     = false
+      transport       = "tcp"      # or "sim"  (nomad_tpu/chaos/)
+      clock           = "wall"     # or "virtual"
+      device_executor = "jax"      # or "bridge" (nomad_tpu/ops/executor.py)
     }
     client {
       enabled    = true
@@ -59,6 +60,13 @@ class AgentConfig:
     # test-only monkeypatch
     transport: str = "tcp"
     clock: str = "wall"
+    # device-executor backend for the scheduling workers'
+    # wave launches (nomad_tpu/ops/executor.py): "jax" runs the
+    # donation-chained in-process kernels (CPU/TPU); "bridge" drives the
+    # same kernels through the C++ PJRT bridge with persistent device
+    # buffers and errors at agent start when the native build or PJRT
+    # plugin is absent (never a silent fallback)
+    device_executor: str = "jax"
 
     def merge(self, other: "AgentConfig",
               set_fields: set) -> "AgentConfig":
@@ -73,7 +81,7 @@ class AgentConfig:
 _BLOCK_KEYS = {
     "ports": {"http"},
     "server": {"enabled", "num_schedulers", "heartbeat_ttl",
-               "acl_enabled", "transport", "clock"},
+               "acl_enabled", "transport", "clock", "device_executor"},
     "client": {"enabled", "count", "node_class", "datacenter"},
     "acl": {"enabled"},
 }
@@ -148,6 +156,15 @@ def parse_agent_config(src: str):
                             f"server clock must be 'wall' or 'virtual', "
                             f"got {v!r}")
                     put("clock", v)
+                if "device_executor" in body:
+                    v = str(body["device_executor"])
+                    # mirror ops.executor.EXECUTOR_BACKENDS; literal so
+                    # config parsing never imports the jax stack
+                    if v not in ("jax", "bridge"):
+                        raise ValueError(
+                            "server device_executor must be 'jax' or "
+                            f"'bridge', got {v!r}")
+                    put("device_executor", v)
             elif node.type == "client":
                 if "enabled" in body:
                     put("client_enabled", bool(body["enabled"]))

@@ -129,7 +129,8 @@ def cmd_agent(args) -> int:
                   join_wan_token=getattr(args, "join_wan_token", ""),
                   transport=cfg.transport,
                   clock=cfg.clock,
-                  log_level=cfg.log_level)
+                  log_level=cfg.log_level,
+                  device_executor=cfg.device_executor)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")

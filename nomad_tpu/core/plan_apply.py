@@ -202,6 +202,10 @@ class PlanApplier:
         # apply records one "commit" interval so the pipeline's overlap
         # of host commit under device compute is measurable
         self.timers = None
+        # optional DeviceExecutor (wired by the Server): every committed
+        # plan reports its origin so a resident usage chain the commit
+        # is FOREIGN to gets invalidated (ops/executor.py)
+        self.executor = None
         # scheduling-quality gauge refresh, throttled: the summary walk
         # is O(nodes in use), so a 100-plan/s wave refreshes once per
         # interval instead of per plan (PERF.md §11: soak budget)
@@ -317,6 +321,12 @@ class PlanApplier:
                 self._stamp_trace(plan, result)
                 self.state.upsert_plan_results(plan, result)
             self.stats.inc("plans")
+            if self.executor is not None:
+                # chain-coupled plans carry their chain id; solo plans
+                # are their own origin — foreign to any resident chain
+                self.executor.note_plan_commit(
+                    plan.coupled_batch[0] if plan.coupled_batch
+                    else plan.eval_id)
             if result.refuted_nodes:
                 self.stats.inc("plans_refuted")
                 REGISTRY.inc("nomad.plan.refuted_nodes",

@@ -61,7 +61,8 @@ class Server:
                  state: Optional[StateStore] = None,
                  eval_batch: int = 64,
                  nack_timeout: Optional[float] = None,
-                 clock: Optional[Clock] = None) -> None:
+                 clock: Optional[Clock] = None,
+                 device_executor: str = "jax") -> None:
         # injected timebase (chaos/clock.py): every endpoint default
         # `now`, heartbeat deadline, and the tick loop read this clock,
         # so a chaos scenario's VirtualClock owns the whole server's
@@ -115,6 +116,19 @@ class Server:
         self.events.attach(self.state)
         self.engine = PlacementEngine()
         self.engine.packer.attach(self.state)
+        # pluggable device executor (ops/executor.py, agent_config
+        # server.device_executor): the seam the workers' wave pipelines
+        # launch through — "jax" (default) or the C++ PJRT "bridge",
+        # both riding retained device buffers with the proposed-usage
+        # chain held resident ACROSS worker passes.  Raises loudly when
+        # "bridge" is configured without the native build.
+        from nomad_tpu.ops.executor import make_executor
+        self.executor = make_executor(device_executor, self.engine)
+        # chain hygiene: node writes / restores / capacity-freeing alloc
+        # writes invalidate the resident chain (it cannot see them)...
+        self.executor.attach_store(self.state)
+        # ...and so does any committed plan from OUTSIDE the chain
+        self.plan_applier.executor = self.executor
         self.dev_mode = dev_mode
         # (baseline, max) delay before a failed eval's follow-up re-enters
         # the queue (reference: evalFailedFollowupBaselineDelay 1min +

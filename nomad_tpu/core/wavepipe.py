@@ -179,7 +179,7 @@ class WaveHandle:
 
 
 class WavePipeline:
-    """Double-buffered wave sequencing over one PlacementEngine.
+    """Double-buffered wave sequencing over one DeviceExecutor.
 
     The worker dispatches wave k+1 (chained on wave k's device-side
     proposed usage) before wave k's host phase runs; this object assigns
@@ -188,11 +188,21 @@ class WavePipeline:
     is effectively 2 (one wave collecting + one in flight) — the
     worker's prefetch slot; deeper queues would let proposed usage drift
     arbitrarily far from committed state for no wall-clock gain on one
-    device."""
+    device.
 
-    def __init__(self, engine, timers: Optional[StageTimers] = None
+    Waves launch through the pluggable device-executor seam
+    (ops/executor.py): the default JAX backend or the C++ PJRT bridge,
+    both keeping node state in retained device buffers.  A bare
+    PlacementEngine is accepted for compatibility (tests, harnesses) and
+    wrapped in a JaxExecutor."""
+
+    def __init__(self, executor, timers: Optional[StageTimers] = None
                  ) -> None:
-        self.engine = engine
+        from nomad_tpu.ops.executor import DeviceExecutor, JaxExecutor
+        if not isinstance(executor, DeviceExecutor):
+            executor = JaxExecutor(executor)
+        self.executor = executor
+        self.engine = executor.engine
         self.timers = timers if timers is not None else StageTimers()
         self._lock = threading.Lock()
         self._seq = 0
@@ -224,7 +234,7 @@ class WavePipeline:
             if used0_dev is not None:
                 self.stats["chained"] += 1
         t0 = time.perf_counter()
-        pending = self.engine.dispatch_batch(
+        pending = self.executor.dispatch_batch(
             snapshot, items, seed=seed, used0_dev=used0_dev,
             masked_node_ids=mask)
         t1 = time.perf_counter()
@@ -242,7 +252,7 @@ class WavePipeline:
         handle.collected = True
         pending = handle.pending
         if not isinstance(pending, dict):
-            return self.engine.collect_batch(pending)
+            return self.executor.collect_batch(pending)
         buf = pending.get("buf")
         t_ready = None
         if buf is not None:
@@ -258,7 +268,7 @@ class WavePipeline:
             self.timers.record("device", handle.t_dispatch[1], t_ready,
                                handle.wave)
         t1 = time.perf_counter()
-        decisions = self.engine.collect_batch(pending)
+        decisions = self.executor.collect_batch(pending)
         self.timers.record("d2h", t1, time.perf_counter(), handle.wave)
         return decisions
 
@@ -267,8 +277,30 @@ class WavePipeline:
         wave chains on, or None when this wave cannot seed a chain."""
         if handle is None or not handle.chainable:
             return None
-        p = handle.pending
-        return (p["used"], p["node_version"], p["npad"])
+        return self.executor.chain_state(handle.pending)
+
+    # --------------------------------------------- resident chain slot
+
+    def claim_chain(self):
+        """Pop the executor-retained resident chain (the previous
+        worker pass's final proposed-usage handle) and merge its masked
+        nodes into this pipeline's refute mask — the retained buffer
+        predates whatever writes refuted them, exactly like an in-pass
+        chain.  Returns (batch_id, seq0, used_triple) or None."""
+        claimed = self.executor.claim_chain()
+        if claimed is None:
+            return None
+        batch_id, seq0, triple, masked = claimed
+        if masked:
+            with self._lock:
+                self._masked.update(masked)
+        return (batch_id, seq0, triple)
+
+    def retain_chain(self, batch_id: str, seq0: int, used_triple) -> None:
+        """Park a finished wave's chain (plus the current refute mask)
+        in the executor for the next dequeued batch."""
+        self.executor.retain_chain(batch_id, seq0, used_triple,
+                                   masked=self.masked_nodes())
 
     # ------------------------------------------------------ refute repair
 

@@ -66,9 +66,13 @@ class Worker:
         # the wave pipeline (core/wavepipe.py): every batched launch
         # dispatches/collects through it, so wave sequencing, stage
         # timers, and the refuted-node mask are shared machinery — the
-        # server's StageTimers make the device/commit overlap provable
+        # server's StageTimers make the device/commit overlap provable.
+        # Launches go through the server's shared device executor
+        # (ops/executor.py) so retained buffer handles and the resident
+        # usage chain are one slot across all workers.
         self.pipeline = WavePipeline(
-            server.engine, getattr(server, "stage_timers", None))
+            getattr(server, "executor", None) or server.engine,
+            getattr(server, "stage_timers", None))
         # cross-batch pipeline: a dequeued batch whose kernel launch was
         # dispatched (chained on the previous batch's device-side
         # proposed usage) while the previous batch's host phase ran
@@ -265,6 +269,18 @@ class Worker:
         prepared_idx = []
         batch_id = ""
         if len(prepared) >= 2:
+            if chain is None:
+                # resident continuation (ops/executor.py): a previous
+                # pass's final wave parked its proposed-usage handle in
+                # the executor; claiming it makes this launch chain
+                # device-resident instead of re-syncing used0 from the
+                # packer through the host.  Claimed only here — a solo
+                # batch must not pop (and strand) the chain it cannot
+                # ride.  The claim pops atomically, so concurrent
+                # workers can never share one chain id (the applier
+                # fence exempts a chain's own writes; a shared id would
+                # let two blind-to-each-other waves wholesale-commit).
+                chain = self.pipeline.claim_chain()
             if chain is not None:
                 batch_id, batch_seq0, used_dev = chain
             else:
@@ -291,6 +307,11 @@ class Worker:
                 log("worker", "warn", "batch launch failed; going solo",
                     worker=self.id, error=str(e))
                 pending = None
+        elif chain is not None:
+            # a prefetch-handed chain this batch cannot ride (fewer than
+            # two coupled evals): park it back for a later coupled batch
+            # instead of stranding the resident handle
+            self.pipeline.retain_chain(*chain)
         return {"batch": batch, "work": work, "pending": pending,
                 "prepared_idx": prepared_idx, "batch_id": batch_id,
                 "batch_seq0": batch_seq0, "snapshot": snapshot, "t": t}
@@ -331,11 +352,16 @@ class Worker:
         # start from this batch's proposed usage — a superset of what
         # will commit, so they can under-pack but never oversubscribe.
         chain_used = self.pipeline.chain_state(pf["pending"])
-        if (chain_used is not None and bds
-                and len(bds) == len(work) and not self._stop.is_set()):
+        chain_ok = (chain_used is not None and bds
+                    and len(bds) == len(work))
+        chain_handed_off = False
+        if chain_ok and not self._stop.is_set():
             nxt = self.server.eval_broker.dequeue_batch(
                 SCHEDULERS_SERVED, max_n, now=t, timeout=0.0)
             if nxt:
+                # the chain buffer is DONATED to the prefetched launch
+                # (alive or failed) — it must not also be retained below
+                chain_handed_off = True
                 try:
                     self._prefetch = self._start_batch(
                         nxt, t, chain=(batch_id, batch_seq0, chain_used))
@@ -429,6 +455,14 @@ class Worker:
                 err = e
             self._settle(ev, token, err, t)
             settled.add(ev.id)
+        # no successor was ready to chain on this batch's proposed
+        # usage: park the handle in the executor so the NEXT dequeued
+        # batch (this worker's or a sibling's) starts device-resident.
+        # Only after the coupled plans committed (the finalize passes
+        # above waited on the applier) — their commits carry the chain's
+        # own origin and must not read as foreign invalidations.
+        if chain_ok and not chain_handed_off:
+            self.pipeline.retain_chain(batch_id, batch_seq0, chain_used)
         return len(work)
 
     def _invoke(self, evaluation: Evaluation, now: float) -> Optional[Exception]:

@@ -1,6 +1,12 @@
 """Device kernels and the host↔device placement engine."""
 
 from .engine import PlacementDecision, PlacementEngine, PlacementRequest  # noqa: F401
+from .executor import (  # noqa: F401
+    DeviceExecutor,
+    ExecutorUnavailable,
+    JaxExecutor,
+    make_executor,
+)
 from .feasibility import constraint_mask, feasible_mask  # noqa: F401
 from .scoring import (  # noqa: F401
     affinity_score,

@@ -347,6 +347,15 @@ class PlacementEngine:
         self._used_dev = None
         self._const_cache: Dict[tuple, object] = {}
         self._dc_cache: Optional[Tuple[int, Dict[str, int]]] = None
+        # host->device sync meter (ops/executor.py installs it): called
+        # with (bytes, seconds) for every node-state upload — full node
+        # tensors, full `used`, and the per-eval delta-replay scatters
+        self.h2d_observer = None
+
+    def _note_h2d(self, nbytes: int, seconds: float) -> None:
+        obs = self.h2d_observer
+        if obs is not None and nbytes:
+            obs(nbytes, seconds)
 
     def _padded_n(self, n: int) -> int:
         """Node count padded to a mesh multiple (identity single-device)."""
@@ -365,6 +374,7 @@ class PlacementEngine:
         and placed with NamedSharding."""
         key = (t.version, len(self.packer.interner), t.attrs.shape[1])
         if self._cache_version != key:
+            t0h = time.perf_counter()
             # packer.lock: a concurrent update()/_on_allocs in another
             # thread mutates these arrays in place — copying mid-mutation
             # would cache a torn tensor under a version that claims
@@ -390,6 +400,10 @@ class PlacementEngine:
                 self._cache_version = key
                 self._used_version = -1
                 self._used_dev = None
+            self._note_h2d(
+                sum(int(getattr(v, "nbytes", 0))
+                    for v in self._dev_cache.values()),
+                time.perf_counter() - t0h)
         return self._dev_cache
 
     def _used_device(self, t: NodeTensors):
@@ -407,6 +421,8 @@ class PlacementEngine:
             ver = t.used_version
             if self._used_dev is not None and self._used_version == ver:
                 return self._used_dev
+            t0h = time.perf_counter()
+            h2d_bytes = 0
             deltas = None
             if self._used_dev is not None:
                 deltas = self.packer.used_deltas_since(self._used_version)
@@ -440,6 +456,7 @@ class PlacementEngine:
                             [v_c, np.zeros((pad - n_c, 3), v_c.dtype)])
                     dev = self._scatter_fn(
                         dev, jnp.asarray(r_c), jnp.asarray(v_c))
+                    h2d_bytes += r_c.nbytes + v_c.nbytes
                 self._used_dev = dev
             else:
                 # copy=True: t.used is mutated in place by the packer's
@@ -455,7 +472,9 @@ class PlacementEngine:
                                   self._padded_n(t.n)),
                         NamedSharding(self.mesh,
                                       PartitionSpec("nodes", None)))
+                h2d_bytes += int(self._used_dev.nbytes)
             self._used_version = ver
+            self._note_h2d(h2d_bytes, time.perf_counter() - t0h)
             return self._used_dev
 
     def _dev_const(self, key, builder):
@@ -1109,7 +1128,7 @@ class PlacementEngine:
                 "t": aux["t"], "ctxs": aux["ctxs"], "n": aux["n"],
                 "npad": aux["npad"], "node_version": aux["t"].version,
                 "perm": aux["perm"], "fills_full": fills_full,
-                "fill_k": fill_k,
+                "fill_k": fill_k, "chained": chained,
                 "prep_ns": time.perf_counter_ns() - aux["t0"]}
 
     def build_multi_inputs(self, snapshot, items: Sequence[BatchItem],

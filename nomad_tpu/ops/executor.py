@@ -1,0 +1,482 @@
+"""Device executor seam — resident buffer handles for the worker loop.
+
+The C++ PJRT bridge proved the production shape (PERF.md §5): upload
+node tensors ONCE into retained device buffers, execute every wave on
+handles, and chain each wave's proposed-usage OUTPUT handle into the
+next wave's `used0` so steady-state scheduling never materializes node
+state on the host.  Before this seam that chain existed only inside one
+worker pass (core/worker.py's prefetch) and in `bench.py --bridge`;
+this module makes it the production contract between the wave pipeline
+(core/wavepipe.py) and the kernels:
+
+  - `DeviceExecutor` is the seam: dispatch/collect a multi-eval wave,
+    hand out a wave's chain state, and RETAIN the final wave's
+    proposed-usage handle across worker passes so the next dequeued
+    batch starts device-resident instead of re-syncing `used0` from the
+    packer through the host.
+  - `JaxExecutor` (default backend, CPU/TPU): delegates to
+    `PlacementEngine.dispatch_batch`, whose chained launches ride the
+    `donate_argnums` jit variants (select.place_multi_chained) — XLA
+    reuses the dead chain buffer in place.
+  - `BridgeExecutor` (fast backend): the same kernels exported as
+    StableHLO and driven through the C++ PJRT bridge
+    (native/bridge.py) with `ntb_upload`/`ntb_execute_resident` —
+    no per-wave argument re-upload, outputs stay device-resident as
+    retained handles.
+
+Safety of the retained chain: proposed usage is a SUPERSET of what the
+chain's own plans commit, so a chained wave can under-pack but never
+oversubscribe — and any write the chain cannot see demotes the
+applier's fenced fast path to the full fit re-check (plan_apply), whose
+refutes feed the pipeline's node mask.  The executor additionally
+INVALIDATES the retained chain (dropping back to a packer-synced
+re-upload, counted in `nomad.executor.invalidations`) on every
+state-store write that changes node state the chain cannot observe:
+
+  - node writes (register / drain / eligibility / attribute change),
+  - snapshot restore,
+  - capacity-freeing alloc writes (terminal transitions),
+  - a committed plan from OUTSIDE the chain (solo/system/foreign
+    worker plans — wired by the plan applier via `note_plan_commit`).
+
+Telemetry (core/telemetry.py, exported via /v1/metrics):
+  nomad.executor.uploads / upload_bytes   host->device node-state syncs
+  nomad.executor.resident_waves           launches that chained handles
+  nomad.executor.invalidations            retained chains dropped
+  nomad.executor.h2d_s                    upload latency histogram
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from nomad_tpu.core.telemetry import REGISTRY
+
+EXECUTOR_BACKENDS = ("jax", "bridge")
+
+
+class ExecutorUnavailable(RuntimeError):
+    """The requested executor backend cannot run in this process."""
+
+
+def make_executor(name: str, engine, plugin: Optional[str] = None,
+                  chain_enabled: bool = True) -> "DeviceExecutor":
+    """Build the configured executor backend over `engine`
+    (agent_config `server.device_executor`).  Raises ValueError on an
+    unknown name and ExecutorUnavailable when `bridge` is requested but
+    the native build or PJRT plugin is absent."""
+    if name in ("", None, "jax"):
+        return JaxExecutor(engine, chain_enabled=chain_enabled)
+    if name == "bridge":
+        return BridgeExecutor(engine, plugin=plugin,
+                              chain_enabled=chain_enabled)
+    raise ValueError(
+        f"unknown device_executor {name!r} "
+        f"(expected one of {EXECUTOR_BACKENDS})")
+
+
+class DeviceExecutor:
+    """Pluggable device-execution seam between the wave pipeline and the
+    kernels.  One instance per Server, shared by its workers — the
+    retained chain is a single slot CLAIMED atomically (claim_chain
+    pops), so two workers can never chain concurrently on the same
+    donated/retained buffer under one chain id (which would exempt each
+    other from the applier's per-node fence)."""
+
+    name = "base"
+
+    def __init__(self, engine, chain_enabled: bool = True) -> None:
+        self.engine = engine
+        # chain_enabled=False is the A/B lever (bench --resident off and
+        # the parity suite's serial reference): every wave re-syncs
+        # `used0` from the packer through the host
+        self.chain_enabled = chain_enabled
+        self._lock = threading.Lock()
+        # (batch_id, seq0, (used, node_version, npad), masked_nodes)
+        self._chain = None
+        self.stats = {"dispatches": 0, "resident_waves": 0,
+                      "invalidations": 0, "uploads": 0, "upload_bytes": 0}
+
+    # ------------------------------------------------------------ waves
+
+    def dispatch_batch(self, snapshot, items: Sequence, seed=0,
+                       used0_dev=None, masked_node_ids=None):
+        raise NotImplementedError
+
+    def collect_batch(self, pending):
+        raise NotImplementedError
+
+    def chain_state(self, pending):
+        """The (usage, node version, padded n) triple a successor wave
+        chains on, or None when `pending` cannot seed a chain."""
+        if not isinstance(pending, dict):
+            return None
+        return (pending["used"], pending["node_version"], pending["npad"])
+
+    def _note_dispatch(self, pending, wanted_chain: bool) -> None:
+        if not isinstance(pending, dict):
+            return
+        chained = bool(pending.get("chained"))
+        with self._lock:
+            self.stats["dispatches"] += 1
+            if chained:
+                self.stats["resident_waves"] += 1
+        if chained:
+            REGISTRY.inc("nomad.executor.resident_waves")
+        elif wanted_chain:
+            # the engine rejected the handed-in chain (node-table
+            # rebuild remapped rows): that buffer is dead
+            self._count_invalidation("stale-node-table")
+
+    # --------------------------------------------- retained chain slot
+
+    def retain_chain(self, batch_id: str, seq0: int, used_triple,
+                     masked=None) -> None:
+        """Park a finished wave's proposed-usage chain for the NEXT
+        dequeued batch (core/worker.py calls this when a fully-coupled
+        batch ends with no prefetch to hand the chain to)."""
+        if not self.chain_enabled or used_triple is None or not batch_id:
+            return
+        with self._lock:
+            old, self._chain = self._chain, (
+                batch_id, seq0, used_triple, frozenset(masked or ()))
+        if old is not None:
+            self._release_chain(old)
+
+    def claim_chain(self):
+        """Pop the retained chain (single consumer — see class doc).
+        Returns (batch_id, seq0, used_triple, masked_nodes) or None."""
+        if not self.chain_enabled:
+            return None
+        with self._lock:
+            c, self._chain = self._chain, None
+        return c
+
+    def invalidate(self, reason: str = "explicit") -> None:
+        """Drop the retained chain: the next wave re-syncs node state
+        from the packer (re-upload counted via uploads/upload_bytes)."""
+        with self._lock:
+            c, self._chain = self._chain, None
+        if c is not None:
+            self._count_invalidation(reason)
+            self._release_chain(c)
+
+    def _count_invalidation(self, reason: str) -> None:
+        with self._lock:
+            self.stats["invalidations"] += 1
+        REGISTRY.inc("nomad.executor.invalidations", reason=reason)
+
+    def _release_chain(self, chain) -> None:
+        """Backend hook: free device resources a dropped chain held."""
+
+    # ------------------------------------------------- store coupling
+
+    def note_plan_commit(self, origin: str) -> None:
+        """The plan applier committed a plan from `origin` (chain id or
+        eval id).  A foreign plan's usage is invisible to the retained
+        chain — drop it so the next wave re-syncs."""
+        with self._lock:
+            c = self._chain
+        if c is not None and origin != c[0]:
+            self.invalidate("foreign-plan")
+
+    def attach_store(self, store) -> None:
+        """Subscribe to state-store events that change node state the
+        retained chain cannot observe (node writes, snapshot restore,
+        capacity-freeing terminal allocs)."""
+
+        def on_event(topic: str, index: int, payload) -> None:
+            if topic == "Node":
+                self.invalidate("node-write")
+            elif topic == "Restore":
+                self.invalidate("restore")
+            elif topic == "Allocations":
+                # placements the chain proposed are non-terminal; a
+                # terminal transition FREES capacity the chain still
+                # counts as used — it must re-sync or under-pack forever
+                # (a blocked eval would never see the freed node)
+                try:
+                    freed = any(a.terminal_status() for a in payload)
+                except TypeError:
+                    freed = True
+                if freed:
+                    self.invalidate("capacity-freed")
+
+        store.subscribe(on_event)
+
+    # ----------------------------------------------------- telemetry
+
+    def _observe_h2d(self, nbytes: int, seconds: float) -> None:
+        with self._lock:
+            self.stats["uploads"] += 1
+            self.stats["upload_bytes"] += int(nbytes)
+        REGISTRY.inc("nomad.executor.uploads")
+        REGISTRY.inc("nomad.executor.upload_bytes", int(nbytes))
+        REGISTRY.observe("nomad.executor.h2d_s", seconds)
+
+    def close(self) -> None:
+        self.invalidate("close")
+
+
+class JaxExecutor(DeviceExecutor):
+    """Default backend: the in-process JAX engine.  Chained launches go
+    through the donated-usage jit variants (select.place_multi_chained),
+    so the previous wave's dead buffer is reused in place; node tensors
+    are device-resident in the engine's version-keyed caches and the
+    executor's H2D observer meters every sync the engine performs."""
+
+    name = "jax"
+
+    def __init__(self, engine, chain_enabled: bool = True) -> None:
+        super().__init__(engine, chain_enabled=chain_enabled)
+        # meter the engine's host->device node-state syncs
+        # (_node_arrays full uploads + _used_device delta replays)
+        engine.h2d_observer = self._observe_h2d
+
+    def dispatch_batch(self, snapshot, items, seed=0, used0_dev=None,
+                       masked_node_ids=None):
+        if not self.chain_enabled:
+            used0_dev = None
+        pending = self.engine.dispatch_batch(
+            snapshot, items, seed=seed, used0_dev=used0_dev,
+            masked_node_ids=masked_node_ids)
+        self._note_dispatch(pending, used0_dev is not None)
+        return pending
+
+    def collect_batch(self, pending):
+        return self.engine.collect_batch(pending)
+
+
+class _BridgeArray:
+    """A device-resident PJRT bridge buffer masquerading as an array:
+    carries shape/dtype for shape-bucket keys and fetches to host
+    lazily on np.asarray() — the compact-fills overflow path then pays
+    its fetch only when the prefix actually overflowed."""
+
+    __slots__ = ("shape", "dtype", "_bridge", "handle", "_host")
+
+    def __init__(self, bridge, handle, shape, dtype) -> None:
+        self._bridge = bridge
+        self.handle = handle
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self._host = None
+
+    def fetch(self) -> np.ndarray:
+        if self._host is None:
+            self._host = self._bridge.fetch(self.handle, self.shape,
+                                            self.dtype)
+        return self._host
+
+    # wavepipe.collect's device-interval stamp calls this on the result
+    # buffer; for the bridge the fetch IS the synchronization point
+    def block_until_ready(self) -> "_BridgeArray":
+        self.fetch()
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.fetch()
+        return a if dtype is None else a.astype(dtype)
+
+    def free(self) -> None:
+        if self.handle:
+            try:
+                self._bridge.buffer_free(self.handle)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            self.handle = 0
+
+
+class BridgeExecutor(DeviceExecutor):
+    """Fast backend: the production multi-eval kernels exported once as
+    StableHLO per shape bucket and driven through the C++ PJRT bridge
+    (native/pjrt_bridge) with persistent device buffers.  Stable inputs
+    (node tensors, LUTs, cached masks) upload once and are reused by
+    object identity; each wave uploads only its small per-wave tensors
+    and fetches only the compact result buffer; the proposed-usage
+    output handle chains into the next wave's `used0` untouched by the
+    host — the `bench.py --bridge` pattern, in the worker loop."""
+
+    name = "bridge"
+
+    # stable-input handle cache bound (entries are freed on eviction)
+    _CACHE_CAP = 256
+
+    def __init__(self, engine, plugin: Optional[str] = None,
+                 chain_enabled: bool = True) -> None:
+        from nomad_tpu.native import bridge as nb
+        plugin = plugin or nb.DEFAULT_PLUGIN
+        if not nb.bridge_available(plugin):
+            raise ExecutorUnavailable(
+                "device_executor 'bridge' requires the native bridge "
+                f"build and a PJRT plugin at {plugin} (build with "
+                "`make -C native`); falling back is not automatic — "
+                "configure device_executor = \"jax\" instead")
+        if engine.mesh is not None:
+            raise ExecutorUnavailable(
+                "device_executor 'bridge' drives a single PJRT device; "
+                "this engine shards over a mesh — use 'jax'")
+        super().__init__(engine, chain_enabled=chain_enabled)
+        self._bridge = nb.PjrtBridge(plugin)
+        self._compiled = {}       # shape signature -> (exec, out_specs)
+        self._h2d_cache = {}      # id(leaf) -> (leaf ref, handle)
+        self._h2d_order = []      # insertion order for eviction
+
+    # ------------------------------------------------------- uploads
+
+    def _leaf_handle(self, leaf) -> int:
+        """Device handle for one input leaf, cached by object identity:
+        the engine's version-keyed caches keep node tensors as the SAME
+        objects across waves, so they upload once; fresh per-wave
+        arrays miss and age out of the bounded cache."""
+        key = id(leaf)
+        hit = self._h2d_cache.get(key)
+        if hit is not None and hit[0] is leaf:
+            return hit[1]
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        t0 = time.perf_counter()
+        handle = self._bridge.upload(arr)
+        self._observe_h2d(arr.nbytes, time.perf_counter() - t0)
+        self._h2d_cache[key] = (leaf, handle)
+        self._h2d_order.append(key)
+        if len(self._h2d_order) > self._CACHE_CAP:
+            for old in self._h2d_order[:self._CACHE_CAP // 4]:
+                stale = self._h2d_cache.pop(old, None)
+                if stale is not None:
+                    try:
+                        self._bridge.buffer_free(stale[1])
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
+            del self._h2d_order[:self._CACHE_CAP // 4]
+        return handle
+
+    def _compile(self, kernel, spec_args):
+        """Compile (once per shape bucket) and return (exec handle,
+        out_specs)."""
+        import jax
+        from nomad_tpu.native.bridge import export_stablehlo
+        sig = tuple((tuple(s.shape), str(s.dtype))
+                    for s in jax.tree_util.tree_leaves(spec_args))
+        hit = self._compiled.get(sig)
+        if hit is not None:
+            return hit
+        hlo = export_stablehlo(kernel, *spec_args)
+        ex = self._bridge.compile(hlo)
+        outs = [(tuple(o.shape), np.dtype(o.dtype))
+                for o in jax.tree_util.tree_leaves(
+                    jax.eval_shape(kernel, *spec_args))]
+        self._compiled[sig] = (ex, outs)
+        return ex, outs
+
+    # --------------------------------------------------------- waves
+
+    def dispatch_batch(self, snapshot, items, seed=0, used0_dev=None,
+                       masked_node_ids=None):
+        import jax
+        from functools import partial
+
+        from .select import FILL_K, place_multi_compact_packed, \
+            place_multi_packed
+
+        if not self.chain_enabled:
+            used0_dev = None
+        if not items:
+            return None
+        built = self.engine.build_multi_inputs(
+            snapshot, items, seed=seed, used0_dev=used0_dev,
+            masked_node_ids=masked_node_ids)
+        if isinstance(built, tuple):
+            return built                       # empty-cluster sentinel
+        inp, rs = built["inp"], built["rs"]
+        chained = built.get("chained", False)
+        if used0_dev is not None and not chained:
+            # version guard rejected the chain: its handle is dead
+            arr = used0_dev[0]
+            if isinstance(arr, _BridgeArray):
+                arr.free()
+        compact = built["cand_rows"] is not None
+        if compact:
+            kernel = partial(place_multi_compact_packed, round_size=rs,
+                             n_lanes=built["n_lanes"])
+            kargs = (inp, built["cand_rows"], built["cand_valid"])
+            used_out, fill_k = 2, min(FILL_K, rs)
+        else:
+            kernel = partial(place_multi_packed, round_size=rs)
+            kargs = (inp,)
+            used_out, fill_k = 1, None
+
+        leaves, treedef = jax.tree_util.tree_flatten(kargs)
+        spec_args = jax.tree_util.tree_unflatten(treedef, [
+            jax.ShapeDtypeStruct(tuple(lf.shape), np.dtype(lf.dtype))
+            for lf in leaves])
+        ex, out_specs = self._compile(kernel, spec_args)
+        consumed = None
+        handles = []
+        for lf in leaves:
+            if isinstance(lf, _BridgeArray):
+                handles.append(lf.handle)      # the chained used0
+                consumed = lf
+            else:
+                handles.append(self._leaf_handle(lf))
+        outs = self._bridge.execute_resident(ex, handles, len(out_specs))
+        if consumed is not None:
+            consumed.free()
+        wrapped = [_BridgeArray(self._bridge, h, *spec)
+                   for h, spec in zip(outs, out_specs)]
+        free_now = [w for i, w in enumerate(wrapped)
+                    if i not in (0, 1 if compact else None, used_out)]
+        for w in free_now:
+            w.free()
+        t = built["t"]
+        pending = {
+            "bridge": True,
+            "buf": wrapped[0],
+            "fills_full": wrapped[1] if compact else None,
+            "fill_k": fill_k,
+            "used": wrapped[used_out],
+            "items": list(items),
+            "spans": built["spans"], "counts": built["counts"],
+            "rs": rs, "t": t, "ctxs": built["ctxs"],
+            "n": built["n"], "npad": built["npad"],
+            "node_version": t.version, "perm": built["perm"],
+            "chained": chained,
+            "prep_ns": time.perf_counter_ns() - built["t0"],
+        }
+        self._note_dispatch(pending, used0_dev is not None)
+        return pending
+
+    def collect_batch(self, pending):
+        if not isinstance(pending, dict) or not pending.get("bridge"):
+            return self.engine.collect_batch(pending)
+        try:
+            # engine.collect_batch np.asarray()s buf (and fills only on
+            # prefix overflow) — _BridgeArray fetches on demand
+            return self.engine.collect_batch(pending)
+        finally:
+            buf = pending.get("buf")
+            if isinstance(buf, _BridgeArray):
+                buf.free()
+            fills = pending.get("fills_full")
+            if isinstance(fills, _BridgeArray):
+                fills.free()
+            # pending["used"] stays alive: it is the chain candidate
+
+    def _release_chain(self, chain) -> None:
+        arr = chain[2][0]
+        if isinstance(arr, _BridgeArray):
+            arr.free()
+
+    def close(self) -> None:
+        super().close()
+        for _, handle in self._h2d_cache.values():
+            try:
+                self._bridge.buffer_free(handle)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        self._h2d_cache.clear()
+        self._h2d_order.clear()
+        self._bridge.close()

@@ -124,6 +124,74 @@ finally:
     agent.shutdown()
 EOF
 
+echo "== executor smoke (device-resident worker loop, jax backend) =="
+# boot a dev agent on the default JAX device executor, push a
+# multi-wave workload through the REAL eval-driven path, and assert
+# the resident usage chain actually carried across waves
+# (nomad.executor.resident_waves > 0) — plus a scoped run of the
+# invariant analyzer's JAX purity/donation pass over the new module
+JAX_PLATFORMS=cpu python - <<'EOF'
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, "scripts")
+from analyze import analyze_source
+
+src = pathlib.Path("nomad_tpu/ops/executor.py").read_text()
+findings = analyze_source(src, path="nomad_tpu/ops/executor.py",
+                          passes=("purity",))
+assert not findings, f"purity/donation findings in executor: {findings}"
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.structs import codec
+
+agent = Agent(num_clients=1, num_workers=1, heartbeat_ttl=3600,
+              device_executor="jax").start()
+api = APIClient(address=agent.address)
+try:
+    def wave():
+        evals = []
+        for _ in range(8):
+            job = mock.batch_job()
+            tg = job.task_groups[0]
+            tg.count = 2
+            # long-running tasks: completions would free capacity and
+            # (correctly) invalidate the chain mid-smoke
+            tg.tasks[0].config = {"run_for_s": 300}
+            tg.tasks[0].resources.cpu = 20
+            tg.tasks[0].resources.memory_mb = 16
+            evals.append(api.jobs.register(codec.encode(job))["EvalID"])
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            done = sum(1 for e in evals
+                       if api.evaluations.info(e).get("Status")
+                       in ("complete", "failed"))
+            if done == len(evals):
+                return
+            time.sleep(0.1)
+        raise AssertionError("executor smoke wave never completed")
+
+    resident = 0
+    for _ in range(4):          # multi-wave; stop at the first chain hit
+        wave()
+        m = api.agent.metrics()
+        resident = m.get("nomad.executor.resident_waves", 0)
+        if resident > 0:
+            break
+    assert resident > 0, (
+        "no launch rode the resident chain: "
+        f"{ {k: v for k, v in m.items() if 'executor' in k} }")
+    assert m.get("nomad.executor.uploads", 0) > 0
+    print(f"executor smoke ok: resident_waves={resident} "
+          f"uploads={m['nomad.executor.uploads']} "
+          f"upload_bytes={m['nomad.executor.upload_bytes']}")
+finally:
+    agent.shutdown()
+EOF
+
 echo "== chaos (seeded fault-injection scenarios on the virtual clock) =="
 # the full chaos suite: every scenario in tests/test_chaos.py with its
 # pinned seed (partition / split-brain / flap storm / lossy raft /

@@ -1,0 +1,203 @@
+"""Device-executor seam (nomad_tpu/ops/executor.py): backend selection
+and validation, the retained resident-chain slot (claim/retain/
+invalidate semantics, store-write coupling), and the telemetry meters
+the seam exports.  The cross-backend bit-for-bit parity proof lives in
+tests/test_wavepipe.py (TestExecutorResidentParity)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.core.telemetry import REGISTRY
+from nomad_tpu.ops import PlacementEngine
+from nomad_tpu.ops.executor import (
+    EXECUTOR_BACKENDS,
+    ExecutorUnavailable,
+    JaxExecutor,
+    make_executor,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Allocation, Resources
+
+NOW = 1.7e9
+
+
+def _engine():
+    return PlacementEngine(mesh=False)
+
+
+class TestMakeExecutor:
+    def test_default_and_jax(self):
+        eng = _engine()
+        for name in ("", "jax"):
+            ex = make_executor(name, eng)
+            assert isinstance(ex, JaxExecutor)
+            assert ex.name == "jax"
+            assert ex.engine is eng
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="device_executor"):
+            make_executor("cuda", _engine())
+
+    def test_bridge_errors_when_unavailable(self):
+        from nomad_tpu.native.bridge import bridge_available
+        if bridge_available():
+            pytest.skip("bridge available: covered by the parity suite")
+        with pytest.raises(ExecutorUnavailable, match="bridge"):
+            make_executor("bridge", _engine())
+
+    def test_backends_registry(self):
+        assert EXECUTOR_BACKENDS == ("jax", "bridge")
+
+
+class TestAgentConfigKnob:
+    def test_parse_and_default(self):
+        from nomad_tpu.agent_config import AgentConfig, parse_agent_config
+        assert AgentConfig().device_executor == "jax"
+        cfg, fields = parse_agent_config(
+            'server { device_executor = "bridge" }')
+        assert cfg.device_executor == "bridge"
+        assert "device_executor" in fields
+
+    def test_invalid_value_rejected(self):
+        from nomad_tpu.agent_config import parse_agent_config
+        with pytest.raises(ValueError, match="device_executor"):
+            parse_agent_config('server { device_executor = "cuda" }')
+
+
+class TestChainSlot:
+    def test_claim_pops_single_consumer(self):
+        ex = JaxExecutor(_engine())
+        triple = (object(), 1, 8)
+        ex.retain_chain("bid", 3, triple, masked={"n1"})
+        got = ex.claim_chain()
+        assert got == ("bid", 3, triple, frozenset({"n1"}))
+        assert ex.claim_chain() is None
+
+    def test_chain_disabled_is_inert(self):
+        ex = JaxExecutor(_engine(), chain_enabled=False)
+        ex.retain_chain("bid", 3, (object(), 1, 8))
+        assert ex.claim_chain() is None
+
+    def test_invalidate_counts_only_real_drops(self):
+        ex = JaxExecutor(_engine())
+        ex.invalidate("noop")
+        assert ex.stats["invalidations"] == 0
+        ex.retain_chain("bid", 3, (object(), 1, 8))
+        ex.invalidate("test")
+        assert ex.stats["invalidations"] == 1
+        assert ex.claim_chain() is None
+
+    def test_foreign_plan_invalidates_own_does_not(self):
+        ex = JaxExecutor(_engine())
+        ex.retain_chain("bid", 3, (object(), 1, 8))
+        ex.note_plan_commit("bid")            # the chain's own commit
+        assert ex.stats["invalidations"] == 0
+        ex.note_plan_commit("someone-else")   # foreign plan
+        assert ex.stats["invalidations"] == 1
+        assert ex.claim_chain() is None
+
+    def test_store_writes_invalidate(self):
+        store = StateStore()
+        ex = JaxExecutor(_engine())
+        ex.attach_store(store)
+
+        # node write (register/drain/eligibility)
+        ex.retain_chain("bid", 1, (object(), 1, 8))
+        store.upsert_node(mock.node())
+        assert ex.stats["invalidations"] == 1
+
+        # capacity-freeing (terminal) alloc write
+        ex.retain_chain("bid", 2, (object(), 1, 8))
+        live = Allocation(id="a-live", namespace="default", job_id="j",
+                          task_group="tg", node_id="n1",
+                          resources=Resources(cpu=10, memory_mb=10),
+                          desired_status="run", client_status="running")
+        store.upsert_allocs([live])
+        assert ex.stats["invalidations"] == 1, \
+            "a live placement must NOT invalidate"
+        done = live.copy()
+        done.client_status = "complete"
+        store.upsert_allocs([done])
+        assert ex.stats["invalidations"] == 2
+
+        # snapshot restore
+        ex.retain_chain("bid", 3, (object(), 1, 8))
+        store.snapshot_restore(store.snapshot_save())
+        assert ex.stats["invalidations"] == 3
+
+
+class TestServerWiring:
+    def test_server_builds_and_wires_executor(self):
+        s = Server(dev_mode=True, device_executor="jax")
+        assert s.executor.name == "jax"
+        assert s.executor.engine is s.engine
+        assert s.plan_applier.executor is s.executor
+        for w in s.workers:
+            assert w.pipeline.executor is s.executor
+
+    def test_server_rejects_unknown_backend(self):
+        with pytest.raises(ValueError, match="device_executor"):
+            Server(dev_mode=True, device_executor="cuda")
+
+    def test_residency_metrics_ride_the_registry(self):
+        c0 = REGISTRY.counter("nomad.executor.resident_waves")
+        u0 = REGISTRY.counter("nomad.executor.uploads")
+        s = Server(dev_mode=True, eval_batch=4)
+        s.establish_leadership()
+        for _ in range(8):
+            n = mock.node()
+            n.resources.cpu = 8000
+            n.resources.memory_mb = 16384
+            s.register_node(n, now=NOW)
+        for wave in range(2):
+            for _ in range(4):
+                job = mock.batch_job()
+                job.task_groups[0].count = 8
+                job.task_groups[0].tasks[0].resources.cpu = 50
+                job.task_groups[0].tasks[0].resources.memory_mb = 16
+                s.register_job(job, now=NOW)
+            s.process_all(now=NOW)
+        assert s.executor.stats["resident_waves"] >= 1
+        assert REGISTRY.counter("nomad.executor.resident_waves") > c0
+        assert REGISTRY.counter("nomad.executor.uploads") > u0
+        assert REGISTRY.counter("nomad.executor.upload_bytes") > 0
+        assert REGISTRY.histogram("nomad.executor.h2d_s") is not None
+
+    def test_serial_vs_resident_same_aggregate_state(self):
+        """The worker-loop A/B the bench's --resident flag runs: chain
+        off (host round-trip every wave) and chain on land identical
+        live-alloc counts with zero refutes."""
+        def run(resident):
+            s = Server(dev_mode=True, eval_batch=4)
+            s.executor.chain_enabled = resident
+            s.establish_leadership()
+            for _ in range(8):
+                n = mock.node()
+                n.resources.cpu = 8000
+                n.resources.memory_mb = 16384
+                s.register_node(n, now=NOW)
+            jobs = []
+            for wave in range(3):
+                for _ in range(4):
+                    job = mock.batch_job()
+                    job.task_groups[0].count = 8
+                    job.task_groups[0].tasks[0].resources.cpu = 50
+                    job.task_groups[0].tasks[0].resources.memory_mb = 16
+                    s.register_job(job, now=NOW)
+                    jobs.append(job)
+                s.process_all(now=NOW)
+            snap = s.state.snapshot()
+            placed = sum(
+                1 for j in jobs
+                for a in snap.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status())
+            return placed, s.plan_applier.stats["plans_refuted"], \
+                dict(s.executor.stats)
+
+        placed_off, refuted_off, st_off = run(False)
+        placed_on, refuted_on, st_on = run(True)
+        assert placed_off == placed_on == 12 * 8
+        assert refuted_off == refuted_on == 0
+        assert st_off["resident_waves"] == 0
+        assert st_on["resident_waves"] >= 1
