@@ -691,6 +691,11 @@ def run_config_5(args):
     # is signature-deduped
     batch = args.batch or 384
 
+    # mesh lever: 'off' pins the single-device engine (the sharded A/B
+    # reference); anything else lets the engine auto-shard the node
+    # axis over every visible device (--mesh N forced the virtual host
+    # device count in main before any jax init)
+    mesh_off = getattr(args, "mesh", "auto") == "off"
     s = Server(dev_mode=False, num_workers=n_workers, eval_batch=batch,
                heartbeat_ttl=1e9,
                # first-time kernel compiles (~40-90s over the tunnel)
@@ -699,7 +704,17 @@ def run_config_5(args):
                # pluggable device executor (ops/executor.py): the REAL
                # eval-driven path rides retained buffer handles — no
                # --bridge side-channel needed for the resident chain
-               device_executor=(args.executor or "jax"))
+               device_executor=(args.executor or "jax"),
+               mesh=False if mesh_off else None)
+    n_devices = s.engine.n_devices
+    # sharded parity FIRST: before any timed wave, the mesh path must
+    # prove bit-equal picks vs the single-device engine at small scale
+    # (the acceptance gate for promoting multichip to the benched path)
+    parity_evals = 0
+    if s.engine.mesh is not None:
+        parity_evals = _sharded_parity_gate()
+        print(f"sharded parity gate ok: {parity_evals} evals, "
+              f"{n_devices} devices", file=sys.stderr)
     # --resident off: the A/B lever for PERF.md §12 — every wave
     # re-syncs used0 from the packer through the host (no chaining)
     s.executor.chain_enabled = (args.resident != "off")
@@ -876,7 +891,11 @@ def run_config_5(args):
         # interpreted fallback as a 5-worker compiled figure
         base_rate_real = None
         base_rate_real_median = None
-    base_sample_py = min(n_place, 300)
+    # the interpreted emulation shuffles the FULL node list per
+    # placement: at 500k-1M nodes that is ~0.5s/placement of pure
+    # list-shuffle, so the sample shrinks with scale (it is a bracket
+    # from below, not a measured tier)
+    base_sample_py = min(n_place, 300 if n_nodes <= 100000 else 30)
     base_rate_py = stock_baseline_rate(nodes, cpu=10, mem=10,
                                        n_place=base_sample_py)
     base_evals_per_sec = base_rate_c / per_eval
@@ -904,6 +923,7 @@ def run_config_5(args):
                       if not a.terminal_status()])
         return g_dt, placed
 
+    quick = getattr(args, "quick", False)
     # warm with the MEASURED ask, twice: a tiny-ask warmup giant fills
     # ~7 nodes and compiles only the small rounds bucket, and the first
     # (10,10) giant's own committed usage shifts the next giant across a
@@ -913,7 +933,8 @@ def run_config_5(args):
     # capped at ~80-93k/s for four rounds running by measuring giant
     # two; warmed giants measure 370-470k/s.
     run_giant(10, 10)
-    run_giant(10, 10)
+    if not quick:
+        run_giant(10, 10)
     giant_dt, giant_placed = run_giant(10, 10)
     giant_rate = giant_placed / giant_dt if giant_dt > 0 else 0.0
 
@@ -937,14 +958,17 @@ def run_config_5(args):
         return drain(evals, jobs, n_waves * n_evals * per_eval,
                      "sustained")
 
-    sus_waves = 3
+    sus_waves = 2 if quick else 3
     sus_dt = None
     sus_stages = None
     # executor residency over the sustained (steady-state) section:
     # chained launches / total launches is the BENCH_r06 before/after
-    # axis the device-resident executor exists to move
+    # axis the device-resident executor exists to move; the mesh
+    # gauges (collective payload, dirty-shard uploads) sample the same
+    # window
     ex0 = dict(s.executor.stats)
-    for _ in range(2):
+    shard_b0 = s.engine.shard_h2d_bytes
+    for _ in range(1 if quick else 2):
         # wavepipe stage timers per sustained run: the winning run's
         # report carries the overlap gauges that PROVE wave k+1's device
         # compute ran under wave k's materialize/commit (commit time no
@@ -962,6 +986,14 @@ def run_config_5(args):
     resident_hit = ex_resident / ex_waves if ex_waves else 0.0
     h2d_per_wave = ((ex1["upload_bytes"] - ex0["upload_bytes"]) / ex_waves
                     if ex_waves else 0.0)
+    # per-wave cross-shard collective payload: O(top-k · n_devices) per
+    # round by construction (engine._note_collective), never O(n_nodes)
+    # — the acceptance gauge for the sharded path
+    collective_per_wave = ((ex1["collective_bytes"]
+                            - ex0["collective_bytes"]) / ex_waves
+                           if ex_waves else 0.0)
+    shard_h2d_per_wave = ((s.engine.shard_h2d_bytes - shard_b0)
+                          / ex_waves if ex_waves else 0.0)
     executor_backend = s.executor.name
 
     # placement QUALITY over the full workload on both sides: bin-pack
@@ -1036,6 +1068,18 @@ def run_config_5(args):
             "resident_chain_hit_rate": round(resident_hit, 4),
             "h2d_bytes_per_wave": round(h2d_per_wave, 1),
             "executor_invalidations": ex1["invalidations"],
+            # mesh deployment (nomad_tpu/parallel): device count, the
+            # fraction of kernel rows that are mesh padding, the
+            # per-wave cross-shard collective payload (O(top-k ·
+            # n_devices), never O(n_nodes)), dirty-shard re-upload
+            # bytes, and whether the small-scale sharded-vs-single
+            # parity gate ran before the timed waves
+            "n_devices": n_devices,
+            "padded_row_fraction": round(
+                s.engine.padded_row_fraction(n_nodes), 6),
+            "collective_bytes_per_wave": round(collective_per_wave, 1),
+            "shard_h2d_bytes_per_wave": round(shard_h2d_per_wave, 1),
+            "sharded_parity_checked": bool(parity_evals),
             **({"baseline_flat_upper_bound_per_sec": round(base_rate_c, 1),
                 "vs_baseline_flat_upper_bound":
                     round(tpu_rate / base_rate_c, 2)}
@@ -1387,6 +1431,65 @@ def run_bridge(args):
         br.close()
 
 
+def _apply_mesh_arg(args):
+    """`--mesh N`: force N virtual host devices BEFORE the first JAX
+    backend init (tests/conftest.py's trick, as a bench flag) so the
+    sharded production path runs on hosts without a real multi-chip
+    mesh.  Must run before any nomad_tpu import in this process; errors
+    loudly when the backend initialized first with fewer devices —
+    never a silent single-device run labeled as sharded."""
+    if args.mesh in ("auto", "off"):
+        return
+    n = int(args.mesh)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+    have = jax.device_count()
+    if have < n:
+        print(f"--mesh {n}: the runtime exposes only {have} device(s) "
+              "(JAX backend initialized before the flag could apply?); "
+              "refusing to run a mislabeled single-device bench",
+              file=sys.stderr)
+        sys.exit(2)
+
+
+def _sharded_parity_gate(seed: int = 17):
+    """Small-scale sharded-vs-single-device parity check, run BEFORE
+    the timed waves whenever config 5 is about to bench the mesh: the
+    SAME zoned multi-eval batch through the auto-mesh engine and the
+    forced single-device engine must pick identical node multisets per
+    eval (metrics included).  Raises on any divergence — a sharded
+    number only prints when the sharded path provably equals the
+    single-device semantics at small scale."""
+    import argparse as _ap
+
+    import numpy as np
+
+    from nomad_tpu.ops import PlacementEngine
+
+    small = _ap.Namespace(nodes=2048, evals=8, placements=320)
+    h, _nodes, items, *_ = _build_bench_items(small)
+    snap = h.state.snapshot()
+    sharded = PlacementEngine()
+    single = PlacementEngine(mesh=False)
+    assert sharded.mesh is not None
+    ds = sharded.place_batch(snap, items, seed=seed)
+    d1 = single.place_batch(snap, items, seed=seed)
+    for gi, (a, b) in enumerate(zip(ds, d1)):
+        if not np.array_equal(np.sort(a.picks), np.sort(b.picks)):
+            raise AssertionError(
+                f"sharded parity gate FAILED on eval {gi}: sharded and "
+                "single-device picks diverge at 2048 nodes — not "
+                "benching the mesh")
+        for m_s, m_1 in zip(a.metrics, b.metrics):
+            assert m_s.nodes_filtered == m_1.nodes_filtered, \
+                (gi, m_s.nodes_filtered, m_1.nodes_filtered)
+    return len(items)
+
+
 RUNNERS = {1: run_config_1, 2: run_config_2, 3: run_config_3,
            4: run_config_4, 5: run_config_5}
 
@@ -1404,6 +1507,19 @@ def main():
     ap.add_argument("--batch", type=int, default=0,
                     help="config 5: max evals per device launch")
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--mesh", default="auto", metavar="auto|off|N",
+                    help="config 5 device mesh: 'auto' shards the node "
+                         "axis over every visible device (>1), 'off' "
+                         "forces the single-device engine (the sharded "
+                         "A/B lever), an integer N forces N virtual "
+                         "host devices (--xla_force_host_platform_"
+                         "device_count) when no real multi-chip mesh "
+                         "exists — the north-star 500k-1M node scenario "
+                         "runs '--mesh 8' on CPU hosts")
+    ap.add_argument("--quick", action="store_true",
+                    help="config 5: one giant-eval warm run and one "
+                         "2-wave sustained run instead of the full "
+                         "ladder (CI multichip smoke + scale sweeps)")
     ap.add_argument("--executor", choices=("jax", "bridge"), default="jax",
                     help="config 5: device-executor backend for the "
                          "worker loop (ops/executor.py); 'bridge' errors "
@@ -1430,6 +1546,7 @@ def main():
                     help="report the measured wave's wall-time split "
                          "across pipeline phases (host vs device)")
     args = ap.parse_args()
+    _apply_mesh_arg(args)
     if args.phases:
         global _PHASES
         _PHASES = PhaseTimers().install()

@@ -62,7 +62,8 @@ class Server:
                  eval_batch: int = 64,
                  nack_timeout: Optional[float] = None,
                  clock: Optional[Clock] = None,
-                 device_executor: str = "jax") -> None:
+                 device_executor: str = "jax",
+                 mesh=None) -> None:
         # injected timebase (chaos/clock.py): every endpoint default
         # `now`, heartbeat deadline, and the tick loop read this clock,
         # so a chaos scenario's VirtualClock owns the whole server's
@@ -114,7 +115,12 @@ class Server:
         self.volumes = VolumeWatcher(self)
         self.events = EventBroker()
         self.events.attach(self.state)
-        self.engine = PlacementEngine()
+        # `mesh`: None = auto (shard the node axis when the runtime
+        # exposes >1 device), False = force single-device, or an
+        # explicit jax.sharding.Mesh — forwarded to PlacementEngine
+        # (the bench's sharded-vs-single A/B and the sharded parity
+        # suite both need the explicit override)
+        self.engine = PlacementEngine(mesh=mesh)
         self.engine.packer.attach(self.state)
         # pluggable device executor (ops/executor.py, agent_config
         # server.device_executor): the seam the workers' wave pipelines

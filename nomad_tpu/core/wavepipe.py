@@ -211,7 +211,13 @@ class WavePipeline:
         # buffer predates the foreign write that refuted them)
         self._masked: set = set()
         self.stats = {"waves": 0, "chained": 0, "masked_nodes": 0,
-                      "repairs": 0}
+                      "repairs": 0,
+                      # mesh launches: cumulative cross-shard collective
+                      # payload of this pipeline's waves (bytes; 0 on a
+                      # single device) — the per-wave figure bench.py
+                      # derives is the acceptance gauge for "top-k is
+                      # the only cross-shard collective"
+                      "collective_bytes": 0}
 
     # ---------------------------------------------------------- dispatch
 
@@ -239,6 +245,10 @@ class WavePipeline:
             masked_node_ids=mask)
         t1 = time.perf_counter()
         self.timers.record("dispatch", t0, t1, wave)
+        if isinstance(pending, dict) and pending.get("collective_bytes"):
+            with self._lock:
+                self.stats["collective_bytes"] += \
+                    int(pending["collective_bytes"])
         return WaveHandle(wave=wave, pending=pending, items=list(items),
                           t_dispatch=(t0, t1))
 

@@ -32,7 +32,7 @@ from nomad_tpu.structs import (
 from .feasibility import constraint_mask, feasible_mask_jit
 from .preempt import Preemptor, preemption_enabled
 from .select import (
-    BulkInputs, FILL_K, MultiEvalInputs, PlacementInputs,
+    BulkInputs, FILL_K, MultiEvalInputs, PlacementInputs, TOP_K,
     place_bulk_packed_jit, place_multi_chained_jit,
     place_multi_compact_chained_jit, place_multi_compact_packed_jit,
     place_multi_packed_jit, place_packed_jit)
@@ -59,6 +59,15 @@ _MESH_SINGLETON = None
 _SHARDED_FN_CACHE: Dict[tuple, object] = {}
 
 
+def _registry():
+    """Process metrics registry, imported lazily: `nomad_tpu.core`'s
+    package __init__ imports the worker, which imports this package — a
+    module-level import here would make the first-importer order
+    matter."""
+    from nomad_tpu.core.telemetry import REGISTRY
+    return REGISTRY
+
+
 def _default_mesh():
     global _MESH_SINGLETON
     if _MESH_SINGLETON is None:
@@ -78,12 +87,22 @@ def _sharded_fn(mesh, kind: str, *shape_args):
                 out_shardings=NamedSharding(mesh,
                                             PartitionSpec("nodes", None)))
         else:
+            from functools import partial as _p
+
             from nomad_tpu.parallel import mesh as pmesh
             builder = {"scan": pmesh.place_sharded_packed_fn,
                        "bulk": pmesh.place_bulk_sharded_packed_fn,
                        "multi": pmesh.place_multi_sharded_packed_fn,
                        "multi_compact":
-                           pmesh.place_multi_compact_sharded_fn}[kind]
+                           pmesh.place_multi_compact_sharded_fn,
+                       # donated-chain variants: wave k+1 consumes wave
+                       # k's dead sharded usage buffer in place
+                       "multi_chained":
+                           _p(pmesh.place_multi_sharded_packed_fn,
+                              chained=True),
+                       "multi_compact_chained":
+                           _p(pmesh.place_multi_compact_sharded_fn,
+                              chained=True)}[kind]
             fn = builder(mesh, *shape_args)
         _SHARDED_FN_CACHE[key] = fn
     return fn
@@ -342,9 +361,17 @@ class PlacementEngine:
             self._node_sharding = NamedSharding(mesh, PartitionSpec("nodes"))
             self._scatter_fn = _sharded_fn(mesh, "scatter")
         self._dev_cache: Dict[str, object] = {}
-        self._cache_version: Tuple[int, int] = (-1, -1)
+        self._cache_version: Tuple[int, int, int] = (-1, -1, -1)
+        self._cache_npad: int = -1
         self._used_version: int = -1
         self._used_dev = None
+        # running meters for the mesh deployment (bench.py surfaces them
+        # per wave): bytes re-uploaded via dirty-SHARD patches (vs full
+        # tensor re-syncs) and the per-launch cross-shard collective
+        # payload (the two-stage top-k all_gathers — O(k·n_devices) per
+        # round by construction, never O(n_nodes))
+        self.shard_h2d_bytes: int = 0
+        self.collective_bytes: int = 0
         self._const_cache: Dict[tuple, object] = {}
         self._dc_cache: Optional[Tuple[int, Dict[str, int]]] = None
         # host->device sync meter (ops/executor.py installs it): called
@@ -356,6 +383,30 @@ class PlacementEngine:
         obs = self.h2d_observer
         if obs is not None and nbytes:
             obs(nbytes, seconds)
+
+    @property
+    def n_devices(self) -> int:
+        return self._ndev
+
+    def padded_row_fraction(self, n: int) -> float:
+        """Fraction of kernel rows that are mesh padding (ineligible)."""
+        npad = self._padded_n(max(n, 1))
+        return (npad - n) / npad if npad else 0.0
+
+    def _note_collective(self, rounds: int, kk: int,
+                         width: int = 5, extra: int = 64) -> int:
+        """Meter one mesh launch's analytic cross-shard collective
+        payload (bytes RECEIVED per device): each round's two-stage
+        top-k all_gathers a [width, kk] candidate pack from every shard
+        — kk <= round_size, so the per-round payload is O(top-k ·
+        n_devices) and INDEPENDENT of n_nodes — plus ~`extra` bytes of
+        psum'd round metrics.  Exposed as engine.collective_bytes and
+        the nomad.engine.collective_bytes counter (bench.py reports it
+        per wave)."""
+        nbytes = rounds * (width * kk * 4 * self._ndev + extra)
+        self.collective_bytes += nbytes
+        _registry().inc("nomad.engine.collective_bytes", nbytes)
+        return nbytes
 
     def _padded_n(self, n: int) -> int:
         """Node count padded to a mesh multiple (identity single-device)."""
@@ -371,7 +422,16 @@ class PlacementEngine:
         incremental HBM sync point.  Width matters: ensure_column can widen
         attrs after a build without bumping the row version.  On a mesh the
         node axis is padded to a device multiple (padded rows ineligible)
-        and placed with NamedSharding."""
+        and placed with NamedSharding.
+
+        Mesh incremental sync: when the version bump came from dirty-ROW
+        refreshes (packer.node_rows_dirty_since — eligibility/attribute
+        writes, row mapping unchanged) and the attrs width and padding
+        are stable, only the SHARDS holding dirty rows re-upload; clean
+        shards keep their resident device buffers
+        (jax.make_array_from_single_device_arrays).  A 1M-node table on
+        8 devices then pays 1/8th of the full sync for a single node
+        write instead of re-uploading every tensor."""
         key = (t.version, len(self.packer.interner), t.attrs.shape[1])
         if self._cache_version != key:
             t0h = time.perf_counter()
@@ -383,28 +443,96 @@ class PlacementEngine:
             # mutates it after the copy too.
             with self.packer.lock:
                 npad = self._padded_n(t.n)
-                if self.mesh is None:
-                    self._dev_cache = {
-                        "attrs": jnp.array(t.attrs),
-                        "cap": jnp.array(t.cap),
-                        "elig": jnp.array(t.elig),
-                    }
-                else:
-                    put = partial(jax.device_put,
-                                  device=self._node_sharding)
-                    self._dev_cache = {
-                        "attrs": put(_pad_rows(t.attrs, npad, UNSET)),
-                        "cap": put(_pad_rows(t.cap, npad)),
-                        "elig": put(_pad_rows(t.elig, npad, False)),
-                    }
+                h2d = 0
+                patched = False
+                if (self.mesh is not None and self._dev_cache
+                        and self._cache_version[2] == key[2]
+                        and self._cache_npad == npad):
+                    rows = self.packer.node_rows_dirty_since(
+                        self._cache_version[0])
+                    if rows is not None:
+                        h2d = self._patch_node_shards(t, npad, rows)
+                        patched = True
+                if not patched:
+                    if self.mesh is None:
+                        self._dev_cache = {
+                            "attrs": jnp.array(t.attrs),
+                            "cap": jnp.array(t.cap),
+                            "elig": jnp.array(t.elig),
+                        }
+                    else:
+                        put = partial(jax.device_put,
+                                      device=self._node_sharding)
+                        self._dev_cache = {
+                            "attrs": put(_pad_rows(t.attrs, npad, UNSET)),
+                            "cap": put(_pad_rows(t.cap, npad)),
+                            "elig": put(_pad_rows(t.elig, npad, False)),
+                        }
+                    h2d = sum(int(getattr(v, "nbytes", 0))
+                              for v in self._dev_cache.values())
+                    # a full re-upload invalidates the resident `used`
+                    # copy too (row remap / width change); a shard patch
+                    # keeps it — _used_device heals the dirty shards
+                    self._used_version = -1
+                    self._used_dev = None
                 self._cache_version = key
-                self._used_version = -1
-                self._used_dev = None
-            self._note_h2d(
-                sum(int(getattr(v, "nbytes", 0))
-                    for v in self._dev_cache.values()),
-                time.perf_counter() - t0h)
+                self._cache_npad = npad
+            self._note_h2d(h2d, time.perf_counter() - t0h)
         return self._dev_cache
+
+    def _shard_of(self, rows: np.ndarray, npad: int) -> set:
+        """Mesh shard indices owning `rows` (node axis split evenly)."""
+        nloc = max(npad // self._ndev, 1)
+        return set((np.asarray(rows, np.int64) // nloc).tolist())
+
+    def _patch_shards(self, arr, host: np.ndarray, fill, npad: int,
+                      dirty_shards: set) -> Tuple[object, int]:
+        """Reassemble a node-sharded device array with only
+        `dirty_shards` re-uploaded from the host tensor (remaining
+        shards reuse their resident per-device buffers).  Returns
+        (new array, bytes uploaded)."""
+        nloc = max(npad // self._ndev, 1)
+        shape = (npad,) + host.shape[1:]
+        sharding = arr.sharding
+        old = {s.device: s.data for s in arr.addressable_shards}
+        bufs = []
+        nbytes = 0
+        for dev, idx in sharding.addressable_devices_indices_map(
+                shape).items():
+            lo = idx[0].start or 0
+            if lo // nloc in dirty_shards:
+                sl = np.full((nloc,) + host.shape[1:], fill, host.dtype)
+                real = max(min(lo + nloc, host.shape[0]) - lo, 0)
+                if real:
+                    sl[:real] = host[lo:lo + real]
+                buf = jax.device_put(sl, dev)
+                nbytes += sl.nbytes
+            else:
+                buf = old[dev]
+            bufs.append(buf)
+        out = jax.make_array_from_single_device_arrays(
+            shape, sharding, bufs)
+        return out, nbytes
+
+    def _patch_node_shards(self, t: NodeTensors, npad: int,
+                           rows: np.ndarray) -> int:
+        """Dirty-shard re-upload of attrs/cap/elig (packer lock held by
+        the caller).  Zero rows = nothing to move (version-only bump)."""
+        if rows.size == 0:
+            return 0
+        dirty = self._shard_of(rows, npad)
+        nbytes = 0
+        cache = dict(self._dev_cache)
+        for name, host, fill in (("attrs", t.attrs, UNSET),
+                                 ("cap", t.cap, 0),
+                                 ("elig", t.elig, False)):
+            cache[name], nb = self._patch_shards(
+                cache[name], host, fill, npad, dirty)
+            nbytes += nb
+        self._dev_cache = cache
+        self.shard_h2d_bytes += nbytes
+        _registry().inc("nomad.engine.shard_h2d_bytes", nbytes)
+        return nbytes
 
     def _used_device(self, t: NodeTensors):
         """Device-resident usage tensor.  Plan applies dirty `used` every
@@ -426,6 +554,35 @@ class PlacementEngine:
             deltas = None
             if self._used_dev is not None:
                 deltas = self.packer.used_deltas_since(self._used_version)
+            if deltas is None and self._used_dev is not None \
+                    and self.mesh is not None:
+                # a dirty-ROW refresh sentinel intervened (node write):
+                # heal only the shards whose rows may be stale — the
+                # union of real-delta rows and sentinel-refreshed rows —
+                # from the host tensor, keeping clean shards resident
+                # (the tentpole's "invalidation re-uploads only dirty
+                # shards"; a full rebuild still returns None here and
+                # falls through to the full upload)
+                sync_rows = self.packer.used_sync_rows_since(
+                    self._used_version)
+                if sync_rows is not None \
+                        and self._cache_npad == self._padded_n(t.n):
+                    if sync_rows.size:
+                        # no host copy of the full tensor: _patch_shards
+                        # copies only the dirty shards' slices (the
+                        # packer lock is held, so no torn reads)
+                        self._used_dev, nb = self._patch_shards(
+                            self._used_dev, t.used, 0,
+                            self._cache_npad,
+                            self._shard_of(sync_rows, self._cache_npad))
+                        h2d_bytes += nb
+                        self.shard_h2d_bytes += nb
+                        _registry().inc("nomad.engine.shard_h2d_bytes",
+                                        nb)
+                    self._used_version = ver
+                    self._note_h2d(h2d_bytes,
+                                   time.perf_counter() - t0h)
+                    return self._used_dev
             if deltas is not None:
                 rows = np.concatenate([d[0] for d in deltas])
                 vals = np.concatenate([d[1] for d in deltas])
@@ -691,6 +848,8 @@ class PlacementEngine:
             if self.mesh is not None:
                 buf, used_dev, job_count_dev = self._sharded(
                     "bulk", round_size, n_rounds)(binp)
+                self._note_collective(
+                    n_rounds, min(round_size, npad // self._ndev))
             elif bulk_api and algo != SCHED_ALGO_SPREAD:
                 # compact output: FILL_K slots always fetched; full
                 # fills stay device-resident for the rare overflow.
@@ -766,6 +925,9 @@ class PlacementEngine:
             )
             if self.mesh is not None:
                 buf, used_dev, job_count_dev = self._sharded("scan")(inp)
+                self._note_collective(
+                    p_pad, min(TOP_K, npad // self._ndev),
+                    width=2, extra=128)
             else:
                 buf, used_dev, job_count_dev = place_packed_jit(inp)
             b = np.asarray(buf)[:p_real]
@@ -1089,12 +1251,24 @@ class PlacementEngine:
         chained = aux.get("chained", False)
         fills_full = None
         fill_k = None
+        coll_bytes = 0
         if aux["cand_rows"] is not None:
             cr = jnp.asarray(aux["cand_rows"])
             cv = jnp.asarray(aux["cand_valid"])
             if self.mesh is not None:
-                buf, fills_full, used_out = self._sharded(
-                    "multi_compact", rs, aux["n_lanes"])(inp, cr, cv)
+                if chained:
+                    # donated sharded chain: wave k's dead sharded usage
+                    # buffer is reused in place, exactly like the
+                    # single-device place_multi_compact_chained_jit
+                    buf, fills_full, used_out = self._sharded(
+                        "multi_compact_chained", rs, aux["n_lanes"])(
+                        inp.used0, inp._replace(used0=None), cr, cv)
+                else:
+                    buf, fills_full, used_out = self._sharded(
+                        "multi_compact", rs, aux["n_lanes"])(inp, cr, cv)
+                coll_bytes = self._note_collective(
+                    int(inp.round_g.shape[0]),
+                    min(rs, int(aux["cand_rows"].shape[-1])))
             elif chained:
                 buf, fills_full, used_out = \
                     place_multi_compact_chained_jit(
@@ -1106,7 +1280,14 @@ class PlacementEngine:
                         inp, cr, cv, rs, aux["n_lanes"])
             fill_k = min(FILL_K, rs)
         elif self.mesh is not None:
-            buf, used_out, _ = self._sharded("multi", rs)(inp)
+            if chained:
+                buf, used_out, _ = self._sharded("multi_chained", rs)(
+                    inp.used0, inp._replace(used0=None))
+            else:
+                buf, used_out, _ = self._sharded("multi", rs)(inp)
+            coll_bytes = self._note_collective(
+                int(inp.round_g.shape[0]),
+                min(rs, aux["npad"] // self._ndev))
         elif chained:
             buf, used_out, _ = place_multi_chained_jit(
                 inp.used0, inp._replace(used0=None), rs)
@@ -1129,6 +1310,7 @@ class PlacementEngine:
                 "npad": aux["npad"], "node_version": aux["t"].version,
                 "perm": aux["perm"], "fills_full": fills_full,
                 "fill_k": fill_k, "chained": chained,
+                "collective_bytes": coll_bytes,
                 "prep_ns": time.perf_counter_ns() - aux["t0"]}
 
     def build_multi_inputs(self, snapshot, items: Sequence[BatchItem],

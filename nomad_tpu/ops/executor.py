@@ -67,11 +67,26 @@ def make_executor(name: str, engine, plugin: Optional[str] = None,
                   chain_enabled: bool = True) -> "DeviceExecutor":
     """Build the configured executor backend over `engine`
     (agent_config `server.device_executor`).  Raises ValueError on an
-    unknown name and ExecutorUnavailable when `bridge` is requested but
-    the native build or PJRT plugin is absent."""
+    unknown name OR on a config the engine cannot honor (bridge over a
+    multi-device mesh), and ExecutorUnavailable when `bridge` is
+    requested but the native build or PJRT plugin is absent.  All three
+    raise at SERVER CONSTRUCTION — agent start — never mid-worker-loop."""
     if name in ("", None, "jax"):
         return JaxExecutor(engine, chain_enabled=chain_enabled)
     if name == "bridge":
+        if getattr(engine, "mesh", None) is not None:
+            # config validation, not availability: the C++ PJRT bridge
+            # drives exactly one device, and this runtime exposes a
+            # multi-device mesh the engine shards the node axis over.
+            # There is no silent fallback — the operator picks one.
+            raise ValueError(
+                "agent_config: server.device_executor = \"bridge\" "
+                "drives a single PJRT device, but this engine shards "
+                f"the node axis over a {engine.mesh.devices.size}-device "
+                "mesh; set server.device_executor = \"jax\" (the "
+                "sharded backend), or run single-device (e.g. "
+                "JAX_PLATFORMS with one visible device) — see README "
+                "\"Scaling out\"")
         return BridgeExecutor(engine, plugin=plugin,
                               chain_enabled=chain_enabled)
     raise ValueError(
@@ -99,7 +114,11 @@ class DeviceExecutor:
         # (batch_id, seq0, (used, node_version, npad), masked_nodes)
         self._chain = None
         self.stats = {"dispatches": 0, "resident_waves": 0,
-                      "invalidations": 0, "uploads": 0, "upload_bytes": 0}
+                      "invalidations": 0, "uploads": 0, "upload_bytes": 0,
+                      # mesh deployments: per-launch cross-shard
+                      # collective payload (engine._note_collective) —
+                      # 0 forever on a single device
+                      "collective_bytes": 0}
 
     # ------------------------------------------------------------ waves
 
@@ -121,8 +140,10 @@ class DeviceExecutor:
         if not isinstance(pending, dict):
             return
         chained = bool(pending.get("chained"))
+        coll = int(pending.get("collective_bytes") or 0)
         with self._lock:
             self.stats["dispatches"] += 1
+            self.stats["collective_bytes"] += coll
             if chained:
                 self.stats["resident_waves"] += 1
         if chained:
@@ -308,6 +329,13 @@ class BridgeExecutor(DeviceExecutor):
 
     def __init__(self, engine, plugin: Optional[str] = None,
                  chain_enabled: bool = True) -> None:
+        # mesh FIRST: a config contradiction (make_executor raises the
+        # agent_config-worded ValueError before ever constructing this
+        # class) must win over mere plugin absence for direct callers
+        if engine.mesh is not None:
+            raise ValueError(
+                "device_executor 'bridge' drives a single PJRT device; "
+                "this engine shards over a mesh — use 'jax'")
         from nomad_tpu.native import bridge as nb
         plugin = plugin or nb.DEFAULT_PLUGIN
         if not nb.bridge_available(plugin):
@@ -316,10 +344,6 @@ class BridgeExecutor(DeviceExecutor):
                 f"build and a PJRT plugin at {plugin} (build with "
                 "`make -C native`); falling back is not automatic — "
                 "configure device_executor = \"jax\" instead")
-        if engine.mesh is not None:
-            raise ExecutorUnavailable(
-                "device_executor 'bridge' drives a single PJRT device; "
-                "this engine shards over a mesh — use 'jax'")
         super().__init__(engine, chain_enabled=chain_enabled)
         self._bridge = nb.PjrtBridge(plugin)
         self._compiled = {}       # shape signature -> (exec, out_specs)

@@ -178,6 +178,18 @@ class ClusterPacker:
         self._delta_log: List[Tuple[int, Optional[np.ndarray],
                                     Optional[np.ndarray]]] = []
         self._used_seq = 0
+        # row-dirty log for NODE-TABLE versions (t.version): entries are
+        # (version, rows) where rows is the np.int64 array of node rows a
+        # dirty-row refresh rewrote, or None for a full rebuild / row
+        # remap.  Mesh engines use it to re-upload only the SHARDS a
+        # node write touched instead of the whole padded node tensor
+        # (ops/engine._node_arrays); bounded like the usage delta log.
+        self._row_dirty_log: List[Tuple[int, Optional[np.ndarray]]] = []
+        # used-version sentinels (rows=None in _delta_log) that came from
+        # a dirty-ROW refresh carry their refreshed rows here, so a
+        # device `used` copy can be healed shard-wise instead of fully
+        # re-uploaded (used_sync_rows_since)
+        self._used_sentinel_rows: Dict[int, Optional[np.ndarray]] = {}
         self.lut_epoch = 0
 
     # ------------------------------------------------------------ columns
@@ -333,15 +345,26 @@ class ClusterPacker:
                 if not per_node:
                     del self._block_counted[nid]
 
-    def _log_delta(self, rows, vals) -> int:
+    def _log_delta(self, rows, vals, refreshed_rows=None) -> int:
         """Append one used-version bump to the replay log.  `rows is None`
         marks a full/row rescan (device copies must re-upload).  Versions
-        in the log are consecutive, which makes continuity provable."""
+        in the log are consecutive, which makes continuity provable.
+
+        `refreshed_rows`: for a sentinel that came from a dirty-ROW
+        refresh (not a full rebuild), the node rows whose usage was
+        re-anchored — lets used_sync_rows_since() heal a device copy
+        shard-wise instead of forcing the full re-upload."""
         self._used_seq += 1
         log = self._delta_log
         log.append((self._used_seq, rows, vals))
+        if rows is None:
+            self._used_sentinel_rows[self._used_seq] = refreshed_rows
         if len(log) > 256:
+            dropped = log[:128]
             del log[:128]
+            for v, r, _ in dropped:
+                if r is None:
+                    self._used_sentinel_rows.pop(v, None)
         return self._used_seq
 
     def used_deltas_since(self, version: int
@@ -363,6 +386,69 @@ class ClusterPacker:
         if expect != self._used_seq + 1:
             return None
         return out
+
+    def used_sync_rows_since(self, version: int) -> Optional[np.ndarray]:
+        """Union of node rows whose device `used` copy at `version` may
+        be stale: real-delta rows plus dirty-row-refresh sentinel rows,
+        oldest entries first.  None when any entry since `version` lacks
+        row information (full rebuild / trimmed window) — the caller
+        must re-upload the whole tensor.  A mesh engine turns this into
+        a per-SHARD patch (ops/engine._used_device)."""
+        if version == self._used_seq:
+            return np.empty(0, np.int64)
+        parts: List[np.ndarray] = []
+        expect = version + 1
+        for v, rows, _ in self._delta_log:
+            if v < expect:
+                continue
+            if v != expect:
+                return None
+            if rows is None:
+                srows = self._used_sentinel_rows.get(v)
+                if srows is None:
+                    return None
+                parts.append(np.asarray(srows, np.int64))
+            else:
+                parts.append(np.asarray(rows, np.int64))
+            expect += 1
+        if expect != self._used_seq + 1:
+            return None
+        if not parts:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(parts))
+
+    def _log_row_dirty(self, rows: Optional[np.ndarray]) -> None:
+        """Record which node rows version `self._seq` rewrote (None =
+        full rebuild / row remap).  Bounded like the usage delta log."""
+        log = self._row_dirty_log
+        log.append((self._seq, rows))
+        if len(log) > 256:
+            del log[:128]
+
+    def node_rows_dirty_since(self, version: int) -> Optional[np.ndarray]:
+        """Node rows rewritten by table versions > `version` (row mapping
+        unchanged throughout), or None when a full rebuild / row remap
+        intervened or the window was trimmed — the caller must re-upload
+        every node tensor."""
+        t = self._tensors
+        if t is None:
+            return None
+        if version == t.version:
+            return np.empty(0, np.int64)
+        parts: List[np.ndarray] = []
+        expect = version + 1
+        for v, rows in self._row_dirty_log:
+            if v < expect:
+                continue
+            if v != expect or rows is None:
+                return None
+            parts.append(np.asarray(rows, np.int64))
+            expect += 1
+        if expect != t.version + 1:
+            return None
+        if not parts:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(parts))
 
     # ------------------------------------------------------------- build
 
@@ -420,6 +506,7 @@ class ClusterPacker:
             self._fill_row(t, i, nd, snapshot, prop_maps[i])
         self._seq += 1
         t.version = self._seq
+        self._log_row_dirty(None)
         t.used_version = self._log_delta(None, None)
         self._tensors = t
         self._dirty.clear()
@@ -458,6 +545,7 @@ class ClusterPacker:
         if not self._dirty:
             self._last_index = getattr(snapshot, "index", self._last_index)
             return t
+        refreshed: List[int] = []
         for nid in self._dirty:
             row = t.id_to_row.get(nid)
             if row is None:
@@ -470,9 +558,13 @@ class ClusterPacker:
                 self.ensure_column(k)
             t.attrs[row, :] = UNSET
             self._fill_row(t, row, nd, snapshot, pm, from_ledger=True)
+            refreshed.append(row)
         self._seq += 1
         t.version = self._seq
-        t.used_version = self._log_delta(None, None)
+        rows_arr = np.asarray(refreshed, np.int64)
+        self._log_row_dirty(rows_arr)
+        t.used_version = self._log_delta(None, None,
+                                         refreshed_rows=rows_arr)
         self._dirty.clear()
         self._last_index = getattr(snapshot, "index", self._last_index)
         return t

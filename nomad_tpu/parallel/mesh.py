@@ -451,9 +451,17 @@ def _multi_local(inp: MultiEvalInputs, round_size: int, top_k: int):
     return outs + (used, jc)
 
 
-def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
+def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int,
+                                  chained: bool = False):
     """Sharded multi-eval batch kernel with the same compact packed
-    buffer layout as ops.select.place_multi_packed."""
+    buffer layout as ops.select.place_multi_packed.
+
+    `chained=True` builds the donated-chain variant (the sharded analog
+    of ops.select.place_multi_chained_jit): the jit takes (used0, inp)
+    with `used0` DONATED — a wave chained on the previous wave's
+    sharded proposed-usage output reuses that dead buffer in place.
+    The engine's cached node tensors ride `inp` and are never
+    donated."""
     spec_n = P(AXIS)
     in_specs = MultiEvalInputs(
         attrs=spec_n, cap=spec_n, used0=spec_n, elig=spec_n, luts=P(),
@@ -483,7 +491,13 @@ def place_multi_sharded_packed_fn(mesh: Mesh, round_size: int):
         buf = jnp.concatenate([fills, meta], axis=1)
         return buf, used, jc
 
-    return jax.jit(f)
+    if not chained:
+        return jax.jit(f)
+
+    def f_chained(used0, inp: MultiEvalInputs):
+        return f(inp._replace(used0=used0))
+
+    return jax.jit(f_chained, donate_argnums=(0,))
 
 
 def _multi_compact_local(inp: MultiEvalInputs, cand_rows, cand_valid,
@@ -574,10 +588,13 @@ def _multi_compact_local(inp: MultiEvalInputs, cand_rows, cand_valid,
 
 
 def place_multi_compact_sharded_fn(mesh: Mesh, round_size: int,
-                                   n_lanes: int):
+                                   n_lanes: int, chained: bool = False):
     """Sharded compact laned multi-eval kernel: same output protocol as
     ops.select.place_multi_compact_packed — (buf_small [T*L, fk+16],
-    fills_full [T*L, round_size], used) — over the node-sharded mesh."""
+    fills_full [T*L, round_size], used) — over the node-sharded mesh.
+    `chained=True`: donated (used0, inp, cand_rows, cand_valid)
+    signature, mirroring place_multi_compact_chained_jit (see
+    place_multi_sharded_packed_fn)."""
     from nomad_tpu.ops.select import FILL_K
     spec_n = P(AXIS)
     in_specs = MultiEvalInputs(
@@ -616,7 +633,13 @@ def place_multi_compact_sharded_fn(mesh: Mesh, round_size: int,
         buf_small = jnp.concatenate([fills[:, :fill_k], meta], axis=1)
         return buf_small, fills, used
 
-    return jax.jit(f)
+    if not chained:
+        return jax.jit(f)
+
+    def f_chained(used0, inp: MultiEvalInputs, cand_rows, cand_valid):
+        return f(inp._replace(used0=used0), cand_rows, cand_valid)
+
+    return jax.jit(f_chained, donate_argnums=(0,))
 
 
 def place_bulk_sharded_packed_fn(mesh: Mesh, round_size: int,

@@ -192,6 +192,29 @@ finally:
     agent.shutdown()
 EOF
 
+echo "== multichip (8-device virtual mesh: parity, scale soak, bench) =="
+# the sharded production path (ISSUE 7): engine-level sharded-vs-single
+# parity + padded-row properties, the resident-chain sharded parity
+# suite, the >=200k-node quality soak, then a 64k-node sharded bench
+# smoke that must report the full 8-way mesh with zero plan refutes.
+# (pytest runs already ride the 8-virtual-device mesh via conftest;
+# bench.py forces it itself with --mesh 8.)
+JAX_PLATFORMS=cpu python -m pytest tests/test_engine_sharded.py -q
+JAX_PLATFORMS=cpu python -m pytest tests/test_wavepipe.py -q \
+    -k "Resident or Sharded or sharded"
+JAX_PLATFORMS=cpu python -m pytest tests/test_multichip_scale.py -q -m slow
+JAX_PLATFORMS=cpu python bench.py --nodes 64000 --evals 16 \
+    --placements 4000 --iters 1 --mesh 8 --quick | python -c '
+import json, sys
+out = json.load(sys.stdin)
+assert out["n_devices"] == 8, out
+assert out["plan_refute_rate"] == 0, out
+assert out["sharded_parity_checked"], out
+assert out["collective_bytes_per_wave"] > 0, out
+print("multichip smoke ok:", out["value"], out["unit"],
+      "n_devices", out["n_devices"],
+      "collective_bytes_per_wave", out["collective_bytes_per_wave"])'
+
 echo "== chaos (seeded fault-injection scenarios on the virtual clock) =="
 # the full chaos suite: every scenario in tests/test_chaos.py with its
 # pinned seed (partition / split-brain / flap storm / lossy raft /

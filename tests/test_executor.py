@@ -49,6 +49,19 @@ class TestMakeExecutor:
     def test_backends_registry(self):
         assert EXECUTOR_BACKENDS == ("jax", "bridge")
 
+    def test_bridge_rejected_on_mesh_at_construction(self):
+        """bridge + a multi-device engine is a CONFIG contradiction: it
+        must fail as an agent_config validation error (ValueError, not
+        ExecutorUnavailable) at make_executor time — i.e. at agent
+        start — whether or not the native build exists."""
+        import jax
+        if jax.device_count() < 2:
+            pytest.skip("needs the virtual multi-device mesh")
+        eng = PlacementEngine()
+        assert eng.mesh is not None
+        with pytest.raises(ValueError, match="agent_config.*mesh"):
+            make_executor("bridge", eng)
+
 
 class TestAgentConfigKnob:
     def test_parse_and_default(self):
@@ -139,6 +152,15 @@ class TestServerWiring:
     def test_server_rejects_unknown_backend(self):
         with pytest.raises(ValueError, match="device_executor"):
             Server(dev_mode=True, device_executor="cuda")
+
+    def test_server_rejects_bridge_on_mesh_at_start(self):
+        """The guard fires at SERVER CONSTRUCTION (agent start), never
+        mid-worker-loop (ISSUE 7 satellite)."""
+        import jax
+        if jax.device_count() < 2:
+            pytest.skip("needs the virtual multi-device mesh")
+        with pytest.raises(ValueError, match="agent_config"):
+            Server(dev_mode=True, device_executor="bridge")
 
     def test_residency_metrics_ride_the_registry(self):
         c0 = REGISTRY.counter("nomad.executor.resident_waves")

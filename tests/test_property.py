@@ -122,6 +122,81 @@ def test_random_workloads_place_validly(seed):
     assert total_live > 0     # the scenario actually exercised placement
 
 
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_padded_rows_unreachable_at_odd_node_counts(seed):
+    """Mesh-padding property (ISSUE 7): with N % n_devices != 0 the
+    sharded engine pads the node axis with ineligible rows — no
+    workload, however oversubscribed, may ever produce an alloc whose
+    node_id is not a live node, and capacity must hold on every real
+    node.  Then node GC shrinks N across a shard boundary (full table
+    rebuild + row remap) and the property must still hold for fresh
+    placements."""
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    rng = random.Random(seed)
+    ndev = jax.device_count()
+    # an explicitly non-multiple node count, small enough to oversubscribe
+    n_nodes = rng.randrange(3 * ndev, 6 * ndev)
+    if n_nodes % ndev == 0:
+        n_nodes += 1 + rng.randrange(ndev - 1)
+    s = Server(dev_mode=True, eval_batch=rng.choice([0, 8]))
+    assert s.engine.mesh is not None
+    s.establish_leadership()
+    nodes = random_cluster(rng, n_nodes)
+    s.state.upsert_nodes(nodes)
+
+    def assert_valid():
+        snap = s.state.snapshot()
+        live_nodes = {nd.id for nd in snap.nodes()}
+        placed = 0
+        for job in snap.jobs():
+            for a in snap.allocs_by_job(job.namespace, job.id):
+                if a.terminal_status():
+                    continue
+                assert a.node_id in live_nodes, \
+                    (job.id, a.node_id, "padded/ghost row placed")
+                placed += 1
+        for nd in snap.nodes():
+            allocs = [a for a in snap.allocs_by_node(nd.id)
+                      if not a.terminal_status()]
+            if allocs:
+                ok, dim, _ = allocs_fit(nd, allocs)
+                assert ok, (nd.id, dim)
+        return placed
+
+    # oversubscribe: ask for far more than the cluster holds
+    for i in range(4):
+        job = random_job(rng, i)
+        job.task_groups[0].count = 200
+        s.register_job(job, now=NOW)
+    s.process_all(now=NOW)
+    assert assert_valid() > 0
+
+    # GC enough nodes to cross a shard boundary (row remap + repad);
+    # real GC only reaps drained nodes, so their allocs terminate first
+    snap = s.state.snapshot()
+    keep = (n_nodes // ndev - 1) * ndev + 1     # still non-multiple
+    for nd in snap.nodes()[keep:]:
+        gone = []
+        for a in snap.allocs_by_node(nd.id):
+            if a.terminal_status():
+                continue
+            dead = a.copy()
+            dead.desired_status = "stop"
+            dead.client_status = "complete"
+            gone.append(dead)
+        if gone:
+            s.state.upsert_allocs(gone)
+        s.state.delete_node(nd.id)
+    for i in range(3):
+        job = random_job(rng, 100 + i)
+        job.task_groups[0].count = 150
+        s.register_job(job, now=NOW)
+    s.process_all(now=NOW)
+    assert_valid()
+
+
 @pytest.mark.parametrize("seed", [11, 12, 13])
 def test_block_reads_equal_classic_reads(seed):
     """Columnar-block state is INVISIBLE to readers: for random bulk

@@ -419,8 +419,14 @@ class TestExecutorResidentParity:
     — including across a forced invalidation (a node knocked out of the
     table mid-run)."""
 
-    def _run_waves(self, nodes, backend, resident, drain_mid=False):
-        s = Server(dev_mode=True, eval_batch=4, device_executor=backend)
+    def _run_waves(self, nodes, backend, resident, drain_mid=False,
+                   mesh=None):
+        """`mesh`: None = the engine's auto choice (the conftest's
+        8-virtual-device mesh -> sharded), False = force the
+        single-device engine (the serial reference the sharded runs
+        must match bit-for-bit)."""
+        s = Server(dev_mode=True, eval_batch=4, device_executor=backend,
+                   mesh=mesh)
         s.executor.chain_enabled = resident
         s.establish_leadership()
         for n in nodes:
@@ -443,6 +449,7 @@ class TestExecutorResidentParity:
             s.process_all(now=NOW)
 
         wave("a")
+        upload_bytes_a = s.executor.stats["upload_bytes"]
         if drain_mid:
             # a node-table write the chain cannot see (drain-style
             # ineligibility; no reschedule evals, so both runs stay on
@@ -451,6 +458,8 @@ class TestExecutorResidentParity:
             s.set_node_eligibility(nodes[0].id, False)
         wave("b")
         stats = dict(s.executor.stats)
+        stats["upload_bytes_wave_a"] = upload_bytes_a
+        stats["shard_h2d_bytes"] = s.engine.shard_h2d_bytes
         refuted = s.plan_applier.stats["plans_refuted"]
         return _contents(s.state), stats, refuted
 
@@ -485,3 +494,44 @@ class TestExecutorResidentParity:
         # node tensors + used uploaded at least once, metered in bytes
         assert stats["uploads"] >= 1
         assert stats["upload_bytes"] > 0
+
+    @pytest.mark.skipif(__import__("jax").device_count() < 2,
+                        reason="needs the virtual multi-device mesh")
+    def test_sharded_resident_matches_single_device_serial(self):
+        """THE promotion contract (ISSUE 7): the 8-way sharded engine
+        riding the retained resident chain lands BIT-FOR-BIT the same
+        state as the serial single-device host-round-trip path."""
+        nodes = _fixed_cluster_nodes(n_nodes=28, seed=7)  # 28 % 8 != 0
+        serial_1dev, st_1, _ = self._run_waves(nodes, "jax", False,
+                                               mesh=False)
+        sharded_res, st_s, refuted = self._run_waves(nodes, "jax", True)
+        assert sharded_res == serial_1dev
+        assert st_1["resident_waves"] == 0
+        assert st_s["resident_waves"] >= 1, st_s
+        assert refuted == 0
+
+    @pytest.mark.skipif(__import__("jax").device_count() < 2,
+                        reason="needs the virtual multi-device mesh")
+    def test_sharded_invalidation_reuploads_one_shard(self):
+        """A mid-run single-node eligibility write dirties ONE shard:
+        the sharded run must invalidate the chain, re-sync only that
+        shard (engine dirty-shard patch, asserted via the executor's
+        upload_bytes meter), and still match the single-device serial
+        run bit-for-bit."""
+        nodes = _fixed_cluster_nodes(n_nodes=64, seed=7)
+        serial_1dev, _, _ = self._run_waves(nodes, "jax", False,
+                                            mesh=False, drain_mid=True)
+        sharded_res, st, refuted = self._run_waves(nodes, "jax", True,
+                                                   drain_mid=True)
+        assert sharded_res == serial_1dev
+        assert refuted == 0
+        assert st["invalidations"] >= 1, st
+        assert st["shard_h2d_bytes"] > 0, \
+            "invalidation fell back to a full-tensor re-sync"
+        # wave b's re-sync (everything after wave a) moved at most the
+        # dirty shard's slice of each tensor — strictly less than wave
+        # a's full upload (8 shards; 2x slack covers the used heal +
+        # per-wave delta scatters)
+        wave_b_bytes = st["upload_bytes"] - st["upload_bytes_wave_a"]
+        assert wave_b_bytes <= 2 * (st["upload_bytes_wave_a"] // 8) + 512, \
+            (wave_b_bytes, st["upload_bytes_wave_a"])
