@@ -31,6 +31,7 @@ from nomad_tpu.core.telemetry import (
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import (
     Allocation,
+    NetworkIndex,
     Plan,
     PlanResult,
     allocs_fit,
@@ -512,8 +513,15 @@ class PlanApplier:
         caller expands to the per-node path."""
         for block in plan.alloc_blocks:
             tmpl = block.template
-            if (tmpl.allocated_ports or tmpl.allocated_devices
-                    or tmpl.resources.networks):
+            if tmpl.allocated_ports or tmpl.allocated_devices:
+                return False
+            if tmpl.resources.networks and block.ports is None:
+                # a networked block must CARRY its columnar port
+                # assignment (ISSUE 8) to ride any block path; with it,
+                # the fenced fast path is as sound as for per-alloc port
+                # plans — evaluate_plan only keeps skip_fit for port
+                # carriers at the chain head (fenced_first), where the
+                # scheduler's NetworkIndex provably saw every live port
                 return False
             for nid in block.node_table:
                 node = snap.node_by_id(nid)
@@ -541,13 +549,15 @@ class PlanApplier:
     @staticmethod
     def _block_demotes(snap, block, pa_nodes) -> bool:
         """Shapes whose re-check the columnar path cannot express — the
-        same demotions _blocks_ok applies (ports/devices/networks, write
-        claims, node-pinned volume modes), plus nodes shared with
-        per-alloc placements (their fit must be checked TOGETHER, which
-        only the expanded per-node path does)."""
+        same demotions _blocks_ok applies (devices, write claims,
+        node-pinned volume modes), plus nodes shared with per-alloc
+        placements (their fit must be checked TOGETHER, which only the
+        expanded per-node path does).  Networked blocks CARRYING their
+        columnar port assignment stay columnar: _eval_blocks audits
+        their ports per node straight off the array (ISSUE 8)."""
         tmpl = block.template
         if (tmpl.allocated_ports or tmpl.allocated_devices
-                or tmpl.resources.networks):
+                or (tmpl.resources.networks and block.ports is None)):
             return True
         if pa_nodes and not pa_nodes.isdisjoint(block.node_table):
             return True
@@ -616,6 +626,18 @@ class PlanApplier:
                 if vol is None or not vol.schedulable:
                     bad.update(b.node_table)
                     break
+        # batched per-node PORT audit input (ISSUE 8): the plan's port
+        # claims per node, aggregated ACROSS port-carrying blocks
+        # straight off the arrays.  A (node, port) claimed twice within
+        # the plan refutes the node outright — no state read needed.
+        plan_ports: Dict[str, set] = {}
+        for b in columnar:
+            for nid, plist in b.ports_by_node().items():
+                claimed = plan_ports.setdefault(nid, set())
+                for port in plist:
+                    if port in claimed:
+                        bad.add(nid)
+                    claimed.add(port)
         # per-node demand aggregated ACROSS blocks (two blocks on one
         # node were fit-checked together on the expanded path)
         total: Dict[str, List[int]] = {}
@@ -640,12 +662,27 @@ class PlanApplier:
             removals = {a.id for a in plan.node_update.get(nid, ())}
             removals.update(
                 a.id for a in plan.node_preemptions.get(nid, ()))
+            # port-carrying nodes: existing used ports collected on the
+            # SAME alloc walk as the capacity sums (the "re-check
+            # batches per node" half of ISSUE 8 — one set build per
+            # node, never a per-alloc allocs_fit materialization)
+            claimed = plan_ports.get(nid) if not skip_fit else None
+            used_ports: Optional[NetworkIndex] = None
+            if claimed:
+                used_ports = NetworkIndex()
+                used_ports.set_node(node)
             for a in snap.allocs_by_node(nid):
                 if a.terminal_status() or a.id in removals:
                     continue
                 cpu += a.resources.cpu
                 mem += a.resources.memory_mb
                 disk += a.resources.disk_mb
+                if used_ports is not None:
+                    used_ports.add_allocs((a,))
+            if used_ports is not None and not claimed.isdisjoint(
+                    used_ports.used_ports):
+                bad.add(nid)
+                continue
             res, rsv = node.resources, node.reserved
             if (cpu > res.cpu - rsv.cpu
                     or mem > res.memory_mb - rsv.memory_mb
@@ -667,10 +704,11 @@ class PlanApplier:
     def _carries_host_assigned(plan: Plan) -> bool:
         """Any placement carrying a port/device assignment — or even just
         a network ask (allocs_fit counts reserved-port asks too).  Block
-        TEMPLATES are inspected too: a block the scheduler should never
-        build (ports ride the per-alloc path) must still demote if a
-        caller hand-built one, because the expanded per-node path only
-        re-checks collisions when skip_fit is off."""
+        TEMPLATES are inspected too: networked blocks carry their port
+        columns (ISSUE 8) and must demote off the skip — their port
+        values were host-assigned against a snapshot a batch-mate's
+        commit may have invalidated; the re-check (columnar per-node
+        port audit in _eval_blocks) only runs when skip_fit is off."""
         for allocs in plan.node_allocation.values():
             for a in allocs:
                 if (a.allocated_ports or a.allocated_devices

@@ -217,7 +217,14 @@ class WavePipeline:
                       # single device) — the per-wave figure bench.py
                       # derives is the acceptance gauge for "top-k is
                       # the only cross-shard collective"
-                      "collective_bytes": 0}
+                      "collective_bytes": 0,
+                      # networked rows whose ports the batched per-node
+                      # carve assigned COLUMNAR (ISSUE 8): networked
+                      # waves no longer demote out of wave coupling, and
+                      # this counter is the proof a wave stayed on the
+                      # block path (the sequential-oracle fallback rides
+                      # nomad.ports.sequential_rows instead)
+                      "port_batched_rows": 0}
 
     # ---------------------------------------------------------- dispatch
 
@@ -311,6 +318,14 @@ class WavePipeline:
         in the executor for the next dequeued batch."""
         self.executor.retain_chain(batch_id, seq0, used_triple,
                                    masked=self.masked_nodes())
+
+    def note_ports_batched(self, n_rows: int) -> None:
+        """A materialize pass carved `n_rows` networked placements'
+        ports columnar (scheduler/generic._carve_ports_batch) — the
+        wave stayed on the block path end to end."""
+        if n_rows:
+            with self._lock:
+                self.stats["port_batched_rows"] += n_rows
 
     # ------------------------------------------------------ refute repair
 

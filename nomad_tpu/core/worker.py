@@ -386,7 +386,12 @@ class Worker:
         # ONE port cache for the whole batch: mates materialize
         # sequentially in this thread, so each sees the previous mates'
         # in-plan port commitments (round-5 verdict #6 — networked
-        # groups ride the batch without colliding)
+        # groups ride the batch without colliding).  Since ISSUE 8 each
+        # mate's ports are carved COLUMNAR per node against this shared
+        # cache (scheduler/generic._carve_ports_batch), so networked
+        # plans stay on the block path — wave coupling, refute-repair
+        # and the resident chain included — instead of demoting to
+        # per-alloc materialize.
         shared_net: Dict[str, object] = {}
 
         wave = pf["pending"].wave if pf["pending"] is not None else -1
@@ -394,11 +399,13 @@ class Worker:
         def submit(i):
             ev, token, sched, prep = work[i]
             try:
+                sched.last_port_carve = 0
                 with self.pipeline.materialize(wave):
                     handles[i] = sched.submit_batched(
                         ev, prep, bds[i],
                         coupled_batch=(batch_id, batch_seq0),
                         net_index_cache=shared_net)
+                self.pipeline.note_ports_batched(sched.last_port_carve)
             except Exception as e:  # noqa: BLE001 - finalize pass nacks
                 handles[i] = e
 

@@ -43,6 +43,13 @@ from .util import ALLOC_RESCHEDULED, tainted_nodes
 MAX_SERVICE_ATTEMPTS = 5
 MAX_BATCH_ATTEMPTS = 2
 
+# Batched port assignment (ISSUE 8): when True, networked fresh blocks
+# ride the columnar path with a per-node bulk port carve; False forces
+# the sequential per-alloc NetworkIndex loop — the PARITY ORACLE the
+# bench gate and tests compare against (bit-for-bit (node, port)
+# equality is the promotion contract, like PR 7's sharded-vs-single).
+PORT_BATCHED = True
+
 # Shared engines so packed node tensors + jit caches persist across evals
 # of one in-process scheduler session (the worker wires its own).  Keyed
 # by the backing store's identity: two Harness/Server instances in one
@@ -85,6 +92,9 @@ class GenericScheduler(Scheduler):
         # counts, the winning metric/top-k, and preemption choices —
         # all host-resident already, so capture costs dict writes only
         self._tg_stats: Dict[str, dict] = {}
+        # rows whose ports the last _materialize_bulk carved COLUMNAR
+        # (the worker mirrors it into the wave pipeline's stats)
+        self.last_port_carve = 0
 
     # ------------------------------------------------------------- process
 
@@ -227,14 +237,18 @@ class GenericScheduler(Scheduler):
         from .device import tg_device_requests
         if tg_device_requests(tg):
             return None
-        # Networked groups RIDE the batch (round-5 verdict #6): the
+        # Networked groups RIDE the batch (round-5 verdict #6), and
+        # since ISSUE 8 they ride the COLUMNAR block path too: the
         # worker threads ONE NetworkIndex cache through every batch
         # mate's materialize pass (materialization is sequential in the
-        # worker thread), so batch-mates landing on one node see each
-        # other's in-plan port commitments and pick disjoint ports.
-        # Safety net: port-carrying plans are demoted from the applier's
-        # skip-fit to the full AllocsFit port re-check, exactly like
-        # solo plans (plan_apply._carries_host_assigned).
+        # worker thread), and each mate's dynamic ports are carved in a
+        # single batched per-node pass (_carve_ports_batch) that lands
+        # as port columns on the AllocBlock — batch-mates landing on one
+        # node commit disjoint ports without per-alloc index round
+        # trips.  Safety net: port-carrying plans are demoted from the
+        # applier's skip-fit to the full re-check, which audits block
+        # ports per node (plan_apply._carries_host_assigned /
+        # _eval_blocks).
         return self.BatchPrep(job, tg, count, block, places, results)
 
     def submit_batched(self, evaluation: Evaluation, prep, bd,
@@ -605,6 +619,55 @@ class GenericScheduler(Scheduler):
             plan.append_alloc(alloc)
             self._note_placed(tg.name, d.metric, evictions=d.evictions)
 
+    @staticmethod
+    def _net_columnar_labels(ask) -> Optional[List[str]]:
+        """The batched-carve-eligible network shape: ONE host network,
+        no static (reserved) ports, uniquely-labeled dynamic ports.
+        Anything else — static asks, multi-network, unlabeled or
+        duplicate labels — rides the sequential per-alloc path, which
+        doubles as the parity oracle (ISSUE 8)."""
+        if len(ask.networks) != 1:
+            return None
+        net = ask.networks[0]
+        if net.reserved_ports or not net.dynamic_ports:
+            return None
+        labels = [p.label for p in net.dynamic_ports]
+        if not all(labels) or len(set(labels)) != len(labels):
+            return None
+        return labels
+
+    def _carve_ports_batch(self, picks_ok, node_ids, n_labels: int,
+                           net_idx, victim_ids):
+        """Vectorized per-node offset scheme (ISSUE 8): group the wave's
+        placements by node, pre-check every node's free dynamic pool
+        against its cumulative demand, then carve each node's ports in
+        ONE cursor pass and scatter them back to rows in row order.
+        Bit-for-bit the sequential per-alloc result — mates landing on
+        one node take ascending first-fit ports in row order, exactly as
+        N ordered assign_ports calls would — without the N sequential
+        index round-trips.  Returns an [n_ok, n_labels] int32 array, or
+        None when any node is short (NOTHING committed — the feasibility
+        pass runs before any claim, so a mid-wave shortfall cannot leak
+        partial claims into the batch-shared index)."""
+        import numpy as np
+        uniq, inv = np.unique(picks_ok, return_inverse=True)
+        counts = np.bincount(inv, minlength=len(uniq)).tolist()
+        indexes = []
+        for r, k in zip(uniq.tolist(), counts):
+            ni = self._net_index(node_ids[int(r)], net_idx, victim_ids)
+            if ni.dyn_free_count() < k * n_labels:
+                return None
+            indexes.append(ni)
+        out = np.empty((len(picks_ok), n_labels), np.int32)
+        order = np.argsort(inv, kind="stable")
+        pos = 0
+        for ni, k in zip(indexes, counts):
+            got = ni.claim_dynamic_block(k * n_labels)
+            out[order[pos:pos + k]] = np.asarray(
+                got, np.int32).reshape(k, n_labels)
+            pos += k
+        return out
+
     def _net_index(self, node_id: str, cache: Dict[str, NetworkIndex],
                    victim_ids) -> NetworkIndex:
         """Per-node port bookkeeping for this plan, built lazily
@@ -858,13 +921,20 @@ class GenericScheduler(Scheduler):
             prefix = f"{job.id}.{tg.name}["     # matches reconcile._name
             indexes = block.indexes
 
-        if (block is not None and not has_net and not bd.evictions
-                and results.deployment is None):
+        net_labels = (self._net_columnar_labels(ask)
+                      if has_net and PORT_BATCHED and block is not None
+                      else None)
+        if (block is not None and not bd.evictions
+                and results.deployment is None
+                and (not has_net or net_labels is not None)):
             # hottest shape (the bench/batch pattern): fresh block, no
-            # ports, no preemptions — stays COLUMNAR end-to-end: the
-            # picks array + shared template become one AllocBlock on the
-            # plan; per-alloc objects never exist on this path (the
-            # store materializes them lazily on first read).
+            # preemptions — stays COLUMNAR end-to-end: the picks array +
+            # shared template become one AllocBlock on the plan;
+            # per-alloc objects never exist on this path (the store
+            # materializes them lazily on first read).  Networked groups
+            # now ride it too (ISSUE 8): dynamic ports are carved per
+            # node in ONE batched pass (bit-for-bit the sequential
+            # result) and land as port COLUMNS on the block.
             import numpy as np
 
             from nomad_tpu.structs import AllocBlock
@@ -872,47 +942,66 @@ class GenericScheduler(Scheduler):
             ok_mask = picks >= 0
             n_ok = int(ok_mask.sum())
             n_fail = count - n_ok
-            if n_fail:
-                # aggregate failure accounting: one stored metric (the
-                # first failing round's), coalesced + queued counters
-                # match the per-pick loop's totals
-                tg_name = tg.name
-                first_fail = int(np.argmin(ok_mask))
-                m = metrics[min(first_fail // rs, len(metrics) - 1)]
-                self._record_failure_shared(tg_name, m)
-                if n_fail > 1:
-                    self.failed_tg_allocs[tg_name].coalesced_failures \
-                        += n_fail - 1
-                    self.queued_allocs[tg_name] = \
-                        self.queued_allocs.get(tg_name, 0) + n_fail - 1
-            if n_ok == 0:
+            picks_ok = (picks[ok_mask] if n_fail else picks) if n_ok \
+                else picks[:0]
+            ports_arr = None
+            if has_net and n_ok:
+                # carve BEFORE any failure accounting: a short node
+                # falls the whole eval back to the sequential per-alloc
+                # oracle below, which keeps its own failure counters
+                ports_arr = self._carve_ports_batch(
+                    picks_ok, node_ids, len(net_labels), net_idx,
+                    victim_ids)
+            if not has_net or n_ok == 0 or ports_arr is not None:
+                if n_fail:
+                    # aggregate failure accounting: one stored metric
+                    # (the first failing round's), coalesced + queued
+                    # counters match the per-pick loop's totals
+                    tg_name = tg.name
+                    first_fail = int(np.argmin(ok_mask))
+                    m = metrics[min(first_fail // rs, len(metrics) - 1)]
+                    self._record_failure_shared(tg_name, m)
+                    if n_fail > 1:
+                        self.failed_tg_allocs[tg_name].coalesced_failures \
+                            += n_fail - 1
+                        self.queued_allocs[tg_name] = \
+                            self.queued_allocs.get(tg_name, 0) + n_fail - 1
+                if n_ok == 0:
+                    return
+                if n_fail:
+                    import itertools
+                    sel = ok_mask.tolist()
+                    ids_ok = list(itertools.compress(ids, sel))
+                    idx_ok = list(itertools.compress(indexes, sel))
+                else:
+                    ids_ok = ids
+                    idx_ok = list(indexes)
+                self._note_placed(tg.name, metrics[0], n=n_ok)
+                if ports_arr is not None:
+                    self.last_port_carve = n_ok
+                    from nomad_tpu.core.telemetry import REGISTRY
+                    REGISTRY.inc("nomad.ports.batched_rows", n_ok)
+                # block-local node table: unique picked rows only
+                # (hundreds), never the full cluster table
+                uniq, inv = np.unique(picks_ok, return_inverse=True)
+                plan.alloc_blocks.append(AllocBlock(
+                    id=new_id(),
+                    template=tmpl,
+                    ids=ids_ok,
+                    name_prefix=prefix,
+                    indexes=idx_ok,
+                    picks=inv.astype(np.int32),
+                    node_table=[node_ids[int(r)] for r in uniq],
+                    metrics=list(metrics),
+                    round_size=rs,
+                    port_labels=(list(net_labels)
+                                 if ports_arr is not None else []),
+                    ports=ports_arr,
+                ))
                 return
-            if n_fail:
-                import itertools
-                sel = ok_mask.tolist()
-                ids_ok = list(itertools.compress(ids, sel))
-                idx_ok = list(itertools.compress(indexes, sel))
-                picks_ok = picks[ok_mask]
-            else:
-                ids_ok = ids
-                idx_ok = list(indexes)
-                picks_ok = picks
-            self._note_placed(tg.name, metrics[0], n=n_ok)
-            # block-local node table: unique picked rows only (hundreds),
-            # never the full cluster table
-            uniq, inv = np.unique(picks_ok, return_inverse=True)
-            plan.alloc_blocks.append(AllocBlock(
-                id=new_id(),
-                template=tmpl,
-                ids=ids_ok,
-                name_prefix=prefix,
-                indexes=idx_ok,
-                picks=inv.astype(np.int32),
-                node_table=[node_ids[int(r)] for r in uniq],
-                metrics=list(metrics),
-                round_size=rs,
-            ))
-            return
+            # a node's dynamic pool was short of the wave's demand:
+            # sequential per-alloc oracle below (runner-up redirects,
+            # per-port exhaustion dimensions)
 
         picks_l = bd.picks.tolist()
         placed_n = 0          # decision-record capture, noted ONCE below
@@ -995,6 +1084,12 @@ class GenericScheduler(Scheduler):
             if victims_n > len(victims_sample):
                 self._tg_stats[tg.name]["preempted"] += (
                     victims_n - len(victims_sample))
+            if has_net:
+                # the sequential oracle ran (ineligible shape, pool
+                # shortfall, or PORT_BATCHED off): meter it so the
+                # batched-vs-sequential split is visible in /v1/metrics
+                from nomad_tpu.core.telemetry import REGISTRY
+                REGISTRY.inc("nomad.ports.sequential_rows", placed_n)
 
     def _record_failure_shared(self, tg_name: str, metric: AllocMetric,
                                copied: bool = False) -> None:

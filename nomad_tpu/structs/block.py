@@ -49,6 +49,15 @@ class AllocBlock:
     # one AllocMetric per water-fill round, shared by the round's allocs
     metrics: List[AllocMetric] = field(default_factory=list)
     round_size: int = 1024
+    # COLUMNAR port assignment (ISSUE 8): ports[i, j] is row i's value for
+    # dynamic-port label port_labels[j].  None for non-networked blocks.
+    # The batched carve in scheduler/generic.py fills these; rows
+    # materialize with per-row allocated_ports dicts and the applier's
+    # per-node port re-check reads them straight off the array
+    # (plan_apply._eval_blocks) — per-alloc objects never exist on the
+    # networked hot path either.
+    port_labels: List[str] = field(default_factory=list)
+    ports: Optional[np.ndarray] = None
     create_index: int = 0
     modify_index: int = 0
 
@@ -85,6 +94,23 @@ class AllocBlock:
         return {nid: (c, c * r.cpu, c * r.memory_mb, c * r.disk_mb)
                 for nid, c in zip(self.node_table, counts) if c}
 
+    def ports_by_node(self) -> Dict[str, list]:
+        """{node_id: [port, ...]} claimed by this block's rows — the
+        applier's batched per-node port re-check input.  One argsort over
+        the picks, no per-alloc objects."""
+        if self.ports is None or not self.ports.size:
+            return {}
+        order = np.argsort(self.picks, kind="stable")
+        grouped = self.ports[order].reshape(len(order), -1)
+        counts = self.node_counts()
+        out: Dict[str, list] = {}
+        pos = 0
+        for nid, c in zip(self.node_table, counts.tolist()):
+            if c:
+                out[nid] = grouped[pos:pos + c].ravel().tolist()
+                pos += c
+        return out
+
     def without_nodes(self, bad_node_ids) -> Optional["AllocBlock"]:
         """A new block with every row placed on `bad_node_ids` dropped —
         the applier's COLUMNAR per-node refute: the surviving rows stay
@@ -117,6 +143,8 @@ class AllocBlock:
             node_table=[self.node_table[int(r)] for r in uniq],
             metrics=list(self.metrics),
             round_size=self.round_size,
+            port_labels=list(self.port_labels),
+            ports=self.ports[keep] if self.ports is not None else None,
         )
 
     def index_of(self, alloc_id: str) -> Optional[int]:
@@ -142,6 +170,9 @@ class AllocBlock:
             rs = self.round_size
             tmpl_d = self.template.__dict__
             ci, mi = self.create_index, self.modify_index
+            plabels = self.port_labels
+            prows = (self.ports.tolist()
+                     if self.ports is not None and plabels else None)
             rows = []
             alloc_new = Allocation.__new__
             n_m = len(metrics) - 1
@@ -157,6 +188,8 @@ class AllocBlock:
                 d["task_states"] = {}
                 d["create_index"] = ci
                 d["modify_index"] = mi
+                if prows is not None:
+                    d["allocated_ports"] = dict(zip(plabels, prows[i]))
                 rows.append(a)
             self._rows = rows
         return self._rows

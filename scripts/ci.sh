@@ -224,6 +224,28 @@ echo "== chaos (seeded fault-injection scenarios on the virtual clock) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
 JAX_PLATFORMS=cpu python -m pytest tests/test_cluster.py -q -m slow
 
+echo "== networked (port parity gate, churn soak, bench smoke) =="
+# batched columnar port assignment (ISSUE 8): the pytest suite runs the
+# batched-vs-sequential parity gate + the NetworkIndex edge cases + the
+# place->kill->replace churn soak, then a --networked --quick bench
+# smoke must report zero (node, port) collisions, a parity-gated run,
+# and a networked rate within the acceptance band of the columnar rate
+JAX_PLATFORMS=cpu python -m pytest tests/test_ports.py -q
+JAX_PLATFORMS=cpu python bench.py --networked --quick | python -c '
+import json, sys
+out = json.load(sys.stdin)
+assert out["port_collisions"] == 0, out
+assert out["port_parity_checked"], out
+assert out["placed"] == out["want"], out
+assert out["port_batched_rows"] > 0, out
+# ratio floor: networked must stay within 3x of the columnar rate at
+# the same shape (the pre-batch per-alloc path sat ~25x under it);
+# CPU-host smoke noise gets a little slack on top of the acceptance
+assert out["networked_vs_columnar_ratio"] <= 4.0, out
+print("networked smoke ok:", out["value"], out["unit"],
+      "ratio", out["networked_vs_columnar_ratio"],
+      "collisions", out["port_collisions"])'
+
 echo "== bench smoke (CPU backend, reduced scale) =="
 JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \
     --placements 2000 --iters 1 | python -c '

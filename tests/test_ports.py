@@ -1,0 +1,451 @@
+"""Batched columnar port assignment (ISSUE 8).
+
+Networked task groups ride the columnar block path: dynamic ports are
+carved per node in one batched pass (scheduler/generic._carve_ports_batch)
+and commit as port columns on the AllocBlock, with the sequential
+per-alloc NetworkIndex loop surviving as the static-port / multi-network
+fallback AND the parity oracle.  This suite covers:
+
+  - NetworkIndex free-cursor semantics: bit-for-bit the linear first-fit
+    scan it replaced, O(1) amortized, failed assignments never burn pool
+    positions
+  - the bulk APIs (claim_dynamic_block / assign_ports_batch) equal n
+    sequential assign+commit calls exactly
+  - batched == sequential end-to-end parity (the bench gate's pytest twin)
+  - edge cases: dynamic-pool exhaustion -> blocked eval naming the
+    exhaustion dimension, static-port conflict vs an in-flight batch
+    mate, preemption-victim ports counted free, port reuse after
+    terminal-alloc GC
+  - churn soak: place -> kill -> replace across >= 3 waves with zero
+    (node, port) collisions among live allocs and no leaked reservations
+"""
+
+import pathlib
+import sys
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server
+from nomad_tpu.structs import (
+    MAX_DYNAMIC_PORT,
+    MIN_DYNAMIC_PORT,
+    NetworkIndex,
+    NetworkResource,
+    Port,
+)
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+NOW = 1.7e9
+
+
+def _linear_pick(used, newly):
+    """The pre-cursor reference implementation: O(pool) first-fit."""
+    for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1):
+        if port not in used and port not in newly:
+            return port
+    return None
+
+
+class TestNetworkIndexCursor:
+    def test_cursor_matches_linear_scan(self):
+        import random
+        rnd = random.Random(7)
+        ni = NetworkIndex()
+        ni.used_ports.update(rnd.sample(
+            range(MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT + 200), 120))
+        for _ in range(150):
+            want = _linear_pick(ni.used_ports, set())
+            got, err = ni.assign_ports(
+                [NetworkResource(dynamic_ports=[Port(label="p")])])
+            assert err == "" and got == {"p": want}
+            ni.commit(got)
+
+    def test_failed_assign_does_not_burn_pool_positions(self):
+        ni = NetworkIndex()
+        # first pick succeeds transiently, then the reserved ask collides
+        # -> whole assignment fails, nothing committed
+        got, err = ni.assign_ports([NetworkResource(
+            dynamic_ports=[Port(label="p")],
+            reserved_ports=[Port(label="r", value=MIN_DYNAMIC_PORT)]),
+            NetworkResource(
+                reserved_ports=[Port(label="r2",
+                                     value=MIN_DYNAMIC_PORT)])])
+        assert got is None and "collision" in err
+        # the next assignment still gets the linear scan's answer:
+        # NOTHING from the failed call was committed, so first-fit
+        # starts from the bottom of the pool again
+        assert _linear_pick(ni.used_ports, set()) == MIN_DYNAMIC_PORT
+        got, err = ni.assign_ports(
+            [NetworkResource(dynamic_ports=[Port(label="p")])])
+        assert got == {"p": MIN_DYNAMIC_PORT}, got
+
+    def test_pick_dynamic_exhaustion(self):
+        ni = NetworkIndex()
+        ni.used_ports.update(range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1))
+        got, err = ni.assign_ports(
+            [NetworkResource(dynamic_ports=[Port(label="p")])])
+        assert got is None
+        assert err == "network: dynamic port exhaustion"
+
+    def test_claim_dynamic_block(self):
+        ni = NetworkIndex()
+        ni.used_ports.update({MIN_DYNAMIC_PORT + 1, MIN_DYNAMIC_PORT + 3})
+        got = ni.claim_dynamic_block(3)
+        assert got == [MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT + 2,
+                       MIN_DYNAMIC_PORT + 4]
+        assert set(got) <= ni.used_ports          # committed
+        # all-or-nothing on shortfall: nothing claimed
+        free_before = ni.dyn_free_count()
+        assert ni.claim_dynamic_block(free_before + 1) is None
+        assert ni.dyn_free_count() == free_before
+
+    def test_assign_ports_batch_matches_sequential(self):
+        import copy
+        ask = [NetworkResource(dynamic_ports=[Port(label="http"),
+                                              Port(label="admin")])]
+        a = NetworkIndex()
+        a.used_ports.update({MIN_DYNAMIC_PORT + 2, MIN_DYNAMIC_PORT + 5})
+        b = copy.deepcopy(a)
+        batch, err = a.assign_ports_batch(ask, 5)
+        assert err == "" and len(batch) == 5
+        seq = []
+        for _ in range(5):
+            got, err = b.assign_ports(ask)
+            assert err == ""
+            b.commit(got)
+            seq.append(got)
+        assert batch == seq
+        assert a.used_ports == b.used_ports
+
+    def test_assign_ports_batch_static_falls_back(self):
+        ni = NetworkIndex()
+        got, err = ni.assign_ports_batch(
+            [NetworkResource(reserved_ports=[Port(label="r", value=80)])],
+            2)
+        assert got is None and "sequential" in err
+
+    def test_dyn_free_count(self):
+        ni = NetworkIndex()
+        pool = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+        assert ni.dyn_free_count() == pool
+        ni.used_ports.add(MIN_DYNAMIC_PORT)
+        ni.used_ports.add(80)                      # outside the pool
+        assert ni.dyn_free_count() == pool - 1
+        ni.used_ports.update(range(MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT + 10))
+        assert ni.dyn_free_count() == pool - 10
+
+
+class TestBatchedSequentialParity:
+    def test_port_parity_gate(self):
+        """The bench gate's pytest twin: the same seeded networked
+        workload through the batched carve and the sequential oracle
+        commits bit-for-bit identical (job, name) -> (node, ports)."""
+        import bench
+        assert bench._port_parity_gate(seed=31) > 0
+
+
+def _networked_server(n_nodes=4, eval_batch=0, node_cpu=100000):
+    s = Server(dev_mode=True, eval_batch=eval_batch)
+    s.establish_leadership()
+    nodes = []
+    for _ in range(n_nodes):
+        n = mock.node()
+        n.resources.cpu = node_cpu
+        n.resources.memory_mb = 100000
+        s.register_node(n, now=NOW)
+        nodes.append(n)
+    return s, nodes
+
+
+def _networked_job(count, labels=("http",), cpu=10, mem=10, static=None):
+    job = mock.batch_job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    net = NetworkResource(
+        dynamic_ports=[Port(label=lb) for lb in labels])
+    if static is not None:
+        net.reserved_ports.append(Port(label="static", value=static))
+    tg.tasks[0].resources.networks = [net]
+    return job
+
+
+def _live_ports(state, jobs):
+    """{(node, port), ...} over live allocs; asserts uniqueness."""
+    seen = set()
+    live = 0
+    snap = state.snapshot()
+    for job in jobs:
+        for a in snap.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status():
+                continue
+            live += 1
+            for port in a.allocated_ports.values():
+                key = (a.node_id, port)
+                assert key not in seen, f"(node, port) collision {key}"
+                seen.add(key)
+    return seen, live
+
+
+class TestColumnarNetworkedPath:
+    def test_block_path_carries_ports(self):
+        """A block-sized networked eval commits COLUMNAR — a live
+        AllocBlock with port columns, no per-alloc table rows — and
+        every materialized row carries a unique (node, port)."""
+        s, _ = _networked_server()
+        job = _networked_job(96, labels=("http", "admin"))
+        s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        assert s.state._alloc_blocks, "networked placements should block"
+        blk = next(iter(s.state._alloc_blocks.values()))
+        assert blk.port_labels == ["http", "admin"]
+        assert blk.ports is not None and blk.ports.shape == (96, 2)
+        assert not s.state._allocs_by_job.get((job.namespace, job.id))
+        seen, live = _live_ports(s.state, [job])
+        assert live == 96 and len(seen) == 192
+        s.shutdown()
+
+    def test_exhaustion_blocks_eval_with_dimension(self):
+        """Dynamic-pool exhaustion: the carve bails to the sequential
+        oracle, which places what fits and parks the rest in a blocked
+        eval whose metric names the exhaustion dimension (the `eval
+        explain` surface)."""
+        from nomad_tpu.core.explain import blocked_cause
+
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        n = mock.node()
+        n.resources.cpu = 100000
+        n.resources.memory_mb = 100000
+        # all but 10 dynamic ports pre-reserved on the node
+        n.reserved.reserved_ports = list(
+            range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT - 9))
+        s.register_node(n, now=NOW)
+        job = _networked_job(66, labels=("http", "admin"))  # wants 132
+        ev = s.register_job(job, now=NOW)
+        s.process_all(now=NOW)
+        seen, live = _live_ports(s.state, [job])
+        assert live == 5                      # 10 free ports / 2 per alloc
+        assert len(seen) == 10
+        done = s.state.eval_by_id(ev.id)
+        assert done.status == "complete"
+        metric = done.failed_tg_allocs[job.task_groups[0].name]
+        assert metric.dimension_exhausted.get(
+            "network: dynamic port exhaustion"), metric.dimension_exhausted
+        cause = blocked_cause(done.failed_tg_allocs)
+        assert "dynamic port exhaustion" in cause, cause
+        # a blocked eval carries the unplaced remainder
+        assert done.blocked_eval, "expected a blocked eval"
+        s.shutdown()
+
+    def test_static_port_conflict_vs_in_flight_batch_mate(self):
+        """Two batch-mates asking the same static port on a one-node
+        cluster: the shared per-batch NetworkIndex hands the port to the
+        first mate and refuses the second — one winner, no double
+        commit, loser blocked on the collision dimension."""
+        s = Server(dev_mode=True, eval_batch=8)
+        s.establish_leadership()
+        n = mock.node()
+        n.resources.cpu = 100000
+        n.resources.memory_mb = 100000
+        s.register_node(n, now=NOW)
+        jobs = [_networked_job(1, labels=("http",), static=8080)
+                for _ in range(2)]
+        evs = [s.register_job(j, now=NOW) for j in jobs]
+        s.process_all(now=NOW)
+        snap = s.state.snapshot()
+        holders = [a for j in jobs
+                   for a in snap.allocs_by_job(j.namespace, j.id)
+                   if not a.terminal_status()]
+        assert len(holders) == 1, [h.allocated_ports for h in holders]
+        assert holders[0].allocated_ports["static"] == 8080
+        loser = next(e for e, j in zip(evs, jobs)
+                     if j.id != holders[0].job_id)
+        done = s.state.eval_by_id(loser.id)
+        exhausted = done.failed_tg_allocs[
+            jobs[0].task_groups[0].name].dimension_exhausted
+        assert any("reserved port collision" in d for d in exhausted), \
+            exhausted
+        s.shutdown()
+
+    def test_preemption_victim_ports_counted_free(self):
+        """_net_index victim exclusion: a preemption victim's ports do
+        not block the preemptor's assignment on the same node."""
+        from nomad_tpu.scheduler import Harness
+        from nomad_tpu.scheduler.generic import GenericScheduler
+
+        h = Harness()
+        n = mock.node()
+        h.state.upsert_node(n)
+        job = mock.job()
+        h.state.upsert_job(job)
+        victim = mock.alloc(job=job, node_id=n.id)
+        victim.allocated_ports = {"http": MIN_DYNAMIC_PORT}
+        h.state.upsert_allocs([victim])
+        sched = GenericScheduler(h.state.snapshot(), h, now=NOW)
+        cache = {}
+        with_victim = sched._net_index(n.id, cache, {victim.id})
+        assert MIN_DYNAMIC_PORT not in with_victim.used_ports
+        without = sched._net_index(n.id, {}, set())
+        assert MIN_DYNAMIC_PORT in without.used_ports
+
+    def test_port_reuse_after_terminal_gc(self):
+        """Ports freed by terminal allocs are reclaimed by the next
+        wave: first-fit restarts from the bottom of the pool, so the
+        replacement allocs reuse the exact freed values — reservations
+        do not leak across alloc lifecycles.  ONE node, so the
+        wave-to-wave pick distribution cannot shift the per-node port
+        sequences (eval ids seed the kernel's tie-break noise)."""
+        s, _ = _networked_server(n_nodes=1)
+        job1 = _networked_job(70)
+        s.register_job(job1, now=NOW)
+        s.process_all(now=NOW)
+        first_ports, live = _live_ports(s.state, [job1])
+        assert live == 70
+        # kill wave 1 (client reports every alloc complete)
+        for a in list(s.state.allocs_by_job(job1.namespace, job1.id)):
+            upd = a.copy_skip_job()
+            upd.client_status = "complete"
+            s.state.update_allocs_from_client([upd])
+        job2 = _networked_job(70)
+        s.register_job(job2, now=NOW)
+        s.process_all(now=NOW)
+        second_ports, live2 = _live_ports(s.state, [job2])
+        assert live2 == 70
+        # freed (node, port) pairs are reused, not leaked: the second
+        # wave's claims sit in the same bottom-of-pool range
+        assert second_ports == first_ports
+
+
+class TestApplierColumnarPortAudit:
+    """The commit-time safety net (plan_apply._eval_blocks): port-
+    carrying blocks stay COLUMNAR through the full re-check, with a
+    per-node used-port set built on the same alloc walk as the capacity
+    sums — colliding nodes refute by masking rows out of the block."""
+
+    @staticmethod
+    def _applier():
+        from nomad_tpu.core import PlanApplier, PlanQueue
+        from nomad_tpu.state import StateStore
+        state = StateStore()
+        q = PlanQueue()
+        q.set_enabled(True)
+        return state, q, PlanApplier(state, q)
+
+    @staticmethod
+    def _port_block(job, nodes, ports):
+        import numpy as np
+        from nomad_tpu.structs import AllocBlock, Allocation, new_ids
+        tmpl = Allocation(
+            namespace=job.namespace, job_id=job.id, job=job,
+            task_group=job.task_groups[0].name, desired_status="run",
+            client_status="pending",
+            resources=job.task_groups[0].combined_resources())
+        uniq = sorted(set(nodes))
+        row = {nid: i for i, nid in enumerate(uniq)}
+        n = len(nodes)
+        return AllocBlock(
+            id="blk-test", template=tmpl, ids=new_ids(n),
+            name_prefix=f"{job.id}.{job.task_groups[0].name}[",
+            indexes=list(range(n)),
+            picks=np.array([row[nid] for nid in nodes], np.int32),
+            node_table=uniq, metrics=[], round_size=max(n, 1),
+            port_labels=["http"],
+            ports=np.array([[p] for p in ports], np.int32))
+
+    def test_collision_with_existing_alloc_refutes_columnar(self):
+        from nomad_tpu.structs import Plan
+        state, q, applier = self._applier()
+        n1, n2 = mock.node(), mock.node()
+        for n in (n1, n2):
+            n.resources.cpu = 100000
+            n.resources.memory_mb = 100000
+            state.upsert_node(n)
+        job = _networked_job(2)
+        state.upsert_job(job)
+        holder = mock.alloc(job=job, node_id=n1.id)
+        holder.allocated_ports = {"http": MIN_DYNAMIC_PORT}
+        state.upsert_allocs([holder])
+        # a stale scheduler assigned n1's already-held port
+        plan = Plan(eval_id="e1", job=job)
+        plan.alloc_blocks.append(self._port_block(
+            job, [n1.id, n2.id], [MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT]))
+        pending = q.enqueue(plan)
+        applier.apply_one(pending)
+        result, err = pending.wait(1)
+        assert err is None
+        assert result.refuted_nodes == [n1.id]
+        # the surviving row committed COLUMNAR on n2 with its port
+        assert result.alloc_blocks and len(result.alloc_blocks[0].ids) == 1
+        live = [a for a in state.snapshot().allocs_by_node(n2.id)
+                if not a.terminal_status()]
+        assert len(live) == 1
+        assert live[0].allocated_ports == {"http": MIN_DYNAMIC_PORT}
+
+    def test_within_plan_duplicate_refutes_node(self):
+        from nomad_tpu.structs import Plan
+        state, q, applier = self._applier()
+        n1 = mock.node()
+        n1.resources.cpu = 100000
+        n1.resources.memory_mb = 100000
+        state.upsert_node(n1)
+        job = _networked_job(2)
+        state.upsert_job(job)
+        plan = Plan(eval_id="e1", job=job)
+        plan.alloc_blocks.append(self._port_block(
+            job, [n1.id, n1.id], [MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT]))
+        pending = q.enqueue(plan)
+        applier.apply_one(pending)
+        result, err = pending.wait(1)
+        assert err is None
+        assert result.refuted_nodes == [n1.id]
+        assert not result.alloc_blocks
+        assert not [a for a in state.snapshot().allocs_by_node(n1.id)
+                    if not a.terminal_status()]
+
+
+class TestPortChurnSoak:
+    def test_churn_three_waves_no_collisions_no_leaks(self):
+        """place -> kill -> replace across >= 3 waves on a small cluster
+        (mates pile onto the same nodes): after every wave, zero
+        (node, port) collisions among LIVE allocs; after the churn, the
+        per-node live port count exactly matches the live asks (no
+        leaked reservations holding pool positions)."""
+        s, nodes = _networked_server(n_nodes=3, eval_batch=16)
+        all_jobs = []
+        prev_jobs = []
+        for wave in range(4):
+            jobs = [_networked_job(66, labels=("http", "admin"))
+                    for _ in range(2)]
+            for j in jobs:
+                s.register_job(j, now=NOW + wave)
+            s.process_all(now=NOW + wave)
+            all_jobs.extend(jobs)
+            # live-set audit over EVERY job ever placed
+            seen, live = _live_ports(s.state, all_jobs)
+            want_live = 132 * (1 + bool(prev_jobs))
+            assert live == want_live, (wave, live)
+            assert len(seen) == 2 * live
+            # kill the previous wave (replace pattern: the wave before
+            # stays live so two waves' ports always coexist)
+            for j in prev_jobs:
+                for a in list(s.state.allocs_by_job(j.namespace, j.id)):
+                    if a.terminal_status():
+                        continue
+                    upd = a.copy_skip_job()
+                    upd.client_status = "complete"
+                    s.state.update_allocs_from_client([upd])
+            prev_jobs = jobs
+        # no leaked reservations: a fresh NetworkIndex built per node
+        # from live state claims exactly the live allocs' ports
+        snap = s.state.snapshot()
+        seen, live = _live_ports(s.state, all_jobs)
+        assert live == 132                    # only the last wave lives
+        for node in nodes:
+            ni = NetworkIndex()
+            ni.set_node(node)
+            ni.add_allocs(snap.allocs_by_node(node.id))
+            node_live = {p for (nid, p) in seen if nid == node.id}
+            assert ni.used_ports == node_live, node.id
+        s.shutdown()
