@@ -793,6 +793,10 @@ def run_config_5(args):
     # warmup wave: identical batch/launch shapes as the measured wave so
     # every kernel compile happens here (tiny asks -> negligible capacity)
     run_wave(batch, per_eval, cpu=1, mem=1, tag="warmup")
+    # health-watchdog baseline (core/flightrec.py): this first check
+    # pins the counter deltas, so the final verdict below covers every
+    # measured wave — the north-star run must report zero SLO breaches
+    s.health.check()
 
     # best of --iters measured waves, like configs 2-4: the shared
     # host's steal/iowait noise swings single runs ~30%.  Later waves
@@ -1063,6 +1067,20 @@ def run_config_5(args):
     zone_counts = sorted(per_zone.values())
     zone_balance = (round(zone_counts[-1] / zone_counts[0], 2)
                     if zone_counts[0] else float("inf"))
+    # health plane (core/flightrec.py): per-wave device-time quantiles
+    # off the cumulative wavepipe histogram, flight-ring occupancy, and
+    # the SLO verdict over the whole run's counter deltas — the clean
+    # north-star run MUST report zero breaches (the standing gate the
+    # soak simulator asserts against)
+    from nomad_tpu.core.flightrec import FLIGHT
+    from nomad_tpu.core.telemetry import REGISTRY as _REG
+    dev_hist = _REG.histogram("nomad.wavepipe.device_s") or {}
+    health = s.health.check()
+    slo_breaches = sum(1 for r in health["Rules"] if not r["Ok"])
+    assert slo_breaches == 0, ("clean north-star run breached SLOs",
+                               [r for r in health["Rules"]
+                                if not r["Ok"]])
+    flight_occupancy = len(FLIGHT.waves())
     s.shutdown()
     # the LEADING ratio is against the realistic middle tier (round-5
     # verdict #1): the flat-array tier is reported as the labeled upper
@@ -1114,6 +1132,14 @@ def run_config_5(args):
             # bytes, and whether the small-scale sharded-vs-single
             # parity gate ran before the timed waves
             "n_devices": n_devices,
+            # health plane (ISSUE 9): per-wave device-time latency
+            # quantiles, flight-recorder ring occupancy, and the SLO
+            # verdict count (asserted 0 above — reported so the
+            # BENCH_r0x trajectory carries the gate's value)
+            "wave_device_s_p50": dev_hist.get("p50", 0.0),
+            "wave_device_s_p99": dev_hist.get("p99", 0.0),
+            "flight_ring_occupancy": flight_occupancy,
+            "slo_breaches": slo_breaches,
             "padded_row_fraction": round(
                 s.engine.padded_row_fraction(n_nodes), 6),
             "collective_bytes_per_wave": round(collective_per_wave, 1),

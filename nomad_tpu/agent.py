@@ -36,7 +36,8 @@ class Agent:
                  transport: str = "tcp",
                  clock: str = "wall",
                  log_level: str = "",
-                 device_executor: str = "jax") -> None:
+                 device_executor: str = "jax",
+                 slo: Optional[Dict[str, float]] = None) -> None:
         # producer-side log gate (agent_config log_level): records below
         # this level never reach the ring or its subscribers.  Only set
         # when explicitly configured — the process-wide ring default
@@ -108,14 +109,15 @@ class Agent:
                 num_workers=num_workers, heartbeat_ttl=heartbeat_ttl,
                 acl_enabled=acl_enabled,
                 transport=self.transport, clock=self.clock,
-                device_executor=device_executor)
+                device_executor=device_executor, slo=slo)
         else:
             self.transport = resolve_transport(transport, node_name="agent",
                                                clock=self.clock)
             self.server = Server(num_workers=num_workers, dev_mode=False,
                                  heartbeat_ttl=heartbeat_ttl,
                                  acl_enabled=acl_enabled, clock=self.clock,
-                                 device_executor=device_executor)
+                                 device_executor=device_executor,
+                                 slo=slo)
         self.clients: List[Client] = []
         if client_enabled:
             if cluster_mode:

@@ -14,6 +14,15 @@ Supported shape (a practical subset of the reference's):
       transport       = "tcp"      # or "sim"  (nomad_tpu/chaos/)
       clock           = "wall"     # or "virtual"
       device_executor = "jax"      # or "bridge" (nomad_tpu/ops/executor.py)
+      slo {                        # health watchdog (core/flightrec.py)
+        p99_plan_queue_ms   = 500
+        refute_rate         = 0.25
+        invalidations_per_s = 50
+        networked_ratio     = 0.25
+        heartbeat_misses    = 64
+        window_s            = 60
+        interval_s          = 5
+      }
     }
     client {
       enabled    = true
@@ -67,6 +76,10 @@ class AgentConfig:
     # buffers and errors at agent start when the native build or PJRT
     # plugin is absent (never a silent fallback)
     device_executor: str = "jax"
+    # health-watchdog SLO thresholds (core/flightrec.py DEFAULT_SLO);
+    # only the keys present here override the defaults, and a negative
+    # threshold disables its rule
+    slo: Dict[str, float] = field(default_factory=dict)
 
     def merge(self, other: "AgentConfig",
               set_fields: set) -> "AgentConfig":
@@ -165,6 +178,33 @@ def parse_agent_config(src: str):
                             "server device_executor must be 'jax' or "
                             f"'bridge', got {v!r}")
                     put("device_executor", v)
+                for b in sub_blocks:
+                    if b.type != "slo":
+                        raise ValueError(
+                            f"unknown server block {b.type!r}")
+                    # mirror core.flightrec.DEFAULT_SLO; literal so
+                    # config parsing stays import-light
+                    known_slo = {"p99_plan_queue_ms", "refute_rate",
+                                 "invalidations_per_s",
+                                 "networked_ratio", "heartbeat_misses",
+                                 "window_s", "interval_s"}
+                    slo = {}
+                    for a in b.body:
+                        if not isinstance(a, Attr):
+                            raise ValueError("slo accepts only "
+                                             "key = number settings")
+                        if a.name not in known_slo:
+                            raise ValueError(
+                                f"unknown slo setting {a.name!r} "
+                                f"(expected one of {sorted(known_slo)})")
+                        v = _literal(a.expr)
+                        if isinstance(v, bool) or not isinstance(
+                                v, (int, float)):
+                            raise ValueError(
+                                f"slo {a.name} must be a number, "
+                                f"got {v!r}")
+                        slo[a.name] = float(v)
+                    put("slo", slo)
             elif node.type == "client":
                 if "enabled" in body:
                     put("client_enabled", bool(body["enabled"]))

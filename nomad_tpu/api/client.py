@@ -299,8 +299,22 @@ class Operator(_Endpoint):
 
     def debug(self) -> Dict:
         """The `operator debug` bundle: stats + metrics + traces +
-        log tail + threads in one document."""
+        log tail + health plane + threads in one document."""
         return self.c.get("/v1/operator/debug")
+
+    def health(self, dumps: bool = False) -> Dict:
+        """SLO verdicts (observed vs threshold per rule); `dumps=True`
+        folds the retained breach dump bundles in."""
+        params = {"dumps": "true"} if dumps else {}
+        return self.c.request("GET", "/v1/operator/health",
+                              params=params)
+
+    def flight_recorder(self, n: Optional[int] = None) -> Dict:
+        """The flight recorder's recent per-wave / per-eval / event
+        rings; `n` caps each ring's tail."""
+        params = {"n": n} if n else {}
+        return self.c.request("GET", "/v1/operator/flight-recorder",
+                              params=params)
 
 
 class System(_Endpoint):

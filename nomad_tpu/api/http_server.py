@@ -493,12 +493,34 @@ class Router:
                         "Leader": raft.leader_name == name,
                         "Voter": True})
                 return {"Servers": servers}
+            if p[1:2] == ["health"] and method == "GET":
+                # SLO verdicts, observed-vs-threshold (the health
+                # watchdog re-evaluates on demand; ?dumps=true folds the
+                # retained breach dump bundles in)
+                doc = s.health.check()
+                if (qs.get("dumps") or ["false"])[0] == "true":
+                    doc["DumpBundles"] = s.health.dumps()
+                return doc
+            if p[1:2] == ["flight-recorder"] and method == "GET":
+                # the bounded recent-history view of the wave hot path
+                # (core/flightrec.py); ?n= caps each ring's tail
+                from nomad_tpu.core.flightrec import FLIGHT
+                n = None
+                if qs.get("n"):
+                    try:
+                        n = max(int(qs["n"][0]), 1)
+                    except ValueError:
+                        raise APIError(400, "bad n")
+                return FLIGHT.snapshot(n_waves=n, n_evals=n, n_events=n)
             if p[1:2] == ["debug"] and method == "GET":
                 # debug bundle (reference: `nomad operator debug`
                 # capture): stats + metrics + prometheus exposition +
-                # recent traces/spans + LogRing tail + threads, one doc
+                # recent traces/spans + LogRing tail + threads + the
+                # health plane (verdicts, dump bundles, flight rings),
+                # one doc
                 import sys as _sys
                 import threading as _threading
+                from nomad_tpu.core.flightrec import FLIGHT
                 from nomad_tpu.core.logging import RING
                 from nomad_tpu.core.telemetry import TRACER
                 return {
@@ -508,9 +530,14 @@ class Router:
                         format="prometheus"),
                     "Traces": TRACER.traces()[-100:],
                     "Spans": TRACER.spans()[-500:],
+                    "TracerDroppedSpans": TRACER.dropped,
                     "SchedulerConfig": codec.encode(
                         s.state.snapshot().scheduler_config()),
                     "Logs": RING.tail(500),
+                    "Health": s.health.check(),
+                    "HealthDumps": s.health.dumps(),
+                    "FlightRecorder": FLIGHT.snapshot(
+                        n_waves=100, n_evals=200, n_events=100),
                     "Threads": [
                         {"Name": t.name, "Daemon": t.daemon,
                          "Alive": t.is_alive()}

@@ -130,7 +130,8 @@ def cmd_agent(args) -> int:
                   transport=cfg.transport,
                   clock=cfg.clock,
                   log_level=cfg.log_level,
-                  device_executor=cfg.device_executor)
+                  device_executor=cfg.device_executor,
+                  slo=cfg.slo or None)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")
@@ -876,6 +877,85 @@ def cmd_operator_debug(args) -> int:
     return 0
 
 
+def cmd_health(args) -> int:
+    """SLO verdicts from the health watchdog (core/flightrec.py):
+    one row per rule, observed vs threshold.  Exit 0 healthy, 1 when
+    any rule is breached (scriptable, like a health check)."""
+    doc = _client(args).operator.health()
+    print(f"Healthy      = {doc.get('Healthy')}")
+    print(f"Breaches     = {doc.get('Breaches', 0)} "
+          f"(checks {doc.get('Checks', 0)}, "
+          f"dump bundles {doc.get('Dumps', 0)})")
+    print(f"Window       = {doc.get('WindowS', 0):.0f}s")
+    print(f"{'Rule':<22} {'Kind':<8} {'Observed':>12} "
+          f"{'Threshold':>12}  {'Status'}")
+    for r in doc.get("Rules", []):
+        obs = r.get("Observed")
+        obs_s = "-" if obs is None else f"{obs:g}"
+        thr = r.get("Threshold", 0)
+        thr_s = "off" if thr < 0 else f"{thr:g}"
+        status = "OK" if r.get("Ok") else "BREACH"
+        print(f"{r.get('Rule', ''):<22} {r.get('Kind', ''):<8} "
+              f"{obs_s:>12} {thr_s:>12}  {status} "
+              f"({r.get('Unit', '')})")
+    return 0 if doc.get("Healthy") else 1
+
+
+def cmd_debug_record(args) -> int:
+    """Flight-recorder tail (`nomad debug record`): recent per-wave and
+    per-eval records; `-dump` fetches the health watchdog's retained
+    breach dump bundles instead."""
+    c = _client(args)
+    if args.dump:
+        doc = c.operator.health(dumps=True)
+        bundles = doc.get("DumpBundles", [])
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(bundles, f, indent=2)
+            print(f"{len(bundles)} dump bundle(s) written to "
+                  f"{args.output}")
+        else:
+            _out(bundles)
+        return 0
+    rec = c.operator.flight_recorder(n=args.n or None)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(f"flight recorder written to {args.output} "
+              f"({len(rec.get('Waves', []))} waves, "
+              f"{len(rec.get('Evals', []))} evals)")
+        return 0
+    stats = rec.get("Stats", {})
+    cap = rec.get("Capacity", {})
+    print(f"Waves  = {len(rec.get('Waves', []))} "
+          f"(ring {cap.get('waves', '?')}, "
+          f"evicted {stats.get('wave_evictions', 0)})")
+    print(f"Evals  = {len(rec.get('Evals', []))} "
+          f"(ring {cap.get('evals', '?')}, "
+          f"evicted {stats.get('eval_evictions', 0)})")
+    print(f"Events = {len(rec.get('Events', []))}")
+    waves = rec.get("Waves", [])[-10:]
+    if waves:
+        print(f"\n{'Wave':>6} {'Items':>6} {'Chain':>6} "
+              f"{'Device(ms)':>11} {'Commit(ms)':>11} {'Refuted':>8}")
+        for w in waves:
+            print(f"{w.get('Wave', 0):>6} {w.get('items', 0):>6} "
+                  f"{'res' if w.get('resident') else '-':>6} "
+                  f"{w.get('device_s', 0) * 1000:>11.2f} "
+                  f"{w.get('commit_s', 0) * 1000:>11.2f} "
+                  f"{w.get('refuted_nodes', 0):>8}")
+    evals = rec.get("Evals", [])[-10:]
+    if evals:
+        print(f"\n{'Eval':<10} {'Type':<9} {'Outcome':<8} "
+              f"{'Sched(ms)':>10} {'Queue(ms)':>10}")
+        for e in evals:
+            print(f"{e.get('EvalID', '')[:8]:<10} "
+                  f"{e.get('type', ''):<9} {e.get('outcome', ''):<8} "
+                  f"{e.get('schedule_s', 0) * 1000:>10.2f} "
+                  f"{e.get('queue_wait_s', 0) * 1000:>10.2f}")
+    return 0
+
+
 def cmd_metrics(args) -> int:
     """reference: `nomad operator metrics [-format prometheus]`."""
     c = _client(args)
@@ -1329,6 +1409,21 @@ def build_parser() -> argparse.ArgumentParser:
     met.add_argument("-format", default="json",
                      choices=["json", "prometheus"])
     met.set_defaults(fn=cmd_metrics)
+
+    hl = sub.add_parser("health",
+                        help="SLO verdicts (observed vs threshold)")
+    hl.set_defaults(fn=cmd_health)
+
+    dbg = sub.add_parser("debug",
+                         help="flight recorder & dump bundles"
+                         ).add_subparsers(dest="debug_cmd", required=True)
+    dr = dbg.add_parser("record")
+    dr.add_argument("-dump", action="store_true",
+                    help="fetch the retained breach dump bundles")
+    dr.add_argument("-n", type=int, default=0,
+                    help="cap each ring's tail")
+    dr.add_argument("-output", default="")
+    dr.set_defaults(fn=cmd_debug_record)
 
     trc = sub.add_parser("trace",
                          help="eval-lifecycle traces").add_subparsers(

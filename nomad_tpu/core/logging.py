@@ -10,18 +10,43 @@ Loss is COUNTED, never silent (core/telemetry.py registry series
 the oldest quarter of the ring, and a full subscriber queue sheds the
 record for that subscriber only.  `min_level` is the producer-side gate,
 set from agent_config's `log_level` (records below it never touch the
-lock — the ack log sits on the eval hot path)."""
+lock — the ack log sits on the eval hot path).
+
+`trace_scope(trace_id)` stamps the active eval's trace id onto every
+record logged inside it (thread-local): a health dump bundle's log tail
+joins its traces without the callers threading ids into every log call
+— the worker's schedule path and the plan applier run inside one."""
 
 from __future__ import annotations
 
 import queue
 import threading
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from nomad_tpu.core.telemetry import REGISTRY
 
 LEVELS = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
+
+# active trace context, per thread (worker schedule / applier apply)
+_TLS = threading.local()
+
+
+def current_trace() -> str:
+    return getattr(_TLS, "trace_id", "")
+
+
+@contextmanager
+def trace_scope(trace_id: str):
+    """Records logged inside this scope carry `trace_id` (unless the
+    call passes its own).  Nests; empty ids are a no-op scope."""
+    prev = getattr(_TLS, "trace_id", "")
+    _TLS.trace_id = trace_id or prev
+    try:
+        yield
+    finally:
+        _TLS.trace_id = prev
 
 
 class LogRing:
@@ -41,6 +66,10 @@ class LogRing:
                "component": component, "msg": msg}
         if fields:
             rec.update(fields)
+        if "trace_id" not in rec:
+            tid = current_trace()
+            if tid:
+                rec["trace_id"] = tid
         trimmed = 0
         with self._lock:
             self._buf.append(rec)

@@ -21,7 +21,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.chaos.clock import Clock, SystemClock
-from nomad_tpu.core.logging import log
+from nomad_tpu.core.flightrec import FLIGHT
+from nomad_tpu.core.logging import log, trace_scope
 from nomad_tpu.core.telemetry import (
     REGISTRY,
     TRACER,
@@ -254,30 +255,40 @@ class PlanApplier:
     def apply_one(self, pending: PendingPlan) -> None:
         plan = pending.plan
         t0 = self.clock.monotonic()
+        wait = 0.0
         if pending.enqueue_t:
             wait = max(0.0, t0 - pending.enqueue_t)
-            REGISTRY.observe("nomad.plan.queue_wait_s", wait)
+            # windowed: the p99 plan-queue SLO (core/flightrec.py) reads
+            # the rolling view of this series, not the lifetime one
+            REGISTRY.observe_windowed("nomad.plan.queue_wait_s", wait)
             if plan.trace_id:
                 TRACER.record("plan.queue_wait", plan.trace_id,
                               t0 - wait, t0,
                               parent=span_id(plan.trace_id,
                                              "worker.schedule"),
                               eval_id=plan.eval_id)
-        if self.timers is not None:
-            with self.timers.time("commit"):
+        with trace_scope(plan.trace_id):
+            if self.timers is not None:
+                with self.timers.time("commit"):
+                    self._apply_one(pending)
+            else:
                 self._apply_one(pending)
-        else:
-            self._apply_one(pending)
         t1 = self.clock.monotonic()
         REGISTRY.observe("nomad.plan.apply_s", t1 - t0)
+        refuted = (len(pending.result.refuted_nodes)
+                   if pending.result is not None else 0)
+        # eval tail record: merges with the worker's settle stamps under
+        # the same eval id (a multi-plan eval accumulates)
+        FLIGHT.record_eval(plan.eval_id, queue_wait_s=round(wait, 9),
+                           apply_s=round(t1 - t0, 9),
+                           refuted_nodes=refuted)
         if plan.trace_id:
             TRACER.record("plan.apply", plan.trace_id, t0, t1,
                           parent=span_id(plan.trace_id, "worker.schedule"),
                           eval_id=plan.eval_id,
                           error=type(pending.error).__name__
                           if pending.error is not None else "",
-                          refuted=len(pending.result.refuted_nodes)
-                          if pending.result is not None else 0)
+                          refuted=refuted)
 
     def _apply_one(self, pending: PendingPlan) -> None:
         plan = pending.plan

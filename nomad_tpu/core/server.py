@@ -38,7 +38,7 @@ from nomad_tpu.structs import (
     new_id,
 )
 
-from . import telemetry
+from . import flightrec, telemetry
 from .logging import log
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
@@ -63,7 +63,8 @@ class Server:
                  nack_timeout: Optional[float] = None,
                  clock: Optional[Clock] = None,
                  device_executor: str = "jax",
-                 mesh=None) -> None:
+                 mesh=None,
+                 slo: Optional[Dict[str, float]] = None) -> None:
         # injected timebase (chaos/clock.py): every endpoint default
         # `now`, heartbeat deadline, and the tick loop read this clock,
         # so a chaos scenario's VirtualClock owns the whole server's
@@ -74,6 +75,7 @@ class Server:
         # simulated cluster share a clock already, so last-write wins is
         # benign)
         telemetry.configure(self.clock)
+        flightrec.configure(self.clock)
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -147,6 +149,24 @@ class Server:
         self._leader = False
         # capacity-change events release blocked evals
         self.state.subscribe(self._on_state_event)
+        # health watchdog (core/flightrec.py): declarative SLO rules
+        # (agent_config server.slo.*) evaluated each tick against the
+        # rolling-window histograms and counter deltas; a breach emits a
+        # HealthBreach event and snapshots a dump bundle
+        self.health = flightrec.HealthWatchdog(slo=slo, clock=self.clock)
+        self.health.on_breach = self._on_health_breach
+
+    def _on_health_breach(self, verdict: Dict, bundle: Dict) -> None:
+        """Fan a newly-breached SLO rule out as a HealthBreach event
+        (live + replayable from the stream buffer) and a log record."""
+        doc = {"Rule": verdict["Rule"], "Kind": verdict["Kind"],
+               "Observed": verdict["Observed"],
+               "Threshold": verdict["Threshold"],
+               "Unit": verdict["Unit"], "At": bundle["At"]}
+        self.events._on_state_event(
+            "HealthBreach", max(self.state.latest_index(), 1), doc)
+        log("health", "error", "SLO breach", rule=verdict["Rule"],
+            observed=verdict["Observed"], threshold=verdict["Threshold"])
 
     # --------------------------------------------------------- leadership
 
@@ -683,6 +703,10 @@ class Server:
         """Periodic leader duties: broker delayed-eval promotion + nack
         timeouts, heartbeat expiry."""
         t = now if now is not None else self.clock.time()
+        # the health watchdog is node-local observability, not a leader
+        # duty: followers evaluate their own SLOs too (throttled to
+        # slo.interval_s; reads the monotonic clock like the windows)
+        self.health.tick(self.clock.monotonic())
         if not self._leader:
             # followers carry no timers/queues; their copies of these
             # duties belong to the leader (reference: leaderLoop)
@@ -702,6 +726,8 @@ class Server:
         for node_id in self.heartbeats.expired(t):
             log("heartbeat", "warn", "node heartbeat missed; marking down",
                 node_id=node_id)
+            # the flap-storm SLO rule counts these per check interval
+            telemetry.REGISTRY.inc("nomad.heartbeat.missed")
             evals = invalidate_heartbeat(self.state, node_id, t)
             self.apply_eval_update(evals, now=t)
         self.deployments.tick(t)

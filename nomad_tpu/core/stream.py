@@ -35,6 +35,10 @@ _TYPE_BY_TOPIC = {
     "Evaluation": "EvaluationUpdated",
     "Allocations": "AllocationUpdated",
     "Deployment": "DeploymentStatusUpdate",
+    # health watchdog SLO breaches (core/flightrec.py): published by the
+    # Server's on_breach hook, not by a store commit — payload is the
+    # breach verdict dict, keyed by rule name
+    "HealthBreach": "HealthBreach",
 }
 
 
@@ -79,6 +83,9 @@ def _expand(topic: str, index: int, payload) -> List[Event]:
                 for a in payload]
     if topic not in _TYPE_BY_TOPIC:
         return []
+    if topic == "HealthBreach":
+        key = payload.get("Rule", "") if isinstance(payload, dict) else ""
+        return [Event("HealthBreach", "HealthBreach", key, index, payload)]
     if isinstance(payload, (str, tuple)):
         key = payload if isinstance(payload, str) else payload[-1]
         return [Event(topic, f"{topic}Deregistered", key, index, None)]

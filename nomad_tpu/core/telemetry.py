@@ -90,18 +90,81 @@ class Histogram:
         return out
 
 
+class WindowedHistogram:
+    """Rolling-window histogram: a ring of sub-window `Histogram`s
+    rotated by the injected clock, so windowed quantiles cover only the
+    last `window_s` seconds of samples.  A cumulative histogram drowns a
+    p99 regression in hours of healthy history; this one forgets.
+
+    Rotation is purely a function of `now` (sub-window index =
+    `now // sub_s`), so under a `VirtualClock` the same observation
+    schedule yields byte-identical windowed summaries — the property the
+    flight-recorder determinism tests pin.  NOT internally locked — the
+    registry serializes every access, like `Histogram`."""
+
+    __slots__ = ("window_s", "n_sub", "sub_s", "buckets", "_subs",
+                 "rotations")
+
+    def __init__(self, window_s: float = 60.0, n_sub: int = 6,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        self.window_s = float(window_s)
+        self.n_sub = max(int(n_sub), 1)
+        self.sub_s = self.window_s / self.n_sub
+        self.buckets = tuple(buckets)
+        # (sub-window index, Histogram), oldest first
+        self._subs: deque = deque()
+        self.rotations = 0
+
+    def _rotate(self, now: float) -> int:
+        epoch = int(now // self.sub_s)
+        while self._subs and self._subs[0][0] <= epoch - self.n_sub:
+            self._subs.popleft()
+            self.rotations += 1
+        return epoch
+
+    def observe(self, value: float, now: float) -> None:
+        epoch = self._rotate(now)
+        if not self._subs or self._subs[-1][0] != epoch:
+            self._subs.append((epoch, Histogram(self.buckets)))
+        self._subs[-1][1].observe(value)
+
+    def merged(self, now: float) -> Histogram:
+        """One Histogram over every sample still inside the window."""
+        self._rotate(now)
+        h = Histogram(self.buckets)
+        for _, sub in self._subs:
+            for i, c in enumerate(sub.counts):
+                h.counts[i] += c
+            h.sum += sub.sum
+            h.count += sub.count
+        return h
+
+    def summary(self, now: float) -> Dict[str, float]:
+        out = self.merged(now).summary()
+        out["window_s"] = self.window_s
+        return out
+
+
 class MetricsRegistry:
     """Thread-safe metric store.  Names are dotted (`nomad.broker.wait_s`);
     a trailing `_s` marks seconds and renders as `_seconds` in the
     prometheus exposition.  Labels are optional keyword args on every
     record call."""
 
-    def __init__(self, clock: Optional[Clock] = None) -> None:
+    def __init__(self, clock: Optional[Clock] = None,
+                 window_s: float = 60.0, window_subs: int = 6) -> None:
         self._lock = threading.Lock()
         self.clock: Clock = clock if clock is not None else SystemClock()
         self._counters: Dict[LabelKey, float] = {}
         self._gauges: Dict[LabelKey, float] = {}
         self._hists: Dict[LabelKey, Histogram] = {}
+        # rolling-window companions for series recorded through
+        # observe_windowed (eval latency, plan-queue wait, wave device
+        # time): the cumulative histogram keeps the lifetime view, the
+        # window keeps the last `window_s` seconds for SLO verdicts
+        self._windows: Dict[LabelKey, WindowedHistogram] = {}
+        self._window_s = float(window_s)
+        self._window_subs = int(window_subs)
 
     def set_clock(self, clock: Clock) -> None:
         self.clock = clock
@@ -121,6 +184,28 @@ class MetricsRegistry:
         k = _key(name, labels)
         with self._lock:
             self._observe_locked(k, value)
+
+    def observe_windowed(self, name: str, value: float, **labels) -> None:
+        """Record into BOTH the cumulative histogram and the series'
+        rolling window, under one lock acquisition.  The window's
+        rotation reads the injected clock, so virtual-time runs produce
+        byte-identical windowed summaries."""
+        k = _key(name, labels)
+        now = self.clock.monotonic()
+        with self._lock:
+            self._observe_locked(k, value)
+            w = self._windows.get(k)
+            if w is None:
+                self._windows[k] = w = WindowedHistogram(
+                    self._window_s, self._window_subs)
+            w.observe(value, now)
+
+    def set_window(self, window_s: float, n_sub: int = 6) -> None:
+        """Resize the rolling window for FUTURE series (agent_config
+        server.slo.window_s); existing windows keep their span."""
+        with self._lock:
+            self._window_s = float(window_s)
+            self._window_subs = int(n_sub)
 
     def _observe_locked(self, k: LabelKey, value: float) -> None:
         h = self._hists.get(k)
@@ -152,6 +237,22 @@ class MetricsRegistry:
             h = self._hists.get(_key(name, labels))
             return h.summary() if h is not None else None
 
+    def window_summary(self, name: str,
+                       **labels) -> Optional[Dict[str, float]]:
+        """Rolling-window p50/p95/p99+sum/count for a series recorded
+        via observe_windowed; None when the series has no window."""
+        now = self.clock.monotonic()
+        with self._lock:
+            w = self._windows.get(_key(name, labels))
+            return w.summary(now) if w is not None else None
+
+    def counter_sum(self, name: str) -> float:
+        """Sum of one counter name across ALL of its label sets (e.g.
+        `nomad.executor.invalidations` regardless of reason)."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counters.items()
+                       if n == name)
+
     @staticmethod
     def _flat(k: LabelKey) -> str:
         name, labels = k
@@ -161,8 +262,9 @@ class MetricsRegistry:
         return f"{name}{{{inner}}}"
 
     def snapshot(self) -> Dict[str, Dict]:
-        """JSON-safe dump: {counters, gauges, histograms} keyed by
-        `name` or `name{label=value,...}`."""
+        """JSON-safe dump: {counters, gauges, histograms, windows} keyed
+        by `name` or `name{label=value,...}`."""
+        now = self.clock.monotonic()
         with self._lock:
             return {
                 "counters": {self._flat(k): v
@@ -171,6 +273,8 @@ class MetricsRegistry:
                            for k, v in sorted(self._gauges.items())},
                 "histograms": {self._flat(k): h.summary()
                                for k, h in sorted(self._hists.items())},
+                "windows": {self._flat(k): w.summary(now)
+                            for k, w in sorted(self._windows.items())},
             }
 
     # --------------------------------------------------------- exposition
@@ -200,6 +304,7 @@ class MetricsRegistry:
         """Text exposition (format 0.0.4): counters, gauges, and
         histograms with CUMULATIVE `_bucket{le=...}` series plus
         `_sum`/`_count`, and `_p50/_p95/_p99` estimate gauges."""
+        now = self.clock.monotonic()
         with self._lock:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
@@ -207,6 +312,8 @@ class MetricsRegistry:
                                 {q: h.quantile(val)
                                  for q, val in _QUANTILES}))
                            for k, h in self._hists.items())
+            windows = sorted((k, w.summary(now))
+                             for k, w in self._windows.items())
         lines: List[str] = []
         typed: set = set()
 
@@ -243,6 +350,19 @@ class MetricsRegistry:
                 head(qname, "gauge")
                 lines.append(f"{qname}{self._prom_labels(labels)} "
                              f"{self._fmt(round(est, 9))}")
+        # rolling-window estimates as gauges: <name>_window_pXX/_count —
+        # the SLO plane's view (the cumulative family above never forgets)
+        for (name, labels), s in windows:
+            pname = self._prom_name(name)
+            for q in ("p50", "p95", "p99"):
+                qname = f"{pname}_window_{q}"
+                head(qname, "gauge")
+                lines.append(f"{qname}{self._prom_labels(labels)} "
+                             f"{self._fmt(round(s[q], 9))}")
+            cname = f"{pname}_window_count"
+            head(cname, "gauge")
+            lines.append(f"{cname}{self._prom_labels(labels)} "
+                         f"{self._fmt(float(s['count']))}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -250,6 +370,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._hists.clear()
+            self._windows.clear()
 
 
 class StatCounters:
@@ -344,6 +465,10 @@ class Tracer:
         self.clock: Clock = clock if clock is not None else SystemClock()
         self._spans: deque = deque(maxlen=max_spans)
         self._seq = 0
+        # overflow accounting: the bounded ring trims the oldest span per
+        # append once full — counted, never silent (the LogRing posture,
+        # `nomad.logring.dropped`), and surfaced in the debug bundle
+        self.dropped = 0
 
     def set_clock(self, clock: Clock) -> None:
         self.clock = clock
@@ -363,10 +488,16 @@ class Tracer:
         }
         if attrs:
             rec["Attrs"] = dict(attrs)
+        overflow = False
         with self._lock:
             self._seq += 1
             rec["Seq"] = self._seq
+            if len(self._spans) == self._spans.maxlen:
+                overflow = True          # append below trims the oldest
+                self.dropped += 1
             self._spans.append(rec)
+        if overflow:
+            REGISTRY.inc("nomad.tracer.dropped_spans")
         return rec
 
     @contextmanager
@@ -415,6 +546,7 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self._seq = 0
+            self.dropped = 0
 
 
 # -------------------------------------------------------------- globals

@@ -38,6 +38,7 @@ this module owns wave sequencing, timing, and the refuted-node mask.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -45,6 +46,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from nomad_tpu.core.flightrec import FLIGHT
 from nomad_tpu.core.telemetry import REGISTRY
 
 # stage names, in pipeline order.  "device" = kernel execution after the
@@ -56,6 +58,11 @@ STAGES = ("dispatch", "device", "d2h", "materialize", "commit")
 # per-stage interval ring size: a bench run records a few thousand
 # intervals; the ring bounds memory on long-lived servers
 _RING = 4096
+
+# process-global wave numbering: the flight recorder merges per-wave
+# records by wave id, and the StageTimers + applier are shared across
+# every worker's pipeline — per-pipeline numbering would collide
+_WAVE_SEQ = itertools.count(1)
 
 
 def _merged(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
@@ -98,8 +105,16 @@ class StageTimers:
             ring.append((wave, t0, t1))
         # per-stage latency distribution on the process registry
         # (core/telemetry.py): the interval ring above keeps proving the
-        # overlap; the histogram adds p50/p95/p99 to /v1/metrics
-        REGISTRY.observe(f"nomad.wavepipe.{stage}_s", t1 - t0)
+        # overlap; the histogram adds p50/p95/p99 to /v1/metrics.  Device
+        # time additionally feeds a ROLLING window (the health plane's
+        # per-wave device-time SLO view), and every stage interval lands
+        # on the wave's flight record.
+        if stage == "device":
+            REGISTRY.observe_windowed(f"nomad.wavepipe.{stage}_s",
+                                      t1 - t0)
+        else:
+            REGISTRY.observe(f"nomad.wavepipe.{stage}_s", t1 - t0)
+        FLIGHT.record_wave(wave, **{f"{stage}_s": round(t1 - t0, 9)})
 
     @contextmanager
     def time(self, stage: str, wave: int = -1):
@@ -237,9 +252,9 @@ class WavePipeline:
         chained dispatches carry the refuted-node mask, fresh dispatches
         clear it (their packer-synced usage already accounts every
         commit)."""
+        wave = next(_WAVE_SEQ)
         with self._lock:
-            self._seq += 1
-            wave = self._seq
+            self._seq = wave
             if used0_dev is None:
                 self._masked.clear()
             mask = frozenset(self._masked) if self._masked else None
@@ -256,6 +271,21 @@ class WavePipeline:
             with self._lock:
                 self.stats["collective_bytes"] += \
                     int(pending["collective_bytes"])
+        # flight record (core/flightrec.py): the wave's launch shape +
+        # the engine/executor gauges the dispatch already computed —
+        # one merge call, nothing new measured on the hot path
+        fields: Dict[str, object] = {"items": len(items),
+                                     "chained": used0_dev is not None,
+                                     "masked_nodes": len(mask or ())}
+        if isinstance(pending, dict):
+            fields["resident"] = bool(pending.get("chained"))
+            for key in ("collective_bytes", "shard_h2d_bytes"):
+                if pending.get(key):
+                    fields[key] = int(pending[key])
+            if pending.get("padded_fraction") is not None:
+                fields["padded_row_fraction"] = round(
+                    float(pending["padded_fraction"]), 6)
+        FLIGHT.record_wave(wave, **fields)
         return WaveHandle(wave=wave, pending=pending, items=list(items),
                           t_dispatch=(t0, t1))
 
@@ -319,13 +349,14 @@ class WavePipeline:
         self.executor.retain_chain(batch_id, seq0, used_triple,
                                    masked=self.masked_nodes())
 
-    def note_ports_batched(self, n_rows: int) -> None:
+    def note_ports_batched(self, n_rows: int, wave: int = -1) -> None:
         """A materialize pass carved `n_rows` networked placements'
         ports columnar (scheduler/generic._carve_ports_batch) — the
         wave stayed on the block path end to end."""
         if n_rows:
             with self._lock:
                 self.stats["port_batched_rows"] += n_rows
+            FLIGHT.record_wave(wave, port_batched_rows=n_rows)
 
     # ------------------------------------------------------ refute repair
 
@@ -341,6 +372,10 @@ class WavePipeline:
             self._masked.update(node_ids)
             self.stats["masked_nodes"] += len(self._masked) - before
             self.stats["repairs"] += 1
+            last_wave = self._seq
+        # the refutes belong to this pipeline's newest wave (the applier
+        # refuted a plan of an already-dispatched wave)
+        FLIGHT.record_wave(last_wave, refuted_nodes=len(node_ids))
 
     def masked_nodes(self) -> frozenset:
         with self._lock:

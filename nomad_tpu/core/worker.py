@@ -11,7 +11,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from nomad_tpu.core.logging import log
+from nomad_tpu.core.flightrec import FLIGHT
+from nomad_tpu.core.logging import log, trace_scope
 from nomad_tpu.core.telemetry import (
     REGISTRY,
     TRACER,
@@ -150,28 +151,38 @@ class Worker:
         broker = self.server.eval_broker
         # schedule duration = dequeue -> settle, per scheduler type: the
         # batched path's span covers its share of the shared device wait
-        # too (that IS this eval's schedule latency)
+        # too (that IS this eval's schedule latency).  Windowed: this is
+        # the health plane's eval-latency SLO series.
         t1 = TRACER.clock.monotonic()
         t0 = self._sched_t0.pop(evaluation.id, t1)
-        REGISTRY.observe("nomad.worker.schedule_s", t1 - t0,
-                         type=evaluation.type)
+        outcome = "ack" if err is None else "nack"
+        REGISTRY.observe_windowed("nomad.worker.schedule_s", t1 - t0,
+                                  type=evaluation.type)
+        # flight-recorder eval tail (core/flightrec.py): joins the
+        # applier's queue-wait/apply stamps recorded under the same id
+        FLIGHT.record_eval(evaluation.id, type=evaluation.type,
+                           worker=self.id, outcome=outcome,
+                           schedule_s=round(t1 - t0, 9),
+                           trace_id=evaluation.trace_id,
+                           job_id=evaluation.job_id)
         if evaluation.trace_id:
             TRACER.record("worker.schedule", evaluation.trace_id, t0, t1,
                           parent=span_id(evaluation.trace_id, "eval"),
                           worker=self.id, type=evaluation.type,
-                          outcome="ack" if err is None else "nack")
-        if err is None:
-            broker.ack(evaluation.id, token)
-            self.stats.inc("acked")
-            log("worker", "debug", "eval acked", worker=self.id,
-                eval_id=evaluation.id, job_id=evaluation.job_id,
-                type=evaluation.type)
-        else:
-            broker.nack(evaluation.id, token, now=t)
-            self.stats.inc("nacked")
-            log("worker", "warn", "eval nacked", worker=self.id,
-                eval_id=evaluation.id, job_id=evaluation.job_id,
-                error=str(err))
+                          outcome=outcome)
+        with trace_scope(evaluation.trace_id):
+            if err is None:
+                broker.ack(evaluation.id, token)
+                self.stats.inc("acked")
+                log("worker", "debug", "eval acked", worker=self.id,
+                    eval_id=evaluation.id, job_id=evaluation.job_id,
+                    type=evaluation.type)
+            else:
+                broker.nack(evaluation.id, token, now=t)
+                self.stats.inc("nacked")
+                log("worker", "warn", "eval nacked", worker=self.id,
+                    eval_id=evaluation.id, job_id=evaluation.job_id,
+                    error=str(err))
 
     def run_batch(self, max_n: int, timeout: float = 0.0,
                   now: Optional[float] = None) -> int:
@@ -400,12 +411,14 @@ class Worker:
             ev, token, sched, prep = work[i]
             try:
                 sched.last_port_carve = 0
-                with self.pipeline.materialize(wave):
+                with trace_scope(ev.trace_id), \
+                        self.pipeline.materialize(wave):
                     handles[i] = sched.submit_batched(
                         ev, prep, bds[i],
                         coupled_batch=(batch_id, batch_seq0),
                         net_index_cache=shared_net)
-                self.pipeline.note_ports_batched(sched.last_port_carve)
+                self.pipeline.note_ports_batched(sched.last_port_carve,
+                                                 wave)
             except Exception as e:  # noqa: BLE001 - finalize pass nacks
                 handles[i] = e
 
@@ -438,10 +451,11 @@ class Worker:
                     if isinstance(h, Exception):
                         err = h
                     else:
-                        err = (sched.finalize_batched(
-                                   ev, h, pipeline=self.pipeline)
-                               if h is not None
-                               else sched.process(ev))  # solo fallback
+                        with trace_scope(ev.trace_id):
+                            err = (sched.finalize_batched(
+                                       ev, h, pipeline=self.pipeline)
+                                   if h is not None
+                                   else sched.process(ev))  # solo fallback
                 except Exception as e:  # noqa: BLE001 - nack, don't die
                     err = e
                 to_settle.append((ev, token, err))
@@ -490,7 +504,10 @@ class Worker:
                                   **kwargs)
         except ValueError as e:
             return e
-        return sched.process(evaluation)
+        # log records emitted while scheduling carry the eval's trace id
+        # (core/logging.trace_scope): a dump bundle's logs join its traces
+        with trace_scope(evaluation.trace_id):
+            return sched.process(evaluation)
 
     # ----------------------------------------------------------- Planner
 

@@ -1242,6 +1242,11 @@ class PlacementEngine:
         way the kernel can avoid re-picking them."""
         if not items:
             return None
+        # per-dispatch dirty-shard upload meter: build_multi_inputs pays
+        # any shard patches this launch needs; the delta rides the
+        # pending dict so the wave pipeline's flight record carries the
+        # per-wave figure without a second engine read
+        shard_b0 = self.shard_h2d_bytes
         built = self.build_multi_inputs(snapshot, items, seed=seed,
                                         used0_dev=used0_dev,
                                         masked_node_ids=masked_node_ids)
@@ -1311,6 +1316,9 @@ class PlacementEngine:
                 "perm": aux["perm"], "fills_full": fills_full,
                 "fill_k": fill_k, "chained": chained,
                 "collective_bytes": coll_bytes,
+                "shard_h2d_bytes": self.shard_h2d_bytes - shard_b0,
+                "padded_fraction":
+                    (aux["npad"] - aux["n"]) / aux["npad"],
                 "prep_ns": time.perf_counter_ns() - aux["t0"]}
 
     def build_multi_inputs(self, snapshot, items: Sequence[BatchItem],

@@ -54,6 +54,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from nomad_tpu.core.flightrec import FLIGHT
 from nomad_tpu.core.telemetry import REGISTRY
 
 EXECUTOR_BACKENDS = ("jax", "bridge")
@@ -190,6 +191,10 @@ class DeviceExecutor:
         with self._lock:
             self.stats["invalidations"] += 1
         REGISTRY.inc("nomad.executor.invalidations", reason=reason)
+        # the flight ring's event lane: an invalidation STORM (every wave
+        # re-uploading node state) is an SLO rule, and the dump bundle
+        # should show which writes caused it
+        FLIGHT.record_event("executor.invalidation", reason=reason)
 
     def _release_chain(self, chain) -> None:
         """Backend hook: free device resources a dropped chain held."""
@@ -468,6 +473,8 @@ class BridgeExecutor(DeviceExecutor):
             "n": built["n"], "npad": built["npad"],
             "node_version": t.version, "perm": built["perm"],
             "chained": chained,
+            "padded_fraction":
+                (built["npad"] - built["n"]) / built["npad"],
             "prep_ns": time.perf_counter_ns() - built["t0"],
         }
         self._note_dispatch(pending, used0_dev is not None)

@@ -124,6 +124,75 @@ finally:
     agent.shutdown()
 EOF
 
+echo "== health smoke (unmeetable SLO -> breach + dump bundle) =="
+# the dump-on-anomaly plane (core/flightrec.py): boot a dev agent with
+# a deliberately-unmeetable plan-queue SLO, drive a workload, and
+# assert /v1/operator/health reports the breach, the retained dump
+# bundle validates against the schema, and the HealthBreach event
+# replays from the stream buffer
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import time
+import urllib.request
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.structs import codec
+
+agent = Agent(num_clients=1, num_workers=1, heartbeat_ttl=3600,
+              slo={"p99_plan_queue_ms": 1e-9, "interval_s": 0.0}).start()
+api = APIClient(address=agent.address)
+try:
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {"run_for_s": 120}
+    api.jobs.register(codec.encode(job))
+    deadline = time.time() + 30
+    doc = {}
+    while time.time() < deadline:
+        doc = api.operator.health(dumps=True)
+        if not doc["Healthy"]:
+            break
+        time.sleep(0.2)
+    assert not doc["Healthy"], doc
+    bad = {r["Rule"] for r in doc["Rules"] if not r["Ok"]}
+    assert "p99_plan_queue_ms" in bad, doc["Rules"]
+    bundles = doc["DumpBundles"]
+    assert bundles, "breach produced no dump bundle"
+    for key in ("Schema", "At", "Breaches", "Verdicts", "SLO",
+                "FlightRecorder", "Windows", "Traces", "Spans", "Logs"):
+        assert key in bundles[0], sorted(bundles[0])
+    assert bundles[0]["Schema"] == "nomad-tpu.health-dump.v1"
+    assert any(b["Rule"] == "p99_plan_queue_ms"
+               for b in bundles[0]["Breaches"])
+    assert "nomad.plan.queue_wait_s" in bundles[0]["Windows"]
+    assert bundles[0]["FlightRecorder"]["Evals"], "flight ring empty"
+    # the breach rode the event stream: replay from the buffer
+    url = agent.address + "/v1/event/stream?topic=HealthBreach:*&index=0"
+    got = None
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        for line in resp:
+            line = line.strip()
+            if not line or line == b"{}":
+                continue
+            for e in json.loads(line).get("Events", []):
+                if e["Topic"] == "HealthBreach":
+                    got = e
+                    break
+            if got:
+                break
+    assert got and got["Key"] == "p99_plan_queue_ms", got
+    # the CLI verdict exits non-zero on breach (scriptable health check)
+    from nomad_tpu.cli import main
+    rc = main(["-address", agent.address, "health"])
+    assert rc == 1, rc
+    print(f"health smoke ok: breach={sorted(bad)} "
+          f"dumps={len(bundles)} event={got['Key']}")
+finally:
+    agent.shutdown()
+EOF
+
 echo "== executor smoke (device-resident worker loop, jax backend) =="
 # boot a dev agent on the default JAX device executor, push a
 # multi-wave workload through the REAL eval-driven path, and assert
@@ -252,6 +321,9 @@ JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \
 import json, sys
 out = json.load(sys.stdin)
 assert out["value"] > 0, out
-print("smoke ok:", out["metric"], out["value"], out["unit"])'
+assert out["slo_breaches"] == 0, out
+assert out["wave_device_s_p99"] > 0, out
+print("smoke ok:", out["metric"], out["value"], out["unit"],
+      "slo_breaches", out["slo_breaches"])'
 
 echo "== CI green =="
