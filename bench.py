@@ -1247,6 +1247,35 @@ def _build_bench_items(args):
     return h, nodes, items, n_nodes, n_evals, per_eval
 
 
+def run_soak(args):
+    """--soak: the virtual-time production soak (chaos/soak.py) as a
+    bench mode, so the soak summary JSON (soak_virtual_hours,
+    soak_evals, soak_breaches, converged_fingerprint) lands next to the
+    bench JSONs in CI.  --quick shrinks to the churn-heavy smoke
+    profile; the default replays the full 2h-virtual cluster-day with
+    chaos scenarios interleaved.  Exits non-zero if any gate failed —
+    a soak regression fails the bench run the same way a scheduling
+    regression fails the smoke."""
+    from nomad_tpu.chaos.soak import run_soak as _run
+    from nomad_tpu.chaos.traffic import TrafficProfile
+
+    if args.quick:
+        profile = TrafficProfile(
+            hours=0.1, n_nodes=4, n_zones=2, service_per_hour=30,
+            batch_per_hour=30, drains_per_hour=10,
+            flap_storms_per_hour=10, flap_storm_nodes=2,
+            preempt_storms_per_hour=10, chaos_scenarios=())
+    else:
+        profile = TrafficProfile()
+    r = _run(seed=args.soak_seed, profile=profile)
+    out = dict(r.summary)
+    out["violations"] = sorted(r.violations)
+    if not r.ok:
+        print(json.dumps(out))
+        raise SystemExit(1)
+    return out
+
+
 def run_networked(args):
     """--networked: batched throughput for NETWORKED task groups.  Since
     ISSUE 8 networked plans ride the COLUMNAR block path: dynamic ports
@@ -1746,6 +1775,12 @@ def main():
     ap.add_argument("--phases", action="store_true",
                     help="report the measured wave's wall-time split "
                          "across pipeline phases (host vs device)")
+    ap.add_argument("--soak", action="store_true",
+                    help="virtual-time production soak (chaos/soak.py):"
+                         " seeded cluster-day replay gated on live SLOs;"
+                         " --quick shrinks to the churny smoke profile")
+    ap.add_argument("--soak-seed", type=int, default=0,
+                    help="seed for --soak (same seed, same bytes)")
     args = ap.parse_args()
     _apply_mesh_arg(args)
     if args.phases:
@@ -1762,6 +1797,10 @@ def main():
                   "(view with xprof/tensorboard)", file=sys.stderr)
             return out
         return RUNNERS[c](args)
+
+    if args.soak:
+        print(json.dumps(run_soak(args)))
+        return
 
     if args.networked:
         print(json.dumps(run_networked(args)))

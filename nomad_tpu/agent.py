@@ -128,7 +128,8 @@ class Agent:
                 # same transport as the server plane: under "sim" the
                 # clients' RPC frames ride the simulated fabric too
                 rpc = RemoteRPC([self.server.rpc.addr],
-                                transport=self.transport)
+                                transport=self.transport,
+                                clock=self.clock)
             else:
                 rpc = InProcessRPC(self.server)
             import os

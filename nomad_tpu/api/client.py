@@ -206,6 +206,22 @@ class Nodes(_Endpoint):
             body={"Eligibility":
                   "eligible" if eligible else "ineligible"})
 
+    def register(self, node_wire: Dict) -> Dict:
+        """reference: Node.Register — introduce (or re-upsert) a node;
+        returns the server's heartbeat TTL for the keepalive loop."""
+        return self.c.put("/v1/nodes", body={"Node": node_wire})
+
+    def heartbeat(self, node_id: str) -> Dict:
+        """reference: Node.UpdateStatus keepalive."""
+        return self.c.put(f"/v1/node/{node_id}/heartbeat")
+
+    def update_allocs(self, node_id: str,
+                      allocs: List[Dict]) -> Dict:
+        """reference: Node.UpdateAlloc — push client-side alloc status
+        transitions (wire-encoded Allocations) up to the server."""
+        return self.c.put(f"/v1/node/{node_id}/allocations",
+                          body={"Allocs": allocs})
+
 
 class Allocations(_Endpoint):
     def list(self) -> List[Dict]:

@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from nomad_tpu.structs import (
+    Allocation,
     DrainStrategy,
     Evaluation,
     Job,
@@ -356,6 +357,17 @@ class Router:
                 return sorted((_node_stub(n)
                                for n in s.state.snapshot().nodes()),
                               key=lambda n: n["ID"])
+            if method in ("PUT", "POST"):
+                # reference: Node.Register RPC — a client (or the soak's
+                # synthetic fleet) introduces itself over the real API;
+                # re-registration of a known id is an upsert
+                wire = (body or {}).get("Node")
+                if not wire or not wire.get("ID"):
+                    raise APIError(400, "Node with ID required")
+                reg = codec.decode(Node, wire)
+                s.register_node(reg)
+                return {"NodeID": reg.id,
+                        "HeartbeatTTL": s.heartbeats.ttl}
         elif head == "node":
             return self._node(method, p[1:], qs, body)
         elif head == "allocations":
@@ -837,6 +849,20 @@ class Router:
             if sub == "purge":
                 s.state.delete_node(node_id)
                 return {}
+            if sub == "heartbeat":
+                # reference: Node.UpdateStatus keepalive — resets the TTL
+                # timer and revives a server-side "down" verdict
+                s.heartbeat_node(node_id)
+                return {"NodeID": node_id,
+                        "HeartbeatTTL": s.heartbeats.ttl}
+            if sub == "allocations":
+                # reference: Node.UpdateAlloc — the client pushes alloc
+                # status transitions (running/complete/failed) up; the
+                # server merges them and reacts to terminal ones
+                updates = [codec.decode(Allocation, w)
+                           for w in (body or {}).get("Allocs", [])]
+                s.update_allocs_from_client(updates)
+                return {"Updated": len(updates)}
         raise APIError(404, f"no node handler for {method} {p}")
 
     def _deployment(self, method: str, p: List[str],

@@ -901,6 +901,70 @@ def cmd_health(args) -> int:
     return 0 if doc.get("Healthy") else 1
 
 
+def cmd_soak(args) -> int:
+    """Virtual-time production soak (`nomad soak`): boot an in-process
+    agent on a VirtualClock, replay a seeded day of cluster life
+    through the real API, and gate on chaos invariants + live SLOs.
+    Needs no running agent — it owns its own.  Exit 0 green (and, with
+    -check-determinism, byte-identical across both runs), 1 otherwise."""
+    from nomad_tpu.chaos.soak import run_soak
+    from nomad_tpu.chaos.traffic import TrafficProfile
+
+    kw = dict(hours=args.hours, n_nodes=args.nodes, n_zones=args.zones)
+    if args.quick:
+        kw.update(hours=min(args.hours, 0.1), n_nodes=min(args.nodes, 4),
+                  n_zones=min(args.zones, 2), service_per_hour=30,
+                  batch_per_hour=30, drains_per_hour=10,
+                  flap_storms_per_hour=10, flap_storm_nodes=2,
+                  preempt_storms_per_hour=10)
+    if args.no_chaos:
+        kw["chaos_scenarios"] = ()
+    profile = TrafficProfile(**kw)
+    runs = 2 if args.check_determinism else 1
+    results = []
+    for i in range(runs):
+        if runs > 1:
+            print(f"== soak run {i + 1}/{runs} (seed {args.seed}) ==")
+        results.append(run_soak(seed=args.seed, profile=profile))
+    r = results[0]
+    s = r.summary
+    print(f"seed                  = {s['seed']}")
+    print(f"virtual hours         = {s['soak_virtual_hours']:g} "
+          f"({s['schedule_events']} schedule events)")
+    print(f"wall seconds          = {s['wall_s']:g} "
+          f"(compression {s['compression_x']:g}x)")
+    print(f"evals                 = {s['soak_evals']}")
+    print(f"watchdog breaches     = {s['soak_breaches']}")
+    print(f"p99 plan-queue        = {s['p99_plan_queue_ms']:g} ms")
+    q = s["quality"]
+    print(f"zone balance max/min  = {q['zone_balance_max_over_min']:g} "
+          f"({q['nodes_in_use']} nodes in use)")
+    print(f"fill cpu/mem          = {q['fill_cpu']:.3f} / "
+          f"{q['fill_memory']:.3f}")
+    print(f"converged fingerprint = {s['converged_fingerprint'][:16]}…")
+    print(f"trace digest          = {s['trace_digest'][:16]}…")
+    ok = all(x.ok for x in results)
+    for x in results:
+        for v in x.violations:
+            print(f"VIOLATION: {v}")
+    if runs > 1:
+        match = (results[0].digest == results[1].digest
+                 and results[0].fingerprint == results[1].fingerprint)
+        print("determinism           = "
+              + ("byte-identical" if match else "DIVERGED"))
+        ok = ok and match
+    print(f"verdict               = {'PASS' if ok else 'FAIL'}")
+    if args.json:
+        doc = dict(s)
+        doc["violations"] = sorted(r.violations)
+        if runs > 1:
+            doc["determinism_ok"] = bool(match)
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        print(f"summary written to {args.json}")
+    return 0 if ok else 1
+
+
 def cmd_debug_record(args) -> int:
     """Flight-recorder tail (`nomad debug record`): recent per-wave and
     per-eval records; `-dump` fetches the health watchdog's retained
@@ -1433,6 +1497,26 @@ def build_parser() -> argparse.ArgumentParser:
     trs = trc.add_parser("status")
     trs.add_argument("trace_id")
     trs.set_defaults(fn=cmd_trace_status)
+
+    sk = sub.add_parser("soak",
+                        help="virtual-time production soak (seeded "
+                             "cluster-day replay, gated on live SLOs)")
+    sk.add_argument("-seed", type=int, default=0)
+    sk.add_argument("-hours", type=float, default=2.0,
+                    help="virtual horizon (default 2h)")
+    sk.add_argument("-nodes", type=int, default=12)
+    sk.add_argument("-zones", type=int, default=3)
+    sk.add_argument("-quick", action="store_true",
+                    help="shrunk churn-heavy profile (~0.1 virtual "
+                         "hours; CI smoke)")
+    sk.add_argument("-no-chaos", dest="no_chaos", action="store_true",
+                    help="skip the interleaved chaos scenarios")
+    sk.add_argument("-check-determinism", dest="check_determinism",
+                    action="store_true",
+                    help="run twice, require byte-identical traces")
+    sk.add_argument("-json", default="",
+                    help="write the summary JSON to this path")
+    sk.set_defaults(fn=cmd_soak)
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)

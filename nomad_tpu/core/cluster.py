@@ -30,7 +30,6 @@ replication fires the local watch), writes are forwarded.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.chaos.clock import Clock, SystemClock
@@ -225,10 +224,15 @@ class RemoteRPC:
     (reference: client/rpc.go + client/servers pool)."""
 
     def __init__(self, servers: List[Tuple[str, int]],
-                 transport: Optional[Transport] = None) -> None:
+                 transport: Optional[Transport] = None,
+                 clock: Optional[Clock] = None) -> None:
         self.servers = [tuple(a) for a in servers]
         self.transport = transport if transport is not None \
             else TCPTransport()
+        # injected timebase for the failover backoff (chaos/clock.py):
+        # under a VirtualClock the retry budget burns virtual seconds,
+        # so a soak's leadership flux resolves on the scenario timeline
+        self.clock = clock if clock is not None else SystemClock()
         self._preferred = 0
 
     def call(self, method: str, *args, timeout: float = 35.0,
@@ -264,7 +268,7 @@ class RemoteRPC:
             # (reference: client/rpc.go retries through its server pool;
             # generous budget covers bootstrap waiting on quorum)
             if attempt < retries - 1:
-                time.sleep(min(0.25 * (attempt + 1), 1.5))
+                self.clock.sleep(min(0.25 * (attempt + 1), 1.5))
         raise ConnectionError(f"no server available: {last_err}")
 
     # --- InProcessRPC surface ---

@@ -24,16 +24,28 @@ from nomad_tpu.structs import Evaluation, new_id
 
 DEFAULT_NACK_TIMEOUT = 60.0
 DEFAULT_DELIVERY_LIMIT = 3
+# requeue penalty (reference: eval_broker.go initialNackDelay /
+# subsequentNackDelay): the first nack redelivers immediately — a
+# transient plan-queue refusal usually clears by the next attempt — but
+# repeat nacks park the eval in the delayed heap so a persistently
+# failing eval cannot hot-loop a worker while the cluster churns
+DEFAULT_INITIAL_NACK_DELAY = 0.0
+DEFAULT_SUBSEQUENT_NACK_DELAY = 20.0
 
 
 class EvalBroker:
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
-                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT) -> None:
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+                 initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
+                 subsequent_nack_delay: float =
+                 DEFAULT_SUBSEQUENT_NACK_DELAY) -> None:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._enabled = False
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        self.initial_nack_delay = initial_nack_delay
+        self.subsequent_nack_delay = subsequent_nack_delay
         self._seq = itertools.count()
         # ready heaps per scheduler type: (-priority, seq, eval)
         self._ready: Dict[str, List[Tuple[int, int, Evaluation]]] = {}
@@ -58,7 +70,8 @@ class EvalBroker:
         # workers dequeue blindly and resolve collisions at plan apply.)
         self.partition_of = None
         self.stats = StatCounters("nomad.broker", (
-            "enqueued", "dequeued", "acked", "nacked", "failed"))
+            "enqueued", "dequeued", "acked", "nacked", "nack_delayed",
+            "failed"))
         # telemetry bookkeeping (core/telemetry.py), both guarded by
         # self._lock: when each eval last became READY (feeds the
         # enqueue->dequeue wait histogram + broker.wait span), and each
@@ -289,7 +302,15 @@ class EvalBroker:
                 self._release_job_locked(key)
             else:
                 self._in_flight_jobs.discard(key)
-                self._enqueue_locked(ev)
+                attempts = self._dequeues.get(eval_id, 0)
+                delay = (self.initial_nack_delay if attempts <= 1
+                         else self.subsequent_nack_delay)
+                if delay > 0.0:
+                    self.stats.inc("nack_delayed")
+                    heapq.heappush(self._delayed,
+                                   (now + delay, next(self._seq), ev))
+                else:
+                    self._enqueue_locked(ev)
             self._cv.notify()
             return None
 

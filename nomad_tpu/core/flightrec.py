@@ -341,6 +341,17 @@ class HealthWatchdog:
                     cb(v, bundle)
         return doc
 
+    def rebase(self, now: Optional[float] = None) -> None:
+        """Reset the counter-delta baseline without evaluating rules.
+        The soak runner calls this after an interleaved chaos scenario
+        ran OTHER servers in this process: the shared REGISTRY counters
+        jumped for reasons outside this server's SLO, and charging that
+        activity to the next check's deltas would fabricate a breach."""
+        t = now if now is not None else self.clock.monotonic()
+        with self._lock:
+            self._last_counters = self._counters()
+            self._last_t = t
+
     def tick(self, now: Optional[float] = None) -> Optional[Dict]:
         """Throttled check (the Server tick calls this every second;
         rules evaluate once per `slo.interval_s`)."""

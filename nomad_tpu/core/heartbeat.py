@@ -50,9 +50,14 @@ class HeartbeatTimers:
         return out
 
 
-def build_node_evals(snap, node_id: str) -> List[Evaluation]:
+def build_node_evals(snap, node_id: str,
+                     include_system: bool = False) -> List[Evaluation]:
     """One TRIGGER_NODE_UPDATE eval per job with live allocs on the node
-    (shared by heartbeat expiry and explicit status updates)."""
+    (shared by heartbeat expiry and explicit status updates).  With
+    `include_system`, also one per running system job eligible for the
+    node's datacenter — a node coming BACK (down→ready) has no live
+    allocs to walk, yet system jobs must regain a placement on it
+    (reference: Node.createNodeEvals)."""
     evals = []
     seen = set()
     for a in snap.allocs_by_node(node_id):
@@ -71,6 +76,25 @@ def build_node_evals(snap, node_id: str) -> List[Evaluation]:
             job_id=a.job_id,
             node_id=node_id,
         ))
+    if include_system:
+        node = snap.node_by_id(node_id)
+        for job in snap.jobs():
+            if job.type != "system" or job.stop:
+                continue
+            if (job.namespace, job.id) in seen:
+                continue
+            if (node is not None and job.datacenters
+                    and node.datacenter not in job.datacenters):
+                continue
+            seen.add((job.namespace, job.id))
+            evals.append(Evaluation(
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=TRIGGER_NODE_UPDATE,
+                job_id=job.id,
+                node_id=node_id,
+            ))
     return evals
 
 

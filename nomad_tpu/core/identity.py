@@ -21,12 +21,24 @@ import base64
 import hashlib
 import hmac
 import json
-import time
 from typing import Dict, Optional
+
+from nomad_tpu.chaos.clock import Clock, SystemClock
 
 _HEADER = {"alg": "HS256", "typ": "JWT"}
 
 IDENTITY_PREFIX = "nomad-wi."      # marks tokens for cheap routing
+
+# injected timebase for the `now=None` defaults (chaos/clock.py): a
+# virtual-time soak must see identity iat/exp on the same timeline as
+# heartbeats and ACL expiry.  Server.__init__ binds its clock here next
+# to telemetry.configure / flightrec.configure.
+_CLOCK: Clock = SystemClock()
+
+
+def configure(clock: Clock) -> None:
+    global _CLOCK
+    _CLOCK = clock
 
 
 def _b64(data: bytes) -> str:
@@ -44,7 +56,7 @@ def mint(secret: str, *, namespace: str, job_id: str, alloc_id: str,
     """Sign one workload identity.  ttl_s=0 → tied to the alloc's
     lifetime only (no expiry claim; the reference's default identities
     are likewise alloc-scoped)."""
-    t = now if now is not None else time.time()
+    t = now if now is not None else _CLOCK.time()
     claims = {"nomad_namespace": namespace, "nomad_job_id": job_id,
               "nomad_allocation_id": alloc_id, "nomad_task": task,
               "iat": int(t)}
@@ -81,7 +93,7 @@ def verify(secret: str, token: str,
     except Exception:  # noqa: BLE001
         return None
     exp = claims.get("exp")
-    t = now if now is not None else time.time()
+    t = now if now is not None else _CLOCK.time()
     if exp is not None and t > exp:
         return None
     return claims

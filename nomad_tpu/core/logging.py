@@ -21,10 +21,10 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from nomad_tpu.chaos.clock import Clock, SystemClock
 from nomad_tpu.core.telemetry import REGISTRY
 
 LEVELS = {"trace": 0, "debug": 1, "info": 2, "warn": 3, "error": 4}
@@ -58,11 +58,15 @@ class LogRing:
         # producer-side gate: records below this level are dropped before
         # touching the lock (the ack log sits on the eval hot path)
         self.min_level = "trace"
+        # injected timebase for record stamps (chaos/clock.py): dump
+        # bundles must carry log ts on the same timeline as the traces
+        # and SLO windows they are joined with
+        self.clock: Clock = SystemClock()
 
     def log(self, component: str, level: str, msg: str, **fields) -> None:
         if LEVELS.get(level, 2) < LEVELS.get(self.min_level, 0):
             return
-        rec = {"ts": time.time(), "level": level,
+        rec = {"ts": self.clock.time(), "level": level,
                "component": component, "msg": msg}
         if fields:
             rec.update(fields)
@@ -107,6 +111,12 @@ class LogRing:
 
 # process-wide default ring (one agent per process in practice)
 RING = LogRing()
+
+
+def configure(clock: Clock) -> None:
+    """Bind the process log ring to an injected clock (every Server
+    calls this with its own, next to telemetry.configure)."""
+    RING.clock = clock
 
 
 def log(component: str, level: str, msg: str, **fields) -> None:

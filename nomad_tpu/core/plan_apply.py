@@ -208,6 +208,11 @@ class PlanApplier:
         # plan reports its origin so a resident usage chain the commit
         # is FOREIGN to gets invalidated (ops/executor.py)
         self.executor = None
+        # optional hook (wired by the Server): allocs this commit
+        # preempted belong to OTHER jobs, which now run below their
+        # desired count — they need follow-up evals or the evicted work
+        # is never replaced (reference: planApply's preemption evals)
+        self.on_preempted = None
         # scheduling-quality gauge refresh, throttled: the summary walk
         # is O(nodes in use), so a 100-plan/s wave refreshes once per
         # interval instead of per plan (PERF.md §11: soak budget)
@@ -350,6 +355,10 @@ class PlanApplier:
                 REGISTRY.inc("nomad.quality.preemptions",
                              sum(len(v) for v in
                                  result.node_preemptions.values()))
+                if self.on_preempted is not None:
+                    self.on_preempted(
+                        [a for allocs in result.node_preemptions.values()
+                         for a in allocs])
             now = self.clock.monotonic()
             if now >= self._quality_next:
                 self._quality_next = now + self.quality_interval

@@ -35,10 +35,12 @@ from nomad_tpu.structs import (
     TRIGGER_JOB_DEREGISTER,
     TRIGGER_JOB_REGISTER,
     TRIGGER_NODE_DRAIN,
+    TRIGGER_PREEMPTION,
     new_id,
 )
 
-from . import flightrec, telemetry
+from . import flightrec, identity, telemetry
+from . import logging as logging_mod
 from .logging import log
 from .blocked_evals import BlockedEvals
 from .deployment_watcher import DeploymentWatcher
@@ -76,6 +78,11 @@ class Server:
         # benign)
         telemetry.configure(self.clock)
         flightrec.configure(self.clock)
+        # the process log ring's record stamps and the identity
+        # iat/exp defaults ride the same timeline (satellite of the
+        # virtual-time soak: no raw time.time() left in core/)
+        logging_mod.configure(self.clock)
+        identity.configure(self.clock)
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -137,6 +144,7 @@ class Server:
         self.executor.attach_store(self.state)
         # ...and so does any committed plan from OUTSIDE the chain
         self.plan_applier.executor = self.executor
+        self.plan_applier.on_preempted = self._on_preempted
         self.dev_mode = dev_mode
         # (baseline, max) delay before a failed eval's follow-up re-enters
         # the queue (reference: evalFailedFollowupBaselineDelay 1min +
@@ -532,6 +540,13 @@ class Server:
         evals: List[Evaluation] = []
         if status == "down" and node is not None:
             evals = build_node_evals(self.state.snapshot(), node_id)
+        elif (status == "ready" and node is not None
+              and node.status != "ready"):
+            # recovered capacity: reconcile jobs that still have allocs
+            # here AND re-place system jobs that lost theirs while the
+            # node was down (reference: Node.createNodeEvals on ready)
+            evals = build_node_evals(self.state.snapshot(), node_id,
+                                     include_system=True)
         self.apply_eval_update(evals, now=t)
         return evals
 
@@ -543,8 +558,25 @@ class Server:
 
     def set_node_eligibility(self, node_id: str, eligible: bool) -> None:
         """reference: Node.UpdateEligibility RPC."""
+        node = self.state.node_by_id(node_id)
+        was_eligible = (node is not None
+                        and node.scheduling_eligibility == "eligible")
+        if eligible and node is not None and node.drain is not None:
+            # a finished drain's marker is cleared lazily on the next
+            # drainer tick; an operator restoring eligibility inside that
+            # window would leave the node drain-flagged (ready_nodes skips
+            # it) with the node-update evals below landing as no-ops —
+            # restoring eligibility cancels any lingering drain first
+            self.drainer.drain_node(node_id, None)
         self.state.update_node_eligibility(
             node_id, "eligible" if eligible else "ineligible")
+        if eligible and node is not None and not was_eligible:
+            # capacity returning from a drain: system jobs whose alloc
+            # was evicted here need a fresh placement, and blocked jobs
+            # a chance at the freed node — without this, a drained-then-
+            # restored node never regains its system allocs
+            self.apply_eval_update(build_node_evals(
+                self.state.snapshot(), node_id, include_system=True))
 
     def update_alloc_desired_transition(self, alloc_ids, transition,
                                         now: Optional[float] = None) -> None:
@@ -619,6 +651,28 @@ class Server:
         self.apply_eval_update(evals, now=t)
 
     # ------------------------------------------------------ eval plumbing
+
+    def _on_preempted(self, allocs: List) -> None:
+        """Plan-applier hook: each job an applied plan preempted runs
+        below its desired count now — one follow-up eval per distinct
+        (namespace, job) replaces the evicted work elsewhere
+        (reference: planApply's preemption follow-up evals)."""
+        seen = set()
+        evals: List[Evaluation] = []
+        for a in allocs:
+            key = (a.namespace, a.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = self.state.job_by_id(a.namespace, a.job_id)
+            evals.append(Evaluation(
+                namespace=a.namespace,
+                priority=job.priority if job else 50,
+                type=job.type if job else "service",
+                triggered_by=TRIGGER_PREEMPTION,
+                job_id=a.job_id,
+            ))
+        self.apply_eval_update(evals)
 
     def apply_eval_update(self, evals: Iterable[Evaluation],
                           now: Optional[float] = None) -> None:

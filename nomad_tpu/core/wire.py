@@ -48,10 +48,11 @@ import itertools
 import os
 import struct
 import threading
-import time
 from typing import Any, Dict, Optional
 
 import msgpack
+
+from nomad_tpu.chaos.clock import Clock, SystemClock
 
 _EXT_DATACLASS = 1
 _EXT_SET = 2
@@ -72,6 +73,18 @@ _seen_lock = threading.Lock()
 
 _REGISTRY: Dict[str, type] = {}
 _registered_modules: set = set()
+
+# injected timebase for frame timestamps / freshness (chaos/clock.py).
+# NOT rebound by Server.__init__ (unlike telemetry/flightrec): frames
+# cross processes, so their freshness window is wall-clock by nature —
+# only a fully-virtual single-process soak (chaos/soak.py) binds its
+# VirtualClock here, and restores the wall clock on teardown.
+_CLOCK: Clock = SystemClock()
+
+
+def set_clock(clock: Clock) -> None:
+    global _CLOCK
+    _CLOCK = clock
 
 
 def set_key(secret: Optional[str], force: bool = False) -> None:
@@ -206,7 +219,7 @@ def encode_frame(msg: Any, tag: bytes = b"") -> bytes:
     the receiver must present the identical tag to decode."""
     body = packb(msg)
     if _aead is not None:
-        ts = struct.pack(">d", time.time())
+        ts = struct.pack(">d", _CLOCK.time())
         nonce = os.urandom(_NONCE_LEN)
         body = ts + nonce + _aead.encrypt(nonce, body, ts + tag)
     return struct.pack(">I", len(body)) + body
@@ -268,7 +281,7 @@ def decode_body(body: bytes, tag: bytes = b"") -> Any:
         ts_raw = body[:_TS_LEN]
         nonce = body[_TS_LEN:_TS_LEN + _NONCE_LEN]
         (ts,) = struct.unpack(">d", ts_raw)
-        now = time.time()
+        now = _CLOCK.time()
         if abs(now - ts) > REPLAY_WINDOW_S:
             raise ValueError("stale frame")
         try:

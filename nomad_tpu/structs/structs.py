@@ -80,6 +80,7 @@ TRIGGER_SCHEDULED = "scheduled"
 # refuted out of an already-dispatched wave (scheduler/generic.py
 # _repair_refuted) instead of re-running the wave's device launch
 TRIGGER_PLAN_REFUTE = "plan-refute-repair"
+TRIGGER_PREEMPTION = "preemption"
 
 # Constraint operands (reference: structs.go ConstraintX consts).
 OP_EQ = "="

@@ -37,6 +37,16 @@ Passes (suppress a finding with `# analyze: ok <pass>` on its line):
           silently — a leadership callback that dies on `NotLeaderError`
           is how state desync starts (VERDICT weak #6).
 
+  rawtime Injected-timebase discipline (nomad_tpu/core/).  A raw
+          `time.time()` / `time.monotonic()` / `time.sleep()` call in
+          the cluster plane bypasses the chaos Clock seam
+          (chaos/clock.py), so a virtual-time soak silently mixes wall
+          and virtual timelines — heartbeat TTLs fire early, SLO
+          windows span the wrong samples, and the same seed stops
+          replaying.  Route through `self.clock` / a module-level bound
+          Clock instead (`time.perf_counter()` stays legal: host-side
+          duration measurement is not cluster time).
+
 `--selftest` runs every pass against an injected violation of its exact
 bug class and exits 0 only when each pass catches its own and stays
 quiet on the clean shapes — the CI stage proving the net has no hole.
@@ -53,7 +63,7 @@ ROOT = Path(__file__).resolve().parent.parent
 
 Finding = Tuple[str, int, str, str]        # (path, lineno, pass, message)
 
-PASS_NAMES = ("lock", "cow", "purity", "thread")
+PASS_NAMES = ("lock", "cow", "purity", "thread", "rawtime")
 
 
 # --------------------------------------------------------------- helpers
@@ -786,6 +796,45 @@ def check_thread(tree: ast.Module, path: str) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------- pass E: rawtime
+
+# cluster-plane time must flow through the injected chaos Clock; these
+# raw calls each pin a timeline to the wall clock.  perf_counter is
+# deliberately absent: host-side duration measurement (wavepipe stage
+# timers) is not cluster time and stays legal.
+_RAWTIME_BANNED = ("time", "monotonic", "sleep")
+
+
+def check_rawtime(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    # names pulled in via `from time import ...` (aliases included)
+    from_imports: Dict[str, str] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module == "time":
+            for a in n.names:
+                if a.name in _RAWTIME_BANNED:
+                    from_imports[a.asname or a.name] = a.name
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        fn = n.func
+        banned = ""
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+                and fn.attr in _RAWTIME_BANNED):
+            banned = fn.attr
+        elif isinstance(fn, ast.Name) and fn.id in from_imports:
+            banned = from_imports[fn.id]
+        if banned:
+            out.append((path, n.lineno, "rawtime",
+                        f"raw `time.{banned}()` bypasses the injected "
+                        "Clock — a virtual-time soak mixes wall and "
+                        "virtual timelines; route through the bound "
+                        "chaos Clock (clock.time()/monotonic()/sleep())"))
+    return out
+
+
 # ----------------------------------------------------------- plumbing
 
 def _scoped_files() -> Dict[str, List[Path]]:
@@ -801,6 +850,7 @@ def _scoped_files() -> Dict[str, List[Path]]:
         "cow": [pkg / "state" / "state_store.py"],
         "purity": purity,
         "thread": all_py,
+        "rawtime": sorted((pkg / "core").glob("*.py")),
     }
 
 
@@ -828,6 +878,8 @@ def analyze_source(text: str, path: str = "<memory>",
             findings.extend(check_purity({path: tree}))
         elif name == "thread":
             findings.extend(check_thread(tree, path))
+        elif name == "rawtime":
+            findings.extend(check_rawtime(tree, path))
     lines = text.splitlines()
     return sorted({f for f in findings
                    if not _suppressed(lines, f[1], f[2])})
@@ -849,17 +901,14 @@ def analyze_repo(root: Path = ROOT) -> List[Finding]:
             except SyntaxError as e:
                 findings.append((key, e.lineno or 0, "parse",
                                  f"syntax error: {e.msg}"))
-    for name in ("lock", "cow", "thread"):
+    single = {"lock": check_lock, "cow": check_cow,
+              "thread": check_thread, "rawtime": check_rawtime}
+    for name, checker in single.items():
         for p in scopes[name]:
             key = str(p)
             if key not in trees:
                 continue
-            if name == "lock":
-                findings.extend(check_lock(trees[key], key))
-            elif name == "cow":
-                findings.extend(check_cow(trees[key], key))
-            else:
-                findings.extend(check_thread(trees[key], key))
+            findings.extend(checker(trees[key], key))
     purity_files = {str(p): trees[str(p)] for p in scopes["purity"]
                     if str(p) in trees}
     findings.extend(check_purity(purity_files))
@@ -990,6 +1039,29 @@ class ClusterServer:
         self.drive()                          # no handler, but managed
 '''
 
+SELFTEST_RAWTIME = '''
+import time
+from time import monotonic as mono
+
+
+class HeartbeatTimers:
+    def expire(self, now=None):
+        t = now if now is not None else time.time()   # VIOLATION
+        return t
+
+    def backoff(self):
+        time.sleep(0.25)                              # VIOLATION
+
+    def deadline(self):
+        return mono() + 30.0                          # VIOLATION: alias
+
+    def ok_paths(self):
+        start = time.perf_counter()                   # ok: host duration
+        t = self.clock.time()                         # ok: injected seam
+        self.clock.sleep(0.1)                         # ok: injected seam
+        return start, t
+'''
+
 
 def selftest() -> int:
     ok = True
@@ -1013,6 +1085,7 @@ def selftest() -> int:
     expect("cow", SELFTEST_COW, 4, "_writable_")
     expect("purity", SELFTEST_PURITY, 5, "DONATED")
     expect("thread", SELFTEST_THREAD, 1, "_on_raft_leader")
+    expect("rawtime", SELFTEST_RAWTIME, 3, "bypasses the injected")
     # suppression: the same violations annotated away must go quiet
     suppressed = SELFTEST_THREAD.replace(
         "def _on_raft_leader(self):",
@@ -1020,8 +1093,8 @@ def selftest() -> int:
     expect("thread", suppressed, 0)
     if ok:
         print("analyze selftest ok: every pass caught its injected "
-              "violation (lock=3 cow=4 purity=5 thread=1, suppression "
-              "honored)")
+              "violation (lock=3 cow=4 purity=5 thread=1 rawtime=3, "
+              "suppression honored)")
         return 0
     return 1
 

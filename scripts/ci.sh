@@ -16,6 +16,11 @@
 #              pipeline must place every alloc (the run asserts
 #              completeness internally; a scheduling regression fails
 #              the run)
+#   soak     — virtual-time production soak (chaos/soak.py): a seeded
+#              cluster-day replayed through the real HTTP API on a
+#              VirtualClock, byte-identical on same-seed replay, gated
+#              on chaos invariants AND live SLOs (zero watchdog
+#              breaches, p99 plan-queue, zone balance / fill gauges)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -292,6 +297,31 @@ echo "== chaos (seeded fault-injection scenarios on the virtual clock) =="
 # clock scenarios superseded in tier-1
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q
 JAX_PLATFORMS=cpu python -m pytest tests/test_cluster.py -q -m slow
+
+echo "== soak (virtual-time cluster-day replay, gated on live SLOs) =="
+# the production soak (chaos/soak.py + chaos/traffic.py): a seeded
+# schedule of service/batch/system jobs, rolling deploys, autoscaling
+# churn, drains, flap storms, and preemption storms drives a REAL
+# agent through the HTTP API on a VirtualClock.  The quick profile
+# runs twice and must be byte-identical (same seed, same bytes); the
+# summary JSON lands next to the bench JSONs, and the slow marker run
+# is the acceptance shape: >=2h virtual, green, zero breaches, <90s
+# wall
+JAX_PLATFORMS=cpu python -m nomad_tpu soak -quick -check-determinism \
+    -json SOAK_ci.json
+python - <<'EOF'
+import json
+out = json.load(open("SOAK_ci.json"))
+for k in ("soak_virtual_hours", "soak_evals", "soak_breaches",
+          "converged_fingerprint", "trace_digest", "determinism_ok"):
+    assert k in out, f"missing summary field {k}"
+assert out["ok"] and out["determinism_ok"], out
+assert out["soak_breaches"] == 0, out
+print("soak summary ok:", out["soak_virtual_hours"], "virtual hours,",
+      out["soak_evals"], "evals, fingerprint",
+      out["converged_fingerprint"][:16])
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_soak_sim.py -q -m slow
 
 echo "== networked (port parity gate, churn soak, bench smoke) =="
 # batched columnar port assignment (ISSUE 8): the pytest suite runs the

@@ -155,3 +155,32 @@ class TestDrainBatching:
         s.process_all(now=NOW + 3)
         assert _live_on(s, sysjob, victim), \
             "system alloc survives evals on the ineligible node"
+
+    def test_eligibility_restore_cancels_lingering_drain(self):
+        # The drainer clears a finished drain's marker lazily, on its next
+        # tick.  An operator restoring eligibility inside that window must
+        # not leave the node drain-flagged (ready_nodes skips draining
+        # nodes, so the restore's node-update evals would no-op and the
+        # node would never host a system alloc again).
+        s = Server(dev_mode=True)
+        s.establish_leadership()
+        for _ in range(2):
+            s.register_node(mock.node(), now=NOW)
+        sysjob = mock.system_job()
+        s.register_job(sysjob, now=NOW)
+        s.process_all(now=NOW)
+        victim = next(a.node_id for a in
+                      s.state.allocs_by_job(sysjob.namespace, sysjob.id))
+        s.drain_node(victim, DrainStrategy(deadline_s=3600), now=NOW + 1)
+        s.process_all(now=NOW + 1)
+        _finish_stops(s, sysjob, NOW + 2)
+        assert not _live_on(s, sysjob, victim)
+        # no tick between completion and restore: marker still set
+        assert s.state.node_by_id(victim).drain is not None
+        s.set_node_eligibility(victim, True)
+        s.process_all(now=NOW + 3)
+        node = s.state.node_by_id(victim)
+        assert node.drain is None, "restore cancelled the lingering drain"
+        assert node.scheduling_eligibility == "eligible"
+        assert _live_on(s, sysjob, victim), \
+            "restored node regained its system alloc"

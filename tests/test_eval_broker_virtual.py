@@ -1,0 +1,188 @@
+"""Eval broker under virtual time: nack-requeue penalty, delayed-eval
+promotion, and delivery-limit failure driven by a VirtualClock — each
+scripted sequence is run twice and its canonical trace compared byte
+for byte (the broker's observable schedule is a pure function of the
+script; reference: eval_broker.go nack delay + delayed eval heap)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.chaos.clock import VirtualClock
+from nomad_tpu.chaos.trace import Trace
+from nomad_tpu.core.eval_broker import EvalBroker
+
+
+def _broker(**kw):
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+class TestNackPenalty:
+    def test_first_nack_redelivers_immediately(self):
+        b = _broker(subsequent_nack_delay=20.0)
+        b.enqueue(mock.eval(id="e1", job_id="j1"), now=0.0)
+        ev, tok = b.dequeue(["service"], now=0.0, timeout=0.0)
+        assert b.nack(ev.id, tok, now=0.0) is None
+        ev2, _ = b.dequeue(["service"], now=0.0, timeout=0.0)
+        assert ev2 is not None and ev2.id == "e1"
+        assert b.stats["nack_delayed"] == 0
+
+    def test_subsequent_nack_parks_in_delayed_heap(self):
+        clock = VirtualClock()
+        b = _broker(subsequent_nack_delay=20.0)
+        b.enqueue(mock.eval(id="e1", job_id="j1"),
+                  now=clock.monotonic())
+        for _ in range(2):          # attempt 1 nack: immediate requeue
+            ev, tok = b.dequeue(["service"], now=clock.monotonic(),
+                                timeout=0.0)
+            assert ev is not None
+            b.nack(ev.id, tok, now=clock.monotonic())
+        assert b.stats["nack_delayed"] == 1
+        # penalty window: nothing ready until 20 virtual seconds pass
+        none, _ = b.dequeue(["service"], now=clock.monotonic(),
+                            timeout=0.0)
+        assert none is None
+        clock.advance(19.5)
+        b.tick(clock.monotonic())
+        none, _ = b.dequeue(["service"], now=clock.monotonic(),
+                            timeout=0.0)
+        assert none is None
+        clock.advance(0.5)
+        b.tick(clock.monotonic())
+        ev, tok = b.dequeue(["service"], now=clock.monotonic(),
+                            timeout=0.0)
+        assert ev is not None and ev.id == "e1"
+        assert b.ack(ev.id, tok) is None
+
+    def test_penalized_eval_counts_as_pending(self):
+        b = _broker(subsequent_nack_delay=20.0)
+        b.enqueue(mock.eval(id="e1", job_id="j1"), now=0.0)
+        for _ in range(2):
+            ev, tok = b.dequeue(["service"], now=0.0, timeout=0.0)
+            b.nack(ev.id, tok, now=0.0)
+        assert b.pending_evals() == 1   # parked, not lost
+
+
+class TestDeterministicReplay:
+    """The same scripted churn twice -> byte-identical canonical
+    traces.  The script exercises every broker path the soak leans on:
+    penalty redeliveries, wait_until promotion, nack-timeout expiry,
+    and delivery-limit failure."""
+
+    def _run_script(self) -> bytes:
+        clock = VirtualClock()
+        trace = Trace()
+        b = _broker(nack_timeout=30.0, delivery_limit=3,
+                    subsequent_nack_delay=10.0)
+        try:
+            # j-flaky nacks until the delivery limit; j-late waits on
+            # wait_until; j-slow's worker dies (nack-timeout expiry);
+            # j-good acks first time
+            b.enqueue(mock.eval(id="e-flaky", job_id="j-flaky"),
+                      now=clock.monotonic())
+            b.enqueue(mock.eval(id="e-late", job_id="j-late",
+                                wait_until=clock.monotonic() + 25.0),
+                      now=clock.monotonic())
+            b.enqueue(mock.eval(id="e-slow", job_id="j-slow"),
+                      now=clock.monotonic())
+            b.enqueue(mock.eval(id="e-good", job_id="j-good"),
+                      now=clock.monotonic())
+            held = {}
+            for _ in range(200):
+                now = clock.monotonic()
+                b.tick(now)
+                while True:
+                    ev, tok = b.dequeue(["service"], now=now,
+                                        timeout=0.0)
+                    if ev is None:
+                        break
+                    attempt = b._dequeues.get(ev.id, 0)
+                    trace.record(now, "dequeue", eval=ev.id,
+                                 attempt=attempt)
+                    if ev.id == "e-flaky":
+                        b.nack(ev.id, tok, now=now)
+                        trace.record(now, "nack", eval=ev.id,
+                                     attempt=attempt)
+                    elif ev.id == "e-slow" and not held:
+                        held[ev.id] = tok   # worker wedges: no ack
+                    else:
+                        b.ack(ev.id, tok)
+                        trace.record(now, "ack", eval=ev.id,
+                                     attempt=attempt)
+                for ev in b.drain_failed():
+                    trace.record(clock.monotonic(), "failed",
+                                 eval=ev.id)
+                clock.advance(1.0)
+            trace.record(clock.monotonic(), "verdict",
+                         stats={k: b.stats[k] for k in
+                                ("enqueued", "dequeued", "acked",
+                                 "nacked", "nack_delayed", "failed")},
+                         pending=b.pending_evals())
+            return trace.canonical_bytes()
+        finally:
+            clock.close()
+
+    def test_double_run_byte_identical(self):
+        first = self._run_script()
+        second = self._run_script()
+        assert first == second
+
+    def test_script_hits_every_path(self):
+        text = self._run_script().decode()
+        # flaky reached the delivery limit and failed out
+        assert 'failed {"at"' in text and '"e-flaky"' in text
+        # the delayed eval was promoted and acked after its wait_until
+        assert '"eval":"e-late"' in text
+        # the wedged delivery expired and the redelivery was acked
+        acks = [ln for ln in text.splitlines()
+                if ln.startswith("ack ") and "e-slow" in ln]
+        assert len(acks) == 1 and '"attempt":2' in acks[0]
+
+
+class TestDelayedPromotion:
+    def test_wait_until_promotes_on_tick(self):
+        clock = VirtualClock()
+        b = _broker()
+        b.enqueue(mock.eval(id="e1", job_id="j1",
+                            wait_until=clock.monotonic() + 5.0),
+                  now=clock.monotonic())
+        none, _ = b.dequeue(["service"], now=clock.monotonic(),
+                            timeout=0.0)
+        assert none is None
+        clock.advance(5.0)
+        b.tick(clock.monotonic())
+        ev, _ = b.dequeue(["service"], now=clock.monotonic(),
+                          timeout=0.0)
+        assert ev is not None and ev.id == "e1"
+
+
+class TestDeliveryLimitChurn:
+    def test_limit_reached_through_penalty_cycles(self):
+        """A persistently nacking eval still fails out at the delivery
+        limit even though later attempts route through the penalty
+        heap (the soak's guarantee that poison evals drain)."""
+        clock = VirtualClock()
+        b = _broker(delivery_limit=3, subsequent_nack_delay=5.0)
+        b.enqueue(mock.eval(id="e1", job_id="j1"),
+                  now=clock.monotonic())
+        nacks = 0
+        for _ in range(100):
+            now = clock.monotonic()
+            b.tick(now)
+            ev, tok = b.dequeue(["service"], now=now, timeout=0.0)
+            if ev is not None:
+                b.nack(ev.id, tok, now=now)
+                nacks += 1
+            if b.failed_evals():
+                break
+            clock.advance(1.0)
+        assert nacks == 3
+        assert [e.id for e in b.drain_failed()] == ["e1"]
+        assert b.stats["nack_delayed"] == 1   # only attempt 2 delayed
+        assert b.pending_evals() == 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,120 @@
+"""Virtual-time production soak (chaos/soak.py): the full agent driven
+through the real HTTP API on a VirtualClock, gated on chaos invariants
+AND the live health plane.
+
+Fast tests run a shrunk profile (a few virtual minutes, ~3s wall); the
+default 2h-virtual profile with chaos scenarios interleaved is
+@pytest.mark.slow and runs in the dedicated CI soak stage."""
+
+import pytest
+
+from nomad_tpu.chaos.soak import coarse_fingerprint, run_soak
+from nomad_tpu.chaos.traffic import TrafficProfile
+
+TINY = dict(hours=0.05, n_nodes=4, n_zones=2, service_per_hour=40,
+            batch_per_hour=40, drains_per_hour=10,
+            flap_storms_per_hour=0, preempt_storms_per_hour=0,
+            chaos_scenarios=())
+
+CHURNY = dict(hours=0.1, n_nodes=4, n_zones=2, service_per_hour=30,
+              batch_per_hour=30, drains_per_hour=10,
+              flap_storms_per_hour=10, flap_storm_nodes=2,
+              preempt_storms_per_hour=10, chaos_scenarios=())
+
+SUMMARY_KEYS = {"seed", "soak_virtual_hours", "soak_evals",
+                "soak_breaches", "converged_fingerprint",
+                "trace_digest", "schedule_events", "wall_s",
+                "compression_x", "p99_plan_queue_ms", "quality", "ok"}
+
+
+def test_tiny_soak_green_and_summarized():
+    r = run_soak(seed=1, profile=TrafficProfile(**TINY))
+    assert r.ok, r.violations
+    assert r.summary["soak_breaches"] == 0
+    assert r.summary["soak_evals"] > 0
+    assert r.summary["soak_virtual_hours"] >= 0.05
+    assert set(r.summary) == SUMMARY_KEYS
+    assert r.summary["converged_fingerprint"] == r.fingerprint
+    assert r.summary["quality"]["nodes_in_use"] > 0
+
+
+def test_same_seed_byte_identical_replay():
+    p = TrafficProfile(**CHURNY)
+    a = run_soak(seed=3, profile=p)
+    b = run_soak(seed=3, profile=p)
+    assert a.ok and b.ok, (a.violations, b.violations)
+    assert a.digest == b.digest
+    assert a.fingerprint == b.fingerprint
+    assert a.trace.canonical_bytes() == b.trace.canonical_bytes()
+
+
+def test_different_seed_different_life():
+    p = TrafficProfile(**TINY)
+    a = run_soak(seed=1, profile=p)
+    b = run_soak(seed=2, profile=p)
+    assert a.ok and b.ok, (a.violations, b.violations)
+    assert a.digest != b.digest
+
+
+def test_churny_soak_survives_flaps_and_preemption():
+    """Flap storms knock heartbeats out (allocs go lost, nodes go down
+    and come back), preemption storms evict low-priority work — the
+    converged state must still place every surviving demand, with zero
+    watchdog breaches."""
+    r = run_soak(seed=3, profile=TrafficProfile(**CHURNY))
+    assert r.ok, r.violations
+    assert r.summary["soak_breaches"] == 0
+    # the canonical trace carries the verdict record
+    lines = r.trace.canonical_lines()
+    assert any(l.startswith("verdict ") for l in lines)
+    assert any(l.startswith("slo ") for l in lines)
+
+
+def test_coarse_fingerprint_ignores_placement_details():
+    """Two snapshots that differ only in WHICH node hosts a replica
+    must fingerprint identically (placement is thread-timing shaped);
+    a different live count must not."""
+    from nomad_tpu import mock
+    from nomad_tpu.state import StateStore
+
+    def build(node_for_alloc, n_allocs=2):
+        s = StateStore()
+        nodes = []
+        for i in range(2):
+            n = mock.node(name=f"fp-n{i}")
+            nodes.append(n)
+            s.upsert_node(n)
+        job = mock.job()
+        job.id = "fp-job"
+        s.upsert_job(job)
+        for k in range(n_allocs):
+            a = mock.alloc()
+            a.job_id = job.id
+            a.namespace = job.namespace
+            a.task_group = job.task_groups[0].name
+            a.node_id = nodes[node_for_alloc(k)].id
+            a.client_status = "running"
+            s.upsert_allocs([a])
+        return s.snapshot()
+
+    fp_a = coarse_fingerprint(build(lambda k: 0))
+    fp_b = coarse_fingerprint(build(lambda k: k % 2))
+    fp_c = coarse_fingerprint(build(lambda k: 0, n_allocs=3))
+    assert fp_a == fp_b
+    assert fp_a != fp_c
+
+
+@pytest.mark.slow
+def test_default_profile_two_virtual_hours():
+    """The acceptance run: the full default profile — 2h of virtual
+    cluster life, chaos scenarios interleaved — replayed green in
+    bounded wall time."""
+    r = run_soak(seed=0)
+    assert r.ok, r.violations
+    assert r.summary["soak_virtual_hours"] >= 2.0
+    assert r.summary["soak_breaches"] == 0
+    assert r.summary["wall_s"] < 90.0
+    assert r.summary["compression_x"] > 50.0
+    chaos_lines = [l for l in r.trace.canonical_lines()
+                   if l.startswith("chaos_result ")]
+    assert len(chaos_lines) == 2

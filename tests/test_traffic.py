@@ -1,0 +1,147 @@
+"""Seeded traffic generator (chaos/traffic.py): determinism, workload
+mix, fault pairing, capacity ledger, and the verified-idempotent retry
+discipline."""
+
+import json
+
+import pytest
+
+from nomad_tpu.chaos.traffic import (
+    DEFAULT_SCENARIOS,
+    FaultyCall,
+    TrafficProfile,
+    fleet,
+    generate_schedule,
+    retry_idempotent,
+    stable_id,
+)
+
+KINDS = {"job.register", "job.deploy", "job.scale", "job.stop",
+         "node.drain", "node.restore", "node.flap", "chaos"}
+
+
+def _blob(events):
+    return json.dumps(events, sort_keys=True).encode()
+
+
+class TestDeterminism:
+    def test_same_seed_byte_identical(self):
+        p = TrafficProfile()
+        assert _blob(generate_schedule(7, p)) == \
+            _blob(generate_schedule(7, p))
+
+    def test_different_seed_differs(self):
+        p = TrafficProfile()
+        assert _blob(generate_schedule(1, p)) != \
+            _blob(generate_schedule(2, p))
+
+    def test_fleet_stable(self):
+        p = TrafficProfile(n_nodes=5, n_zones=2)
+        a, b = fleet(3, p), fleet(3, p)
+        assert a == b
+        assert [s["datacenter"] for s in a] == \
+            ["dc1", "dc2", "dc1", "dc2", "dc1"]
+        assert len({s["id"] for s in a}) == 5
+
+    def test_stable_id_is_not_positional_soup(self):
+        assert stable_id("node", 1, 2) != stable_id("node", 12, "")
+        assert len(stable_id("x")) == 32
+
+
+class TestScheduleShape:
+    def setup_method(self):
+        self.p = TrafficProfile(hours=1.0)
+        self.events = generate_schedule(11, self.p)
+
+    def test_sorted_and_known_kinds(self):
+        ats = [e["at"] for e in self.events]
+        assert ats == sorted(ats)
+        assert {e["kind"] for e in self.events} <= KINDS
+
+    def test_mixed_workload_present(self):
+        kinds = [e["kind"] for e in self.events]
+        regs = [e for e in self.events if e["kind"] == "job.register"]
+        assert {e["jtype"] for e in regs} == {"service", "batch",
+                                              "system"}
+        assert "node.drain" in kinds and "node.flap" in kinds
+
+    def test_drains_paired_with_restores(self):
+        drains = [e for e in self.events if e["kind"] == "node.drain"]
+        restores = {(e["node"], e["at"])
+                    for e in self.events if e["kind"] == "node.restore"}
+        assert drains
+        for d in drains:
+            assert (d["node"], round(d["at"] + d["duration"], 3)) \
+                in restores
+
+    def test_chaos_interleaved_inside_active_window(self):
+        chaos = [e for e in self.events if e["kind"] == "chaos"]
+        assert [e["scenario"] for e in chaos] == list(DEFAULT_SCENARIOS)
+        active_end = self.p.hours * 3600 * (1 - self.p.quiet_tail_frac)
+        for e in chaos:
+            assert 0 < e["at"] < active_end
+            assert e["seed"] == 11 * 1000 + chaos.index(e)
+
+    def test_faults_stay_clear_of_quiet_tail(self):
+        active_end = self.p.hours * 3600 * (1 - self.p.quiet_tail_frac)
+        for e in self.events:
+            if e["kind"] in ("node.drain", "node.flap"):
+                assert e["at"] + e["duration"] < active_end
+
+    def test_batch_runtimes_clear_the_tail(self):
+        active_end = self.p.hours * 3600 * (1 - self.p.quiet_tail_frac)
+        for e in self.events:
+            if e["kind"] == "job.register" and "runtime_s" in e \
+                    and e["jtype"] == "batch" and \
+                    e["job"].startswith("bat-"):
+                assert e["at"] + e["runtime_s"] < active_end
+
+    def test_capacity_ledger_bounds_standing_demand(self):
+        """Replaying register/scale/stop events against a cpu ledger
+        must never exceed the capacity fraction — that bound is what
+        makes 'every surviving demand placed' a reachable target."""
+        budget = (self.p.n_nodes * self.p.node_cpu
+                  * self.p.capacity_fraction)
+        booked = {}
+        for e in self.events:
+            if e["kind"] == "job.register" and e["jtype"] == "service":
+                booked[e["job"]] = e["count"] * e["cpu"]
+            elif e["kind"] == "job.scale":
+                booked[e["job"]] = e["count"] * e["cpu"]
+            elif e["kind"] == "job.stop":
+                booked.pop(e["job"], None)
+            assert sum(booked.values()) <= budget + 1e-9
+
+
+class TestRetryIdempotent:
+    def test_clean_call_single_attempt(self):
+        result, n = retry_idempotent(lambda: 42, lambda: False)
+        assert (result, n) == (42, 1)
+
+    def test_landed_but_reply_lost_is_not_reissued(self):
+        state = []
+        op = FaultyCall(lambda: state.append("x"), fail_first=1)
+        result, n = retry_idempotent(op, lambda: bool(state))
+        assert result is None and n == 1
+        assert state == ["x"]          # applied exactly once
+
+    def test_not_landed_reissues_until_success(self):
+        state = []
+        calls = []
+
+        def op():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("injected: request lost")
+            state.append("x")
+            return "ok"
+
+        result, n = retry_idempotent(op, lambda: bool(state))
+        assert (result, n) == ("ok", 3)
+        assert state == ["x"]
+
+    def test_budget_spent_raises_last_error(self):
+        def op():
+            raise ConnectionError("down")
+        with pytest.raises(ConnectionError):
+            retry_idempotent(op, lambda: False, attempts=3)

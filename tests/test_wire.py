@@ -205,12 +205,18 @@ class TestFrameAuth:
         assert b"hunter2" not in frame
 
     def test_stale_frame_rejected(self, monkeypatch):
+        # a sender whose injected clock (wire.set_clock) runs far behind
+        # stamps frames outside the freshness window — the receiver on
+        # the real clock drops them
         wire.set_key("cluster-secret")
-        real_time = wire.time.time
-        monkeypatch.setattr(wire.time, "time",
-                            lambda: real_time() - 2 * wire.REPLAY_WINDOW_S)
+
+        class Skewed(wire.SystemClock):
+            def time(self):
+                return super().time() - 2 * wire.REPLAY_WINDOW_S
+
+        monkeypatch.setattr(wire, "_CLOCK", Skewed())
         body = wire.encode_frame({"a": 1})[4:]
-        monkeypatch.setattr(wire.time, "time", real_time)
+        monkeypatch.setattr(wire, "_CLOCK", wire.SystemClock())
         with pytest.raises(ValueError):
             wire.decode_body(body)
 
