@@ -705,7 +705,11 @@ def run_config_5(args):
                # eval-driven path rides retained buffer handles — no
                # --bridge side-channel needed for the resident chain
                device_executor=(args.executor or "jax"),
-               mesh=False if mesh_off else None)
+               mesh=False if mesh_off else None,
+               # host sampling profiler (core/profiling.py): None keeps
+               # the always-on default; --sampler-hz 0 disables (the
+               # PERF.md §16 overhead A/B lever)
+               profile_hz=getattr(args, "sampler_hz", None))
     n_devices = s.engine.n_devices
     # sharded parity FIRST: before any timed wave, the mesh path must
     # prove bit-equal picks vs the single-device engine at small scale
@@ -971,7 +975,14 @@ def run_config_5(args):
     # gauges (collective payload, dirty-shard uploads) sample the same
     # window
     ex0 = dict(s.executor.stats)
+    by_cause0 = dict(s.executor.upload_bytes_by_cause)
     shard_b0 = s.engine.shard_h2d_bytes
+    # host-profiler window over the same section: the sustained waves
+    # are the steady state the GIL-wait question (ROADMAP item 5: would
+    # multi-process workers pay off?) is about, so the headline
+    # gil_wait_fraction is measured HERE, not over warmup/compile
+    from nomad_tpu.core import profiling as _prof
+    prof0 = _prof.PROFILER.snapshot()
     for _ in range(1 if quick else 2):
         # wavepipe stage timers per sustained run: the winning run's
         # report carries the overlap gauges that PROVE wave k+1's device
@@ -984,7 +995,13 @@ def run_config_5(args):
             sus_stages = s.stage_timers.report()
     sus_evals_per_sec = sus_waves * n_evals / sus_dt
     sus_rate = sus_waves * n_place / sus_dt
+    prof1 = _prof.PROFILER.snapshot()
+    prof_window = _prof.role_window(prof0, prof1)
+    gil_by_role = {r: round(_prof.SamplingProfiler._gil_fraction(
+        prof_window, r), 4) for r in sorted(prof_window)}
+    gil_wait_fraction = gil_by_role.get("worker", 0.0)
     ex1 = dict(s.executor.stats)
+    by_cause1 = dict(s.executor.upload_bytes_by_cause)
     ex_waves = ex1["dispatches"] - ex0["dispatches"]
     ex_resident = ex1["resident_waves"] - ex0["resident_waves"]
     resident_hit = ex_resident / ex_waves if ex_waves else 0.0
@@ -998,6 +1015,17 @@ def run_config_5(args):
                            if ex_waves else 0.0)
     shard_h2d_per_wave = ((s.engine.shard_h2d_bytes - shard_b0)
                           / ex_waves if ex_waves else 0.0)
+    # h2d split by CAUSE over the same window (the sum stays
+    # h2d_bytes_per_wave): steady-state waves should be dominated by
+    # invalidation-replay scatters, not full initial uploads — a full
+    # re-upload showing up here means chain residency broke
+    h2d_by_cause_per_wave = {
+        cause: round((by_cause1.get(cause, 0)
+                      - by_cause0.get(cause, 0)) / ex_waves, 1)
+        for cause in sorted(by_cause1)
+        if by_cause1.get(cause, 0) != by_cause0.get(cause, 0)} \
+        if ex_waves else {}
+    compile_summary = _prof.COMPILE.snapshot()
     executor_backend = s.executor.name
 
     # networked tier (ISSUE 8): one wave of the SAME shape with a
@@ -1124,7 +1152,37 @@ def run_config_5(args):
             "executor_backend": executor_backend,
             "resident_chain_hit_rate": round(resident_hit, 4),
             "h2d_bytes_per_wave": round(h2d_per_wave, 1),
+            # the same bytes split by CAUSE (core/profiling plane):
+            # initial-upload / dirty-shard-patch / invalidation-replay —
+            # steady state should be replay-dominated; the sum above is
+            # unchanged for trajectory continuity
+            "h2d_bytes_by_cause_per_wave": h2d_by_cause_per_wave,
             "executor_invalidations": ex1["invalidations"],
+            # device ledger (ops/executor.ledger): live HBM residency
+            # estimate from retained/donated handle sizes + the compile
+            # cache's per-shape-bucket hit economics
+            "hbm_resident_bytes": ex1.get("hbm_resident_bytes", 0),
+            "hbm_high_watermark_bytes":
+                ex1.get("hbm_high_watermark_bytes", 0),
+            "compile_cache_hits": compile_summary["hits"],
+            "compile_cache_misses": compile_summary["misses"],
+            "compile_cache_hit_rate":
+                round(compile_summary["hit_rate"], 4),
+            "compile_first_launch_s":
+                round(compile_summary["first_launch_s"], 3),
+            # host sampling profiler over the sustained section
+            # (core/profiling.py): how much of the workers' sampled wall
+            # time was runnable-but-not-running (ROADMAP item 5's
+            # baseline number), plus the sampler's own cost (PERF.md §16
+            # budget: <= 0.02); absent when --sampler-hz 0 disabled it
+            **({"gil_wait_fraction": gil_wait_fraction,
+                "gil_wait_fraction_by_role": gil_by_role,
+                "sampler_hz": prof1["hz"],
+                "sampler_overhead_fraction":
+                    round(prof1["overhead_fraction"], 5),
+                "profile_attributed_fraction":
+                    round(prof1["attributed_fraction"], 4)}
+               if prof1["running"] or prof1["samples"] else {}),
             # mesh deployment (nomad_tpu/parallel): device count, the
             # fraction of kernel rows that are mesh padding, the
             # per-wave cross-shard collective payload (O(top-k ·
@@ -1758,6 +1816,12 @@ def main():
                     help="config 5: retain the device-resident usage "
                          "chain across waves (off = host round-trip "
                          "every wave; the PERF.md §12 A/B lever)")
+    ap.add_argument("--sampler-hz", dest="sampler_hz", type=float,
+                    default=None, metavar="HZ",
+                    help="config 5: host sampling-profiler rate "
+                         "(core/profiling.py); default keeps the "
+                         "always-on 19 Hz, 0 disables — the PERF.md "
+                         "§16 overhead A/B lever")
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="write a JAX profiler (xprof) trace of the "
                          "benched kernel launches to DIR (SURVEY §6.1)")

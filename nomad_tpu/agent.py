@@ -37,7 +37,8 @@ class Agent:
                  clock: str = "wall",
                  log_level: str = "",
                  device_executor: str = "jax",
-                 slo: Optional[Dict[str, float]] = None) -> None:
+                 slo: Optional[Dict[str, float]] = None,
+                 profile_hz: Optional[float] = None) -> None:
         # producer-side log gate (agent_config log_level): records below
         # this level never reach the ring or its subscribers.  Only set
         # when explicitly configured — the process-wide ring default
@@ -109,7 +110,8 @@ class Agent:
                 num_workers=num_workers, heartbeat_ttl=heartbeat_ttl,
                 acl_enabled=acl_enabled,
                 transport=self.transport, clock=self.clock,
-                device_executor=device_executor, slo=slo)
+                device_executor=device_executor, slo=slo,
+                profile_hz=profile_hz)
         else:
             self.transport = resolve_transport(transport, node_name="agent",
                                                clock=self.clock)
@@ -117,7 +119,7 @@ class Agent:
                                  heartbeat_ttl=heartbeat_ttl,
                                  acl_enabled=acl_enabled, clock=self.clock,
                                  device_executor=device_executor,
-                                 slo=slo)
+                                 slo=slo, profile_hz=profile_hz)
         self.clients: List[Client] = []
         if client_enabled:
             if cluster_mode:

@@ -14,6 +14,7 @@ Supported shape (a practical subset of the reference's):
       transport       = "tcp"      # or "sim"  (nomad_tpu/chaos/)
       clock           = "wall"     # or "virtual"
       device_executor = "jax"      # or "bridge" (nomad_tpu/ops/executor.py)
+      profile_hz      = 19         # host sampler rate; 0 disables
       slo {                        # health watchdog (core/flightrec.py)
         p99_plan_queue_ms   = 500
         refute_rate         = 0.25
@@ -76,6 +77,10 @@ class AgentConfig:
     # buffers and errors at agent start when the native build or PJRT
     # plugin is absent (never a silent fallback)
     device_executor: str = "jax"
+    # continuous-profiling sampler rate (core/profiling.py): the host
+    # stack sampler is always-on at profiling.DEFAULT_HZ when this is
+    # None; a positive value re-tunes it and <= 0 disables it
+    profile_hz: Optional[float] = None
     # health-watchdog SLO thresholds (core/flightrec.py DEFAULT_SLO);
     # only the keys present here override the defaults, and a negative
     # threshold disables its rule
@@ -94,7 +99,8 @@ class AgentConfig:
 _BLOCK_KEYS = {
     "ports": {"http"},
     "server": {"enabled", "num_schedulers", "heartbeat_ttl",
-               "acl_enabled", "transport", "clock", "device_executor"},
+               "acl_enabled", "transport", "clock", "device_executor",
+               "profile_hz"},
     "client": {"enabled", "count", "node_class", "datacenter"},
     "acl": {"enabled"},
 }
@@ -178,6 +184,14 @@ def parse_agent_config(src: str):
                             "server device_executor must be 'jax' or "
                             f"'bridge', got {v!r}")
                     put("device_executor", v)
+                if "profile_hz" in body:
+                    v = body["profile_hz"]
+                    if isinstance(v, bool) or not isinstance(
+                            v, (int, float)):
+                        raise ValueError(
+                            f"server profile_hz must be a number, "
+                            f"got {v!r}")
+                    put("profile_hz", float(v))
                 for b in sub_blocks:
                     if b.type != "slo":
                         raise ValueError(

@@ -332,6 +332,26 @@ class Operator(_Endpoint):
         return self.c.request("GET", "/v1/operator/flight-recorder",
                               params=params)
 
+    def profile(self, duration_s: float = 2.0, trace: bool = False,
+                trace_dir: Optional[str] = None) -> Dict:
+        """Timed on-demand profile capture (blocks ~duration_s): folded
+        host stacks, bucket breakdown, device compile/HBM ledger,
+        flight rings — one "nomad-tpu.profile.v1" bundle.  `trace=True`
+        additionally records a `jax.profiler` trace into `trace_dir`."""
+        body: Dict = {"DurationS": duration_s, "Trace": trace}
+        if trace_dir:
+            body["TraceDir"] = trace_dir
+        return self.c.request("POST", "/v1/operator/profile", body=body)
+
+    def profile_status(self) -> Dict:
+        """Live sampler snapshot (no capture): buckets, GIL fractions,
+        folded stacks, retained capture ids."""
+        return self.c.get("/v1/operator/profile")
+
+    def profile_capture(self, capture_id: str) -> Dict:
+        """One retained capture bundle by id (`prof-0001`)."""
+        return self.c.get(f"/v1/operator/profile/{capture_id}")
+
 
 class System(_Endpoint):
     def gc(self) -> Dict:

@@ -524,6 +524,36 @@ class Router:
                     except ValueError:
                         raise APIError(400, "bad n")
                 return FLIGHT.snapshot(n_waves=n, n_evals=n, n_events=n)
+            if p[1:2] == ["profile"]:
+                # continuous profiling plane (core/profiling.py).
+                #   GET  /v1/operator/profile        live sampler snapshot
+                #        (+folded stacks, retained capture ids)
+                #   GET  /v1/operator/profile/<id>   one retained bundle
+                #   POST /v1/operator/profile        timed capture; body
+                #        {DurationS, Trace, TraceDir} — operator-write ACL
+                #        (captures cost real wall time on the agent)
+                from nomad_tpu.core.profiling import PROFILER
+                if p[2:3] and method == "GET":
+                    cap = PROFILER.get_capture(p[2])
+                    if cap is None:
+                        raise APIError(404, f"no capture {p[2]!r}")
+                    return cap
+                if method == "GET":
+                    doc = PROFILER.snapshot()
+                    doc["folded"] = PROFILER.folded()
+                    doc["captures"] = [c["id"]
+                                       for c in PROFILER.captures()]
+                    return doc
+                if method in ("PUT", "POST"):
+                    b = body or {}
+                    try:
+                        dur = float(b.get("DurationS", 2.0))
+                    except (TypeError, ValueError):
+                        raise APIError(400, "bad DurationS")
+                    return PROFILER.capture(
+                        duration_s=dur,
+                        include_trace=bool(b.get("Trace", False)),
+                        trace_dir=b.get("TraceDir"))
             if p[1:2] == ["debug"] and method == "GET":
                 # debug bundle (reference: `nomad operator debug`
                 # capture): stats + metrics + prometheus exposition +
@@ -534,6 +564,7 @@ class Router:
                 import threading as _threading
                 from nomad_tpu.core.flightrec import FLIGHT
                 from nomad_tpu.core.logging import RING
+                from nomad_tpu.core.profiling import PROFILER
                 from nomad_tpu.core.telemetry import TRACER
                 return {
                     "Stats": self.agent.stats(),
@@ -550,6 +581,11 @@ class Router:
                     "HealthDumps": s.health.dumps(),
                     "FlightRecorder": FLIGHT.snapshot(
                         n_waves=100, n_evals=200, n_events=100),
+                    # where the process spends its time (buckets + GIL
+                    # fraction) and the device compile/HBM ledger — the
+                    # profiling plane folded into the one-doc bundle
+                    "Profiler": PROFILER.brief(),
+                    "DeviceLedger": s.executor.ledger(),
                     "Threads": [
                         {"Name": t.name, "Daemon": t.daemon,
                          "Alive": t.is_alive()}

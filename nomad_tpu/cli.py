@@ -131,7 +131,8 @@ def cmd_agent(args) -> int:
                   clock=cfg.clock,
                   log_level=cfg.log_level,
                   device_executor=cfg.device_executor,
-                  slo=cfg.slo or None)
+                  slo=cfg.slo or None,
+                  profile_hz=cfg.profile_hz)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")
@@ -901,6 +902,79 @@ def cmd_health(args) -> int:
     return 0 if doc.get("Healthy") else 1
 
 
+def cmd_profile(args) -> int:
+    """On-demand profile capture (`nomad profile`): ask the agent for a
+    timed capture — folded host stacks, time-bucket breakdown, GIL-wait
+    fractions, the device compile/HBM ledger — and summarize it.
+    `-output` keeps the full bundle JSON; `-folded` writes just the
+    folded stacks (pipe into flamegraph.pl / load into speedscope).
+    `-status` prints the live sampler view without capturing."""
+    c = _client(args)
+    # a capture blocks server-side for its whole window
+    c.timeout = max(c.timeout, args.duration + 30.0)
+    if args.status:
+        doc = c.operator.profile_status()
+        print(f"sampler   = "
+              f"{'running' if doc.get('running') else 'stopped'} "
+              f"@ {doc.get('hz', 0):g} Hz "
+              f"({doc.get('samples', 0)} samples, "
+              f"overhead {doc.get('overhead_fraction', 0):.4f})")
+        for b, v in sorted(doc.get("buckets", {}).items(),
+                           key=lambda kv: -kv[1]):
+            print(f"  {b:<12} {v:10.1f}")
+        print(f"captures  = {doc.get('captures', [])}")
+        return 0
+    bundle = c.operator.profile(
+        duration_s=args.duration,
+        trace=bool(args.trace or args.trace_dir),
+        trace_dir=args.trace_dir or None)
+    print(f"capture {bundle['id']} ({bundle['schema']}): "
+          f"{bundle['samples']} samples over "
+          f"{bundle['duration_s']:g}s @ {bundle['hz']:g} Hz")
+    ts = bundle.get("thread_samples", 0) or 1
+    print(f"\n{'Bucket':<12} {'Weight':>10} {'Share':>8}")
+    for b, v in sorted(bundle.get("buckets", {}).items(),
+                       key=lambda kv: -kv[1]):
+        print(f"{b:<12} {v:>10.1f} {v / ts:>8.1%}")
+    print(f"attributed   = {bundle.get('attributed_fraction', 0):.1%} "
+          f"of {ts} thread-samples")
+    gil = bundle.get("gil_wait_fraction_by_role", {})
+    if gil:
+        print("gil-wait     = "
+              + "  ".join(f"{r}:{f:.1%}" for r, f in sorted(gil.items())))
+    comp = bundle.get("compile_ledger", {})
+    print(f"compiles     = {comp.get('misses', 0)} "
+          f"(hit rate {comp.get('hit_rate', 0):.1%}, "
+          f"first-launch {comp.get('first_launch_s', 0):.2f}s, "
+          f"steady {comp.get('steady_s', 0):.2f}s)")
+    led = bundle.get("device_ledger") or {}
+    if led:
+        print(f"hbm resident = {led.get('hbm_resident_bytes', 0)} B "
+              f"(high watermark "
+              f"{led.get('hbm_high_watermark_bytes', 0)} B)")
+        by_cause = led.get("upload_bytes_by_cause", {})
+        if by_cause:
+            print("h2d by cause = "
+                  + "  ".join(f"{k}:{v}"
+                              for k, v in sorted(by_cause.items())))
+    tr = bundle.get("jax_trace")
+    if tr:
+        print(f"jax trace    = "
+              + (tr.get("dir", "") if tr.get("ok")
+                 else f"unavailable ({tr.get('error', '')})"))
+    if args.folded:
+        with open(args.folded, "w") as f:
+            f.write("\n".join(bundle.get("folded", [])) + "\n")
+        print(f"{len(bundle.get('folded', []))} folded stacks written "
+              f"to {args.folded} (flamegraph.pl {args.folded} > "
+              f"flame.svg, or load into speedscope)")
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(bundle, f, indent=2)
+        print(f"profile bundle written to {args.output}")
+    return 0
+
+
 def cmd_soak(args) -> int:
     """Virtual-time production soak (`nomad soak`): boot an in-process
     agent on a VirtualClock, replay a seeded day of cluster life
@@ -1477,6 +1551,25 @@ def build_parser() -> argparse.ArgumentParser:
     hl = sub.add_parser("health",
                         help="SLO verdicts (observed vs threshold)")
     hl.set_defaults(fn=cmd_health)
+
+    prof = sub.add_parser("profile",
+                          help="on-demand profile capture (folded "
+                               "stacks, buckets, device ledger)")
+    prof.add_argument("-duration", type=float, default=2.0,
+                      help="capture window seconds (default 2)")
+    prof.add_argument("-output", default="",
+                      help="write the full bundle JSON to this path")
+    prof.add_argument("-folded", default="",
+                      help="write the folded stacks to this path "
+                           "(flamegraph.pl / speedscope input)")
+    prof.add_argument("-trace", action="store_true",
+                      help="also record a jax.profiler trace")
+    prof.add_argument("-trace-dir", dest="trace_dir", default="",
+                      help="directory for the jax.profiler trace "
+                           "(implies -trace)")
+    prof.add_argument("-status", action="store_true",
+                      help="print the live sampler view; no capture")
+    prof.set_defaults(fn=cmd_profile)
 
     dbg = sub.add_parser("debug",
                          help="flight recorder & dump bundles"

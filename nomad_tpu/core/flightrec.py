@@ -36,6 +36,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu.chaos.clock import Clock, SystemClock
+from nomad_tpu.core.profiling import PROFILER
 from nomad_tpu.core.telemetry import REGISTRY, TRACER, MetricsRegistry, Tracer
 
 
@@ -376,6 +377,11 @@ class HealthWatchdog:
             "Verdicts": [dict(v) for v in verdicts],
             "SLO": dict(self.slo),
             "FlightRecorder": self.flight.snapshot(),
+            # where the process was spending time when it breached, and
+            # the endpoint to pull a full capture from (sampler reads the
+            # real clock, so this section is excluded from soak
+            # byte-identity assertions — see tests/test_profiling.py)
+            "Profiler": PROFILER.brief(),
             "Windows": snap["windows"],
             "Counters": snap["counters"],
             "Traces": self.tracer.traces()[-50:],

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.chaos.clock import Clock, SystemClock
+from nomad_tpu.core import profiling
 from nomad_tpu.core.flightrec import FLIGHT
 from nomad_tpu.core.logging import log, trace_scope
 from nomad_tpu.core.telemetry import (
@@ -239,7 +240,12 @@ class PlanApplier:
             # escaping the dequeue/timer path would silently kill THE
             # serialization point of the whole system — log and continue
             try:
-                pending = self.queue.dequeue(timeout=0.1)
+                # profiling marker: the dequeue is the applier's park
+                # point — without it a sampled Condition.wait frame is
+                # heuristically classified; the marker makes the
+                # applier's idle share exact (core/profiling.py)
+                with profiling.activity("idle"):
+                    pending = self.queue.dequeue(timeout=0.1)
                 if pending is None:
                     continue
                 self.apply_one(pending)

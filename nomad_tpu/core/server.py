@@ -39,7 +39,7 @@ from nomad_tpu.structs import (
     new_id,
 )
 
-from . import flightrec, identity, telemetry
+from . import flightrec, identity, profiling, telemetry
 from . import logging as logging_mod
 from .logging import log
 from .blocked_evals import BlockedEvals
@@ -66,7 +66,8 @@ class Server:
                  clock: Optional[Clock] = None,
                  device_executor: str = "jax",
                  mesh=None,
-                 slo: Optional[Dict[str, float]] = None) -> None:
+                 slo: Optional[Dict[str, float]] = None,
+                 profile_hz: Optional[float] = None) -> None:
         # injected timebase (chaos/clock.py): every endpoint default
         # `now`, heartbeat deadline, and the tick loop read this clock,
         # so a chaos scenario's VirtualClock owns the whole server's
@@ -163,6 +164,21 @@ class Server:
         # HealthBreach event and snapshots a dump bundle
         self.health = flightrec.HealthWatchdog(slo=slo, clock=self.clock)
         self.health.on_breach = self._on_health_breach
+        # continuous profiling plane (core/profiling.py): the host
+        # sampler is always-on at a low default rate (agent_config
+        # server.profile_hz tunes it; <= 0 disables).  Unlike every
+        # configure() above, the PROFILER deliberately does NOT get this
+        # server's injected clock — it samples the real process
+        # regardless of whose timeline the server runs on, and stays up
+        # across server close (it profiles the process, not a server)
+        profiling.configure(hz=profile_hz)
+        profiling.PROFILER.device_ledger_provider = self._device_ledger
+        profiling.PROFILER.flight_provider = flightrec.FLIGHT.snapshot
+
+    def _device_ledger(self) -> Dict:
+        """Capture-bundle provider: this server's executor ledger
+        (compile cache + HBM residency + transfer attribution)."""
+        return self.executor.ledger()
 
     def _on_health_breach(self, verdict: Dict, bundle: Dict) -> None:
         """Fan a newly-breached SLO rule out as a HealthBreach event

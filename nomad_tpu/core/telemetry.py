@@ -253,6 +253,19 @@ class MetricsRegistry:
             return sum(v for (n, _), v in self._counters.items()
                        if n == name)
 
+    def counter_labels(self, name: str) -> Dict[str, float]:
+        """Per-label-set values for one counter name, keyed by the
+        flattened label string (`cause=initial-upload`); the unlabeled
+        series appears under ``""``.  Lets bench/profile surfaces break
+        a counter down by cause without reaching into internals."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for (n, labels), v in sorted(self._counters.items()):
+                if n != name:
+                    continue
+                out[",".join(f"{lk}={lv}" for lk, lv in labels)] = v
+            return out
+
     @staticmethod
     def _flat(k: LabelKey) -> str:
         name, labels = k

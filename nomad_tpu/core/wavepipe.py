@@ -46,6 +46,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from nomad_tpu.core import profiling
 from nomad_tpu.core.flightrec import FLIGHT
 from nomad_tpu.core.telemetry import REGISTRY
 
@@ -306,8 +307,11 @@ class WavePipeline:
             try:
                 # the pipeline's ONE deliberate sync point: collect()
                 # exists to pay this wait, after the successor wave has
-                # already been dispatched
-                buf.block_until_ready()   # analyze: ok purity
+                # already been dispatched.  The profiling marker pins
+                # the sampler's classification — the GIL is released in
+                # here, so these samples are device-wait, not host time
+                with profiling.activity("device-wait"):
+                    buf.block_until_ready()   # analyze: ok purity
                 t_ready = time.perf_counter()
             except (AttributeError, RuntimeError):
                 pass

@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from nomad_tpu.core import profiling
 from nomad_tpu.core.flightrec import FLIGHT
 from nomad_tpu.core.logging import log, trace_scope
 from nomad_tpu.core.telemetry import (
@@ -131,8 +132,14 @@ class Worker:
             return self.run_batch(batch_n, timeout=timeout, now=now)
         broker = self.server.eval_broker
         t = now if now is not None else self.server.clock.time()
-        evaluation, token = broker.dequeue(SCHEDULERS_SERVED, now=t,
-                                           timeout=timeout)
+        # profiling marker: an empty queue parks the worker inside the
+        # broker's condition wait — mark the whole dequeue idle so the
+        # sampler's worker-role buckets separate "no work" from GIL/host
+        # time (a busy dequeue returns in microseconds; its share of
+        # samples is negligible)
+        with profiling.activity("idle"):
+            evaluation, token = broker.dequeue(SCHEDULERS_SERVED, now=t,
+                                               timeout=timeout)
         if evaluation is None:
             return 0
         self._eval_token = token
@@ -205,8 +212,9 @@ class Worker:
         pf = self._prefetch
         self._prefetch = None
         if pf is None:
-            batch = broker.dequeue_batch(SCHEDULERS_SERVED, max_n, now=t,
-                                         timeout=timeout)
+            with profiling.activity("idle"):   # see run_once's marker
+                batch = broker.dequeue_batch(SCHEDULERS_SERVED, max_n,
+                                             now=t, timeout=timeout)
             if not batch:
                 return 0
         else:
@@ -241,7 +249,10 @@ class Worker:
         state = self.server.state
         max_idx = max((ev.modify_index or 0) for ev, _ in batch)
         if max_idx:
-            state.wait_for_index(max_idx, timeout=5.0)
+            # waiting on the applier to reach the eval's index is a
+            # pipeline stall, not host work — lock-wait for the sampler
+            with profiling.activity("lock-wait"):
+                state.wait_for_index(max_idx, timeout=5.0)
         # placement-write fence read ATOMICALLY with the snapshot: a
         # foreign write between separate reads would be invisible to the
         # fence yet missing from the snapshot (the applier would then

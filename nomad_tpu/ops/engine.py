@@ -58,6 +58,19 @@ _scatter_add_jit = jax.jit(lambda u, r, v: u.at[r].add(v))
 _MESH_SINGLETON = None
 _SHARDED_FN_CACHE: Dict[tuple, object] = {}
 
+# shape buckets already launched at least once in this process — the
+# compile-ledger mirror of jax's process-global jit caches (a second
+# engine in the same process hits the jit cache, so it must not count
+# a fresh compile).  See `PlacementEngine._launch`.
+_KERNEL_SHAPES_SEEN: set = set()
+
+
+def _compile_ledger():
+    """Process compile ledger (core/profiling.py), imported lazily for
+    the same first-importer-order reason as `_registry` below."""
+    from nomad_tpu.core.profiling import COMPILE
+    return COMPILE
+
 
 def _registry():
     """Process metrics registry, imported lazily: `nomad_tpu.core`'s
@@ -375,14 +388,74 @@ class PlacementEngine:
         self._const_cache: Dict[tuple, object] = {}
         self._dc_cache: Optional[Tuple[int, Dict[str, int]]] = None
         # host->device sync meter (ops/executor.py installs it): called
-        # with (bytes, seconds) for every node-state upload — full node
-        # tensors, full `used`, and the per-eval delta-replay scatters
+        # with (bytes, seconds, cause) for every node-state upload —
+        # full node tensors ("initial-upload"), dirty-shard patches
+        # ("dirty-shard-patch"), and the per-eval delta-replay scatters
+        # ("invalidation-replay"); the d2h twin meters result fetches
         self.h2d_observer = None
+        self.d2h_observer = None
 
-    def _note_h2d(self, nbytes: int, seconds: float) -> None:
+    def _note_h2d(self, nbytes: int, seconds: float,
+                  cause: str = "initial-upload") -> None:
         obs = self.h2d_observer
         if obs is not None and nbytes:
-            obs(nbytes, seconds)
+            obs(nbytes, seconds, cause)
+
+    def _note_d2h(self, nbytes: int, seconds: float,
+                  cause: str = "result-fetch") -> None:
+        obs = self.d2h_observer
+        if obs is not None and nbytes:
+            obs(nbytes, seconds, cause)
+
+    def _fetch(self, arr) -> np.ndarray:
+        """Materialize a device result buffer on the host with the d2h
+        ledger fed ("result-fetch" cause): every byte the scheduler
+        pulls back from the chip is attributed, matching the h2d side."""
+        t0 = time.perf_counter_ns()
+        out = np.asarray(arr)
+        self._note_d2h(out.nbytes, (time.perf_counter_ns() - t0) / 1e9)
+        return out
+
+    def _launch(self, kind: str, shape_key: tuple, fn, *args):
+        """Run one compiled-kernel launch under the compile ledger
+        (core/profiling.py): the FIRST launch of a shape bucket pays
+        trace+lower+compile synchronously inside the call (PERF.md §13
+        measured this split by hand), later launches are steady
+        dispatches.  The bucket key mirrors what makes jax recompile —
+        kernel kind + the static shape arguments."""
+        site = f"engine.{kind}/" + "x".join(str(s) for s in shape_key)
+        key = (kind, shape_key)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        dt = time.perf_counter() - t0
+        led = _compile_ledger()
+        if key in _KERNEL_SHAPES_SEEN:
+            led.note_hit(site)
+            led.note_steady(site, dt)
+        else:
+            _KERNEL_SHAPES_SEEN.add(key)
+            led.note_miss(site, dt)
+        return out
+
+    def device_resident_bytes(self) -> int:
+        """Estimated HBM residency of this engine's retained device
+        buffers (node tensors, resident `used`, const cache).  Reads
+        WITHOUT the packer lock — callers sit inside _note_h2d, some of
+        whose call sites already hold it — so a concurrent eviction can
+        tear an iteration; this is a gauge, skip and report the partial
+        sum rather than block the hot path."""
+        total = 0
+        try:
+            for v in tuple(self._dev_cache.values()):
+                total += int(getattr(v, "nbytes", 0))
+            u = self._used_dev
+            if u is not None:
+                total += int(getattr(u, "nbytes", 0))
+            for v in tuple(self._const_cache.values()):
+                total += int(getattr(v, "nbytes", 0))
+        except RuntimeError:
+            pass
+        return total
 
     @property
     def n_devices(self) -> int:
@@ -477,7 +550,9 @@ class PlacementEngine:
                     self._used_dev = None
                 self._cache_version = key
                 self._cache_npad = npad
-            self._note_h2d(h2d, time.perf_counter() - t0h)
+            self._note_h2d(h2d, time.perf_counter() - t0h,
+                           "dirty-shard-patch" if patched
+                           else "initial-upload")
         return self._dev_cache
 
     def _shard_of(self, rows: np.ndarray, npad: int) -> set:
@@ -581,7 +656,8 @@ class PlacementEngine:
                                         nb)
                     self._used_version = ver
                     self._note_h2d(h2d_bytes,
-                                   time.perf_counter() - t0h)
+                                   time.perf_counter() - t0h,
+                                   "dirty-shard-patch")
                     return self._used_dev
             if deltas is not None:
                 rows = np.concatenate([d[0] for d in deltas])
@@ -611,7 +687,9 @@ class PlacementEngine:
                             [r_c, np.zeros(pad - n_c, r_c.dtype)])
                         v_c = np.concatenate(
                             [v_c, np.zeros((pad - n_c, 3), v_c.dtype)])
-                    dev = self._scatter_fn(
+                    dev = self._launch(
+                        "scatter", (int(dev.shape[0]), pad),
+                        self._scatter_fn,
                         dev, jnp.asarray(r_c), jnp.asarray(v_c))
                     h2d_bytes += r_c.nbytes + v_c.nbytes
                 self._used_dev = dev
@@ -631,7 +709,13 @@ class PlacementEngine:
                                       PartitionSpec("nodes", None)))
                 h2d_bytes += int(self._used_dev.nbytes)
             self._used_version = ver
-            self._note_h2d(h2d_bytes, time.perf_counter() - t0h)
+            # the delta-log scatter replays stale usage after a chain
+            # invalidation / plan commit; a full upload is the initial
+            # (or post-rebuild) sync — two different costs the single
+            # upload_bytes counter used to conflate
+            self._note_h2d(h2d_bytes, time.perf_counter() - t0h,
+                           "invalidation-replay" if deltas is not None
+                           else "initial-upload")
             return self._used_dev
 
     def _dev_const(self, key, builder):
@@ -846,8 +930,9 @@ class PlacementEngine:
             fills_full = None
             slot_k = 0
             if self.mesh is not None:
-                buf, used_dev, job_count_dev = self._sharded(
-                    "bulk", round_size, n_rounds)(binp)
+                buf, used_dev, job_count_dev = self._launch(
+                    "bulk", (round_size, n_rounds, npad),
+                    self._sharded("bulk", round_size, n_rounds), binp)
                 self._note_collective(
                     n_rounds, min(round_size, npad // self._ndev))
             elif bulk_api and algo != SCHED_ALGO_SPREAD:
@@ -858,16 +943,19 @@ class PlacementEngine:
                 # prefix every time and pay two fetches — it keeps the
                 # full layout (code-review r5).
                 slot_k = min(FILL_K, round_size)
-                buf, fills_full, used_dev, job_count_dev = \
-                    place_bulk_packed_jit(binp, round_size, n_rounds,
-                                          False, slot_k)
+                buf, fills_full, used_dev, job_count_dev = self._launch(
+                    "bulk_compact", (round_size, n_rounds, npad, slot_k),
+                    place_bulk_packed_jit, binp, round_size, n_rounds,
+                    False, slot_k)
             else:
-                buf, used_dev, job_count_dev = place_bulk_packed_jit(
-                    binp, round_size, n_rounds, not bulk_api)
+                buf, used_dev, job_count_dev = self._launch(
+                    "bulk", (round_size, n_rounds, npad, bulk_api),
+                    place_bulk_packed_jit, binp, round_size, n_rounds,
+                    not bulk_api)
             tg_idx = np.full(p_real, g_idx, np.int32)
             if bulk_api:
                 buf_np, slot_k = _resolve_compact_fills(
-                    np.asarray(buf), fills_full, slot_k)
+                    self._fetch(buf), fills_full, slot_k)
                 picks, _, meta = _unpack_bulk_compact(
                     buf_np, round_size, p_real, slot_k=slot_k)
                 if npad != n:
@@ -882,7 +970,7 @@ class PlacementEngine:
                     job_count_dev, p_real, n, t0)
             (picks, scores, topk_rows, topk_scores,
              n_feas, n_filt, n_exh, dim_exh) = _unpack_bulk(
-                np.asarray(buf), round_size, p_real, n)
+                self._fetch(buf), round_size, p_real, n)
             n_filt = n_filt - (npad - n)
             inp = binp      # _preempt_fallback field source
         else:
@@ -924,13 +1012,15 @@ class PlacementEngine:
                 extra_mask=extra_mask,
             )
             if self.mesh is not None:
-                buf, used_dev, job_count_dev = self._sharded("scan")(inp)
+                buf, used_dev, job_count_dev = self._launch(
+                    "scan", (npad, p_pad), self._sharded("scan"), inp)
                 self._note_collective(
                     p_pad, min(TOP_K, npad // self._ndev),
                     width=2, extra=128)
             else:
-                buf, used_dev, job_count_dev = place_packed_jit(inp)
-            b = np.asarray(buf)[:p_real]
+                buf, used_dev, job_count_dev = self._launch(
+                    "scan", (npad, p_pad), place_packed_jit, inp)
+            b = self._fetch(buf)[:p_real]
             picks = b[:, 0].copy()
             scores = b[:, 1].view(np.float32)
             topk_rows = b[:, 2:5]
@@ -1257,6 +1347,7 @@ class PlacementEngine:
         fills_full = None
         fill_k = None
         coll_bytes = 0
+        skey = (rs, aux["npad"], aux["n_lanes"])
         if aux["cand_rows"] is not None:
             cr = jnp.asarray(aux["cand_rows"])
             cv = jnp.asarray(aux["cand_valid"])
@@ -1265,39 +1356,51 @@ class PlacementEngine:
                     # donated sharded chain: wave k's dead sharded usage
                     # buffer is reused in place, exactly like the
                     # single-device place_multi_compact_chained_jit
-                    buf, fills_full, used_out = self._sharded(
-                        "multi_compact_chained", rs, aux["n_lanes"])(
+                    buf, fills_full, used_out = self._launch(
+                        "multi_compact_chained", skey,
+                        self._sharded("multi_compact_chained", rs,
+                                      aux["n_lanes"]),
                         inp.used0, inp._replace(used0=None), cr, cv)
                 else:
-                    buf, fills_full, used_out = self._sharded(
-                        "multi_compact", rs, aux["n_lanes"])(inp, cr, cv)
+                    buf, fills_full, used_out = self._launch(
+                        "multi_compact", skey,
+                        self._sharded("multi_compact", rs,
+                                      aux["n_lanes"]),
+                        inp, cr, cv)
                 coll_bytes = self._note_collective(
                     int(inp.round_g.shape[0]),
                     min(rs, int(aux["cand_rows"].shape[-1])))
             elif chained:
-                buf, fills_full, used_out = \
-                    place_multi_compact_chained_jit(
-                        inp.used0, inp._replace(used0=None), cr, cv,
-                        rs, aux["n_lanes"])
+                buf, fills_full, used_out = self._launch(
+                    "multi_compact_chained", skey,
+                    place_multi_compact_chained_jit,
+                    inp.used0, inp._replace(used0=None), cr, cv,
+                    rs, aux["n_lanes"])
             else:
-                buf, fills_full, used_out = \
-                    place_multi_compact_packed_jit(
-                        inp, cr, cv, rs, aux["n_lanes"])
+                buf, fills_full, used_out = self._launch(
+                    "multi_compact", skey,
+                    place_multi_compact_packed_jit,
+                    inp, cr, cv, rs, aux["n_lanes"])
             fill_k = min(FILL_K, rs)
         elif self.mesh is not None:
             if chained:
-                buf, used_out, _ = self._sharded("multi_chained", rs)(
+                buf, used_out, _ = self._launch(
+                    "multi_chained", skey,
+                    self._sharded("multi_chained", rs),
                     inp.used0, inp._replace(used0=None))
             else:
-                buf, used_out, _ = self._sharded("multi", rs)(inp)
+                buf, used_out, _ = self._launch(
+                    "multi", skey, self._sharded("multi", rs), inp)
             coll_bytes = self._note_collective(
                 int(inp.round_g.shape[0]),
                 min(rs, aux["npad"] // self._ndev))
         elif chained:
-            buf, used_out, _ = place_multi_chained_jit(
+            buf, used_out, _ = self._launch(
+                "multi_chained", skey, place_multi_chained_jit,
                 inp.used0, inp._replace(used0=None), rs)
         else:
-            buf, used_out, _ = place_multi_packed_jit(inp, rs)
+            buf, used_out, _ = self._launch(
+                "multi", skey, place_multi_packed_jit, inp, rs)
         # start the device->host copy of the result buffer NOW: over the
         # tunnel the fetch has a ~0.1s fixed latency, and queueing it
         # behind the compute lets a prefetched batch's transfer ride out
@@ -1651,7 +1754,7 @@ class PlacementEngine:
         t, ctxs, n, npad = (pending["t"], pending["ctxs"],
                             pending["n"], pending["npad"])
         t1 = time.perf_counter_ns()
-        buf_np = np.asarray(pending["buf"])
+        buf_np = self._fetch(pending["buf"])
         if pending.get("perm") is not None:
             # laned schedule: reorder rows back to eval-major order so
             # the spans below slice each eval's contiguous rounds
@@ -1659,7 +1762,7 @@ class PlacementEngine:
         fill_k = pending.get("fill_k")
 
         def _full_fills():
-            full = np.asarray(pending["fills_full"])
+            full = self._fetch(pending["fills_full"])
             if pending.get("perm") is not None:
                 full = full[pending["perm"]]
             return full

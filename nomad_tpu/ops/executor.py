@@ -41,6 +41,12 @@ state-store write that changes node state the chain cannot observe:
 
 Telemetry (core/telemetry.py, exported via /v1/metrics):
   nomad.executor.uploads / upload_bytes   host->device node-state syncs
+  nomad.executor.upload_bytes_by_cause    the same bytes split by cause
+                                          (initial-upload / dirty-shard-
+                                          patch / invalidation-replay)
+  nomad.executor.d2h_bytes / d2h_s        device->host result fetches
+  nomad.executor.hbm_resident_bytes       retained-handle HBM estimate
+  nomad.executor.hbm_high_watermark_bytes   ... and its high watermark
   nomad.executor.resident_waves           launches that chained handles
   nomad.executor.invalidations            retained chains dropped
   nomad.executor.h2d_s                    upload latency histogram
@@ -119,7 +125,18 @@ class DeviceExecutor:
                       # mesh deployments: per-launch cross-shard
                       # collective payload (engine._note_collective) —
                       # 0 forever on a single device
-                      "collective_bytes": 0}
+                      "collective_bytes": 0,
+                      # device->host result fetches (the d2h twin)
+                      "d2h_fetches": 0, "d2h_bytes": 0,
+                      # HBM residency estimate from retained/donated
+                      # handle sizes, plus its high watermark
+                      "hbm_resident_bytes": 0,
+                      "hbm_high_watermark_bytes": 0}
+        # upload_bytes split by CAUSE (initial-upload / dirty-shard-patch
+        # / invalidation-replay) — kept OUT of `stats` so existing
+        # numeric delta readers (bench, perfcheck) stay shape-stable;
+        # `upload_bytes` above remains the sum for continuity
+        self.upload_bytes_by_cause: dict = {}
 
     # ------------------------------------------------------------ waves
 
@@ -236,13 +253,74 @@ class DeviceExecutor:
 
     # ----------------------------------------------------- telemetry
 
-    def _observe_h2d(self, nbytes: int, seconds: float) -> None:
+    def _observe_h2d(self, nbytes: int, seconds: float,
+                     cause: str = "initial-upload") -> None:
         with self._lock:
             self.stats["uploads"] += 1
             self.stats["upload_bytes"] += int(nbytes)
+            self.upload_bytes_by_cause[cause] = \
+                self.upload_bytes_by_cause.get(cause, 0) + int(nbytes)
+            self._update_hbm_locked()
         REGISTRY.inc("nomad.executor.uploads")
         REGISTRY.inc("nomad.executor.upload_bytes", int(nbytes))
+        # the by-cause twin rides a SEPARATE counter name: labeling the
+        # original would double `counter_sum("...upload_bytes")` readers
+        REGISTRY.inc("nomad.executor.upload_bytes_by_cause",
+                     int(nbytes), cause=cause)
         REGISTRY.observe("nomad.executor.h2d_s", seconds)
+
+    def _observe_d2h(self, nbytes: int, seconds: float,
+                     cause: str = "result-fetch") -> None:
+        with self._lock:
+            self.stats["d2h_fetches"] += 1
+            self.stats["d2h_bytes"] += int(nbytes)
+        REGISTRY.inc("nomad.executor.d2h_bytes", int(nbytes),
+                     cause=cause)
+        REGISTRY.observe("nomad.executor.d2h_s", seconds)
+
+    def _update_hbm_locked(self) -> None:
+        """Refresh the HBM-residency estimate (self._lock held): the
+        engine's retained device caches plus the parked chain handle.
+        An estimate from handle sizes, not an allocator query — the
+        high watermark is the capacity-planning number."""
+        total = 0
+        eng = self.engine
+        if eng is not None and hasattr(eng, "device_resident_bytes"):
+            total += eng.device_resident_bytes()
+        c = self._chain
+        if c is not None:
+            total += int(getattr(c[2][0], "nbytes", 0))
+        self.stats["hbm_resident_bytes"] = total
+        if total > self.stats["hbm_high_watermark_bytes"]:
+            self.stats["hbm_high_watermark_bytes"] = total
+        REGISTRY.set_gauge("nomad.executor.hbm_resident_bytes", total)
+        REGISTRY.set_gauge("nomad.executor.hbm_high_watermark_bytes",
+                           self.stats["hbm_high_watermark_bytes"])
+
+    def ledger(self) -> dict:
+        """The device ledger (capture bundles, /v1/operator/debug):
+        compile-cache traffic, HBM residency + watermark, and h2d/d2h
+        transfer attribution by cause."""
+        from nomad_tpu.core.profiling import COMPILE
+        with self._lock:
+            self._update_hbm_locked()
+            stats = dict(self.stats)
+            by_cause = dict(self.upload_bytes_by_cause)
+        return {
+            "backend": self.name,
+            "compile": COMPILE.snapshot(),
+            "hbm_resident_bytes": stats["hbm_resident_bytes"],
+            "hbm_high_watermark_bytes":
+                stats["hbm_high_watermark_bytes"],
+            "uploads": stats["uploads"],
+            "upload_bytes": stats["upload_bytes"],
+            "upload_bytes_by_cause": by_cause,
+            "d2h_fetches": stats["d2h_fetches"],
+            "d2h_bytes": stats["d2h_bytes"],
+            "invalidations": stats["invalidations"],
+            "resident_waves": stats["resident_waves"],
+            "dispatches": stats["dispatches"],
+        }
 
     def close(self) -> None:
         self.invalidate("close")
@@ -260,8 +338,10 @@ class JaxExecutor(DeviceExecutor):
     def __init__(self, engine, chain_enabled: bool = True) -> None:
         super().__init__(engine, chain_enabled=chain_enabled)
         # meter the engine's host->device node-state syncs
-        # (_node_arrays full uploads + _used_device delta replays)
+        # (_node_arrays full uploads + _used_device delta replays) and
+        # its device->host result fetches
         engine.h2d_observer = self._observe_h2d
+        engine.d2h_observer = self._observe_d2h
 
     def dispatch_batch(self, snapshot, items, seed=0, used0_dev=None,
                        masked_node_ids=None):
@@ -351,6 +431,11 @@ class BridgeExecutor(DeviceExecutor):
                 "configure device_executor = \"jax\" instead")
         super().__init__(engine, chain_enabled=chain_enabled)
         self._bridge = nb.PjrtBridge(plugin)
+        # the engine's collect path materializes bridge result buffers
+        # (np.asarray on _BridgeArray) — meter those d2h fetches; h2d
+        # stays unmetered on the engine side for the bridge (its real
+        # uploads go through _leaf_handle below)
+        engine.d2h_observer = self._observe_d2h
         self._compiled = {}       # shape signature -> (exec, out_specs)
         self._h2d_cache = {}      # id(leaf) -> (leaf ref, handle)
         self._h2d_order = []      # insertion order for eviction
@@ -388,16 +473,25 @@ class BridgeExecutor(DeviceExecutor):
         out_specs)."""
         import jax
         from nomad_tpu.native.bridge import export_stablehlo
+        from nomad_tpu.core.profiling import COMPILE
         sig = tuple((tuple(s.shape), str(s.dtype))
                     for s in jax.tree_util.tree_leaves(spec_args))
+        # shape-bucket site label: the largest leaf (the node-axis
+        # tensor) tells buckets apart without dumping the whole sig
+        dims = max((s[0] for s in sig if s[0]), default=(),
+                   key=lambda t: int(np.prod(t)))
+        site = "bridge/" + "x".join(map(str, dims))
         hit = self._compiled.get(sig)
         if hit is not None:
+            COMPILE.note_hit(site)
             return hit
+        t0 = time.perf_counter()
         hlo = export_stablehlo(kernel, *spec_args)
         ex = self._bridge.compile(hlo)
         outs = [(tuple(o.shape), np.dtype(o.dtype))
                 for o in jax.tree_util.tree_leaves(
                     jax.eval_shape(kernel, *spec_args))]
+        COMPILE.note_miss(site, time.perf_counter() - t0)
         self._compiled[sig] = (ex, outs)
         return ex, outs
 

@@ -266,6 +266,75 @@ finally:
     agent.shutdown()
 EOF
 
+echo "== profile smoke (continuous profiling plane, capture bundle) =="
+# boot a dev agent under load, take a short on-demand capture through
+# POST /v1/operator/profile, and validate the bundle schema: compile
+# ledger populated (the agent just compiled its kernels), HBM
+# watermark nonzero, h2d split by cause, >= 90% of sampled thread
+# time in a named bucket, sampler overhead within the 2% budget
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.structs import codec
+
+agent = Agent(num_clients=1, num_workers=1, heartbeat_ttl=3600,
+              device_executor="jax").start()
+api = APIClient(address=agent.address)
+try:
+    evals = []
+    for _ in range(8):
+        job = mock.batch_job()
+        tg = job.task_groups[0]
+        tg.count = 2
+        tg.tasks[0].config = {"run_for_s": 300}
+        tg.tasks[0].resources.cpu = 20
+        tg.tasks[0].resources.memory_mb = 16
+        evals.append(api.jobs.register(codec.encode(job))["EvalID"])
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        done = sum(1 for e in evals
+                   if api.evaluations.info(e).get("Status")
+                   in ("complete", "failed"))
+        if done == len(evals):
+            break
+        time.sleep(0.1)
+
+    st = api.operator.profile_status()
+    assert st["running"], "sampler must be always-on by default"
+    b = api.operator.profile(duration_s=1.5)
+    assert b["schema"] == "nomad-tpu.profile.v1", b["schema"]
+    assert b["samples"] > 0, b["samples"]
+    assert b["attributed_fraction"] >= 0.90, b["attributed_fraction"]
+    assert b["overhead_fraction"] <= 0.02, b["overhead_fraction"]
+    comp = b["compile_ledger"]
+    assert comp["misses"] > 0 and comp["sites"], comp
+    led = b["device_ledger"]
+    assert led and led["hbm_high_watermark_bytes"] > 0, led
+    assert led["upload_bytes_by_cause"], led
+    assert b["folded"], "capture carried no folded stacks"
+    assert b["flight_recorder"] is not None
+    # retained + addressable by id, and folded into the debug bundle
+    assert api.operator.profile_capture(b["id"])["id"] == b["id"]
+    dbg = api.operator.debug()
+    assert "Profiler" in dbg and "DeviceLedger" in dbg, sorted(dbg)
+    print(f"profile smoke ok: {b['id']} samples={b['samples']} "
+          f"attributed={b['attributed_fraction']:.3f} "
+          f"overhead={b['overhead_fraction']:.5f} "
+          f"compile_sites={len(comp['sites'])} "
+          f"hbm_watermark={led['hbm_high_watermark_bytes']}")
+finally:
+    agent.shutdown()
+EOF
+
+echo "== perfcheck (trajectory gate comparator, self-check) =="
+# the bench/soak tolerance-band comparator must pass the checked-in
+# baselines against themselves and catch injected regressions before
+# anything trusts its verdicts (the analyze.py --selftest posture)
+python scripts/perfcheck.py --self-check
+
 echo "== multichip (8-device virtual mesh: parity, scale soak, bench) =="
 # the sharded production path (ISSUE 7): engine-level sharded-vs-single
 # parity + padded-row properties, the resident-chain sharded parity

@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""Performance-trajectory gate (scripts/perfcheck.py).
+
+Compares a FRESH bench/soak summary JSON against the checked-in
+trajectory files (BENCH_r0*.json / SOAK_r01.json) with per-metric
+tolerance bands and emits one machine-readable verdict document —
+CI's answer to "did this change quietly regress the numbers the
+repo's README/PERF.md advertise?".
+
+Two comparison kinds:
+
+  bench — numeric bands.  Throughput metrics are FLOORS (fresh must
+      stay within `rel` below baseline), latency metrics are CEILINGS.
+      Comparisons are only meaningful at matching scale, so the gate
+      first checks the shape fields (n_evals / placements_per_eval /
+      workers) and fails with `incomparable` when they differ (override
+      with --allow-scale-mismatch for cross-shape exploration).
+      Absolute gates (sampler overhead budget, attribution floor, zero
+      SLO breaches) apply to the fresh doc alone, baseline-free.
+  soak — the seeded virtual-time soak is deterministic BY CONTRACT
+      (same seed, same bytes), so same-profile runs compare exactly:
+      fingerprints, digests, eval counts, breach counts.  Wall-clock
+      fields are informational (they measure the host, not the code).
+
+Usage:
+    python scripts/perfcheck.py --kind bench --fresh out.json
+    python scripts/perfcheck.py --kind soak --fresh SOAK_ci.json \
+        --baseline SOAK_r01.json
+    python scripts/perfcheck.py --band value=0.25 --fresh out.json
+    python scripts/perfcheck.py --self-check        # CI wiring test
+
+Exit codes: 0 pass, 1 fail, 2 usage/shape error.  The verdict JSON
+(stdout, or --json PATH) carries one row per metric with
+status ok | fail | skip and the band that was applied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (direction, rel_tol, abs_slack) per bench metric.
+#   min:  fresh >= baseline * (1 - rel) - abs      (throughput floor)
+#   max:  fresh <= baseline * (1 + rel) + abs      (latency ceiling)
+#   exact: fresh == baseline
+# rel tolerances are deliberately wide: CI hosts differ from the bench
+# host; the gate exists to catch step regressions (2x slowdowns,
+# latency blowups), not single-digit noise.
+BENCH_BANDS: Dict[str, Tuple[str, float, float]] = {
+    "value": ("min", 0.40, 0.0),
+    "sustained_evals_per_sec": ("min", 0.40, 0.0),
+    "placements_per_sec": ("min", 0.40, 0.0),
+    "sustained_placements_per_sec": ("min", 0.40, 0.0),
+    "single_eval_placements_per_sec": ("min", 0.40, 0.0),
+    "networked_evals_per_s": ("min", 0.50, 0.0),
+    "p99_plan_queue_ms": ("max", 1.00, 1.0),
+    "p50_plan_queue_ms": ("max", 1.00, 1.0),
+    "plan_refute_rate": ("max", 0.0, 0.05),
+    "resident_chain_hit_rate": ("min", 0.0, 0.10),
+    "h2d_bytes_per_wave": ("max", 1.00, 4096.0),
+    "quality_nodes_used_tpu": ("max", 0.25, 2.0),
+    "quality_zone_balance_max_over_min": ("max", 0.25, 0.10),
+    "sampler_overhead_fraction": ("max", 0.0, 0.02),
+}
+
+# baseline-free gates on the fresh doc: (op, threshold); checked only
+# when the field is present (older docs predate the profiling plane)
+BENCH_ABS_GATES: Dict[str, Tuple[str, float]] = {
+    "slo_breaches": ("==", 0),
+    "plan_refute_rate": ("<=", 0.25),
+    # profiling-plane acceptance: sampler within budget, >= 90% of
+    # sampled wall time attributed to a named bucket
+    "sampler_overhead_fraction": ("<=", 0.02),
+    "profile_attributed_fraction": (">=", 0.90),
+}
+
+# bench comparisons only make sense at one workload shape
+BENCH_SCALE_KEYS = ("n_evals", "placements_per_eval", "workers")
+
+# deterministic-by-contract soak fields: exact equality
+SOAK_EXACT = ("converged_fingerprint", "trace_digest", "soak_evals",
+              "schedule_events", "soak_breaches", "soak_virtual_hours",
+              "p99_plan_queue_ms")
+
+# the fresh soak must be green regardless of what the baseline says
+SOAK_ABS_GATES: Dict[str, Tuple[str, float]] = {
+    "soak_breaches": ("==", 0),
+}
+
+
+def _load(path: str) -> Dict:
+    with open(path) as f:
+        doc = json.load(f)
+    # BENCH_r0x wrappers carry the parsed summary under "parsed"
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def _latest_bench_baseline() -> Optional[str]:
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    return paths[-1] if paths else None
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def _check_band(metric: str, base, fresh,
+                band: Tuple[str, float, float]) -> Dict:
+    direction, rel, slack = band
+    row = {"metric": metric, "baseline": base, "fresh": fresh,
+           "direction": direction, "rel_tol": rel, "abs_slack": slack}
+    if direction == "exact":
+        # exact bands also cover string fields (fingerprints, digests)
+        if base is None or fresh is None:
+            row["status"] = "skip"
+            row["reason"] = "missing on one side"
+        else:
+            row["status"] = "ok" if fresh == base else "fail"
+        return row
+    b, f = _num(base), _num(fresh)
+    if b is None or f is None:
+        row["status"] = "skip"
+        row["reason"] = "non-numeric or missing on one side"
+        return row
+    if direction == "min":
+        limit = b * (1.0 - rel) - slack
+        ok = f >= limit
+    else:  # max
+        limit = b * (1.0 + rel) + slack
+        ok = f <= limit
+    row["limit"] = round(limit, 6)
+    row["status"] = "ok" if ok else "fail"
+    return row
+
+
+def _check_abs(metric: str, fresh, gate: Tuple[str, float]) -> Dict:
+    op, thr = gate
+    row = {"metric": metric, "fresh": fresh, "gate": f"{op} {thr}"}
+    f = _num(fresh)
+    if f is None:
+        row["status"] = "skip"
+        row["reason"] = "missing from fresh doc"
+        return row
+    ok = {"<=": f <= thr, ">=": f >= thr, "==": f == thr}[op]
+    row["status"] = "ok" if ok else "fail"
+    return row
+
+
+def compare_bench(base: Dict, fresh: Dict,
+                  bands: Dict[str, Tuple[str, float, float]],
+                  allow_scale_mismatch: bool = False) -> Dict:
+    checks: List[Dict] = []
+    mismatched = [k for k in BENCH_SCALE_KEYS
+                  if k in base and k in fresh
+                  and base[k] != fresh[k]]
+    if mismatched and not allow_scale_mismatch:
+        return {"kind": "bench", "verdict": "incomparable",
+                "scale_mismatch": {
+                    k: {"baseline": base[k], "fresh": fresh[k]}
+                    for k in mismatched},
+                "checks": []}
+    for metric, band in sorted(bands.items()):
+        if metric not in base and metric not in fresh:
+            continue
+        checks.append(_check_band(
+            metric, base.get(metric), fresh.get(metric), band))
+    for metric, gate in sorted(BENCH_ABS_GATES.items()):
+        checks.append(_check_abs(metric, fresh.get(metric), gate))
+    failed = sorted({c["metric"] for c in checks
+                     if c["status"] == "fail"})
+    return {"kind": "bench",
+            "verdict": "pass" if not failed else "fail",
+            "failed": failed,
+            "skipped": [c["metric"] for c in checks
+                        if c["status"] == "skip"],
+            "checks": checks}
+
+
+def compare_soak(base: Dict, fresh: Dict) -> Dict:
+    checks: List[Dict] = []
+    for metric in SOAK_EXACT:
+        if metric not in base and metric not in fresh:
+            continue
+        checks.append(_check_band(metric, base.get(metric),
+                                  fresh.get(metric),
+                                  ("exact", 0.0, 0.0)))
+    # list-valued: violations must be empty on BOTH sides
+    row = {"metric": "violations",
+           "baseline": base.get("violations", []),
+           "fresh": fresh.get("violations", [])}
+    row["status"] = ("ok" if not fresh.get("violations") else "fail")
+    checks.append(row)
+    for metric, gate in sorted(SOAK_ABS_GATES.items()):
+        checks.append(_check_abs(metric, fresh.get(metric), gate))
+    failed = sorted({c["metric"] for c in checks
+                     if c["status"] == "fail"})
+    return {"kind": "soak",
+            "verdict": "pass" if not failed else "fail",
+            "failed": failed,
+            "skipped": [c["metric"] for c in checks
+                        if c["status"] == "skip"],
+            "checks": checks,
+            # informational: host speed, not code speed
+            "wall_s": {"baseline": base.get("wall_s"),
+                       "fresh": fresh.get("wall_s")}}
+
+
+def _parse_band_overrides(items: List[str],
+                          bands: Dict) -> Dict:
+    out = dict(bands)
+    for it in items:
+        if "=" not in it:
+            raise SystemExit(f"--band wants metric=REL_TOL, got {it!r}")
+        metric, tol = it.split("=", 1)
+        direction, _, slack = out.get(metric, ("min", 0.0, 0.0))
+        out[metric] = (direction, float(tol), slack)
+    return out
+
+
+def self_check() -> int:
+    """CI wiring test: each kind must pass against itself and fail
+    against an injected regression — proves the comparator would catch
+    a real one (the analyze.py --selftest posture)."""
+    bench_path = _latest_bench_baseline()
+    soak_path = os.path.join(ROOT, "SOAK_r01.json")
+    ok = True
+    if bench_path:
+        base = _load(bench_path)
+        v = compare_bench(base, dict(base), BENCH_BANDS)
+        print(f"bench self vs self: {v['verdict']} "
+              f"({os.path.basename(bench_path)})")
+        ok &= v["verdict"] == "pass"
+        bad = dict(base)
+        bad["value"] = base["value"] * 0.4
+        bad["p99_plan_queue_ms"] = \
+            base.get("p99_plan_queue_ms", 1.0) * 10 + 10
+        v = compare_bench(base, bad, BENCH_BANDS)
+        print(f"bench injected regression: {v['verdict']} "
+              f"(failed: {v['failed']})")
+        ok &= v["verdict"] == "fail" and "value" in v["failed"]
+        v = compare_bench(base, {**base, "workers": 99}, BENCH_BANDS)
+        print(f"bench scale mismatch: {v['verdict']}")
+        ok &= v["verdict"] == "incomparable"
+    else:
+        print("no BENCH_r*.json baseline — bench self-check skipped")
+    if os.path.exists(soak_path):
+        base = _load(soak_path)
+        v = compare_soak(base, dict(base))
+        print(f"soak self vs self: {v['verdict']}")
+        ok &= v["verdict"] == "pass"
+        bad = dict(base)
+        bad["converged_fingerprint"] = "0" * 64
+        bad["soak_breaches"] = 3
+        v = compare_soak(base, bad)
+        print(f"soak injected regression: {v['verdict']} "
+              f"(failed: {v['failed']})")
+        ok &= (v["verdict"] == "fail"
+               and "converged_fingerprint" in v["failed"]
+               and "soak_breaches" in v["failed"])
+    else:
+        print("no SOAK_r01.json baseline — soak self-check skipped")
+    print(f"perfcheck self-check: {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare fresh bench/soak JSON against the "
+                    "checked-in trajectory with tolerance bands")
+    ap.add_argument("--kind", choices=("bench", "soak"),
+                    default="bench")
+    ap.add_argument("--fresh", help="fresh summary JSON to judge")
+    ap.add_argument("--baseline",
+                    help="baseline JSON (default: newest BENCH_r*.json"
+                         " / SOAK_r01.json)")
+    ap.add_argument("--band", action="append", default=[],
+                    metavar="METRIC=REL_TOL",
+                    help="override a metric's relative tolerance")
+    ap.add_argument("--allow-scale-mismatch", action="store_true",
+                    help="compare across different workload shapes "
+                         "anyway (exploration, not gating)")
+    ap.add_argument("--json", default="",
+                    help="also write the verdict doc to this path")
+    ap.add_argument("--self-check", action="store_true",
+                    help="validate the comparator against the "
+                         "checked-in baselines (CI wiring test)")
+    args = ap.parse_args(argv)
+
+    if args.self_check:
+        return self_check()
+    if not args.fresh:
+        ap.error("--fresh is required (or use --self-check)")
+    baseline = args.baseline
+    if not baseline:
+        baseline = (_latest_bench_baseline() if args.kind == "bench"
+                    else os.path.join(ROOT, "SOAK_r01.json"))
+    if not baseline or not os.path.exists(baseline):
+        print(f"no baseline found ({baseline!r})", file=sys.stderr)
+        return 2
+    try:
+        base, fresh = _load(baseline), _load(args.fresh)
+    except (OSError, ValueError) as e:
+        print(f"cannot load inputs: {e}", file=sys.stderr)
+        return 2
+    if args.kind == "bench":
+        bands = _parse_band_overrides(args.band, BENCH_BANDS)
+        verdict = compare_bench(base, fresh, bands,
+                                args.allow_scale_mismatch)
+    else:
+        verdict = compare_soak(base, fresh)
+    verdict["baseline_path"] = os.path.relpath(baseline, ROOT)
+    verdict["fresh_path"] = args.fresh
+    out = json.dumps(verdict, indent=2, sort_keys=True)
+    print(out)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+    return 0 if verdict["verdict"] == "pass" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -202,7 +202,8 @@ class TestShardedEngineParity:
         sharded.packer.attach(h.state)
         h2d = {"bytes": 0}
         sharded.h2d_observer = \
-            lambda nb, s: h2d.__setitem__("bytes", h2d["bytes"] + nb)
+            lambda nb, s, cause: h2d.__setitem__("bytes",
+                                                 h2d["bytes"] + nb)
 
         def place(seed):
             job = mock.batch_job()

@@ -1,0 +1,169 @@
+"""Performance-trajectory gate coverage (scripts/perfcheck.py).
+
+The comparator itself is load-bearing CI wiring: these tests prove the
+bands fail when they should (step regressions, flipped fingerprints,
+scale mismatches) and pass when they should (identity, noise inside
+the tolerance), plus the --self-check posture against the checked-in
+trajectory files."""
+
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "perfcheck", ROOT / "scripts" / "perfcheck.py")
+perfcheck = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(perfcheck)
+
+BENCH = {
+    "n_evals": 16, "placements_per_eval": 2000, "workers": 2,
+    "value": 100.0, "sustained_evals_per_sec": 100.0,
+    "p99_plan_queue_ms": 2.0, "plan_refute_rate": 0.0,
+    "h2d_bytes_per_wave": 2560.0, "slo_breaches": 0,
+    "sampler_overhead_fraction": 0.004,
+    "profile_attributed_fraction": 1.0,
+}
+
+SOAK = {
+    "soak_virtual_hours": 2.0, "soak_evals": 500, "soak_breaches": 0,
+    "schedule_events": 900, "p99_plan_queue_ms": 1.5,
+    "converged_fingerprint": "a" * 64, "trace_digest": "b" * 64,
+    "violations": [], "wall_s": 40.0,
+}
+
+
+def test_bench_identity_passes():
+    v = perfcheck.compare_bench(BENCH, dict(BENCH),
+                                perfcheck.BENCH_BANDS)
+    assert v["verdict"] == "pass", v
+    assert v["failed"] == []
+
+
+def test_bench_noise_inside_band_passes():
+    fresh = dict(BENCH, value=75.0, p99_plan_queue_ms=3.5)
+    v = perfcheck.compare_bench(BENCH, fresh, perfcheck.BENCH_BANDS)
+    assert v["verdict"] == "pass", v
+
+
+def test_bench_step_regression_fails_named():
+    fresh = dict(BENCH, value=40.0, p99_plan_queue_ms=30.0)
+    v = perfcheck.compare_bench(BENCH, fresh, perfcheck.BENCH_BANDS)
+    assert v["verdict"] == "fail"
+    assert "value" in v["failed"]
+    assert "p99_plan_queue_ms" in v["failed"]
+
+
+def test_bench_abs_gates_are_baseline_free():
+    # the fresh doc alone must satisfy the profiling-plane acceptance
+    fresh = dict(BENCH, sampler_overhead_fraction=0.05,
+                 profile_attributed_fraction=0.5, slo_breaches=2)
+    v = perfcheck.compare_bench(BENCH, fresh, perfcheck.BENCH_BANDS)
+    assert v["verdict"] == "fail"
+    for m in ("sampler_overhead_fraction",
+              "profile_attributed_fraction", "slo_breaches"):
+        assert m in v["failed"], v["failed"]
+
+
+def test_bench_scale_mismatch_is_incomparable():
+    v = perfcheck.compare_bench(BENCH, dict(BENCH, workers=1),
+                                perfcheck.BENCH_BANDS)
+    assert v["verdict"] == "incomparable"
+    assert "workers" in v["scale_mismatch"]
+    v = perfcheck.compare_bench(BENCH, dict(BENCH, workers=1),
+                                perfcheck.BENCH_BANDS,
+                                allow_scale_mismatch=True)
+    assert v["verdict"] == "pass"
+
+
+def test_bench_missing_fields_skip_not_fail():
+    # pre-profiling-plane baselines lack the sampler fields entirely
+    base = {k: v for k, v in BENCH.items()
+            if not k.startswith(("sampler", "profile"))}
+    v = perfcheck.compare_bench(base, dict(base),
+                                perfcheck.BENCH_BANDS)
+    assert v["verdict"] == "pass"
+    assert "sampler_overhead_fraction" in v["skipped"]
+
+
+def test_soak_identity_passes():
+    v = perfcheck.compare_soak(SOAK, dict(SOAK))
+    assert v["verdict"] == "pass", v
+    assert v["wall_s"] == {"baseline": 40.0, "fresh": 40.0}
+
+
+def test_soak_fingerprint_flip_fails_exact():
+    # exact bands compare strings too — a changed fingerprint is a
+    # determinism break, not noise
+    v = perfcheck.compare_soak(SOAK, dict(SOAK,
+                                          converged_fingerprint="0" * 64))
+    assert v["verdict"] == "fail"
+    assert v["failed"] == ["converged_fingerprint"]
+
+
+def test_soak_wall_clock_is_informational():
+    v = perfcheck.compare_soak(SOAK, dict(SOAK, wall_s=400.0))
+    assert v["verdict"] == "pass"
+
+
+def test_soak_violations_and_breaches_fail():
+    fresh = dict(SOAK, violations=["broker: stuck eval"],
+                 soak_breaches=3)
+    v = perfcheck.compare_soak(SOAK, fresh)
+    assert v["verdict"] == "fail"
+    assert "violations" in v["failed"]
+    assert "soak_breaches" in v["failed"]
+
+
+def test_band_override_parsing():
+    bands = perfcheck._parse_band_overrides(
+        ["value=0.10"], perfcheck.BENCH_BANDS)
+    assert bands["value"] == ("min", 0.10, 0.0)
+    v = perfcheck.compare_bench(BENCH, dict(BENCH, value=75.0), bands)
+    assert v["verdict"] == "fail"   # 25% drop vs the tightened 10% band
+
+
+def test_load_unwraps_bench_round_wrapper(tmp_path):
+    p = tmp_path / "BENCH_wrapped.json"
+    p.write_text(json.dumps({"round": 7, "parsed": BENCH}))
+    assert perfcheck._load(str(p)) == BENCH
+
+
+def test_cli_verdict_json_and_exit_codes(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BENCH))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(BENCH))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(dict(BENCH, value=1.0)))
+    out = tmp_path / "verdict.json"
+    script = str(ROOT / "scripts" / "perfcheck.py")
+    r = subprocess.run(
+        [sys.executable, script, "--kind", "bench",
+         "--fresh", str(good), "--baseline", str(base),
+         "--json", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["verdict"] == "pass"
+    assert doc["baseline_path"]
+    r = subprocess.run(
+        [sys.executable, script, "--fresh", str(bad),
+         "--baseline", str(base)],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "value" in json.loads(r.stdout)["failed"]
+    r = subprocess.run(
+        [sys.executable, script, "--fresh", str(tmp_path / "nope.json"),
+         "--baseline", str(tmp_path / "missing.json")],
+        capture_output=True, text=True)
+    assert r.returncode == 2
+
+
+def test_self_check_green_against_checked_in_trajectory():
+    """The exact gate scripts/ci.sh runs: comparator passes against
+    itself and catches injected regressions on the real baselines."""
+    assert perfcheck.self_check() == 0
