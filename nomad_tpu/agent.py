@@ -38,7 +38,8 @@ class Agent:
                  log_level: str = "",
                  device_executor: str = "jax",
                  slo: Optional[Dict[str, float]] = None,
-                 profile_hz: Optional[float] = None) -> None:
+                 profile_hz: Optional[float] = None,
+                 worker_mode: str = "thread") -> None:
         # producer-side log gate (agent_config log_level): records below
         # this level never reach the ring or its subscribers.  Only set
         # when explicitly configured — the process-wide ring default
@@ -111,7 +112,7 @@ class Agent:
                 acl_enabled=acl_enabled,
                 transport=self.transport, clock=self.clock,
                 device_executor=device_executor, slo=slo,
-                profile_hz=profile_hz)
+                profile_hz=profile_hz, worker_mode=worker_mode)
         else:
             self.transport = resolve_transport(transport, node_name="agent",
                                                clock=self.clock)
@@ -119,7 +120,8 @@ class Agent:
                                  heartbeat_ttl=heartbeat_ttl,
                                  acl_enabled=acl_enabled, clock=self.clock,
                                  device_executor=device_executor,
-                                 slo=slo, profile_hz=profile_hz)
+                                 slo=slo, profile_hz=profile_hz,
+                                 worker_mode=worker_mode)
         self.clients: List[Client] = []
         if client_enabled:
             if cluster_mode:

@@ -15,6 +15,8 @@ Supported shape (a practical subset of the reference's):
       clock           = "wall"     # or "virtual"
       device_executor = "jax"      # or "bridge" (nomad_tpu/ops/executor.py)
       profile_hz      = 19         # host sampler rate; 0 disables
+      scheduler_workers = 2        # alias of num_schedulers
+      worker_mode     = "thread"   # or "process" (core/workerpool.py)
       slo {                        # health watchdog (core/flightrec.py)
         p99_plan_queue_ms   = 500
         refute_rate         = 0.25
@@ -52,6 +54,12 @@ class AgentConfig:
     region: str = "global"
     server_enabled: bool = True
     num_workers: int = 1
+    # scheduler worker plane (core/workerpool.py): "thread" (default)
+    # keeps workers as in-process threads; "process" runs the batchable
+    # scheduler types in num_workers spawned processes over replica
+    # state, with device work funneled to the parent-owned executor.
+    # Thread mode is required for clock = "virtual".
+    worker_mode: str = "thread"
     heartbeat_ttl: float = 30.0
     client_enabled: bool = True
     client_count: int = 1
@@ -98,7 +106,8 @@ class AgentConfig:
 
 _BLOCK_KEYS = {
     "ports": {"http"},
-    "server": {"enabled", "num_schedulers", "heartbeat_ttl",
+    "server": {"enabled", "num_schedulers", "scheduler_workers",
+               "worker_mode", "heartbeat_ttl",
                "acl_enabled", "transport", "clock", "device_executor",
                "profile_hz"},
     "client": {"enabled", "count", "node_class", "datacenter"},
@@ -155,6 +164,17 @@ def parse_agent_config(src: str):
                     put("server_enabled", bool(body["enabled"]))
                 if "num_schedulers" in body:
                     put("num_workers", int(body["num_schedulers"]))
+                if "scheduler_workers" in body:
+                    # preferred name (the reference's num_schedulers is
+                    # kept as an alias); later key wins like any merge
+                    put("num_workers", int(body["scheduler_workers"]))
+                if "worker_mode" in body:
+                    v = str(body["worker_mode"])
+                    if v not in ("thread", "process"):
+                        raise ValueError(
+                            "server worker_mode must be 'thread' or "
+                            f"'process', got {v!r}")
+                    put("worker_mode", v)
                 if "heartbeat_ttl" in body:
                     from nomad_tpu.jobspec.schema import parse_duration
                     put("heartbeat_ttl",

@@ -174,6 +174,18 @@ class SoakRunner:
             # desired count and make convergence timing-dependent
             tg.reschedule_policy = ReschedulePolicy(
                 unlimited=True, delay_s=5.0, delay_function="constant")
+        if e.get("ports"):
+            # networked fleet (TrafficProfile.networked_fraction): the
+            # allocs ride the columnar port-assignment path end to end
+            from nomad_tpu.structs import NetworkResource, Port
+            tg.tasks[0].resources.networks = [NetworkResource(
+                dynamic_ports=[Port(label=f"p{k}")
+                               for k in range(int(e["ports"]))])]
+        if e.get("node_class"):
+            from nomad_tpu.structs import Constraint
+            job.constraints = list(job.constraints or []) + [Constraint(
+                ltarget="${node.class}", operand="=",
+                rtarget=e["node_class"])]
         if "rev" in e:
             job.meta = {"rev": str(e["rev"])}
         return job, tg.name
@@ -456,6 +468,8 @@ class SoakRunner:
                                  datacenter=spec["datacenter"])
                 node.resources.cpu = spec["cpu"]
                 node.resources.memory_mb = spec["mem"]
+                if spec.get("node_class"):
+                    node.node_class = spec["node_class"]
                 nw = codec.encode(node)
                 retry_idempotent(
                     lambda nw=nw: c.nodes.register(nw),

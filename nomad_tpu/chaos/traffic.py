@@ -85,6 +85,16 @@ class TrafficProfile:
     batch_runtime_min: float = 60.0
     batch_runtime_cap: float = 1200.0
 
+    # networked service fleets: this fraction of service registrations
+    # request dynamic ports (their job.register events grow a "ports"
+    # count, so the soak exercises the columnar port-assignment path);
+    # when `node_classes` is non-empty the fleet's nodes get classes
+    # round-robin and each networked service pins one class.  Both
+    # default OFF, and every extra rng draw is gated behind a nonzero
+    # knob, so existing seeded schedules stay byte-identical.
+    networked_fraction: float = 0.0
+    node_classes: Tuple[str, ...] = ()
+
 
 def stable_id(*parts) -> str:
     """Deterministic 32-hex id from the seed + a sequence label (node
@@ -101,13 +111,18 @@ def fleet(seed: int, profile: Optional[TrafficProfile] = None
     p = profile or TrafficProfile()
     out = []
     for i in range(p.n_nodes):
-        out.append({
+        spec = {
             "name": f"soak-n{i:03d}",
             "id": stable_id("node", seed, i),
             "datacenter": f"dc{(i % p.n_zones) + 1}",
             "cpu": p.node_cpu,
             "mem": p.node_mem,
-        })
+        }
+        if p.node_classes:
+            # round-robin, sequence-derived (no rng: the fleet shape
+            # must not perturb the schedule's draw order)
+            spec["node_class"] = p.node_classes[i % len(p.node_classes)]
+        out.append(spec)
     return out
 
 
@@ -183,9 +198,17 @@ def generate_schedule(seed: int,
         if count == 0:
             continue
         ledger.book(job, count, cpu)
-        events.append({"at": at, "kind": "job.register", "job": job,
-                       "jtype": "service", "count": count, "cpu": cpu,
-                       "mem": 128, "priority": 50, "rev": 0})
+        ev = {"at": at, "kind": "job.register", "job": job,
+              "jtype": "service", "count": count, "cpu": cpu,
+              "mem": 128, "priority": 50, "rev": 0}
+        # gated draws: with the knob at its 0.0 default no rng state is
+        # consumed here, so pre-existing seeded schedules replay intact
+        if (p.networked_fraction > 0
+                and rng.random() < p.networked_fraction):
+            ev["ports"] = rng.randint(1, 2)
+            if p.node_classes:
+                ev["node_class"] = rng.choice(p.node_classes)
+        events.append(ev)
         t = at
         if rng.random() < p.deploy_frac:
             t = rng.uniform(t + 30.0, max(t + 31.0, active_end * 0.8))

@@ -96,6 +96,8 @@ def cmd_agent(args) -> int:
         cfg.client_count = args.clients
     if args.workers is not None:
         cfg.num_workers = args.workers
+    if getattr(args, "worker_mode", None):
+        cfg.worker_mode = args.worker_mode
 
     if not cfg.server_enabled:
         print("Error: client-only agents need a remote RPC transport; "
@@ -132,7 +134,8 @@ def cmd_agent(args) -> int:
                   log_level=cfg.log_level,
                   device_executor=cfg.device_executor,
                   slo=cfg.slo or None,
-                  profile_hz=cfg.profile_hz)
+                  profile_hz=cfg.profile_hz,
+                  worker_mode=cfg.worker_mode)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")
@@ -1178,6 +1181,10 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-bind", default="")
     ag.add_argument("-clients", type=int, default=None)
     ag.add_argument("-workers", type=int, default=None)
+    ag.add_argument("-worker-mode", dest="worker_mode", default=None,
+                    choices=("thread", "process"),
+                    help="scheduler worker plane: in-process threads "
+                         "(default) or a multi-process pool")
     # multi-server cluster mode (reference: -server, -bootstrap-expect,
     # -join / server_join)
     ag.add_argument("-server-name", dest="server_name", default="")

@@ -502,6 +502,11 @@ class PlanApplier:
     def _eval_nodes(self, snap, plan, result, skip_fit, claim_state,
                     committed_releases, pending_nodes, final_refused,
                     fit_cleared) -> None:
+        # per-reason refute counts (logged below): a refuted node's
+        # cause — port collision vs capacity vs claim — decides the
+        # operator's next move and is invisible from the count alone
+        why: Dict[str, int] = {}
+        refused0 = len(final_refused)
         while pending_nodes:
             progressed = False
             deferred = []
@@ -511,7 +516,8 @@ class PlanApplier:
                                              skip_fit=skip_fit or
                                              node_id in fit_cleared,
                                              claim_state=claim_state,
-                                             released=committed_releases)
+                                             released=committed_releases,
+                                             why=why)
                 if verdict == NODE_OK:
                     result.node_allocation[node_id] = new_allocs
                     committed_releases.update(
@@ -530,6 +536,10 @@ class PlanApplier:
                 final_refused.extend(deferred)
                 break
             pending_nodes = deferred
+        if len(final_refused) > refused0:
+            log("plan", "warn", "plan nodes refuted",
+                eval_id=plan.eval_id,
+                nodes=len(final_refused) - refused0, reasons=dict(why))
 
     @staticmethod
     def _blocks_ok(snap, plan: Plan) -> bool:
@@ -637,6 +647,17 @@ class PlanApplier:
         if not columnar:
             return
         bad: set = set()
+        # per-reason refute counts for the log line below: a mass
+        # refute's cause (volume gone vs port collision vs fit) decides
+        # the operator's next move and is invisible from the count alone
+        why: Dict[str, int] = {}
+
+        def _mark(nids, reason: str) -> None:
+            fresh = set(nids) - bad
+            if fresh:
+                why[reason] = why.get(reason, 0) + len(fresh)
+                bad.update(fresh)
+
         # whole-block volume verdicts (uniform across a block's rows:
         # only read-only multi-node claims reach this path)
         for b in columnar:
@@ -650,7 +671,7 @@ class PlanApplier:
                     continue
                 vol = snap.csi_volume_by_id(tmpl.namespace, vreq.source)
                 if vol is None or not vol.schedulable:
-                    bad.update(b.node_table)
+                    _mark(b.node_table, "volume")
                     break
         # batched per-node PORT audit input (ISSUE 8): the plan's port
         # claims per node, aggregated ACROSS port-carrying blocks
@@ -662,7 +683,7 @@ class PlanApplier:
                 claimed = plan_ports.setdefault(nid, set())
                 for port in plist:
                     if port in claimed:
-                        bad.add(nid)
+                        _mark((nid,), "in-plan-dup-port")
                     claimed.add(port)
         # per-node demand aggregated ACROSS blocks (two blocks on one
         # node were fit-checked together on the expanded path)
@@ -681,7 +702,7 @@ class PlanApplier:
                 continue
             node = snap.node_by_id(nid)
             if node is None or node.status == "down":
-                bad.add(nid)
+                _mark((nid,), "node-down")
                 continue
             if skip_fit:
                 continue
@@ -707,13 +728,13 @@ class PlanApplier:
                     used_ports.add_allocs((a,))
             if used_ports is not None and not claimed.isdisjoint(
                     used_ports.used_ports):
-                bad.add(nid)
+                _mark((nid,), "port-collision")
                 continue
             res, rsv = node.resources, node.reserved
             if (cpu > res.cpu - rsv.cpu
                     or mem > res.memory_mb - rsv.memory_mb
                     or disk > res.disk_mb - rsv.disk_mb):
-                bad.add(nid)
+                _mark((nid,), "fit")
         refused: set = set()
         for b in columnar:
             bad_b = bad.intersection(b.node_table)
@@ -724,6 +745,9 @@ class PlanApplier:
             kept = b.without_nodes(bad_b)
             if kept is not None:
                 result.alloc_blocks.append(kept)
+        if refused:
+            log("plan", "warn", "block nodes refuted",
+                nodes=len(refused), reasons=dict(why))
         final_refused.extend(sorted(refused))
 
     @staticmethod
@@ -751,13 +775,19 @@ class PlanApplier:
                       new_allocs: List[Allocation],
                       skip_fit: bool = False,
                       claim_state: Optional[tuple] = None,
-                      released: frozenset = frozenset()) -> int:
+                      released: frozenset = frozenset(),
+                      why: Optional[Dict[str, int]] = None) -> int:
+        def _why(reason: str) -> None:
+            if why is not None:
+                why[reason] = why.get(reason, 0) + 1
         plan_claims, plan_claim_nodes = claim_state or (None, None)
         node = snap.node_by_id(node_id)
         if node is None:
+            _why("node-missing")
             return NODE_REFUSED
         if node.status == "down":
             # only stops are allowed on down nodes
+            _why("node-down")
             return NODE_REFUSED
         if not skip_fit:
             existing = {a.id: a for a in snap.allocs_by_node(node_id)
@@ -771,9 +801,10 @@ class PlanApplier:
             # check_devices: a concurrent worker may have assigned the same
             # device instances against its own stale snapshot — the refute
             # here is what makes host-side device assignment race-safe
-            ok, _, _ = allocs_fit(node, list(existing.values()),
-                                  check_devices=True)
+            ok, dim, _ = allocs_fit(node, list(existing.values()),
+                                    check_devices=True)
             if not ok:
+                _why(f"fit:{dim}")
                 return NODE_REFUSED
         # CSI claim re-check (reference: CSIVolumeChecker claim_ok at the
         # serialization point): access-mode limits and schedulable=false
@@ -806,11 +837,14 @@ class PlanApplier:
                 key = (a.namespace, vreq.source)
                 vol = snap.csi_volume_by_id(a.namespace, vreq.source)
                 if vol is None or not vol.schedulable:
+                    _why("volume-gone")
                     return NODE_REFUSED      # can never clear in-plan
                 if not vreq.read_only and vol.reader_only():
+                    _why("volume-mode")
                     return NODE_REFUSED      # mode mismatch: also final
                 if not vol.claim_ok(vreq.read_only, releasing,
                                     node_id=node_id):
+                    _why("volume-claim")
                     return NODE_CLAIM_REFUSED
                 if vol.single_node():
                     # single-node access modes pin READERS too: a claim
@@ -818,6 +852,7 @@ class PlanApplier:
                     # final (in-plan claims only grow)
                     pinned = (plan_claim_nodes or {}).get(key, "")
                     if pinned and pinned != node_id:
+                        _why("volume-node-pin")
                         return NODE_REFUSED
                     local_nodes[key] = node_id
                 if not vreq.read_only:
@@ -826,6 +861,7 @@ class PlanApplier:
                             and plan_claims is not None
                             and (plan_claims.get(key, 0)
                                  + local_claims.get(key, 0))):
+                        _why("volume-writer-limit")
                         return NODE_REFUSED
                     local_claims[key] = local_claims.get(key, 0) + 1
         if plan_claims is not None:

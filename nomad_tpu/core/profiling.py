@@ -91,9 +91,17 @@ _ROLE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("exec-", "client"),
     ("plugin-", "client"),
     ("chaos-", "chaos"),
+    # multi-process worker plane (core/workerpool): parent attendants
+    # and child-side RPC threads do scheduler work on behalf of a pool
+    # worker — account them under the worker role
+    ("pool-", "worker"),
 )
 
-BUCKETS = ("device-wait", "lock-wait", "gil-wait", "idle", "wire", "host")
+# `queue-wait`: blocked behind the shared device executor's submission
+# queue (ops/executor.SubmissionFrontEnd) — the multi-process pool's
+# analogue of gil-wait
+BUCKETS = ("device-wait", "lock-wait", "gil-wait", "queue-wait",
+           "idle", "wire", "host")
 
 # stack-frame classification tables (checked against the co_name and
 # filename of sampled frames, innermost first)
@@ -347,6 +355,10 @@ class SamplingProfiler:
         # rings); plain callables so this module imports nothing above
         self.device_ledger_provider: Optional[Callable[[], Dict]] = None
         self.flight_provider: Optional[Callable[[], Dict]] = None
+        # remote samplers: pool worker processes run their OWN
+        # SamplingProfiler and ship snapshot docs up; the parent merges
+        # the latest doc per key into its snapshot/capture surfaces
+        self._remote: Dict[str, Dict] = {}
 
     # ------------------------------------------------------- lifecycle
 
@@ -491,6 +503,7 @@ class SamplingProfiler:
             self_s = self._self_s
             elapsed = self._elapsed()
             overflow = dict(self._overflow)
+            remote = {k: dict(v) for k, v in self._remote.items()}
         totals: Dict[str, float] = {b: 0.0 for b in BUCKETS}
         roles: Dict[str, Dict[str, float]] = {}
         for (role, bucket), w in buckets.items():
@@ -518,7 +531,23 @@ class SamplingProfiler:
                 (self_s / elapsed) if elapsed > 0 else 0.0,
             "sampler_self_s": round(self_s, 6),
             "fold_overflow": overflow,
+            # latest per-process sampler doc shipped via publish_remote
+            # (empty in the default single-process deployment)
+            "remote": remote,
         }
+
+    def publish_remote(self, key: str, doc: Dict) -> None:
+        """Merge a pool worker process's sampler snapshot under `key`
+        (core/workerpool's attendant calls this on every `prof` report;
+        newest doc wins)."""
+        if not isinstance(doc, dict):
+            return
+        with self._lock:
+            self._remote[key] = doc
+
+    def drop_remote(self, key: str) -> None:
+        with self._lock:
+            self._remote.pop(key, None)
 
     @staticmethod
     def _gil_fraction(roles: Dict[str, Dict[str, float]],
@@ -642,6 +671,9 @@ class SamplingProfiler:
             "compile_ledger": COMPILE.snapshot(),
             "flight_recorder": flight,
             "jax_trace": trace_info,
+            # per-process sampler docs from the multi-process worker
+            # plane (latest snapshot per pool worker at capture time)
+            "remote_samplers": snap.get("remote", {}),
         }
         with self._lock:
             self._captures.append(bundle)

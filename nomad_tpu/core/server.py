@@ -67,7 +67,8 @@ class Server:
                  device_executor: str = "jax",
                  mesh=None,
                  slo: Optional[Dict[str, float]] = None,
-                 profile_hz: Optional[float] = None) -> None:
+                 profile_hz: Optional[float] = None,
+                 worker_mode: str = "thread") -> None:
         # injected timebase (chaos/clock.py): every endpoint default
         # `now`, heartbeat deadline, and the tick loop read this clock,
         # so a chaos scenario's VirtualClock owns the whole server's
@@ -153,7 +154,39 @@ class Server:
         self.failed_follow_up_delay = failed_follow_up_delay
         self.acl_enabled = acl_enabled
         self._acl_cache: Dict[tuple, object] = {}
-        self.workers = [Worker(self, i) for i in range(num_workers)]
+        # worker plane (ISSUE 14): "thread" (default) keeps every
+        # scheduler worker as an in-process thread — byte-identical to
+        # pre-pool builds, and the only mode a VirtualClock can drive.
+        # "process" runs the batchable scheduler types in N spawned
+        # worker processes (core/workerpool.py) over replica state +
+        # the parent-owned device executor behind a submission queue;
+        # one thread worker stays in-parent for system/sysbatch/_core.
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(
+                f"worker_mode must be 'thread' or 'process', "
+                f"got {worker_mode!r}")
+        if worker_mode == "process" and not isinstance(self.clock,
+                                                       SystemClock):
+            # children run on the wall clock of the same host; a
+            # virtual timeline cannot cross the process boundary
+            raise ValueError(
+                "worker_mode='process' requires the wall clock "
+                "(seeded VirtualClock soaks stay thread-mode)")
+        self.worker_mode = worker_mode
+        self.device_front = None
+        self.worker_pool = None
+        if worker_mode == "process":
+            from nomad_tpu.core.workerpool import (PARENT_SCHEDULERS,
+                                                   WorkerPool)
+            from nomad_tpu.ops.executor import SubmissionFrontEnd
+            # every device launch — pool children AND the in-parent
+            # worker — funnels through the submission queue so the
+            # resident-buffer chain keeps one owner
+            self.device_front = SubmissionFrontEnd(self.executor)
+            self.workers = [Worker(self, 0, served=PARENT_SCHEDULERS)]
+            self.worker_pool = WorkerPool(self, max(num_workers, 1))
+        else:
+            self.workers = [Worker(self, i) for i in range(num_workers)]
         self._applier_running = False
         self._leader = False
         # capacity-change events release blocked evals
@@ -262,6 +295,9 @@ class Server:
         self._applier_running = True
         for w in self.workers:
             w.start()
+        if self.worker_pool is not None:
+            self.worker_pool.ensure_started()
+            self.worker_pool.resume()
         self._tick_stop = threading.Event()
 
         def tick_loop():
@@ -284,6 +320,8 @@ class Server:
             self._tick_stop.set()
             self._tick_thread.join(timeout=5)
             self._tick_thread = None
+        if self.worker_pool is not None:
+            self.worker_pool.close()
         for w in self.workers:
             w.stop()
         if self._applier_running:
@@ -308,8 +346,16 @@ class Server:
         self._applier_running = True
         for w in self.workers:
             w.start()
+        if self.worker_pool is not None:
+            self.worker_pool.ensure_started()
+            self.worker_pool.resume()
 
     def stop_scheduling(self) -> None:
+        if self.worker_pool is not None:
+            # quiesce children FIRST (their plans must drain through the
+            # applier before it stops); processes stay warm for the next
+            # round — only shutdown() reaps them
+            self.worker_pool.pause(wait=True)
         for w in self.workers:
             w.stop()
         self.plan_applier.stop()

@@ -38,9 +38,21 @@ class Worker:
     own reference to the shared PlacementEngine so packed tensors and jit
     caches are shared across workers (device work is serialized by JAX)."""
 
-    def __init__(self, server, worker_id: int = 0) -> None:
+    def __init__(self, server, worker_id: int = 0,
+                 served: Optional[List[str]] = None) -> None:
         self.server = server
         self.id = worker_id
+        # scheduler types this worker dequeues; the multi-process pool
+        # (core/workerpool) splits the namespace — children serve the
+        # batchable types, the parent's thread worker keeps the rest
+        self.served = (list(served) if served is not None
+                       else list(SCHEDULERS_SERVED))
+        # extra optimistic-concurrency plan attempts for schedulers this
+        # worker builds: pool children set it on their server shim
+        # (replica staleness needs more retry headroom than the shared
+        # store's near-immediate visibility)
+        self.schedule_attempt_boost = getattr(
+            server, "schedule_attempt_boost", 0)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stats = StatCounters("nomad.worker",
@@ -138,7 +150,7 @@ class Worker:
         # time (a busy dequeue returns in microseconds; its share of
         # samples is negligible)
         with profiling.activity("idle"):
-            evaluation, token = broker.dequeue(SCHEDULERS_SERVED, now=t,
+            evaluation, token = broker.dequeue(self.served, now=t,
                                                timeout=timeout)
         if evaluation is None:
             return 0
@@ -213,7 +225,7 @@ class Worker:
         self._prefetch = None
         if pf is None:
             with profiling.activity("idle"):   # see run_once's marker
-                batch = broker.dequeue_batch(SCHEDULERS_SERVED, max_n,
+                batch = broker.dequeue_batch(self.served, max_n,
                                              now=t, timeout=timeout)
             if not batch:
                 return 0
@@ -379,7 +391,7 @@ class Worker:
         chain_handed_off = False
         if chain_ok and not self._stop.is_set():
             nxt = self.server.eval_broker.dequeue_batch(
-                SCHEDULERS_SERVED, max_n, now=t, timeout=0.0)
+                self.served, max_n, now=t, timeout=0.0)
             if nxt:
                 # the chain buffer is DONATED to the prefetched launch
                 # (alive or failed) — it must not also be retained below
@@ -544,7 +556,13 @@ class Worker:
     def refreshed_snapshot(self):
         """Fresh state view after a partial commit (the retry loop must
         see the refuting writes) — the fence tracks it so the retry's
-        next plan may fast-path again."""
+        next plan may fast-path again.  Pool children first pull the
+        parent's journal delta into their replica: a replica only
+        advances at dequeue, and a retry against the pre-refute view
+        would re-pick the exact assignment that just refuted."""
+        refresh = getattr(self.server, "refresh_state", None)
+        if refresh is not None:
+            refresh()
         snap, self._snapshot_seq = \
             self.server.state.snapshot_and_placement_seq()
         self._snapshot = snap

@@ -103,9 +103,10 @@ def make_executor(name: str, engine, plugin: Optional[str] = None,
 
 class DeviceExecutor:
     """Pluggable device-execution seam between the wave pipeline and the
-    kernels.  One instance per Server, shared by its workers — the
-    retained chain is a single slot CLAIMED atomically (claim_chain
-    pops), so two workers can never chain concurrently on the same
+    kernels.  One instance per Server, shared by its workers — each
+    retained chain lives in a per-CLIENT slot CLAIMED atomically
+    (claim_chain pops; in-process workers share the default "" slot),
+    so two workers can never chain concurrently on the same
     donated/retained buffer under one chain id (which would exempt each
     other from the applier's per-node fence)."""
 
@@ -118,8 +119,14 @@ class DeviceExecutor:
         # `used0` from the packer through the host
         self.chain_enabled = chain_enabled
         self._lock = threading.Lock()
-        # (batch_id, seq0, (used, node_version, npad), masked_nodes)
-        self._chain = None
+        # client -> (batch_id, seq0, (used, node_version, npad),
+        # masked_nodes).  One slot per chain CLIENT: the in-process
+        # worker plane uses the default "" slot (single slot, exactly
+        # the pre-pool behavior); the multi-process pool
+        # (core/workerpool) keys a slot per worker process so each
+        # child's retained chain survives other children's waves while
+        # foreign plan commits still drop every slot they invalidate.
+        self._chains: dict = {}
         self.stats = {"dispatches": 0, "resident_waves": 0,
                       "invalidations": 0, "uploads": 0, "upload_bytes": 0,
                       # mesh deployments: per-launch cross-shard
@@ -174,34 +181,47 @@ class DeviceExecutor:
     # --------------------------------------------- retained chain slot
 
     def retain_chain(self, batch_id: str, seq0: int, used_triple,
-                     masked=None) -> None:
+                     masked=None, client: str = "") -> None:
         """Park a finished wave's proposed-usage chain for the NEXT
         dequeued batch (core/worker.py calls this when a fully-coupled
         batch ends with no prefetch to hand the chain to)."""
         if not self.chain_enabled or used_triple is None or not batch_id:
             return
         with self._lock:
-            old, self._chain = self._chain, (
+            old = self._chains.get(client)
+            self._chains[client] = (
                 batch_id, seq0, used_triple, frozenset(masked or ()))
         if old is not None:
             self._release_chain(old)
 
-    def claim_chain(self):
-        """Pop the retained chain (single consumer — see class doc).
-        Returns (batch_id, seq0, used_triple, masked_nodes) or None."""
+    def claim_chain(self, client: str = ""):
+        """Pop the client's retained chain (single consumer per slot —
+        see class doc).  Returns (batch_id, seq0, used_triple,
+        masked_nodes) or None."""
         if not self.chain_enabled:
             return None
         with self._lock:
-            c, self._chain = self._chain, None
-        return c
+            return self._chains.pop(client, None)
 
     def invalidate(self, reason: str = "explicit") -> None:
-        """Drop the retained chain: the next wave re-syncs node state
-        from the packer (re-upload counted via uploads/upload_bytes)."""
+        """Drop every retained chain: the next wave of each client
+        re-syncs node state from the packer (re-upload counted via
+        uploads/upload_bytes).  The triggers (node writes, restore,
+        capacity-freeing allocs) blind ALL chains equally, so there is
+        no per-client variant."""
         with self._lock:
-            c, self._chain = self._chain, None
-        if c is not None:
+            dropped = list(self._chains.values())
+            self._chains.clear()
+        for c in dropped:
             self._count_invalidation(reason)
+            self._release_chain(c)
+
+    def drop_client(self, client: str) -> None:
+        """Forget one client's slot (pool worker exited/crashed)."""
+        with self._lock:
+            c = self._chains.pop(client, None)
+        if c is not None:
+            self._count_invalidation("client-drop")
             self._release_chain(c)
 
     def _count_invalidation(self, reason: str) -> None:
@@ -220,12 +240,18 @@ class DeviceExecutor:
 
     def note_plan_commit(self, origin: str) -> None:
         """The plan applier committed a plan from `origin` (chain id or
-        eval id).  A foreign plan's usage is invisible to the retained
-        chain — drop it so the next wave re-syncs."""
+        eval id).  A foreign plan's usage is invisible to every retained
+        chain EXCEPT the one that proposed it — drop the others so
+        their next wave re-syncs."""
         with self._lock:
-            c = self._chain
-        if c is not None and origin != c[0]:
-            self.invalidate("foreign-plan")
+            dropped = [c for c in self._chains.values()
+                       if c[0] != origin]
+            if dropped:
+                self._chains = {k: c for k, c in self._chains.items()
+                                if c[0] == origin}
+        for c in dropped:
+            self._count_invalidation("foreign-plan")
+            self._release_chain(c)
 
     def attach_store(self, store) -> None:
         """Subscribe to state-store events that change node state the
@@ -287,8 +313,7 @@ class DeviceExecutor:
         eng = self.engine
         if eng is not None and hasattr(eng, "device_resident_bytes"):
             total += eng.device_resident_bytes()
-        c = self._chain
-        if c is not None:
+        for c in self._chains.values():
             total += int(getattr(c[2][0], "nbytes", 0))
         self.stats["hbm_resident_bytes"] = total
         if total > self.stats["hbm_high_watermark_bytes"]:
@@ -605,3 +630,66 @@ class BridgeExecutor(DeviceExecutor):
         self._h2d_cache.clear()
         self._h2d_order.clear()
         self._bridge.close()
+
+
+class SubmissionFrontEnd:
+    """Thin submission queue in front of a shared DeviceExecutor.
+
+    The multi-process worker pool (core/workerpool.py) funnels every
+    child's device work through the parent-owned executor; this
+    front-end serializes those submissions under ONE lock so the
+    resident-buffer chain and the engine's version-keyed device caches
+    keep their single-owner invariants — callers queue, they never
+    interleave inside a dispatch.  Contended acquisition is metered as
+    the `queue-wait` profiling bucket (the pool's analogue of the
+    thread plane's gil-wait) and accumulated in `stats["queue_wait_s"]`
+    for the bench JSON."""
+
+    def __init__(self, executor: DeviceExecutor) -> None:
+        self.executor = executor
+        self._lock = threading.Lock()
+        self.stats = {"submits": 0, "queue_wait_s": 0.0,
+                      "queue_waits": 0}
+
+    def _acquire(self) -> None:
+        if self._lock.acquire(blocking=False):
+            return
+        from nomad_tpu.core.profiling import activity
+        t0 = time.perf_counter()
+        with activity("queue-wait"):
+            self._lock.acquire()
+        waited = time.perf_counter() - t0
+        self.stats["queue_wait_s"] += waited
+        self.stats["queue_waits"] += 1
+
+    def dispatch_batch(self, snapshot, items, seed=0, used0_dev=None,
+                       masked_node_ids=None):
+        self._acquire()
+        try:
+            self.stats["submits"] += 1
+            return self.executor.dispatch_batch(
+                snapshot, items, seed=seed, used0_dev=used0_dev,
+                masked_node_ids=masked_node_ids)
+        finally:
+            self._lock.release()
+
+    def collect_batch(self, pending):
+        self._acquire()
+        try:
+            return self.executor.collect_batch(pending)
+        finally:
+            self._lock.release()
+
+    def chain_state(self, pending):
+        return self.executor.chain_state(pending)
+
+    def claim_chain(self, client: str = ""):
+        return self.executor.claim_chain(client)
+
+    def retain_chain(self, batch_id: str, seq0: int, used_triple,
+                     masked=None, client: str = "") -> None:
+        self.executor.retain_chain(batch_id, seq0, used_triple,
+                                   masked=masked, client=client)
+
+    def drop_client(self, client: str) -> None:
+        self.executor.drop_client(client)

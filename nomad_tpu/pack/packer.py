@@ -58,6 +58,14 @@ DOP_LUT = 5         # set(col) and luts[arg, attrs[col]]
 
 _TARGET_RE = re.compile(r"^\$\{(.+)\}$")
 
+# hard ceiling on the node-table height: the bulk kernels' packed fill
+# rows encode (node row, count) in one int32 as `row << 11 | count`
+# (ops/select.py pack_round_buffer), leaving 20 usable row bits.  The
+# kernels assert this deep in a launch; validating HERE, at table-build
+# time, turns an opaque kernel abort into a clear registration-time
+# error naming the cap.
+PACKED_FILL_CAP = 1 << 20
+
 
 def resolve_target_key(target: str) -> str:
     """Normalize a constraint l-target to a column key
@@ -482,6 +490,12 @@ class ClusterPacker:
     def _build_locked(self, snapshot) -> NodeTensors:
         nodes = snapshot.nodes()
         n = len(nodes)
+        if n >= PACKED_FILL_CAP:
+            raise ValueError(
+                f"cluster has {n} nodes; the packed-fill encoding "
+                f"supports at most {PACKED_FILL_CAP - 1} "
+                f"(PACKED_FILL_CAP = 2^20 rows — ops/select.py packs "
+                f"node rows into 20 bits of each fill word)")
         # discover all columns first so attrs has stable width this build
         prop_maps = [node_property_map(nd) for nd in nodes]
         for pm in prop_maps:

@@ -86,6 +86,10 @@ class GenericScheduler(Scheduler):
         self.now = now if now is not None else time.time()
         self.max_attempts = (MAX_BATCH_ATTEMPTS if is_batch
                              else MAX_SERVICE_ATTEMPTS)
+        # replica-fed planners (pool worker processes) see the head
+        # later than a thread worker reading the shared store, so their
+        # optimistic-concurrency retries need more headroom
+        self.max_attempts += getattr(planner, "schedule_attempt_boost", 0)
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.queued_allocs: Dict[str, int] = {}
         # decision-record capture (core/explain.py): per-TG placed

@@ -159,6 +159,17 @@ class StateStore:
         self._fill_sums = [0.0, 0.0, 0.0]      # clamped fill fractions
         # listeners for state-change events (event broker seam, SURVEY §6.5)
         self._listeners: List[Callable[[str, int, object], None]] = []
+        # dirty-key journal for worker-plane replicas (core/workerpool):
+        # (index, section, key) markers appended at the _emit chokepoint.
+        # export_since() resolves the keys against the LIVE tables, so a
+        # replica pulls incremental upserts/tombstones keyed by modify
+        # index; whenever the bounded journal cannot cover the requested
+        # range it falls back to a full snapshot_save document.  The
+        # floor is the newest index the journal can no longer vouch for.
+        from collections import deque
+        self._journal: "deque" = deque()
+        self._journal_cap = 8192
+        self._journal_floor = 0
 
     # ------------------------------------------------------------- indexes
 
@@ -360,11 +371,294 @@ class StateStore:
             self._listeners.append(fn)
 
     def _emit(self, topic: str, index: int, payload: object) -> None:
+        self._journal_note(topic, index, payload)
         for fn in list(self._listeners):
             try:
                 fn(topic, index, payload)
             except Exception:  # noqa: BLE001 - listener isolation
                 pass
+
+    # ------------------------------------------- replica export (deltas)
+
+    def _journal_note(self, topic: str, index: int, payload) -> None:
+        """Record dirty keys for export_since (lock held — _emit fires
+        from write paths).  Payload fidelity varies by topic (object on
+        upsert, bare key on delete); the journal stores only (section,
+        key) and export resolves the CURRENT object — missing means a
+        tombstone, so deletes need no separate bookkeeping."""
+        if topic == "Node":
+            entries = [("nodes", payload if isinstance(payload, str)
+                        else payload.id)]
+        elif topic == "Job":
+            entries = [("jobs", tuple(payload) if isinstance(
+                payload, tuple) else payload.ns_id())]
+        elif topic == "Evaluation":
+            entries = [("evals", payload.id)]
+        elif topic == "Allocations":
+            entries = [("allocs", a.id) for a in payload]
+        elif topic == "Deployment":
+            entries = [("deployments", payload.id)]
+        elif topic == "AllocBlock":
+            entries = [("alloc_blocks", payload.id)]
+        elif topic == "BlockMaterialized":
+            # the block's rows moved into the per-alloc tables without
+            # an "Allocations" event: carry the member ids so the delta
+            # ships the materialized rows along with the tombstone
+            entries = [("block_gone", (payload.id, tuple(payload.ids)))]
+        elif topic == "CSIVolume":
+            entries = [("csi_volumes", (payload.namespace, payload.id))]
+        elif topic == "Restore":
+            self._journal.clear()
+            self._journal_floor = index
+            return
+        else:
+            return                      # PlanResult etc: no replica table
+        j = self._journal
+        for e in entries:
+            if len(j) >= self._journal_cap:
+                self._journal_floor = j[0][0]
+                j.popleft()
+            j.append((index,) + e)
+
+    def export_since(self, since_index: int) -> Dict:
+        """Wire-shippable state export for scheduler-worker replicas
+        (core/workerpool).  Returns {"kind": "empty"|"delta"|"full", ...}
+        with the head index + placement fence; a delta carries current
+        objects for every key dirtied after `since_index` (newest state
+        wins — intermediate versions are not replayed) plus tombstones
+        for keys that no longer resolve.  The config-plane tables
+        (scheduler config, namespaces, node pools) are tiny and have no
+        journal topic, so every delta ships them wholesale."""
+        with self._lock:
+            latest = self._index
+            fence = self._placement_seq
+            if since_index >= latest:
+                return {"kind": "empty", "index": latest, "fence": fence}
+            if since_index < self._journal_floor:
+                return {"kind": "full", "doc": self.snapshot_save(),
+                        "index": self._index, "fence": self._placement_seq}
+            ups: Dict[str, list] = {}
+            dels: List[tuple] = []
+            seen: set = set()
+
+            def resolve(section, key, table):
+                if (section, key) in seen:
+                    return
+                seen.add((section, key))
+                obj = table.get(key)
+                if obj is None:
+                    dels.append((section, key))
+                else:
+                    ups.setdefault(section, []).append(obj)
+
+            tables = {"nodes": self._nodes, "jobs": self._jobs,
+                      "evals": self._evals, "allocs": self._allocs,
+                      "deployments": self._deployments,
+                      "alloc_blocks": self._alloc_blocks,
+                      "csi_volumes": self._csi_volumes}
+            for idx, section, key in self._journal:
+                if idx <= since_index:
+                    continue
+                if section == "block_gone":
+                    bid, member_ids = key
+                    if bid not in self._alloc_blocks:
+                        if ("alloc_blocks", bid) not in seen:
+                            seen.add(("alloc_blocks", bid))
+                            dels.append(("alloc_blocks", bid))
+                        for aid in member_ids:
+                            resolve("allocs", aid, self._allocs)
+                    continue
+                resolve(section, key, tables[section])
+            # embedded job pointers ship once via the jobs section; the
+            # replica re-attaches them on apply (snapshot_restore's rule)
+            if "allocs" in ups:
+                slim = []
+                for a in ups["allocs"]:
+                    a = a.copy_skip_job()
+                    a.job = None
+                    slim.append(a)
+                ups["allocs"] = slim
+            return {"kind": "delta", "index": latest, "fence": fence,
+                    "upserts": ups, "deletes": dels,
+                    "scheduler_config": self._scheduler_config,
+                    "namespaces": list(self._namespaces.values()),
+                    "node_pools": list(self._node_pools.values())}
+
+    def apply_export(self, export: Dict) -> None:
+        """Apply an export_since document to THIS store (the replica
+        side; the parent store never calls this).  Fresh outer dicts are
+        published for every touched table so snapshots handed to
+        schedulers stay immutable; the index and placement fence are
+        set to the parent's EXACT values (plan fences computed on the
+        replica must line up with the parent applier's per-node seqs)."""
+        kind = export.get("kind")
+        if kind == "full":
+            self.snapshot_restore(export["doc"])
+        elif kind == "delta":
+            self._apply_delta(export)
+        with self._index_cv:
+            if kind == "full":
+                # snapshot_restore bumps PAST the doc index (the FSM
+                # restore rule); a replica must sit at the parent's exact
+                # head or its next pull's `since` skips the parent's next
+                # write forever (the dirtied key never re-exports)
+                self._index = int(export["index"])
+            else:
+                self._index = max(int(export["index"]), self._index)
+            self._placement_seq = int(export["fence"])
+            self._index_cv.notify_all()
+
+    def _apply_delta(self, export: Dict) -> None:
+        with self._lock:
+            ups = export.get("upserts", {})
+            if ups.get("nodes"):
+                self._nodes = {**self._nodes,
+                               **{n.id: n for n in ups["nodes"]}}
+            for j in ups.get("jobs", ()):
+                self._jobs = {**self._jobs, j.ns_id(): j}
+                versions = dict(self._job_versions.get(j.ns_id(), {}))
+                versions[j.version] = j
+                self._job_versions = {**self._job_versions,
+                                      j.ns_id(): versions}
+            if ups.get("evals"):
+                evals = dict(self._evals)
+                by_job = dict(self._evals_by_job)
+                for e in ups["evals"]:
+                    evals[e.id] = e
+                    k = (e.namespace, e.job_id)
+                    bucket = dict(by_job.get(k, {}))
+                    bucket[e.id] = e
+                    by_job[k] = bucket
+                self._evals = evals
+                self._evals_by_job = by_job
+            if ups.get("allocs"):
+                table = dict(self._allocs)
+                by_node = dict(self._allocs_by_node)
+                by_job = dict(self._allocs_by_job)
+                for a in ups["allocs"]:
+                    a.job = (self._job_versions.get(
+                        (a.namespace, a.job_id), {}).get(a.job_version)
+                        or self._jobs.get((a.namespace, a.job_id)))
+                    prev = table.get(a.id)
+                    if (prev is not None and prev.node_id
+                            and prev.node_id != a.node_id):
+                        b = dict(by_node.get(prev.node_id, {}))
+                        b.pop(a.id, None)
+                        by_node[prev.node_id] = b
+                    table[a.id] = a
+                    if a.node_id:
+                        b = dict(by_node.get(a.node_id, {}))
+                        b[a.id] = a
+                        by_node[a.node_id] = b
+                    k = (a.namespace, a.job_id)
+                    b = dict(by_job.get(k, {}))
+                    b[a.id] = a
+                    by_job[k] = b
+                self._allocs = table
+                self._allocs_by_node = by_node
+                self._allocs_by_job = by_job
+            if ups.get("deployments"):
+                self._deployments = {
+                    **self._deployments,
+                    **{d.id: d for d in ups["deployments"]}}
+            if ups.get("csi_volumes"):
+                self._csi_volumes = {
+                    **self._csi_volumes,
+                    **{(v.namespace, v.id): v
+                       for v in ups["csi_volumes"]}}
+            for b in ups.get("alloc_blocks", ()):
+                self._insert_replica_block_locked(b)
+            for section, key in export.get("deletes", ()):
+                self._delete_replica_key_locked(section, key)
+            self._scheduler_config = (export.get("scheduler_config")
+                                      or self._scheduler_config)
+            if export.get("namespaces"):
+                self._namespaces = {n.name: n
+                                    for n in export["namespaces"]}
+            if export.get("node_pools"):
+                self._node_pools = {p.name: p
+                                    for p in export["node_pools"]}
+            # handed-out snapshots saw only the replaced dicts; fresh
+            # copies above mean nothing shared was mutated in place
+            self._alloc_tables_shared = False
+            self._block_tables_shared = False
+            self._eval_tables_shared = False
+
+    def _insert_replica_block_locked(self, b) -> None:
+        self._alloc_blocks = {**self._alloc_blocks, b.id: b}
+        jkey = (b.template.namespace, b.template.job_id)
+        bj = dict(self._blocks_by_job)
+        bj[jkey] = tuple(x for x in bj.get(jkey, ())
+                         if x.id != b.id) + (b,)
+        self._blocks_by_job = bj
+        bn = dict(self._blocks_by_node)
+        for nid in b.node_table:
+            bn[nid] = tuple(x for x in bn.get(nid, ())
+                            if x.id != b.id) + (b,)
+        self._blocks_by_node = bn
+
+    def _delete_replica_key_locked(self, section: str, key) -> None:
+        key = tuple(key) if isinstance(key, list) else key
+        if section == "nodes":
+            self._nodes = {k: v for k, v in self._nodes.items()
+                           if k != key}
+        elif section == "jobs":
+            self._jobs = {k: v for k, v in self._jobs.items()
+                          if k != key}
+            self._job_versions = {k: v for k, v
+                                  in self._job_versions.items()
+                                  if k != key}
+        elif section == "evals":
+            e = self._evals.get(key)
+            self._evals = {k: v for k, v in self._evals.items()
+                           if k != key}
+            if e is not None:
+                k = (e.namespace, e.job_id)
+                by_job = dict(self._evals_by_job)
+                bucket = dict(by_job.get(k, {}))
+                bucket.pop(key, None)
+                by_job[k] = bucket
+                self._evals_by_job = by_job
+        elif section == "allocs":
+            a = self._allocs.get(key)
+            self._allocs = {k: v for k, v in self._allocs.items()
+                            if k != key}
+            if a is not None:
+                by_node = dict(self._allocs_by_node)
+                if a.node_id and a.node_id in by_node:
+                    b = dict(by_node[a.node_id])
+                    b.pop(key, None)
+                    by_node[a.node_id] = b
+                    self._allocs_by_node = by_node
+                by_job = dict(self._allocs_by_job)
+                jk = (a.namespace, a.job_id)
+                if jk in by_job:
+                    b = dict(by_job[jk])
+                    b.pop(key, None)
+                    by_job[jk] = b
+                    self._allocs_by_job = by_job
+        elif section == "alloc_blocks":
+            b = self._alloc_blocks.get(key)
+            self._alloc_blocks = {k: v for k, v
+                                  in self._alloc_blocks.items()
+                                  if k != key}
+            if b is not None:
+                self._blocks_by_job = {
+                    k: t for k, t in
+                    ((k, tuple(x for x in t if x.id != key))
+                     for k, t in self._blocks_by_job.items()) if t}
+                self._blocks_by_node = {
+                    k: t for k, t in
+                    ((k, tuple(x for x in t if x.id != key))
+                     for k, t in self._blocks_by_node.items()) if t}
+        elif section == "deployments":
+            self._deployments = {k: v for k, v
+                                 in self._deployments.items()
+                                 if k != key}
+        elif section == "csi_volumes":
+            self._csi_volumes = {k: v for k, v
+                                 in self._csi_volumes.items()
+                                 if k != key}
 
     # --------------------------------------------------------------- nodes
 

@@ -32,6 +32,51 @@ from .structs import (
 # Maximum per-node score magnitude from the fit function.
 MAX_FIT_SCORE = 18.0
 
+# Where fresh NetworkIndex cursors start their dynamic-port scan.  The
+# scan order is a ROTATION of the ascending range (base..MAX, then
+# MIN..base-1): with the default base the rotation is the identity and
+# picks are bit-for-bit the historical ascending first-fit.  Pool worker
+# processes (core/workerpool.py) set a per-process base carved from
+# disjoint shards of the range, so two workers placing on one node
+# against the same snapshot pick non-overlapping ports instead of both
+# taking first-fit-from-20000 and refuting at the applier.
+_DYN_SCAN_BASE = MIN_DYNAMIC_PORT
+_DYN_RANGE = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+# Rotating mode (pool children only): committed picks push the process
+# base forward, so a child's NEXT batch — whose snapshot may predate
+# this batch's commits (wavepipe prefetch overlap) — starts past every
+# port this process already claimed instead of re-offering them.
+_DYN_SCAN_ROTATE = False
+
+
+def set_dynamic_port_scan_base(base: int,
+                               rotate: Optional[bool] = None) -> None:
+    """Set this process's dynamic-port scan start (clamped into range).
+    Affects only indexes built after the call.  `rotate=True` makes
+    committed picks advance the base (see _advance_scan_base)."""
+    global _DYN_SCAN_BASE, _DYN_SCAN_ROTATE
+    _DYN_SCAN_BASE = min(max(int(base), MIN_DYNAMIC_PORT),
+                         MAX_DYNAMIC_PORT)
+    if rotate is not None:
+        _DYN_SCAN_ROTATE = bool(rotate)
+
+
+def _advance_scan_base(ports: Iterable[int]) -> None:
+    """In rotating mode, move the process scan base just past the
+    furthest committed pick in current scan order.  Freed ports come
+    back when the rotation wraps (a fresh index rebuilds `used_ports`
+    from state), so the range is recycled, not consumed."""
+    if not _DYN_SCAN_ROTATE:
+        return
+    base_off = _DYN_SCAN_BASE - MIN_DYNAMIC_PORT
+    far = -1
+    for p in ports:
+        if MIN_DYNAMIC_PORT <= p <= MAX_DYNAMIC_PORT:
+            far = max(far, (p - MIN_DYNAMIC_PORT - base_off) % _DYN_RANGE)
+    if far >= 0:
+        set_dynamic_port_scan_base(
+            MIN_DYNAMIC_PORT + (base_off + far + 1) % _DYN_RANGE)
+
 
 def score_fit_binpack(node_cpu: float, node_mem: float,
                       used_cpu: float, used_mem: float) -> float:
@@ -74,22 +119,30 @@ class NetworkIndex:
     (the packed-tensor plane models ports as one bitmap per node, which is
     also what the kernels consume).
 
-    Dynamic picks run off a FREE CURSOR: `_cursor` maintains the invariant
-    that every port below it is in `used_ports`.  Ports are only ever
-    claimed within an index's lifetime (never released — a freed port
-    shows up in a FRESH index built from state), so the cursor only moves
-    forward and repeated assignment on a loaded node is O(1) amortized
-    instead of the O(pool) first-fit scan per port it replaces (PERF.md
-    §6).  The pick sequence is bit-for-bit the linear scan's: everything
-    the cursor skipped is used forever."""
+    Dynamic picks run off a FREE CURSOR: `_vcursor` maintains the
+    invariant that every port before it IN SCAN ORDER is in
+    `used_ports`.  Scan order is the ascending range rotated to start at
+    this process's scan base (the identity rotation by default — see
+    set_dynamic_port_scan_base).  Ports are only ever claimed within an
+    index's lifetime (never released — a freed port shows up in a FRESH
+    index built from state), so the cursor only moves forward and
+    repeated assignment on a loaded node is O(1) amortized instead of
+    the O(pool) first-fit scan per port it replaces (PERF.md §6).  The
+    pick sequence is bit-for-bit the linear scan's: everything the
+    cursor skipped is used forever."""
 
     used_ports: Set[int] = field(default_factory=set)
 
     def __post_init__(self) -> None:
         # not dataclass fields: pick-path accelerators, reconstructible
         # from used_ports (and deliberately absent from the wire form)
-        self._cursor = MIN_DYNAMIC_PORT
+        self._voff = _DYN_SCAN_BASE - MIN_DYNAMIC_PORT
+        self._vcursor = 0
         self._dyn_memo: Tuple[int, int] = (-1, 0)   # (len(used), free)
+
+    def _vport(self, v: int) -> int:
+        """Virtual scan position -> port number (rotation of the range)."""
+        return MIN_DYNAMIC_PORT + (self._voff + v) % _DYN_RANGE
 
     def set_node(self, node: Node) -> None:
         for p in node.reserved.reserved_ports:
@@ -143,13 +196,14 @@ class NetworkIndex:
         a failed, never-committed assignment cannot burn pool positions
         the linear scan would still offer."""
         used = self.used_ports
-        port = self._cursor
-        while port <= MAX_DYNAMIC_PORT and port in used:
-            port += 1
-        self._cursor = port
-        while port <= MAX_DYNAMIC_PORT and (port in used or port in newly):
-            port += 1
-        return port if port <= MAX_DYNAMIC_PORT else None
+        v = self._vcursor
+        while v < _DYN_RANGE and self._vport(v) in used:
+            v += 1
+        self._vcursor = v
+        while v < _DYN_RANGE and (self._vport(v) in used
+                                  or self._vport(v) in newly):
+            v += 1
+        return self._vport(v) if v < _DYN_RANGE else None
 
     def dyn_free_count(self) -> int:
         """Free ports remaining in the dynamic pool — the batched carve's
@@ -167,24 +221,28 @@ class NetworkIndex:
 
     def claim_dynamic_block(self, n_ports: int) -> Optional[List[int]]:
         """Claim-and-commit the first `n_ports` free dynamic ports in
-        ascending first-fit order — ONE cursor pass for a whole node's
-        wave demand instead of n_ports scans.  All-or-nothing: returns
-        None (nothing committed) when the pool is short; callers gate on
-        `dyn_free_count()` first so this cannot fail mid-wave."""
+        scan order (ascending first-fit under the default rotation) —
+        ONE cursor pass for a whole node's wave demand instead of
+        n_ports scans.  All-or-nothing: returns None (nothing committed)
+        when the pool is short; callers gate on `dyn_free_count()` first
+        so this cannot fail mid-wave."""
         if n_ports <= 0:
             return []
         used = self.used_ports
-        port = self._cursor
+        v = self._vcursor
         out: List[int] = []
-        while len(out) < n_ports and port <= MAX_DYNAMIC_PORT:
+        while len(out) < n_ports and v < _DYN_RANGE:
+            port = self._vport(v)
             if port not in used:
                 out.append(port)
-            port += 1
+            v += 1
         if len(out) < n_ports:
             return None
         used.update(out)
-        # every port below `port` is now used (pre-existing or claimed)
-        self._cursor = port
+        # everything before `v` in scan order is now used
+        # (pre-existing or claimed)
+        self._vcursor = v
+        _advance_scan_base(out)
         return out
 
     def assign_ports_batch(self, ask: List[NetworkResource], n: int,
@@ -218,6 +276,7 @@ class NetworkIndex:
 
     def commit(self, ports: Dict[str, int]) -> None:
         self.used_ports.update(ports.values())
+        _advance_scan_base(ports.values())
 
 
 # ---------------------------------------------------------------------------

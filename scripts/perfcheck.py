@@ -83,6 +83,54 @@ BENCH_ABS_GATES: Dict[str, Tuple[str, float]] = {
 # bench comparisons only make sense at one workload shape
 BENCH_SCALE_KEYS = ("n_evals", "placements_per_eval", "workers")
 
+# multi-process worker scaling (core/workerpool.py): with 2+ process
+# workers the sustained rate must beat the 1-worker leg of the same
+# doc's A/B pair by this factor.  Only meaningful where there are
+# cores to scale onto, so the gate SKIPS (does not pass vacuously,
+# does not fail) on one-core hosts and in thread mode — thread-mode
+# docs are judged by the ordinary r05 bands above instead.
+MIN_PROCESS_SCALING = 1.7
+
+
+def check_worker_scaling(fresh: Dict) -> Dict:
+    row: Dict = {"metric": "worker_scaling",
+                 "gate": f">= {MIN_PROCESS_SCALING}x 1-worker sustained"}
+    by_w = fresh.get("sustained_evals_per_s_by_workers")
+    if not isinstance(by_w, dict):
+        row["status"] = "skip"
+        row["reason"] = "no sustained_evals_per_s_by_workers in doc"
+        return row
+    multi = sorted(int(k) for k in by_w
+                   if str(k).isdigit() and int(k) >= 2)
+    if "1" not in by_w or not multi:
+        row["status"] = "skip"
+        row["reason"] = "doc lacks the (1, N>=2) A/B pair " \
+                        "(run bench --workers 2)"
+        return row
+    if fresh.get("worker_mode") != "process":
+        row["status"] = "skip"
+        row["reason"] = "thread mode: host phases serialize on the " \
+                        "GIL; the scaling gate is process-mode only"
+        return row
+    cpus = _num(fresh.get("host_cores")) or 0
+    if cpus < 2:
+        row["status"] = "skip"
+        row["reason"] = f"host has {int(cpus)} core(s): no second " \
+                        "core to scale onto (gate runs on multi-core " \
+                        "CI hosts)"
+        return row
+    n = multi[-1]
+    one, many = _num(by_w["1"]), _num(by_w[str(n)])
+    if not one or many is None:
+        row["status"] = "skip"
+        row["reason"] = "non-numeric A/B entries"
+        return row
+    row.update(workers=n, one_worker=one, multi_worker=many,
+               ratio=round(many / one, 3),
+               limit=round(MIN_PROCESS_SCALING * one, 3))
+    row["status"] = "ok" if many >= MIN_PROCESS_SCALING * one else "fail"
+    return row
+
 # deterministic-by-contract soak fields: exact equality
 SOAK_EXACT = ("converged_fingerprint", "trace_digest", "soak_evals",
               "schedule_events", "soak_breaches", "soak_virtual_hours",
@@ -175,6 +223,8 @@ def compare_bench(base: Dict, fresh: Dict,
             metric, base.get(metric), fresh.get(metric), band))
     for metric, gate in sorted(BENCH_ABS_GATES.items()):
         checks.append(_check_abs(metric, fresh.get(metric), gate))
+    if "sustained_evals_per_s_by_workers" in fresh:
+        checks.append(check_worker_scaling(fresh))
     failed = sorted({c["metric"] for c in checks
                      if c["status"] == "fail"})
     return {"kind": "bench",
@@ -183,6 +233,29 @@ def compare_bench(base: Dict, fresh: Dict,
             "skipped": [c["metric"] for c in checks
                         if c["status"] == "skip"],
             "checks": checks}
+
+
+def compare_workers(fresh: Dict) -> Dict:
+    """--kind workers: judge a worker-A/B doc ALONE (no baseline — a
+    2-worker doc is deliberately a different shape from the r05
+    1-worker trajectory, so the scale-mismatch guard would reject a
+    bench-kind comparison).  The scaling band plus the baseline-free
+    absolute gates (refute rate, SLO breaches, sampler budget)."""
+    checks: List[Dict] = [check_worker_scaling(fresh)]
+    for metric, gate in sorted(BENCH_ABS_GATES.items()):
+        checks.append(_check_abs(metric, fresh.get(metric), gate))
+    failed = sorted({c["metric"] for c in checks
+                     if c["status"] == "fail"})
+    return {"kind": "workers",
+            "verdict": "pass" if not failed else "fail",
+            "failed": failed,
+            "skipped": [c["metric"] for c in checks
+                        if c["status"] == "skip"],
+            "checks": checks,
+            "worker_mode": fresh.get("worker_mode"),
+            "host_cores": fresh.get("host_cores"),
+            "sustained_evals_per_s_by_workers":
+                fresh.get("sustained_evals_per_s_by_workers")}
 
 
 def compare_soak(base: Dict, fresh: Dict) -> Dict:
@@ -268,6 +341,22 @@ def self_check() -> int:
                and "soak_breaches" in v["failed"])
     else:
         print("no SOAK_r01.json baseline — soak self-check skipped")
+    # worker-scaling band wiring: the gate must catch a sub-1.7x
+    # process-mode pair, and must SKIP (not fail) thread-mode and
+    # one-core docs where the gate is meaningless
+    doc = {"worker_mode": "process", "host_cores": 4,
+           "sustained_evals_per_s_by_workers": {"1": 10.0, "2": 18.0}}
+    scaled = check_worker_scaling(doc)["status"]
+    flat = check_worker_scaling(
+        {**doc, "sustained_evals_per_s_by_workers":
+         {"1": 10.0, "2": 12.0}})["status"]
+    threaded = check_worker_scaling(
+        {**doc, "worker_mode": "thread"})["status"]
+    onecore = check_worker_scaling({**doc, "host_cores": 1})["status"]
+    print(f"worker scaling band: 1.8x={scaled} 1.2x={flat} "
+          f"thread={threaded} one-core={onecore}")
+    ok &= (scaled == "ok" and flat == "fail"
+           and threaded == "skip" and onecore == "skip")
     print(f"perfcheck self-check: {'PASS' if ok else 'FAIL'}")
     return 0 if ok else 1
 
@@ -276,8 +365,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="compare fresh bench/soak JSON against the "
                     "checked-in trajectory with tolerance bands")
-    ap.add_argument("--kind", choices=("bench", "soak"),
-                    default="bench")
+    ap.add_argument("--kind", choices=("bench", "soak", "workers"),
+                    default="bench",
+                    help="workers: judge a --workers N A/B doc alone "
+                         "(process-scaling band + absolute gates; no "
+                         "baseline needed)")
     ap.add_argument("--fresh", help="fresh summary JSON to judge")
     ap.add_argument("--baseline",
                     help="baseline JSON (default: newest BENCH_r*.json"
@@ -299,6 +391,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         return self_check()
     if not args.fresh:
         ap.error("--fresh is required (or use --self-check)")
+    if args.kind == "workers":
+        try:
+            fresh = _load(args.fresh)
+        except (OSError, ValueError) as e:
+            print(f"cannot load inputs: {e}", file=sys.stderr)
+            return 2
+        verdict = compare_workers(fresh)
+        verdict["fresh_path"] = args.fresh
+        out = json.dumps(verdict, indent=2, sort_keys=True)
+        print(out)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(out + "\n")
+        return 0 if verdict["verdict"] == "pass" else 1
     baseline = args.baseline
     if not baseline:
         baseline = (_latest_bench_baseline() if args.kind == "bench"

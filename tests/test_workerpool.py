@@ -1,0 +1,444 @@
+"""Multi-process worker plane tests (core/workerpool.py).
+
+Fast tier-1 tests cover the pieces in isolation: state export/delta
+round-trips, the device submission front-end's serialization, the
+sharded dynamic-port scan, and the replica-vs-thread visibility knobs.
+The spawn-based integration tests (real worker processes against a
+live Server) are marked `slow` and ride the ci.sh multiproc stage —
+each spawn pays a full interpreter + jax import.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import funcs as structs_funcs
+from nomad_tpu.structs.funcs import NetworkIndex, set_dynamic_port_scan_base
+from nomad_tpu.structs.structs import (
+    MAX_DYNAMIC_PORT,
+    MIN_DYNAMIC_PORT,
+    NetworkResource,
+    Port,
+    VolumeRequest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_port_scan():
+    """Every test leaves the process scan base at its historical
+    default — the thread plane's byte-identical seeded soaks depend on
+    ascending-from-20000 picks."""
+    yield
+    set_dynamic_port_scan_base(MIN_DYNAMIC_PORT, rotate=False)
+
+
+# =====================================================================
+# state export / delta round-trip
+# =====================================================================
+
+
+class TestStateExport:
+    def _seeded_store(self):
+        s = StateStore()
+        nodes = [mock.node(name=f"n{i}") for i in range(4)]
+        s.upsert_nodes(nodes)
+        job = mock.job()
+        s.upsert_job(job)
+        allocs = [mock.alloc(node_id=nodes[i % 4].id, job=job,
+                             job_id=job.id)
+                  for i in range(6)]
+        s.upsert_allocs(allocs)
+        return s, nodes, job, allocs
+
+    def test_full_export_bootstraps_replica(self):
+        s, nodes, job, allocs = self._seeded_store()
+        # a replica older than the journal floor gets a full snapshot
+        s._journal_floor = s.latest_index()
+        export = s.export_since(0)
+        assert export["kind"] == "full"
+        r = StateStore()
+        r.apply_export(export)
+        assert r.latest_index() == s.latest_index()
+        rs, ss = r.snapshot(), s.snapshot()
+        assert {n.id for n in rs.nodes()} == {n.id for n in nodes}
+        assert len(rs.allocs_by_node(nodes[0].id)) == \
+            len(ss.allocs_by_node(nodes[0].id))
+
+    def test_delta_ships_only_dirtied_keys(self):
+        s, nodes, job, allocs = self._seeded_store()
+        r = StateStore()
+        r.apply_export(s.export_since(0))
+        since = r.latest_index()
+        # dirty one node and one alloc
+        n0 = nodes[0].copy()
+        n0.status = "down"
+        s.upsert_node(n0)
+        a0 = allocs[0].copy_skip_job()
+        a0.job = job
+        a0.client_status = "running"
+        s.upsert_allocs([a0])
+        export = s.export_since(since)
+        assert export["kind"] == "delta"
+        assert {n.id for n in export["upserts"]["nodes"]} == {n0.id}
+        assert {a.id for a in export["upserts"]["allocs"]} == {a0.id}
+        r.apply_export(export)
+        assert r.node_by_id(n0.id).status == "down"
+        got = {a.id: a for a in r.snapshot().allocs_by_node(nodes[0].id)}
+        assert got[a0.id].client_status == "running"
+        # the replica re-attaches the embedded job pointer (slimmed on
+        # the wire) so schedulers can resolve task groups
+        assert got[a0.id].job is not None
+        assert r.latest_index() == s.latest_index()
+
+    def test_delta_carries_deletions_as_tombstones(self):
+        s, nodes, job, allocs = self._seeded_store()
+        r = StateStore()
+        r.apply_export(s.export_since(0))
+        since = r.latest_index()
+        s.delete_node(nodes[3].id)
+        export = s.export_since(since)
+        assert export["kind"] == "delta"
+        assert ("nodes", nodes[3].id) in export["deletes"]
+        r.apply_export(export)
+        assert r.node_by_id(nodes[3].id) is None
+
+    def test_fresh_replica_bootstraps_via_delta(self):
+        # journal floor starts at 0, so since=0 rides the delta path:
+        # every key dirtied since genesis ships as an upsert
+        s, nodes, job, allocs = self._seeded_store()
+        export = s.export_since(0)
+        assert export["kind"] == "delta"
+        r = StateStore()
+        r.apply_export(export)
+        assert {n.id for n in r.snapshot().nodes()} == \
+            {n.id for n in nodes}
+        assert r.latest_index() == s.latest_index()
+
+    def test_empty_export_when_caught_up(self):
+        s, _, _, _ = self._seeded_store()
+        export = s.export_since(s.latest_index())
+        assert export["kind"] == "empty"
+
+    def test_export_survives_wire_roundtrip(self):
+        from nomad_tpu.core import wire
+        from nomad_tpu.core.workerpool import _ensure_wire_types
+        _ensure_wire_types()
+        s, nodes, job, allocs = self._seeded_store()
+        export = wire.unpackb(wire.packb(s.export_since(0)))
+        r = StateStore()
+        r.apply_export(export)
+        assert {n.id for n in r.snapshot().nodes()} == \
+            {n.id for n in nodes}
+        assert r.latest_index() == s.latest_index()
+
+
+# =====================================================================
+# device submission front-end
+# =====================================================================
+
+
+class _SlowExecutor:
+    """Records overlap: dispatches must never interleave."""
+
+    def __init__(self):
+        self.inside = 0
+        self.max_inside = 0
+        self.calls = 0
+        self._guard = threading.Lock()
+
+    def dispatch_batch(self, snapshot, items, seed=0, used0_dev=None,
+                       masked_node_ids=None):
+        with self._guard:
+            self.inside += 1
+            self.max_inside = max(self.max_inside, self.inside)
+        time.sleep(0.01)
+        with self._guard:
+            self.inside -= 1
+            self.calls += 1
+        return {"ok": True}
+
+
+class TestSubmissionFrontEnd:
+    def test_serializes_and_meters_queue_wait(self):
+        from nomad_tpu.ops.executor import SubmissionFrontEnd
+        front = SubmissionFrontEnd(_SlowExecutor())
+        threads = [threading.Thread(
+            target=lambda: front.dispatch_batch(None, []))
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert front.executor.calls == 4
+        assert front.executor.max_inside == 1     # never interleaved
+        assert front.stats["submits"] == 4
+        # with 4 threads racing a 10ms dispatch, someone waited
+        assert front.stats["queue_waits"] >= 1
+        assert front.stats["queue_wait_s"] > 0.0
+
+
+# =====================================================================
+# sharded dynamic-port scan
+# =====================================================================
+
+
+class TestPortScanSharding:
+    def test_default_base_is_bit_identical_ascending(self):
+        ni = NetworkIndex()
+        got = ni.claim_dynamic_block(3)
+        assert got == [MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT + 1,
+                       MIN_DYNAMIC_PORT + 2]
+
+    def test_offset_base_starts_mid_range_and_wraps(self):
+        base = MAX_DYNAMIC_PORT - 1
+        set_dynamic_port_scan_base(base)
+        ni = NetworkIndex()
+        got = ni.claim_dynamic_block(4)
+        assert got == [base, MAX_DYNAMIC_PORT,
+                       MIN_DYNAMIC_PORT, MIN_DYNAMIC_PORT + 1]
+
+    def test_assign_ports_respects_base(self):
+        set_dynamic_port_scan_base(25000)
+        ni = NetworkIndex()
+        ask = [NetworkResource(dynamic_ports=[Port(label="http")])]
+        ports, dim = ni.assign_ports(ask)
+        assert dim == ""
+        assert ports["http"] == 25000
+
+    def test_disjoint_shards_never_collide(self):
+        """Two 'processes' (simulated by switching the base) placing on
+        the same empty node pick disjoint ports."""
+        set_dynamic_port_scan_base(20000)
+        a = NetworkIndex().claim_dynamic_block(16)
+        set_dynamic_port_scan_base(26000)
+        b = NetworkIndex().claim_dynamic_block(16)
+        assert not set(a) & set(b)
+
+    def test_rotating_mode_advances_past_commits(self):
+        set_dynamic_port_scan_base(24000, rotate=True)
+        first = NetworkIndex().claim_dynamic_block(4)
+        assert first[0] == 24000
+        # a FRESH index (stale-snapshot analogue: it has no idea the
+        # first claim happened) still starts past the committed picks
+        second = NetworkIndex().claim_dynamic_block(4)
+        assert not set(first) & set(second)
+        assert second[0] == 24004
+
+    def test_non_rotating_mode_base_is_stable(self):
+        set_dynamic_port_scan_base(24000, rotate=False)
+        NetworkIndex().claim_dynamic_block(4)
+        assert NetworkIndex().claim_dynamic_block(1) == [24000]
+
+    def test_commit_advances_in_rotating_mode(self):
+        set_dynamic_port_scan_base(24000, rotate=True)
+        ni = NetworkIndex()
+        ask = [NetworkResource(dynamic_ports=[Port(label="http")])]
+        ports, _ = ni.assign_ports(ask)
+        ni.commit(ports)
+        assert NetworkIndex().claim_dynamic_block(1) == [24001]
+
+    def test_dyn_free_count_unaffected_by_base(self):
+        set_dynamic_port_scan_base(29000)
+        ni = NetworkIndex()
+        free0 = ni.dyn_free_count()
+        assert free0 == MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+        ni.claim_dynamic_block(5)
+        assert ni.dyn_free_count() == free0 - 5
+
+
+# =====================================================================
+# replica-staleness knobs
+# =====================================================================
+
+
+class TestReplicaKnobs:
+    def test_thread_worker_keeps_reference_attempt_limits(self):
+        from nomad_tpu.core.server import Server
+        from nomad_tpu.scheduler.generic import (
+            MAX_BATCH_ATTEMPTS, GenericScheduler)
+        s = Server(dev_mode=True, num_workers=1)
+        s.establish_leadership()
+        try:
+            worker = s.workers[0] if getattr(s, "workers", None) else None
+            if worker is None:
+                pytest.skip("dev-mode server exposes no worker list")
+            assert getattr(worker, "schedule_attempt_boost", 0) == 0
+            sched = GenericScheduler(s.state.snapshot(), worker,
+                                     is_batch=True, engine=s.engine)
+            assert sched.max_attempts == MAX_BATCH_ATTEMPTS
+        finally:
+            s.shutdown()
+
+    def test_child_server_shim_boosts_attempts(self):
+        from nomad_tpu.core.workerpool import _ChildServer
+        assert _ChildServer.schedule_attempt_boost > 0
+
+
+# =====================================================================
+# packed-fill cap (pack/packer.py)
+# =====================================================================
+
+
+class TestPackedFillCap:
+    def test_cap_is_the_20_bit_row_limit(self):
+        from nomad_tpu.pack import packer as packer_mod
+        assert packer_mod.PACKED_FILL_CAP == 1 << 20
+
+    def test_oversized_cluster_raises_named_error(self, monkeypatch):
+        from nomad_tpu.pack import packer as packer_mod
+        monkeypatch.setattr(packer_mod, "PACKED_FILL_CAP", 4)
+        store = StateStore()
+        store.upsert_nodes([mock.node(name=f"n{i}") for i in range(4)])
+        p = packer_mod.ClusterPacker()
+        with pytest.raises(ValueError) as exc:
+            p.build(store.snapshot())
+        assert "PACKED_FILL_CAP" in str(exc.value)
+
+
+# =====================================================================
+# traffic knobs (chaos/traffic.py)
+# =====================================================================
+
+
+class TestTrafficKnobs:
+    def test_networked_fraction_and_classes_are_deterministic(self):
+        from nomad_tpu.chaos.traffic import (TrafficProfile, fleet,
+                                             generate_schedule)
+        prof = TrafficProfile(hours=0.5, networked_fraction=0.7,
+                              node_classes=("edge", "core"))
+        a = generate_schedule(1234, prof)
+        b = generate_schedule(1234, prof)
+        assert a == b
+        ported = [e for e in a if e.get("ports")]
+        assert ported, "0.7 networked_fraction produced no port asks"
+        assert all(e.get("node_class") in ("edge", "core")
+                   for e in ported)
+        nodes = fleet(1234, prof)
+        assert {n["node_class"] for n in nodes} == {"edge", "core"}
+
+    def test_zero_knobs_do_not_consume_rng(self):
+        from nomad_tpu.chaos.traffic import TrafficProfile, generate_schedule
+        base = TrafficProfile(hours=0.5)
+        off = TrafficProfile(hours=0.5, networked_fraction=0.0,
+                             node_classes=())
+        assert generate_schedule(77, base) == generate_schedule(77, off)
+
+
+# =====================================================================
+# spawn-based integration (slow: real worker processes)
+# =====================================================================
+
+
+def _build_cluster(n):
+    nodes = []
+    for i in range(n):
+        nd = mock.node(name=f"pool-n{i}")
+        nd.datacenter = f"dc{i % 3 + 1}"
+        nodes.append(nd)
+    return nodes
+
+
+def _make_batch_job(count, net=False, zone_vol=None):
+    job = mock.batch_job()
+    job.datacenters = ["dc1", "dc2", "dc3"]
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = 10
+    tg.tasks[0].resources.memory_mb = 10
+    if zone_vol is not None:
+        tg.volumes = {"data": VolumeRequest(
+            name="data", type="csi", source=zone_vol, read_only=True)}
+    if net:
+        tg.tasks[0].resources.networks = [
+            NetworkResource(dynamic_ports=[Port(label="http")])]
+    return job
+
+
+def _drain(server, evs, deadline_s=90.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        sts = [getattr(server.state.eval_by_id(e.id), "status", None)
+               for e in evs]
+        if all(st in ("complete", "failed", "canceled") for st in sts):
+            return sts
+        time.sleep(0.05)
+    return [getattr(server.state.eval_by_id(e.id), "status", None)
+            for e in evs]
+
+
+@pytest.mark.slow
+class TestProcessPoolIntegration:
+    def _server(self, workers=2):
+        from nomad_tpu.core.server import Server
+        s = Server(dev_mode=False, num_workers=workers, eval_batch=8,
+                   heartbeat_ttl=1e9, nack_timeout=600.0,
+                   worker_mode="process", mesh=False)
+        s.establish_leadership()
+        return s
+
+    def test_networked_waves_complete_without_refutes(self):
+        s = self._server()
+        try:
+            s.state.upsert_nodes(_build_cluster(60))
+            evs = [s.register_job(_make_batch_job(8, net=True),
+                                  now=time.time())
+                   for _ in range(6)]
+            s.start_scheduling()
+            sts = _drain(s, evs)
+            s.stop_scheduling()
+            assert sts == ["complete"] * len(evs), sts
+            # exact placement count: 6 jobs x 8 allocs, none duplicated
+            snap = s.state.snapshot()
+            allocs = [a for n in snap.nodes()
+                      for a in snap.allocs_by_node(n.id)
+                      if not a.terminal_status()]
+            assert len(allocs) == 48
+            assert len({a.id for a in allocs}) == 48
+            # every networked alloc carries a port; no (node, port) dup
+            seen = set()
+            for a in allocs:
+                assert a.allocated_ports, a.id
+                for port in a.allocated_ports.values():
+                    key = (a.node_id, port)
+                    assert key not in seen
+                    seen.add(key)
+            assert s.plan_applier.stats["plans_refuted"] == 0
+            assert s.worker_pool.pool_stats()["alive"] == 2
+        finally:
+            s.shutdown()
+
+    def test_worker_crash_recovers_and_respawns(self):
+        s = self._server()
+        try:
+            s.state.upsert_nodes(_build_cluster(30))
+            s.start_scheduling()
+            # let the children finish coming up, then kill one
+            deadline = time.time() + 60
+            while (s.worker_pool.alive_workers() < 2
+                   and time.time() < deadline):
+                time.sleep(0.1)
+            victim = s.worker_pool._children[0]
+            victim.proc.terminate()
+            victim.proc.join(timeout=30)
+            evs = [s.register_job(_make_batch_job(4), now=time.time())
+                   for _ in range(4)]
+            sts = _drain(s, evs)
+            s.stop_scheduling()
+            assert sts == ["complete"] * len(evs), sts
+            stats = s.worker_pool.pool_stats()
+            assert stats["respawns"] >= 1
+            assert stats["alive"] == 2
+        finally:
+            s.shutdown()
+
+    def test_thread_mode_is_the_default_and_poolless(self):
+        from nomad_tpu.core.server import Server
+        s = Server(dev_mode=True, num_workers=2)
+        try:
+            assert s.worker_mode == "thread"
+            assert s.worker_pool is None
+        finally:
+            s.shutdown()
