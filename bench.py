@@ -802,6 +802,12 @@ def run_config_5(args):
     # --resident off: the A/B lever for PERF.md §12 — every wave
     # re-syncs used0 from the packer through the host (no chaining)
     s.executor.chain_enabled = (args.resident != "off")
+    # timeline plane (core/timeline.py): the bench has no tick loop, so
+    # the drain poll below samples explicitly; reset() pins the counter
+    # base so the headline's timeline covers this run only
+    from nomad_tpu.core import timeline as _tl
+    _tl.TIMELINE.reset()
+    _bench_t0 = time.perf_counter()
     s.establish_leadership()
     nodes, vols = _build_bench_cluster(n_nodes)
     s.state.upsert_nodes(nodes)
@@ -836,6 +842,7 @@ def run_config_5(args):
         blocked eval, so the reported rate must count COMMITTED allocs,
         not finished evals)."""
         s.engine.packer.update(s.state.snapshot())
+        _tl.TIMELINE.annotate("bench.wave", tag=tag, evals=len(evals))
         t0 = time.perf_counter()
         s.start_scheduling()
         deadline = time.time() + 1200
@@ -848,6 +855,10 @@ def run_config_5(args):
                                                     "canceled"):
                     done.add(eid)
             pending -= done
+            # the bench's stand-in for Server.tick's per-tick sample:
+            # last-write-wins within each 1s bucket, so the 0.05s poll
+            # cadence costs one row per second, not twenty
+            _tl.TIMELINE.sample()
             if pending:
                 time.sleep(0.05)
         dt = time.perf_counter() - t0
@@ -1236,6 +1247,14 @@ def run_config_5(args):
     # the best wave for cross-round continuity; the median shows what a
     # typical window looks like on both sides
     value_median = n_evals / statistics.median(wave_dts)
+    # timeline plane (core/timeline.py): points/annotations retained
+    # over this run, and the sampler's own cost as a fraction of the
+    # whole run's wall — perfcheck gates it at the same <= 0.02 budget
+    # as the host profiler
+    tl_stats = _tl.TIMELINE.snapshot_stats()
+    tl_overhead = round(
+        tl_stats["sample_s"]
+        / max(time.perf_counter() - _bench_t0, 1e-9), 5)
     return {"metric": "northstar_50knodes_100kallocs_evals_per_sec",
             "value": round(evals_per_sec, 2), "unit": "evals/sec",
             "value_best": round(evals_per_sec, 2),
@@ -1309,6 +1328,12 @@ def run_config_5(args):
                 "profile_attributed_fraction":
                     round(prof1["attributed_fraction"], 4)}
                if prof1["running"] or prof1["samples"] else {}),
+            # retrospective timeline (ISSUE 15): clock-aligned history
+            # sampled from the drain polls above; the overhead gate
+            # mirrors the sampler's (scripts/perfcheck.py: <= 0.02)
+            "timeline_points": tl_stats["points"],
+            "timeline_annotations": tl_stats["annotations"],
+            "timeline_overhead_fraction": tl_overhead,
             # mesh deployment (nomad_tpu/parallel): device count, the
             # fraction of kernel rows that are mesh padding, the
             # per-wave cross-shard collective payload (O(top-k ·
@@ -1454,6 +1479,17 @@ def run_soak(args):
     r = _run(seed=args.soak_seed, profile=profile)
     out = dict(r.summary)
     out["violations"] = sorted(r.violations)
+    if getattr(args, "soak_out", ""):
+        # the retrospective lands next to the summary: full-resolution
+        # timeline dump (the `nomad timeline -input` / `nomad report
+        # -input` doc) + the rendered post-mortem
+        from nomad_tpu.core.timeline import render_report_md
+        with open(args.soak_out + ".timeline.json", "w") as f:
+            json.dump(r.timeline, f, indent=2, sort_keys=True)
+        with open(args.soak_out + ".report.md", "w") as f:
+            f.write(render_report_md(r.report))
+        print(f"timeline + report written to {args.soak_out}.*",
+              file=sys.stderr)
     if not r.ok:
         print(json.dumps(out))
         raise SystemExit(1)
@@ -1979,6 +2015,12 @@ def main():
                          " --quick shrinks to the churny smoke profile")
     ap.add_argument("--soak-seed", type=int, default=0,
                     help="seed for --soak (same seed, same bytes)")
+    ap.add_argument("--soak-out", dest="soak_out", default="",
+                    metavar="PREFIX",
+                    help="--soak: write PREFIX.timeline.json (the "
+                         "full-resolution timeline dump) and "
+                         "PREFIX.report.md (the breach post-mortem) "
+                         "next to the summary")
     args = ap.parse_args()
     _apply_mesh_arg(args)
     if args.phases:

@@ -352,6 +352,32 @@ class Operator(_Endpoint):
         """One retained capture bundle by id (`prof-0001`)."""
         return self.c.get(f"/v1/operator/profile/{capture_id}")
 
+    def timeline(self, start: Optional[float] = None,
+                 end: Optional[float] = None,
+                 step: Optional[float] = None,
+                 series: Optional[List[str]] = None) -> Dict:
+        """Clock-aligned metric history (core/timeline.py): min/max/avg/
+        last per query step with cross-plane annotations interleaved.
+        All args optional — the default query spans the retained
+        window at native resolution."""
+        params: Dict = {}
+        if start is not None:
+            params["start"] = start
+        if end is not None:
+            params["end"] = end
+        if step is not None:
+            params["step"] = step
+        if series:
+            params["series"] = ",".join(series)
+        return self.c.request("GET", "/v1/operator/timeline",
+                              params=params)
+
+    def timeline_dump(self) -> Dict:
+        """Full-resolution timeline doc plus the breach/spike
+        post-mortem report — what `nomad report` renders."""
+        return self.c.request("GET", "/v1/operator/timeline",
+                              params={"dump": "true"})
+
 
 class System(_Endpoint):
     def gc(self) -> Dict:

@@ -524,6 +524,37 @@ class Router:
                     except ValueError:
                         raise APIError(400, "bad n")
                 return FLIGHT.snapshot(n_waves=n, n_evals=n, n_events=n)
+            if p[1:2] == ["timeline"] and method == "GET":
+                # retrospective timeline plane (core/timeline.py):
+                #   ?start=&end=&step=&series=a,b  range aggregation
+                #        (min/max/avg/last per step, annotations
+                #        interleaved)
+                #   ?dump=true                     full-resolution doc +
+                #        post-mortem report, what `nomad report` reads
+                from nomad_tpu.core.timeline import TIMELINE, build_report
+
+                def _qf(key: str) -> Optional[float]:
+                    if not qs.get(key):
+                        return None
+                    try:
+                        return float(qs[key][0])
+                    except ValueError:
+                        raise APIError(400, f"bad {key}")
+
+                names = None
+                if qs.get("series"):
+                    names = [x for x in qs["series"][0].split(",") if x]
+                try:
+                    if (qs.get("dump") or ["false"])[0] == "true":
+                        doc = TIMELINE.query()
+                        doc["Report"] = build_report(doc)
+                        return doc
+                    return TIMELINE.query(start=_qf("start"),
+                                          end=_qf("end"),
+                                          step=_qf("step"),
+                                          series=names)
+                except ValueError as e:
+                    raise APIError(400, str(e))
             if p[1:2] == ["profile"]:
                 # continuous profiling plane (core/profiling.py).
                 #   GET  /v1/operator/profile        live sampler snapshot
@@ -566,6 +597,8 @@ class Router:
                 from nomad_tpu.core.logging import RING
                 from nomad_tpu.core.profiling import PROFILER
                 from nomad_tpu.core.telemetry import TRACER
+                from nomad_tpu.core.timeline import TIMELINE
+                tl_win = TIMELINE.window()
                 return {
                     "Stats": self.agent.stats(),
                     "Metrics": self.agent.metrics(),
@@ -585,6 +618,16 @@ class Router:
                     # fraction) and the device compile/HBM ledger — the
                     # profiling plane folded into the one-doc bundle
                     "Profiler": PROFILER.brief(),
+                    # the timeline plane, bounded: retained window,
+                    # sampler stats, and the most recent two minutes of
+                    # clock-aligned history (not the full ring)
+                    "Timeline": {
+                        "Window": tl_win,
+                        "Stats": TIMELINE.snapshot_stats(),
+                        "Recent": (TIMELINE.slice(
+                            max(tl_win[1] - 120.0, tl_win[0]),
+                            tl_win[1]) if tl_win else None),
+                    },
                     "DeviceLedger": s.executor.ledger(),
                     "Threads": [
                         {"Name": t.name, "Daemon": t.daemon,

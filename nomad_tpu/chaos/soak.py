@@ -99,12 +99,22 @@ def coarse_fingerprint(snap) -> str:
 
 class SoakResult:
     def __init__(self, ok: bool, violations: List[str], trace: Trace,
-                 fingerprint: str, summary: Dict) -> None:
+                 fingerprint: str, summary: Dict,
+                 timeline: Optional[Dict] = None,
+                 timeline_canonical: Optional[Dict] = None,
+                 report: Optional[Dict] = None) -> None:
         self.ok = ok
         self.violations = violations
         self.trace = trace
         self.fingerprint = fingerprint
         self.summary = summary
+        # retrospective timeline plane (core/timeline.py): the full
+        # query doc, the determinism-safe canonical dump, and the
+        # breach/spike post-mortem — cmd_soak/bench write these next
+        # to the trace
+        self.timeline = timeline
+        self.timeline_canonical = timeline_canonical
+        self.report = report
 
     @property
     def digest(self) -> str:
@@ -193,8 +203,17 @@ class SoakRunner:
     # ----------------------------------------------------------- events
 
     def _apply_event(self, c, e: Dict, now: float) -> None:
+        from nomad_tpu.core.timeline import TIMELINE
         from nomad_tpu.structs import codec
         kind = e["kind"]
+        # every traffic event lands in the annotation stream at its
+        # SCHEDULED virtual time (deterministic; `nomad report`
+        # attributes breaches/spikes to these)
+        TIMELINE.annotate(
+            f"traffic.{kind}", now=e["at"],
+            **{k: e[k] for k in ("job", "node", "count", "rev",
+                                 "duration", "scenario", "jtype")
+               if k in e})
         if kind == "job.register":
             job, group = self._build_job(e)
             wire_job = codec.encode(job)
@@ -253,9 +272,21 @@ class SoakRunner:
         planes to the soak's clock and absorb the scenario's counter
         activity so it cannot fabricate a watchdog breach."""
         from nomad_tpu.chaos.scenarios import run_scenario
-        res = run_scenario(e["scenario"], seed=e["seed"])
-        self._rebind_clock()
+        from nomad_tpu.core.timeline import TIMELINE
+        TIMELINE.annotate("chaos.begin", now=e["at"],
+                          scenario=e["scenario"])
+        # the scenario boots its own servers on its own VirtualClock;
+        # their ticks must not write scenario-time rows into THIS
+        # soak's history
+        TIMELINE.enabled = False
+        try:
+            res = run_scenario(e["scenario"], seed=e["seed"])
+        finally:
+            TIMELINE.enabled = True
+            self._rebind_clock()
         self.agent.server.health.rebase()
+        TIMELINE.annotate("chaos.end", now=e["at"],
+                          scenario=e["scenario"], ok=bool(res.ok))
         self.trace.record(e["at"], "chaos_result",
                           scenario=e["scenario"], ok=bool(res.ok),
                           digest=res.trace.digest(),
@@ -269,10 +300,12 @@ class SoakRunner:
     def _rebind_clock(self) -> None:
         from nomad_tpu.core import flightrec, identity, telemetry
         from nomad_tpu.core import logging as logging_mod
+        from nomad_tpu.core import timeline as timeline_mod
         telemetry.configure(self.clock)
         flightrec.configure(self.clock)
         logging_mod.configure(self.clock)
         identity.configure(self.clock)
+        timeline_mod.configure(self.clock)
 
     # -------------------------------------------------- synthetic fleet
 
@@ -445,8 +478,19 @@ class SoakRunner:
             self.trace.record(e["at"], e["kind"],
                               **{k: v for k, v in e.items()
                                  if k not in ("at", "kind")})
+        from nomad_tpu.core import telemetry as telemetry_mod
+        from nomad_tpu.core import timeline as timeline_mod
         self.clock = VirtualClock(epoch=_EPOCH)
         wire.set_clock(self.clock)
+        # run-isolate the retrospective timeline: the registry is
+        # process-global, so the rolling windows and quality gauges the
+        # timeline samples would otherwise leak one run's residue into
+        # the next and break same-seed byte-identity of the canonical
+        # dump; counters need no clearing (the timeline rebases them
+        # at reset())
+        telemetry_mod.REGISTRY.clear_series("nomad.plan.queue_wait_s")
+        telemetry_mod.REGISTRY.clear_series("nomad.quality.")
+        timeline_mod.TIMELINE.reset()
         self.agent = Agent(client_enabled=False, num_workers=2,
                            heartbeat_ttl=self.heartbeat_ttl,
                            clock=self.clock, slo=self.slo).start()
@@ -492,6 +536,19 @@ class SoakRunner:
                     self._sweep_allocs(c, now)
                     next_sweep = now + self.sweep_interval
                 self._quiesce()
+                # deterministic tick duties for this virtual instant
+                # (heartbeat expiry, delayed-eval promotion, drains)
+                # land BEFORE the settled timeline row: the threaded
+                # tick loop races the step's work, this one is
+                # serialized behind Server._tick_lock and runs against
+                # the quiesced plane
+                self.agent.server.tick()
+                self._quiesce()
+                # settled rows win the bucket: whatever mid-step values
+                # the async tick sampled are replaced by this
+                # post-quiesce row, which is a pure function of the
+                # step's converged state — the byte-identity carrier
+                timeline_mod.TIMELINE.sample(now, settled=True)
                 if now >= horizon and ei >= len(self.schedule):
                     snap = self.agent.server.state.snapshot()
                     if not self._converged(snap) or now >= deadline_v:
@@ -517,6 +574,15 @@ class SoakRunner:
                               fingerprint=fingerprint)
             wall_s = _wall.monotonic() - t_wall0
             stats = self.agent.server.eval_broker.stats
+            # retrospective artifacts, emitted next to the canonical
+            # trace: the determinism-safe canonical dump (digested into
+            # the summary), the full query doc, and the post-mortem
+            # report attributing breaches/spikes to annotations
+            tl = timeline_mod.TIMELINE
+            tl_stats = tl.snapshot_stats()
+            self.timeline = tl.query()
+            self.timeline_canonical = tl.canonical_dump()
+            self.report = timeline_mod.build_report(self.timeline)
             summary = {
                 "seed": self.seed,
                 "soak_virtual_hours": round(end_v / 3600.0, 4),
@@ -532,10 +598,27 @@ class SoakRunner:
                 "p99_plan_queue_ms": self._p99_ms,
                 "quality": {k: round(v, 6)
                             for k, v in self._quality.items()},
+                "timeline_points": int(tl_stats["points"]),
+                "timeline_annotations": int(tl_stats["annotations"]),
+                # self-metered sample cost over the run's wall time
+                # (perfcheck gates this at <= 0.02)
+                "timeline_overhead_fraction":
+                    round(tl_stats["sample_s"] / wall_s, 6)
+                    if wall_s > 0 else 0.0,
+                "timeline_evictions":
+                    int(tl_stats["point_evictions"]
+                        + tl_stats["annotation_evictions"]
+                        + tl_stats["volatile_evictions"]),
+                # sha256 of the canonical dump: the same-seed double-run
+                # test compares these (and the full bytes)
+                "timeline_digest": tl.canonical_digest(),
                 "ok": bool(ok),
             }
             return SoakResult(ok, self.violations, self.trace,
-                              fingerprint, summary)
+                              fingerprint, summary,
+                              timeline=self.timeline,
+                              timeline_canonical=self.timeline_canonical,
+                              report=self.report)
         finally:
             self.agent.shutdown()
             self.clock.close()

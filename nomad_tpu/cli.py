@@ -1020,6 +1020,10 @@ def cmd_soak(args) -> int:
           f"{q['fill_memory']:.3f}")
     print(f"converged fingerprint = {s['converged_fingerprint'][:16]}…")
     print(f"trace digest          = {s['trace_digest'][:16]}…")
+    print(f"timeline              = {s['timeline_points']} points, "
+          f"{s['timeline_annotations']} annotations "
+          f"(overhead {s['timeline_overhead_fraction']:.4f}, "
+          f"digest {s['timeline_digest'][:16]}…)")
     ok = all(x.ok for x in results)
     for x in results:
         for v in x.violations:
@@ -1039,7 +1043,93 @@ def cmd_soak(args) -> int:
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
         print(f"summary written to {args.json}")
+        # the retrospective rides along next to the canonical trace:
+        # full-resolution timeline dump + rendered post-mortem
+        from nomad_tpu.core.timeline import render_report_md
+        base = (args.json[:-5] if args.json.endswith(".json")
+                else args.json)
+        with open(base + ".timeline.json", "w") as f:
+            json.dump(r.timeline, f, indent=2, sort_keys=True)
+        with open(base + ".report.md", "w") as f:
+            f.write(render_report_md(r.report))
+        print(f"timeline written to {base}.timeline.json, "
+              f"report to {base}.report.md")
     return 0 if ok else 1
+
+
+def cmd_timeline(args) -> int:
+    """Clock-aligned metric history (`nomad timeline`): one sparkline
+    row per series over the retained window, recent annotations below.
+    Reads the live agent, or `-input` replays a dump written by
+    `nomad soak -json` / the timeline endpoint's ?dump=true."""
+    from nomad_tpu.core.timeline import sparkline
+    if args.input:
+        with open(args.input) as f:
+            doc = json.load(f)
+    else:
+        names = ([x for x in args.series.split(",") if x]
+                 if args.series else None)
+        doc = _client(args).operator.timeline(
+            start=args.start, end=args.end,
+            step=args.step or None, series=names)
+    print(f"window      = [{doc.get('Start')}, {doc.get('End')}] "
+          f"step {doc.get('Step')}s "
+          f"({doc.get('Points', 0)} native points)")
+    series = doc.get("Series", {})
+    width = max(args.width, 8)
+    namew = max([len(n) for n in series] + [6])
+    print(f"\n{'Series':<{namew}} {'':{width}}  "
+          f"{'Min':>10} {'Avg':>10} {'Max':>10} {'Last':>10}")
+    for name in sorted(series):
+        pts = series[name]
+        vals = [p["Avg"] for p in pts]
+        if not pts:
+            print(f"{name:<{namew}} {'·' * width}  "
+                  f"{'-':>10} {'-':>10} {'-':>10} {'-':>10}")
+            continue
+        print(f"{name:<{namew}} "
+              f"{sparkline(vals, width=width):{width}}  "
+              f"{min(p['Min'] for p in pts):>10g} "
+              f"{sum(vals) / len(vals):>10.4g} "
+              f"{max(p['Max'] for p in pts):>10g} "
+              f"{pts[-1]['Last']:>10g}")
+    anns = doc.get("Annotations", [])
+    print(f"\nannotations = {len(anns)}")
+    for a in anns[-args.n:]:
+        fields = ", ".join(f"{k}={v}" for k, v in sorted(a.items())
+                           if k not in ("T", "Kind"))
+        print(f"  t={a['T']:<12g} {a['Kind']:<24} {fields}")
+    return 0
+
+
+def cmd_report(args) -> int:
+    """Breach/spike post-mortem (`nomad report`): attributes every
+    health breach and metric spike in the timeline to its nearest-in-
+    time cluster annotations (traffic, chaos, deploys, leadership,
+    drains).  Markdown by default, `-json` for the raw report doc;
+    reads the live agent or an `-input` timeline dump."""
+    from nomad_tpu.core.timeline import build_report, render_report_md
+    if args.input:
+        with open(args.input) as f:
+            doc = json.load(f)
+        report = doc.get("Report") or build_report(
+            doc, attribution_window_s=args.window)
+    else:
+        doc = _client(args).operator.timeline_dump()
+        report = (doc.get("Report")
+                  if args.window == 60.0 and doc.get("Report")
+                  else build_report(doc,
+                                    attribution_window_s=args.window))
+    out = (json.dumps(report, indent=2, sort_keys=True) + "\n"
+           if args.json else render_report_md(report))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+        print(f"report written to {args.output} "
+              f"({len(report.get('Incidents', []))} incident(s))")
+    else:
+        sys.stdout.write(out)
+    return 0
 
 
 def cmd_debug_record(args) -> int:
@@ -1617,6 +1707,37 @@ def build_parser() -> argparse.ArgumentParser:
     sk.add_argument("-json", default="",
                     help="write the summary JSON to this path")
     sk.set_defaults(fn=cmd_soak)
+
+    tl = sub.add_parser("timeline",
+                        help="clock-aligned metric history "
+                             "(sparklines + annotations)")
+    tl.add_argument("-start", type=float, default=None)
+    tl.add_argument("-end", type=float, default=None)
+    tl.add_argument("-step", type=float, default=0.0,
+                    help="aggregation step seconds (default: native)")
+    tl.add_argument("-series", default="",
+                    help="comma-separated series names (default: all)")
+    tl.add_argument("-input", default="",
+                    help="render a timeline dump file instead of "
+                         "querying the agent")
+    tl.add_argument("-width", type=int, default=40,
+                    help="sparkline width (default 40)")
+    tl.add_argument("-n", type=int, default=12,
+                    help="annotation tail length (default 12)")
+    tl.set_defaults(fn=cmd_timeline)
+
+    rp = sub.add_parser("report",
+                        help="breach/spike post-mortem attributed to "
+                             "nearest-in-time annotations")
+    rp.add_argument("-input", default="",
+                    help="timeline dump file (default: live agent)")
+    rp.add_argument("-json", action="store_true",
+                    help="emit the raw report doc instead of Markdown")
+    rp.add_argument("-output", default="",
+                    help="write the report to this path")
+    rp.add_argument("-window", type=float, default=60.0,
+                    help="attribution window seconds (default 60)")
+    rp.set_defaults(fn=cmd_report)
 
     st = sub.add_parser("status")
     st.set_defaults(fn=cmd_status)

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.core.logging import log
+from nomad_tpu.core.timeline import TIMELINE
 from nomad_tpu.structs import (
     DesiredTransition,
     DrainStrategy,
@@ -60,6 +61,9 @@ class NodeDrainer:
         log("drain", "info",
             "drain started" if strategy is not None else "drain cancelled",
             node_id=node_id)
+        TIMELINE.annotate(
+            "drain.begin" if strategy is not None else "drain.cancel",
+            node=node_id)
         if strategy is not None:
             self.tick(t)   # release the first batch immediately
 
@@ -126,4 +130,5 @@ class NodeDrainer:
         if not remaining:
             # drain complete: clear the marker, keep the node ineligible
             log("drain", "info", "drain complete", node_id=node.id)
+            TIMELINE.annotate("drain.complete", node=node.id)
             self.server.state.update_node_drain(node.id, None)

@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Optional
 from nomad_tpu.chaos.clock import Clock, SystemClock
 from nomad_tpu.core.profiling import PROFILER
 from nomad_tpu.core.telemetry import REGISTRY, TRACER, MetricsRegistry, Tracer
+from nomad_tpu.core.timeline import TIMELINE, Timeline
 
 
 class FlightRecorder:
@@ -145,10 +146,21 @@ class FlightRecorder:
                  n_evals: Optional[int] = None,
                  n_events: Optional[int] = None) -> Dict:
         """JSON-safe dump of the rings, newest last."""
+        waves = self.waves(n_waves)
+        evals = self.evals(n_evals)
+        events = self.events(n_events)
+        # [start, end] on the shared clock across every retained record:
+        # `nomad report` cross-links flight dumps into the timeline via
+        # this window (None when the rings are empty)
+        stamps = [r["T"] for ring in (waves, evals, events)
+                  for r in ring if "T" in r]
         return {
-            "Waves": self.waves(n_waves),
-            "Evals": self.evals(n_evals),
-            "Events": self.events(n_events),
+            "Waves": waves,
+            "Evals": evals,
+            "Events": events,
+            "TimelineWindow": ([round(min(stamps), 9),
+                                round(max(stamps), 9)]
+                               if stamps else None),
             "Stats": dict(self.stats),
             "Capacity": {"waves": self._waves.maxlen,
                          "evals": self._evals.maxlen,
@@ -211,6 +223,7 @@ class HealthWatchdog:
                  flight: Optional[FlightRecorder] = None,
                  tracer: Optional[Tracer] = None,
                  log_ring=_UNSET,
+                 timeline: Optional[Timeline] = None,
                  max_dumps: int = 8) -> None:
         cfg = dict(DEFAULT_SLO)
         for k, v in (slo or {}).items():
@@ -224,6 +237,7 @@ class HealthWatchdog:
         self.registry = registry if registry is not None else REGISTRY
         self.flight = flight if flight is not None else FLIGHT
         self.tracer = tracer if tracer is not None else TRACER
+        self.timeline = timeline if timeline is not None else TIMELINE
         if log_ring is _UNSET:
             from nomad_tpu.core.logging import RING
             log_ring = RING
@@ -315,6 +329,8 @@ class HealthWatchdog:
             verdicts = self._verdicts(cur, last, dt)
             failing = [v for v in verdicts if not v["Ok"]]
             newly = [v for v in failing if v["Rule"] not in self._breached]
+            recovered = sorted(self._breached
+                               - {v["Rule"] for v in failing})
             self._breached = {v["Rule"] for v in failing}
             self.stats["checks"] += 1
             bundle = None
@@ -336,10 +352,20 @@ class HealthWatchdog:
             self.registry.inc("nomad.health.breaches", len(newly))
             self.flight.record_event(
                 "health.breach", rules=[v["Rule"] for v in newly])
+            for v in newly:
+                # the timeline's breach annotations are what `nomad
+                # report` attributes to nearby cluster events
+                self.timeline.annotate("health.breach", now=t,
+                                       rule=v["Rule"],
+                                       observed=v["Observed"],
+                                       threshold=v["Threshold"])
             cb = self.on_breach
             if cb is not None:
                 for v in newly:
                     cb(v, bundle)
+        for rule in recovered:
+            self.timeline.annotate("health.recover", now=t,
+                                   rule=rule)
         return doc
 
     def rebase(self, now: Optional[float] = None) -> None:
@@ -382,6 +408,13 @@ class HealthWatchdog:
             # real clock, so this section is excluded from soak
             # byte-identity assertions — see tests/test_profiling.py)
             "Profiler": PROFILER.brief(),
+            # the surrounding timeline slice (±window around the
+            # breach; the future half is whatever history exists by
+            # dump time) — "what was the cluster doing when this
+            # breached" without a second query
+            "Timeline": self.timeline.slice(
+                now - self.slo["window_s"],
+                now + self.slo["window_s"]),
             "Windows": snap["windows"],
             "Counters": snap["counters"],
             "Traces": self.tracer.traces()[-50:],

@@ -487,6 +487,18 @@ class SamplingProfiler:
 
     # -------------------------------------------------------- exports
 
+    def gil_fraction(self, role: str = "worker") -> float:
+        """Cheap point read of one role's gil-wait share — the timeline
+        samples this every tick, so it must not pay snapshot()'s full
+        fold/matrix build."""
+        with self._lock:
+            items = [(b, w) for (r, b), w in self._buckets.items()
+                     if r == role]
+        total = sum(w for _, w in items)
+        if not total:
+            return 0.0
+        return dict(items).get("gil-wait", 0.0) / total
+
     def _elapsed(self) -> float:
         e = self._elapsed_base
         if self._started_at:
@@ -609,6 +621,11 @@ class SamplingProfiler:
         trace_info = None
         if include_trace:
             trace_info = self._start_trace(trace_dir)
+        # cross-link seam for `nomad report`: the capture's [start, end]
+        # on the TIMELINE's (injected) clock, whatever wall span the
+        # capture itself measures
+        from nomad_tpu.core.timeline import TIMELINE
+        tl_start = TIMELINE.clock.monotonic()
         # real-time wait on a never-set Event: the capture window is
         # wall time by contract, whatever clock the cluster runs on
         threading.Event().wait(duration_s)
@@ -651,6 +668,10 @@ class SamplingProfiler:
             # the module docstring's clock-discipline contract)
             "captured_unix": time.time(),  # analyze: ok rawtime
             "duration_s": duration_s,
+            # [start, end] on the timeline clock (core/timeline.py):
+            # `nomad report` cross-links captures into its incident view
+            "timeline_window": [round(tl_start, 9),
+                                round(TIMELINE.clock.monotonic(), 9)],
             "hz": snap["hz"],
             "sampler_was_running": was_running,
             "samples": snap["samples"] - base["samples"],

@@ -39,7 +39,7 @@ from nomad_tpu.structs import (
     new_id,
 )
 
-from . import flightrec, identity, profiling, telemetry
+from . import flightrec, identity, profiling, telemetry, timeline
 from . import logging as logging_mod
 from .logging import log
 from .blocked_evals import BlockedEvals
@@ -80,6 +80,10 @@ class Server:
         # benign)
         telemetry.configure(self.clock)
         flightrec.configure(self.clock)
+        # the retrospective timeline samples off the same injected
+        # clock on every tick (core/timeline.py) — VirtualClock soaks
+        # replay its canonical dump byte-identical
+        timeline.configure(self.clock)
         # the process log ring's record stamps and the identity
         # iat/exp defaults ride the same timeline (satellite of the
         # virtual-time soak: no raw time.time() left in core/)
@@ -189,6 +193,12 @@ class Server:
             self.workers = [Worker(self, i) for i in range(num_workers)]
         self._applier_running = False
         self._leader = False
+        # serializes tick() bodies: the soak runner drives an explicit
+        # tick after each quiesce (so heartbeat expiry lands in a
+        # deterministic virtual-time bucket of the timeline) while the
+        # threaded tick loop keeps its own cadence — the duties are
+        # idempotent but must not interleave
+        self._tick_lock = threading.Lock()
         # capacity-change events release blocked evals
         self.state.subscribe(self._on_state_event)
         # health watchdog (core/flightrec.py): declarative SLO rules
@@ -233,6 +243,8 @@ class Server:
         self._leader = True
         log("server", "info", "leadership established")
         telemetry.REGISTRY.inc("nomad.server.leadership_transitions")
+        timeline.TIMELINE.annotate("leadership.established",
+                                   region=self.region)
         # workload-identity signing secret: minted once per cluster
         # (first-writer-wins in the store; replicated + snapshotted)
         if not self.state.identity_secret():
@@ -278,6 +290,8 @@ class Server:
         self._leader = False
         log("server", "info", "leadership revoked")
         telemetry.REGISTRY.inc("nomad.server.leadership_revocations")
+        timeline.TIMELINE.annotate("leadership.revoked",
+                                   region=self.region)
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
         self.plan_queue.set_enabled(False)
@@ -633,6 +647,7 @@ class Server:
         self.state.update_node_eligibility(
             node_id, "eligible" if eligible else "ineligible")
         if eligible and node is not None and not was_eligible:
+            timeline.TIMELINE.annotate("drain.restore", node=node_id)
             # capacity returning from a drain: system jobs whose alloc
             # was evicted here need a fresh placement, and blocked jobs
             # a chance at the freed node — without this, a drained-then-
@@ -819,10 +834,18 @@ class Server:
         """Periodic leader duties: broker delayed-eval promotion + nack
         timeouts, heartbeat expiry."""
         t = now if now is not None else self.clock.time()
+        with self._tick_lock:
+            self._tick_locked(t)
+
+    def _tick_locked(self, t: float) -> None:
         # the health watchdog is node-local observability, not a leader
         # duty: followers evaluate their own SLOs too (throttled to
         # slo.interval_s; reads the monotonic clock like the windows)
         self.health.tick(self.clock.monotonic())
+        # retrospective history rides the same cadence: one clock-
+        # aligned timeline row per tick, followers included (their
+        # gauges and windows are node-local too)
+        timeline.TIMELINE.sample(self.clock.monotonic())
         if not self._leader:
             # followers carry no timers/queues; their copies of these
             # duties belong to the leader (reference: leaderLoop)

@@ -266,6 +266,23 @@ class MetricsRegistry:
                 out[",".join(f"{lk}={lv}" for lk, lv in labels)] = v
             return out
 
+    def clear_series(self, prefix: str) -> int:
+        """Drop every counter/gauge/histogram/window whose name starts
+        with `prefix`.  The soak runner clears the point-in-time series
+        the timeline samples (rolling windows, quality gauges) at run
+        start: they are process-global and would otherwise leak one
+        run's residue into the next, breaking same-seed byte-identity
+        of the timeline's canonical dump.  Returns how many series
+        were removed."""
+        n = 0
+        with self._lock:
+            for store in (self._counters, self._gauges,
+                          self._hists, self._windows):
+                for k in [k for k in store if k[0].startswith(prefix)]:
+                    del store[k]
+                    n += 1
+        return n
+
     @staticmethod
     def _flat(k: LabelKey) -> str:
         name, labels = k

@@ -430,10 +430,12 @@ def _sanitize_log_rec(rec: Dict) -> Dict:
 def _report_loop(chan: _Channel, stop_evt, idx: int) -> None:
     from nomad_tpu.core import profiling
     from nomad_tpu.core.logging import LEVELS, RING
+    from nomad_tpu.core.timeline import TIMELINE
     # warn+ records ship to the parent ring: a child's nack reasons and
     # scheduler errors must be visible from the one process an operator
     # actually tails (logging.RING is per-process)
     logq = RING.subscribe(maxsize=512)
+    tl_seq = 0   # high-water mark of timeline writes already shipped
     while not stop_evt.wait(0.5):
         if chan.closed.is_set():
             return
@@ -451,6 +453,15 @@ def _report_loop(chan: _Channel, stop_evt, idx: int) -> None:
             chan.notify("prof",
                         {"idx": idx,
                          "snapshot": profiling.PROFILER.snapshot()})
+            # retrospective timeline (core/timeline.py): sample this
+            # process's registry on the report cadence and ship only
+            # what the parent hasn't seen — the parent folds the rows
+            # in under `col@pool-N` series names
+            TIMELINE.sample()
+            delta = TIMELINE.export_delta(since_seq=tl_seq)
+            if delta["Samples"] or delta["Annotations"]:
+                chan.notify("tl", {"idx": idx, "delta": delta})
+            tl_seq = delta["Seq"]
         except _ChannelClosed:
             return
 
@@ -799,6 +810,15 @@ class WorkerPool:
             profiling.PROFILER.publish_remote(
                 f"pool-worker-{child.idx}", payload.get("snapshot"))
             return None
+        if op == "tl":
+            # child timeline delta (same reporter cadence as `prof`):
+            # rows fold into the parent timeline under `col@pool-N`,
+            # annotations join the stream tagged with their origin
+            from nomad_tpu.core.timeline import TIMELINE
+            delta = payload.get("delta")
+            if isinstance(delta, dict):
+                TIMELINE.merge_delta(delta, origin=f"pool-{child.idx}")
+            return None
         if op == "logs":
             # child warn+ records, re-logged into the parent ring (the
             # one an operator tails / `operator debug` bundles) with the
@@ -929,4 +949,7 @@ class WorkerPool:
         self.stats["respawns"] += 1
         log("workerpool", "warn", "pool worker exited; respawning",
             worker=child.idx, respawn=child.respawns)
+        from nomad_tpu.core.timeline import TIMELINE
+        TIMELINE.annotate("pool.respawn", worker=child.idx,
+                          respawn=child.respawns)
         self._spawn(child)

@@ -61,6 +61,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from nomad_tpu.core.flightrec import FLIGHT
+from nomad_tpu.core.timeline import TIMELINE
 from nomad_tpu.core.telemetry import REGISTRY
 
 EXECUTOR_BACKENDS = ("jax", "bridge")
@@ -232,6 +233,9 @@ class DeviceExecutor:
         # re-uploading node state) is an SLO rule, and the dump bundle
         # should show which writes caused it
         FLIGHT.record_event("executor.invalidation", reason=reason)
+        # ...and the retrospective timeline's (volatile) annotation lane,
+        # so `nomad report` can line storms up against breaches
+        TIMELINE.annotate("executor.invalidation", reason=reason)
 
     def _release_chain(self, chain) -> None:
         """Backend hook: free device resources a dropped chain held."""

@@ -136,7 +136,8 @@ def _callee_name(call: ast.Call) -> Optional[str]:
 
 # ------------------------------------------------------- pass A: lock
 
-LOCK_ATTRS = {"_lock", "lock", "_cv", "_index_cv", "_apply_cv"}
+LOCK_ATTRS = {"_lock", "lock", "_cv", "_index_cv", "_apply_cv",
+              "_tick_lock"}
 LOCKED_PREFIXES = ("_writable_",)
 
 

@@ -329,6 +329,74 @@ finally:
     agent.shutdown()
 EOF
 
+echo "== timeline smoke (retrospective plane: breach post-mortem + HTTP) =="
+# the retrospective timeline plane (core/timeline.py): a seeded
+# flap-storm soak with a zero-tolerance heartbeat SLO must produce a
+# breach whose post-mortem report pins the storm's own traffic.node.*
+# annotation (not merely the nearest-in-time noise); then a live dev
+# agent must serve clock-aligned history over GET /v1/operator/timeline
+# and render it through `nomad timeline` / `nomad report`
+JAX_PLATFORMS=cpu python - <<'EOF'
+from nomad_tpu.chaos.soak import run_soak
+from nomad_tpu.chaos.traffic import TrafficProfile
+from nomad_tpu.core.timeline import build_report, render_report_md
+
+r = run_soak(seed=7, profile=TrafficProfile(
+    hours=0.05, n_nodes=4, n_zones=2, service_per_hour=40,
+    batch_per_hour=40, drains_per_hour=0.0, flap_storms_per_hour=20.0,
+    flap_storm_nodes=2, preempt_storms_per_hour=0.0,
+    chaos_scenarios=()), slo={"heartbeat_misses": 0.0})
+rep = build_report(r.timeline)
+breaches = [i for i in rep["Incidents"]
+            if i["Kind"] == "breach" and i["Rule"] == "heartbeat_misses"]
+assert breaches, rep["AnnotationKinds"]
+attributed = [a for i in breaches for a in i["Attribution"]]
+assert any(a["Kind"].startswith("traffic.node.")
+           for a in attributed), attributed
+md = render_report_md(rep)
+assert "heartbeat_misses" in md and "traffic.node." in md
+assert len(r.summary["timeline_digest"]) == 64
+print(f"timeline report smoke ok: {len(breaches)} heartbeat breach(es)"
+      f" attributed to the flap storm, digest"
+      f" {r.summary['timeline_digest'][:16]}")
+EOF
+JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.cli import main
+from nomad_tpu.structs import codec
+
+agent = Agent(num_clients=1, num_workers=1, heartbeat_ttl=3600).start()
+api = APIClient(address=agent.address)
+try:
+    job = mock.batch_job()
+    job.task_groups[0].count = 2
+    api.jobs.register(codec.encode(job))
+    deadline = time.time() + 30
+    doc = {}
+    while time.time() < deadline:
+        doc = api.operator.timeline()
+        if doc["Points"] > 1 and doc["Annotations"]:
+            break
+        time.sleep(0.2)
+    assert doc["Schema"] == "nomad-tpu.timeline.v1", doc["Schema"]
+    assert doc["Points"] > 1, doc
+    kinds = {a["Kind"] for a in doc["Annotations"]}
+    assert "leadership.established" in kinds, sorted(kinds)
+    sub = api.operator.timeline(series=["evals_per_s"], step=5.0)
+    assert set(sub["Series"]) == {"evals_per_s"}, sorted(sub["Series"])
+    assert "Timeline" in api.operator.debug(), "debug bundle lost it"
+    assert main(["-address", agent.address, "timeline"]) == 0
+    assert main(["-address", agent.address, "report"]) == 0
+    print(f"timeline http smoke ok: {doc['Points']} points,"
+          f" kinds={sorted(kinds)}")
+finally:
+    agent.shutdown()
+EOF
+
 echo "== perfcheck (trajectory gate comparator, self-check) =="
 # the bench/soak tolerance-band comparator must pass the checked-in
 # baselines against themselves and catch injected regressions before

@@ -67,6 +67,7 @@ BENCH_BANDS: Dict[str, Tuple[str, float, float]] = {
     "quality_nodes_used_tpu": ("max", 0.25, 2.0),
     "quality_zone_balance_max_over_min": ("max", 0.25, 0.10),
     "sampler_overhead_fraction": ("max", 0.0, 0.02),
+    "timeline_overhead_fraction": ("max", 0.0, 0.02),
 }
 
 # baseline-free gates on the fresh doc: (op, threshold); checked only
@@ -78,6 +79,10 @@ BENCH_ABS_GATES: Dict[str, Tuple[str, float]] = {
     # sampled wall time attributed to a named bucket
     "sampler_overhead_fraction": ("<=", 0.02),
     "profile_attributed_fraction": (">=", 0.90),
+    # timeline-plane acceptance (core/timeline.py): per-tick sampling
+    # plus annotation routing stay within the same observability budget
+    # as the host profiler
+    "timeline_overhead_fraction": ("<=", 0.02),
 }
 
 # bench comparisons only make sense at one workload shape
@@ -134,7 +139,10 @@ def check_worker_scaling(fresh: Dict) -> Dict:
 # deterministic-by-contract soak fields: exact equality
 SOAK_EXACT = ("converged_fingerprint", "trace_digest", "soak_evals",
               "schedule_events", "soak_breaches", "soak_virtual_hours",
-              "p99_plan_queue_ms")
+              "p99_plan_queue_ms",
+              # the canonical timeline dump's digest (core/timeline.py):
+              # same seed, same clock-aligned history, byte for byte
+              "timeline_digest")
 
 # the fresh soak must be green regardless of what the baseline says
 SOAK_ABS_GATES: Dict[str, Tuple[str, float]] = {
@@ -341,6 +349,19 @@ def self_check() -> int:
                and "soak_breaches" in v["failed"])
     else:
         print("no SOAK_r01.json baseline — soak self-check skipped")
+    # timeline-plane gate wiring: an injected overhead regression (5%
+    # against the 2% budget) must fail the absolute gate; a doc within
+    # budget must pass; a doc predating the plane must skip
+    over = _check_abs("timeline_overhead_fraction", 0.05,
+                      BENCH_ABS_GATES["timeline_overhead_fraction"])
+    under = _check_abs("timeline_overhead_fraction", 0.004,
+                       BENCH_ABS_GATES["timeline_overhead_fraction"])
+    absent = _check_abs("timeline_overhead_fraction", None,
+                        BENCH_ABS_GATES["timeline_overhead_fraction"])
+    print(f"timeline overhead gate: 5%={over['status']} "
+          f"0.4%={under['status']} absent={absent['status']}")
+    ok &= (over["status"] == "fail" and under["status"] == "ok"
+           and absent["status"] == "skip")
     # worker-scaling band wiring: the gate must catch a sub-1.7x
     # process-mode pair, and must SKIP (not fail) thread-mode and
     # one-core docs where the gate is meaningless

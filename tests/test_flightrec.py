@@ -23,6 +23,7 @@ from nomad_tpu.core.flightrec import (
 )
 from nomad_tpu.core.logging import RING, log, trace_scope
 from nomad_tpu.core.server import Server
+from nomad_tpu.core.timeline import Timeline
 from nomad_tpu.core.telemetry import (
     MetricsRegistry,
     REGISTRY,
@@ -152,8 +153,9 @@ def _loaded_watchdog(slo, observe):
     reg = MetricsRegistry(clock=clk)
     fl = FlightRecorder(clock=clk, max_waves=16)
     tr = Tracer(clock=clk)
+    tl = Timeline(clock=clk, registry=reg)
     wd = HealthWatchdog(slo=slo, clock=clk, registry=reg, flight=fl,
-                        tracer=tr, log_ring=None)
+                        tracer=tr, log_ring=None, timeline=tl)
     wd.check()                          # baseline for the counter deltas
     observe(reg, clk, fl)
     return wd, clk, reg

@@ -24,7 +24,10 @@ CHURNY = dict(hours=0.1, n_nodes=4, n_zones=2, service_per_hour=30,
 SUMMARY_KEYS = {"seed", "soak_virtual_hours", "soak_evals",
                 "soak_breaches", "converged_fingerprint",
                 "trace_digest", "schedule_events", "wall_s",
-                "compression_x", "p99_plan_queue_ms", "quality", "ok"}
+                "compression_x", "p99_plan_queue_ms", "quality", "ok",
+                "timeline_points", "timeline_annotations",
+                "timeline_overhead_fraction", "timeline_evictions",
+                "timeline_digest"}
 
 
 def test_tiny_soak_green_and_summarized():

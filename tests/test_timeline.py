@@ -1,0 +1,329 @@
+"""Retrospective timeline plane (core/timeline.py, ISSUE 15):
+clock-aligned columnar history + cross-plane annotations.
+
+Determinism is the load-bearing property: a VirtualClock soak must
+replay a byte-identical CANONICAL dump for the same seed (the same
+gate the trace digest already passes), eviction must be counted and
+never silent, query aggregation must be exact on known inputs, the
+post-mortem must attribute a seeded flap-storm's breach to the storm's
+own annotation, and pool-child deltas must merge losslessly under
+`col@origin` names."""
+
+import json
+
+import pytest
+
+from nomad_tpu.chaos.clock import VirtualClock
+from nomad_tpu.chaos.soak import run_soak
+from nomad_tpu.chaos.traffic import TrafficProfile
+from nomad_tpu.core.telemetry import MetricsRegistry
+from nomad_tpu.core.timeline import (CANONICAL_SERIES, REPORT_SCHEMA,
+                                     SCHEMA, Timeline, build_report,
+                                     render_report_md, sparkline)
+
+# no drains: drain batch pacing is sweep-ordering shaped (like the
+# flight event ring, it is deliberately outside the byte-identity
+# gate); flap storms stay in — heartbeat expiry lands on quiesced
+# virtual-time boundaries so misses ARE canonical
+STORMY = dict(hours=0.05, n_nodes=4, n_zones=2, service_per_hour=40,
+              batch_per_hour=40, drains_per_hour=0.0,
+              flap_storms_per_hour=20.0, flap_storm_nodes=2,
+              preempt_storms_per_hour=0.0, chaos_scenarios=())
+
+
+def _mini(step_s=1.0, max_points=8192, max_annotations=4096):
+    """An isolated Timeline over its own registry + VirtualClock —
+    no interference with the process singleton."""
+    clock = VirtualClock(start=1000.0)
+    reg = MetricsRegistry(clock=clock)
+    tl = Timeline(clock=clock, registry=reg, step_s=step_s,
+                  max_points=max_points,
+                  max_annotations=max_annotations)
+    tl.reset()
+    return tl, reg, clock
+
+
+class TestSoakByteIdentity:
+    def test_same_seed_same_canonical_dump(self):
+        p = TrafficProfile(**STORMY)
+        a = run_soak(seed=7, profile=p)
+        b = run_soak(seed=7, profile=p)
+        assert a.ok and b.ok, (a.violations, b.violations)
+        ja = json.dumps(a.timeline_canonical, sort_keys=True)
+        jb = json.dumps(b.timeline_canonical, sort_keys=True)
+        assert ja == jb
+        assert (a.summary["timeline_digest"]
+                == b.summary["timeline_digest"])
+        # the dump actually carries history, not a vacuous match
+        assert a.timeline_canonical["Schema"] == SCHEMA
+        assert len(a.timeline_canonical["Buckets"]) > 10
+        assert set(a.timeline_canonical["Series"]) \
+            == set(CANONICAL_SERIES)
+        kinds = {x["Kind"] for x in a.timeline_canonical["Annotations"]}
+        assert "traffic.node.flap" in kinds
+        assert "leadership.established" in kinds
+
+    def test_summary_carries_timeline_keys_within_budget(self):
+        r = run_soak(seed=5, profile=TrafficProfile(**STORMY))
+        s = r.summary
+        assert s["timeline_points"] > 10
+        assert s["timeline_annotations"] > 0
+        assert s["timeline_evictions"] == 0
+        # the 2% budget is gated at bench scale (scripts/perfcheck.py)
+        # and measured over the standard soak in PERF.md §18; a ~4s
+        # quick soak amortizes nothing, so only gross blowups fail here
+        assert 0.0 <= s["timeline_overhead_fraction"] <= 0.05
+        assert len(s["timeline_digest"]) == 64
+        int(s["timeline_digest"], 16)
+        # the full query doc + report ride the result
+        assert r.timeline["Schema"] == SCHEMA
+        assert r.report["Schema"] == REPORT_SCHEMA
+
+
+class TestRings:
+    def test_point_eviction_is_counted_never_silent(self):
+        tl, reg, clock = _mini(max_points=4)
+        for i in range(10):
+            tl.sample(now=float(i))
+        assert len(tl.query()["Series"]["nodes_in_use"]) <= 4
+        st = tl.snapshot_stats()
+        assert st["points"] == 4
+        assert st["point_evictions"] == 6
+        assert st["samples"] == 10
+        # oldest buckets went first
+        assert tl.window() == [6.0, 10.0]
+
+    def test_settled_row_survives_racy_resample(self):
+        tl, reg, clock = _mini()
+        reg.set_gauge("nomad.quality.nodes_in_use", 3.0)
+        tl.sample(now=5.2, settled=True)
+        reg.set_gauge("nomad.quality.nodes_in_use", 99.0)
+        tl.sample(now=5.8)                       # same bucket, unsettled
+        pts = tl.query(series=["nodes_in_use"])["Series"]["nodes_in_use"]
+        assert [p["Last"] for p in pts] == [3.0]
+        # a later settled sample MAY replace a settled row
+        reg.set_gauge("nomad.quality.nodes_in_use", 4.0)
+        tl.sample(now=5.9, settled=True)
+        pts = tl.query(series=["nodes_in_use"])["Series"]["nodes_in_use"]
+        assert [p["Last"] for p in pts] == [4.0]
+
+    def test_annotation_rings_are_partitioned(self):
+        """A storm of volatile annotations (executor invalidations)
+        must never evict the canonical stream."""
+        tl, reg, clock = _mini(max_annotations=3)
+        tl.annotate("chaos.begin", now=1.0, scenario="x")
+        tl.annotate("health.breach", now=2.0, rule="r")
+        for i in range(50):
+            tl.annotate("executor.invalidation", now=3.0 + i,
+                        reason="chain")
+        anns = tl.query()["Annotations"] if tl.window() else []
+        st = tl.snapshot_stats()
+        assert st["volatile_evictions"] == 47
+        assert st["annotation_evictions"] == 0
+        dump = tl.canonical_dump()
+        kinds = [a["Kind"] for a in dump["Annotations"]]
+        assert kinds == ["chaos.begin", "health.breach"]
+        assert all(a["Kind"] != "executor.invalidation"
+                   for a in dump["Annotations"])
+        del anns
+
+    def test_disabled_timeline_records_nothing(self):
+        tl, reg, clock = _mini()
+        tl.enabled = False
+        tl.sample(now=1.0)
+        tl.annotate("chaos.begin", now=1.0)
+        assert tl.window() is None
+        assert tl.canonical_dump()["Annotations"] == []
+
+
+class TestQuery:
+    def test_rejects_unknown_series_and_bad_ranges(self):
+        tl, reg, clock = _mini()
+        tl.sample(now=1.0)
+        with pytest.raises(ValueError, match="unknown timeline series"):
+            tl.query(series=["nope"])
+        with pytest.raises(ValueError, match="step"):
+            tl.query(step=0)
+        with pytest.raises(ValueError, match="step"):
+            tl.query(step=-1.0)
+        with pytest.raises(ValueError, match="end"):
+            tl.query(start=10.0, end=1.0)
+
+    def test_empty_timeline_queries_clean(self):
+        tl, reg, clock = _mini()
+        doc = tl.query()
+        assert doc["Points"] == 0
+        assert all(v == [] for v in doc["Series"].values())
+        assert doc["Annotations"] == []
+        assert tl.window() is None
+
+    def test_step_aggregation_min_max_avg_last(self):
+        """Exact aggregation over known raw values: merged `col@origin`
+        columns pass raw numbers through `_native`, so the arithmetic
+        is checkable to the digit."""
+        tl, reg, clock = _mini()
+        samples = [[t, {"acked": v}] for t, v in
+                   [(0, 1.0), (1, 3.0), (2, 5.0), (3, 7.0)]]
+        tl.merge_delta({"Seq": 4, "StepS": 1.0, "Samples": samples,
+                        "Annotations": []}, origin="w1")
+        doc = tl.query(series=["acked@w1"], step=2.0)
+        pts = doc["Series"]["acked@w1"]
+        assert [p["T"] for p in pts] == [0.0, 2.0]
+        assert pts[0] == {"T": 0.0, "Min": 1.0, "Max": 3.0, "Avg": 2.0,
+                          "Last": 3.0, "Count": 2}
+        assert pts[1] == {"T": 2.0, "Min": 5.0, "Max": 7.0, "Avg": 6.0,
+                          "Last": 7.0, "Count": 2}
+        # half-open range [start, end): t=2 excluded
+        doc = tl.query(series=["acked@w1"], step=1.0, start=0.0,
+                       end=2.0)
+        assert [p["T"] for p in doc["Series"]["acked@w1"]] == [0.0, 1.0]
+
+    def test_first_bucket_rates_are_none_not_zero(self):
+        """A rate needs the previous bucket; the first one is unknowable
+        and must be absent from aggregation, never fabricated as 0."""
+        tl, reg, clock = _mini()
+        reg.inc("nomad.broker.acked", 10)
+        tl.sample(now=0.5)
+        reg.inc("nomad.broker.acked", 4)
+        tl.sample(now=1.5)
+        pts = tl.query(series=["evals_per_s"])["Series"]["evals_per_s"]
+        # only the second bucket has a derivable rate: 4 acks / 1s
+        assert [p["T"] for p in pts] == [1.0]
+        assert pts[0]["Last"] == 4.0
+
+    def test_run_relative_counters_rebase_on_reset(self):
+        tl, reg, clock = _mini()
+        reg.inc("nomad.broker.acked", 1000)    # pre-run residue
+        tl.reset()
+        reg.inc("nomad.broker.acked", 2)
+        tl.sample(now=0.0, settled=True)
+        reg.inc("nomad.broker.acked", 2)
+        tl.sample(now=1.0, settled=True)
+        dump = tl.canonical_dump()
+        # cum column stores raw minus the reset() base, so two same-seed
+        # runs in one process agree regardless of prior traffic
+        i = dump["Buckets"].index(0)
+        pts = tl.query(series=["evals_per_s"])["Series"]["evals_per_s"]
+        assert pts[0]["Last"] == 2.0
+        assert i == 0
+
+
+class TestReport:
+    def _dump(self):
+        anns = [
+            {"T": 95.0, "Kind": "traffic.node.flap", "node": "n1"},
+            {"T": 100.0, "Kind": "health.breach",
+             "rule": "heartbeat_misses", "observed": 3.0,
+             "threshold": 0.0},
+            {"T": 170.0, "Kind": "health.recover",
+             "rule": "heartbeat_misses"},
+            {"T": 400.0, "Kind": "traffic.job.deploy", "job": "svc-1"},
+        ]
+        pts = [{"T": float(t), "Min": 1.0, "Max": 1.0, "Avg": 1.0,
+                "Last": 1.0, "Count": 1} for t in range(90, 110)]
+        return {"Schema": SCHEMA, "Start": 90.0, "End": 110.0,
+                "Step": 1.0, "Points": 20,
+                "Series": {"nodes_in_use": pts}, "Annotations": anns}
+
+    def test_breach_attributed_to_nearest_annotation(self):
+        rep = build_report(self._dump())
+        assert rep["Schema"] == REPORT_SCHEMA
+        breaches = [i for i in rep["Incidents"] if i["Kind"] == "breach"]
+        assert len(breaches) == 1
+        inc = breaches[0]
+        assert inc["Rule"] == "heartbeat_misses"
+        attr = inc["Attribution"]
+        assert attr, "breach must be attributed"
+        # nearest-in-time wins; health.* kinds never self-attribute
+        assert attr[0]["Kind"] == "traffic.node.flap"
+        assert attr[0]["DtS"] == -5.0
+        assert all(not a["Kind"].startswith("health.") for a in attr)
+        # the deploy at t=400 is outside the 60s window
+        assert all(a["Kind"] != "traffic.job.deploy" for a in attr)
+
+    def test_spike_needs_positive_baseline(self):
+        """An idle-most-of-the-window series (median 0) must not flag
+        every blip as an infinite-ratio spike."""
+        pts = [{"T": float(t), "Min": 0.0, "Max": 0.0, "Avg": 0.0,
+                "Last": 0.0, "Count": 1} for t in range(20)]
+        pts[10] = {"T": 10.0, "Min": 4.0, "Max": 4.0, "Avg": 4.0,
+                   "Last": 4.0, "Count": 1}
+        doc = {"Start": 0.0, "End": 20.0, "Points": 20,
+               "Series": {"evals_per_s": pts}, "Annotations": []}
+        assert build_report(doc)["Incidents"] == []
+
+    def test_flap_storm_soak_attributes_heartbeat_breach(self):
+        """The acceptance scenario: a seeded flap-storm soak run with a
+        zero-tolerance heartbeat SLO must produce a breach the report
+        pins on the storm's own traffic annotation."""
+        r = run_soak(seed=7, profile=TrafficProfile(**STORMY),
+                     slo={"heartbeat_misses": 0.0})
+        rep = build_report(r.timeline)
+        breaches = [i for i in rep["Incidents"]
+                    if i["Kind"] == "breach"
+                    and i["Rule"] == "heartbeat_misses"]
+        assert breaches, rep["AnnotationKinds"]
+        attributed = [a for i in breaches for a in i["Attribution"]]
+        assert any(a["Kind"].startswith("traffic.node.")
+                   for a in attributed), attributed
+        # and the Markdown face names the storm
+        md = render_report_md(rep)
+        assert "heartbeat_misses" in md
+        assert "traffic.node." in md
+
+    def test_render_helpers(self):
+        assert len(sparkline([1.0, 2.0, 3.0], width=8)) == 3
+        assert len(sparkline(list(map(float, range(100))), width=8)) == 8
+        assert sparkline([None, 1.0], width=4) == "·▁"
+        md = render_report_md(build_report(self._dump()))
+        assert md.startswith("# Timeline retrospective")
+
+
+class TestDeltaMerge:
+    def test_child_delta_merges_under_origin_names(self):
+        child, creg, _ = _mini()
+        creg.inc("nomad.broker.acked", 3)
+        child.sample(now=2.0)
+        child.annotate("pool.respawn", now=2.5, worker=1, respawn=1)
+        delta = child.export_delta(since_seq=0)
+        assert delta["Samples"] and delta["Annotations"]
+
+        parent, preg, _ = _mini()
+        parent.sample(now=2.2)
+        parent.merge_delta(delta, origin="pool-1")
+        doc = parent.query(series=["acked@pool-1"])
+        pts = doc["Series"]["acked@pool-1"]
+        assert [p["Last"] for p in pts] == [3.0]
+        anns = doc["Annotations"]
+        assert any(a["Kind"] == "pool.respawn"
+                   and a.get("Origin") == "pool-1" for a in anns)
+        st = parent.snapshot_stats()
+        assert st["merges"] == 1
+        assert st["merged_points"] == 1
+        assert st["merged_annotations"] == 1
+        # merged (origin-tagged) annotations stay out of the canonical
+        # stream — child timing is not replayable
+        assert parent.canonical_dump()["Annotations"] == []
+
+    def test_export_delta_high_water_mark(self):
+        tl, reg, _ = _mini()
+        tl.sample(now=1.0)
+        d1 = tl.export_delta(since_seq=0)
+        assert len(d1["Samples"]) == 1
+        # nothing new since d1 -> empty delta
+        d2 = tl.export_delta(since_seq=d1["Seq"])
+        assert d2["Samples"] == [] and d2["Annotations"] == []
+        tl.sample(now=2.0)
+        tl.annotate("drain.begin", now=2.1, node="n1")
+        d3 = tl.export_delta(since_seq=d1["Seq"])
+        assert len(d3["Samples"]) == 1
+        assert [a["Kind"] for a in d3["Annotations"]] == ["drain.begin"]
+
+    def test_merge_rebuckets_foreign_step(self):
+        parent, _, _ = _mini(step_s=2.0)
+        delta = {"Seq": 1, "StepS": 1.0,
+                 "Samples": [[5, {"acked": 9.0}]],  # child t=5s
+                 "Annotations": []}
+        parent.merge_delta(delta, origin="w")
+        pts = parent.query(series=["acked@w"])["Series"]["acked@w"]
+        assert [p["T"] for p in pts] == [4.0]      # bucket 2 @ step 2s
