@@ -1509,8 +1509,11 @@ class Router:
         staged.version = (s.state.job_by_id(job.namespace, job.id).version + 1
                           if s.state.job_by_id(job.namespace, job.id)
                           else 0)
+        # `now` from the server's injected clock: a dry-run plan under a
+        # virtual-time soak must reason about reschedule/drain windows
+        # in virtual time, not the host wall
         sched = new_scheduler(job.type, _StagedState(snap, staged), planner,
-                              engine=s.engine)
+                              engine=s.engine, now=s.clock.time())
         sched.process(ev)
         plan = planner.plan
         out: Dict[str, Any] = {

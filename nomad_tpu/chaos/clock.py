@@ -65,14 +65,16 @@ class SystemClock(Clock):
 
     kind = "wall"
 
+    # the seam itself: SystemClock is the one blessed wall-clock
+    # implementation every other module routes through
     def monotonic(self) -> float:
-        return time.monotonic()
+        return time.monotonic()          # analyze: ok rawtime
 
     def time(self) -> float:
-        return time.time()
+        return time.time()               # analyze: ok rawtime
 
     def sleep(self, seconds: float) -> None:
-        time.sleep(seconds)
+        time.sleep(seconds)              # analyze: ok rawtime
 
     def wait(self, event: threading.Event, timeout: float) -> bool:
         return event.wait(timeout)
@@ -90,7 +92,8 @@ class VirtualClock(Clock):
     def __init__(self, start: float = 0.0,
                  epoch: Optional[float] = None) -> None:
         self._now = float(start)
-        self._epoch = time.time() if epoch is None else float(epoch)
+        # one wall read anchors the virtual epoch
+        self._epoch = time.time() if epoch is None else float(epoch)  # analyze: ok rawtime
         self._cv = threading.Condition()
         self._closed = False
         self._extern: list = []          # Conditions to poke on advance

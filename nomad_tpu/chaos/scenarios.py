@@ -31,7 +31,6 @@ from __future__ import annotations
 import hashlib
 import random
 import threading
-import time
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu import mock
@@ -40,9 +39,14 @@ from nomad_tpu.core.cluster import ClusterServer
 from nomad_tpu.structs import DrainStrategy
 
 from . import invariants
-from .clock import VirtualClock
+from .clock import SystemClock, VirtualClock
 from .transport import SimNetwork
 from .trace import Trace, state_fingerprint
+
+# host-side wall pacing: real sleeps that let server threads run
+# between virtual-clock advances, and real drain deadlines — metered on
+# the host wall clock on purpose, never on the scenario's VirtualClock
+_wall = SystemClock()
 
 # virtual seconds between timeline steps; real sleep per step lets the
 # server threads run between advances.  The RATIO (virtual:real ~13:1)
@@ -636,7 +640,7 @@ class ScenarioRunner:
                     next_sample = now_v + _SAMPLE_EVERY_V
                 pump_keepalive()
                 clock.advance(_STEP_V)
-                time.sleep(_STEP_REAL)
+                _wall.sleep(_STEP_REAL)
             # any faults scheduled exactly at the end
             while fault_i < len(faults):
                 apply_fault(faults[fault_i])
@@ -662,7 +666,7 @@ class ScenarioRunner:
                     next_check = now_v + _CONVERGE_CHECK_V
                 pump_keepalive()
                 clock.advance(_STEP_V)
-                time.sleep(_STEP_REAL)
+                _wall.sleep(_STEP_REAL)
             wl_stop.set()
             wl_thread.join(timeout=5)
             if not final_ok:
@@ -684,9 +688,9 @@ class ScenarioRunner:
                             return True
                 return False
 
-            drain_deadline = time.time() + 2.0
-            while observers_behind() and time.time() < drain_deadline:
-                time.sleep(0.005)
+            drain_deadline = _wall.time() + 2.0
+            while observers_behind() and _wall.time() < drain_deadline:
+                _wall.sleep(0.005)
 
             sample()
             leader = next((s for s in servers if s.raft.is_leader()),
@@ -723,7 +727,7 @@ class ScenarioRunner:
             def drive():
                 while not drv_stop.is_set():
                     clock.advance(0.05)
-                    time.sleep(0.002)
+                    _wall.sleep(0.002)
 
             drv = threading.Thread(target=drive, daemon=True,
                                    name="chaos-teardown-drive")

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import hashlib
 import json
-import time as _wall
 from typing import Dict, List, Optional
 
 from nomad_tpu.chaos.clock import SystemClock, VirtualClock
@@ -46,6 +45,11 @@ from nomad_tpu.chaos.traffic import (
     generate_schedule,
     retry_idempotent,
 )
+
+# host-side wall pacing (progress deadlines, yield-to-clock-waiters):
+# deliberately NOT the injected soak clock — the soak drives a
+# VirtualClock for cluster time while these calls meter real host time
+_wall = SystemClock()
 
 
 def _landed(probe) -> bool:

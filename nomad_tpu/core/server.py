@@ -110,9 +110,12 @@ class Server:
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_applier = PlanApplier(self.state, self.plan_queue)
-        # plan queue-wait / apply latencies measure on the injected clock
+        # plan queue-wait / apply latencies measure on the injected
+        # clock; the store's eval create/modify stamps ride it too, so
+        # a virtual-time soak stamps replayable virtual times
         self.plan_queue.clock = self.clock
         self.plan_applier.clock = self.clock
+        self.state.clock = self.clock
         # shared per-stage wall-interval timers (core/wavepipe.py): the
         # workers' WavePipelines record dispatch/device/d2h/materialize,
         # the applier records commit — one clock, so the device↔commit

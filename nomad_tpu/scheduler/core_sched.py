@@ -9,9 +9,9 @@ ignores thresholds.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
+from nomad_tpu.chaos.clock import SystemClock
 from nomad_tpu.structs import (
     EVAL_STATUS_COMPLETE,
     Evaluation,
@@ -19,6 +19,10 @@ from nomad_tpu.structs import (
 )
 
 from .base import Planner, Scheduler
+
+# wall fallback when the driver passes no `now` (one-shot CLI paths);
+# server paths always inject now from the bound chaos Clock
+_WALL = SystemClock()
 
 CORE_JOB_EVAL_GC = "eval-gc"
 CORE_JOB_JOB_GC = "job-gc"
@@ -42,7 +46,7 @@ class CoreScheduler(Scheduler):
         self.state = state      # snapshot (read)
         self.store = store      # live StateStore (delete operations)
         self.planner = planner
-        self.now = now if now is not None else time.time()
+        self.now = now if now is not None else _WALL.time()
 
     def process(self, evaluation: Evaluation) -> Optional[Exception]:
         kind = evaluation.job_id

@@ -13,10 +13,10 @@ in a single device round-trip.
 
 from __future__ import annotations
 
-import time
 import zlib
 from typing import Dict, List, Optional
 
+from nomad_tpu.chaos.clock import SystemClock
 from nomad_tpu.ops import PlacementEngine, PlacementRequest
 from nomad_tpu.ops.engine import BulkDecisions
 from nomad_tpu.structs import (
@@ -38,6 +38,10 @@ from .base import Planner, Scheduler
 from .reconcile import PlaceRequest as RPlace
 from .reconcile import ReconcileResults, _name, reconcile
 from .util import ALLOC_RESCHEDULED, tainted_nodes
+
+# wall fallback when the driver passes no `now` (one-shot CLI paths);
+# server paths always inject now from the bound chaos Clock
+_WALL = SystemClock()
 
 # reference: maxServiceScheduleAttempts / maxBatchScheduleAttempts
 MAX_SERVICE_ATTEMPTS = 5
@@ -83,7 +87,7 @@ class GenericScheduler(Scheduler):
         self.planner = planner
         self.is_batch = is_batch
         self.engine = _engine(engine, state)
-        self.now = now if now is not None else time.time()
+        self.now = now if now is not None else _WALL.time()
         self.max_attempts = (MAX_BATCH_ATTEMPTS if is_batch
                              else MAX_SERVICE_ATTEMPTS)
         # replica-fed planners (pool worker processes) see the head

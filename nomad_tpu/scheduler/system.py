@@ -9,12 +9,12 @@ alloc), so no scan is needed; capacity is checked host-side per node.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
+from nomad_tpu.chaos.clock import SystemClock
 from nomad_tpu.ops import PlacementEngine
 from nomad_tpu.ops.feasibility import feasible_mask
 from nomad_tpu.structs import (
@@ -32,6 +32,10 @@ from .base import Planner, Scheduler
 from .generic import _engine
 from .util import ALLOC_LOST, ALLOC_NOT_NEEDED, tainted_nodes, tasks_updated
 
+# wall fallback when the driver passes no `now` (one-shot CLI paths);
+# server paths always inject now from the bound chaos Clock
+_WALL = SystemClock()
+
 MAX_SYSTEM_ATTEMPTS = 5
 
 
@@ -45,7 +49,7 @@ class SystemScheduler(Scheduler):
         self.planner = planner
         self.sysbatch = sysbatch
         self.engine = _engine(engine, state)
-        self.now = now if now is not None else time.time()
+        self.now = now if now is not None else _WALL.time()
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         # decision-record capture (core/explain.py)
         self._tg_stats: Dict[str, dict] = {}

@@ -26,6 +26,9 @@ class Harness:
 
     def __init__(self, state: Optional[StateStore] = None) -> None:
         self.state = state or StateStore()
+        # deterministic timebase for every scheduler the harness builds:
+        # tests that never pass `now` should not inherit the host wall
+        self.clock = self.state.clock
         # One engine for the harness's lifetime, attached to the store for
         # dirty-row tracking: packed node tensors and their device uploads
         # survive across process() calls exactly like the server's shared
@@ -93,6 +96,7 @@ class Harness:
         """reference: Harness.Process — snapshot state, build the scheduler,
         run one eval through it."""
         kwargs.setdefault("engine", self.engine)
+        kwargs.setdefault("now", self.clock.time())
         sched: Scheduler = new_scheduler(scheduler_name, self.snapshot(),
                                          self, **kwargs)
         return sched.process(evaluation)

@@ -17,7 +17,6 @@ are rebuildable from here at any time (checkpoint/resume, SURVEY.md §6.4).
 from __future__ import annotations
 
 import threading
-import time as _time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from nomad_tpu.structs import (
@@ -51,6 +50,13 @@ class StateStore:
     def __init__(self) -> None:
         import uuid as _uuid
         self.store_id = str(_uuid.uuid4())   # distinguishes stores for caches
+        # injected timebase for eval create/modify stamps; Server
+        # rebinds this to its chaos Clock so virtual-time soaks stamp
+        # virtual (replayable) times instead of wall times.  Imported
+        # lazily: nomad_tpu.chaos's package init reaches back into
+        # nomad_tpu.state via transport -> core -> plan_apply
+        from nomad_tpu.chaos.clock import SystemClock
+        self.clock = SystemClock()
         self._lock = threading.RLock()
         self._index_cv = threading.Condition(self._lock)
         self._index = 0
@@ -801,7 +807,7 @@ class StateStore:
             table, by_job = self._writable_eval_tables()
             fresh = self._fresh_eval_buckets
             inserted = []
-            now = _time.time()
+            now = self.clock.time()
             for e in evals:
                 prev = table.get(e.id)
                 e = e.copy()

@@ -1,0 +1,27 @@
+"""Invariant analyzer package.
+
+Layout: `common.py` (Finding/helpers/suppression), one module per pass
+(lockpass, cowpass, puritypass, threadpass, rawtimepass, lockorder,
+determinism, wireproto), `driver.py` (scoping, repo walk, suppression
+accounting, CLI), `selftests.py` (injected-violation fixtures).
+
+The pass modules import each other flat (`from common import ...`) so
+they also run as plain scripts; this __init__ bootstraps the package
+directory onto sys.path before touching them.
+"""
+
+import sys
+from pathlib import Path
+
+_PKG = Path(__file__).resolve().parent
+if str(_PKG) not in sys.path:
+    sys.path.insert(0, str(_PKG))
+
+from common import Finding, PASS_NAMES, ROOT
+from driver import (analyze_repo, analyze_repo_full, analyze_source,
+                    main, update_manifest)
+from selftests import selftest
+
+__all__ = ["Finding", "PASS_NAMES", "ROOT", "analyze_repo",
+           "analyze_repo_full", "analyze_source", "main", "selftest",
+           "update_manifest"]

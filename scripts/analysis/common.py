@@ -1,0 +1,90 @@
+"""Shared AST plumbing for the invariant-analyzer passes.
+
+Every pass module imports from here: the `Finding` record shape, the
+walk helpers that respect nested-def boundaries, and the suppression
+matcher (`# analyze: ok <pass>` / `# analyze: ok *` on a finding's
+line).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List, Optional, Tuple
+
+ROOT = Path(__file__).resolve().parent.parent.parent
+
+Finding = Tuple[str, int, str, str]        # (path, lineno, pass, message)
+
+PASS_NAMES = ("lock", "cow", "purity", "thread", "rawtime",
+              "lockorder", "determinism", "wireproto")
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class defs
+    (their bodies run in a different dynamic context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _functions(tree: ast.Module):
+    """Every function/method def in the module (flat)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """The first attribute name hanging off `self` in an access chain
+    (`self._allocs[k].x.pop` -> '_allocs'), or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The root Name of an access chain (`vol.read_allocs.pop` -> 'vol'),
+    or None when the chain roots elsewhere (a call result, self, ...)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted path of a pure Name/Attribute chain ('inp.used0'), else
+    None (subscripts and calls are not stable paths)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _suppressed(text_lines: List[str], lineno: int, pass_name: str
+                ) -> bool:
+    if not (1 <= lineno <= len(text_lines)):
+        return False
+    line = text_lines[lineno - 1]
+    return (f"analyze: ok {pass_name}" in line
+            or "analyze: ok *" in line)

@@ -1,0 +1,210 @@
+"""Pass `cow` — COW / snapshot-isolation discipline (state_store.py).
+
+Objects reachable from a snapshot are immutable: in-place writes to the
+claim-vol / alloc / block / eval tables (or to objects fetched from
+them) must flow through a `_writable_*` helper whose returned copy is
+private to the head for this snapshot cycle.  Mutating a table object
+obtained any other way — or a `dataclasses.replace` shallow copy, whose
+inner dicts are still shared — is exactly the
+`_materialize_block_locked` snapshot leak fixed twice before this pass
+existed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from common import (Finding, _callee_name, _functions, _root_name,
+                    _self_attr, _walk_skip_defs)
+
+# tables reachable from a StateSnapshot (or published like them): the
+# head may only mutate PRIVATE copies of these
+SNAP_TABLES = {
+    "_nodes", "_jobs", "_job_versions", "_evals", "_allocs",
+    "_deployments", "_namespaces", "_node_pools", "_csi_volumes",
+    "_acl_policies", "_acl_tokens", "_acl_by_secret",
+    "_acl_auth_methods", "_acl_binding_rules", "_variables", "_services",
+    "_allocs_by_node", "_allocs_by_job", "_evals_by_job",
+    "_alloc_blocks", "_blocks_by_job", "_blocks_by_node",
+}
+
+MUTATORS = {"pop", "update", "setdefault", "clear", "add", "remove",
+            "discard", "append", "extend", "insert", "popitem"}
+
+FRESH_CALLS = {"dict", "list", "set", "frozenset", "sorted"}
+
+
+def _is_fresh_expr(node: ast.AST) -> bool:
+    """A brand-new container private to this frame."""
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in FRESH_CALLS):
+        return True
+    return False
+
+
+def _is_writable_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _callee_name(node) is not None
+            and _callee_name(node).startswith("_writable_"))
+
+
+def _is_replace_call(node: ast.AST) -> bool:
+    """dataclasses.replace(...) — a SHALLOW copy: inner claim dicts are
+    still the snapshot's unless explicitly replaced, so the result stays
+    snapshot-tainted for in-place mutation purposes."""
+    return (isinstance(node, ast.Call)
+            and _callee_name(node) == "replace")
+
+
+def _snap_rooted(node: ast.AST) -> bool:
+    """Expression that reads out of a snapshot-shared table:
+    self.<SNAP>..., self.<SNAP>.get(...), self.<SNAP>.values(), ..."""
+    attr = _self_attr(node)
+    return attr in SNAP_TABLES
+
+
+def check_cow(tree: ast.Module, path: str) -> List[Finding]:
+    """Two taint grades: `snap` objects came straight out of a
+    snapshot-shared table (NO mutation allowed), `shallow` objects are
+    dataclasses.replace copies — a fresh outer object whose inner
+    containers are still the snapshot's, so scalar attribute writes are
+    fine but inner-container mutation is the leak."""
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        blessed: Set[str] = set()
+        tainted: Set[str] = set()       # snap grade
+        shallow: Set[str] = set()
+        fresh_attrs: Set[str] = set()
+
+        stmts = list(_walk_skip_defs(fn))
+        # attributes wholesale-reassigned to a fresh container in this
+        # function (snapshot_restore's reset-then-fill shape): in-place
+        # writes to them cannot reach a snapshot taken before the call
+        for s in stmts:
+            if isinstance(s, ast.Assign) and _is_fresh_expr(s.value):
+                for t in s.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        fresh_attrs.add(t.attr)
+            if isinstance(s, ast.Assign) and isinstance(s.value, ast.Dict):
+                for t in s.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        fresh_attrs.add(t.attr)
+
+        def classify(value: ast.AST) -> Optional[str]:
+            if _is_writable_call(value) or _is_fresh_expr(value):
+                return "blessed"
+            if _is_replace_call(value):
+                return "shallow"
+            if _snap_rooted(value):
+                return "tainted"
+            root = _root_name(value)
+            if isinstance(value, ast.Call):
+                return None          # other call results: neutral copy
+            if root in blessed:
+                return "blessed"
+            if root in tainted:
+                return "tainted"
+            if root in shallow:
+                return "shallow"
+            return None
+
+        def bind(target: ast.AST, klass: Optional[str]) -> None:
+            names = [n.id for n in ast.walk(target)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Store)]
+            for nm in names:
+                if klass == "blessed":
+                    blessed.add(nm)
+                    tainted.discard(nm)
+                    shallow.discard(nm)
+                elif klass == "tainted" and nm not in blessed:
+                    tainted.add(nm)
+                elif klass == "shallow" and nm not in blessed:
+                    shallow.add(nm)
+
+        # fixed-point propagation over the function's assignments
+        for _ in range(4):
+            before = (len(blessed), len(tainted), len(shallow))
+            for s in stmts:
+                if isinstance(s, ast.Assign):
+                    k = classify(s.value)
+                    for t in s.targets:
+                        bind(t, k)
+                elif isinstance(s, (ast.For, ast.AsyncFor)):
+                    it = s.iter
+                    k = None
+                    if _snap_rooted(it):
+                        k = "tainted"
+                    elif (_root_name(it) in tainted
+                          and not isinstance(it, ast.Call)):
+                        k = "tainted"
+                    elif (isinstance(it, ast.Call)
+                          and _root_name(it.func) in tainted):
+                        k = "tainted"       # tainted.values()/.items()
+                    bind(s.target, k)
+            if (len(blessed), len(tainted), len(shallow)) == before:
+                break
+
+        def flag(node: ast.AST, what: str) -> None:
+            out.append((path, node.lineno, "cow",
+                        f"{what} — snapshot-shared state must be "
+                        "mutated only through a _writable_* copy"))
+
+        for n in stmts:
+            # subscript / attribute stores
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        root = _root_name(t.value)
+                        if (attr in SNAP_TABLES
+                                and attr not in fresh_attrs):
+                            flag(t, f"direct write into self.{attr}[...]")
+                        elif root in tainted:
+                            flag(t, "item write on a snapshot-fetched "
+                                    "object")
+                        elif (root in shallow
+                              and isinstance(t.value, ast.Attribute)):
+                            flag(t, "item write into an inner container "
+                                    "of a dataclasses.replace shallow "
+                                    "copy (still the snapshot's dict)")
+                    elif isinstance(t, ast.Attribute):
+                        if _root_name(t.value) in tainted:
+                            flag(t, "attribute write on a "
+                                    "snapshot-fetched object")
+            if isinstance(n, ast.Delete):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr in SNAP_TABLES and attr not in fresh_attrs:
+                            flag(t, f"del on self.{attr}[...]")
+                        elif _root_name(t.value) in tainted:
+                            flag(t, "del on a snapshot-fetched object")
+            # mutator method calls
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in MUTATORS):
+                obj = n.func.value
+                attr = _self_attr(obj)
+                root = _root_name(obj)
+                if attr in SNAP_TABLES and attr not in fresh_attrs:
+                    flag(n, f"self.{attr}.{n.func.attr}(...) in place")
+                elif root in tainted:
+                    flag(n, f".{n.func.attr}(...) on a snapshot-fetched "
+                            "object")
+                elif (root in shallow
+                      and isinstance(obj, (ast.Attribute, ast.Subscript))):
+                    flag(n, f".{n.func.attr}(...) on an inner container "
+                            "of a dataclasses.replace shallow copy "
+                            "(still the snapshot's dict)")
+    return out

@@ -1,0 +1,181 @@
+"""Pass `determinism` — canonical-plane drift detection.
+
+The canonical/volatile boundary (PR 13): canonical traces, converged
+fingerprints, timeline canonical dumps, and wire frames must be
+byte-identical for the same seed across reruns and hosts.  In the
+modules feeding those outputs this pass flags the classic sources of
+silent drift:
+
+  - iteration over an unordered `set` (for / list / tuple / join /
+    enumerate on a set-typed value) — CPython set order varies with
+    PYTHONHASHSEED and insertion history; wrap in sorted();
+  - unseeded process-global randomness (`random.*` module calls,
+    `np.random.*` legacy global state) — seed an explicit
+    `random.Random(seed)` / `np.random.default_rng(seed)` instead;
+  - `id()` / builtin `hash()` used for ordering — both vary per process
+    (hash randomization, allocator layout), so any sort keyed on them
+    reorders canonical output between runs;
+  - filesystem enumeration order (`listdir` / `glob` / `rglob` /
+    `iterdir` / `scandir` not wrapped directly in sorted()) — readdir
+    order is filesystem-dependent.
+
+dict iteration is deliberately NOT flagged: CPython dicts are
+insertion-ordered, and the planes already lean on that (wire's replay
+cache eviction, ordered journal tables).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from common import Finding, _callee_name, _dotted, _functions
+
+_FS_ENUM = {"listdir", "iterdir", "glob", "rglob", "scandir"}
+_SET_FACTORIES = {"set", "frozenset"}
+_ORDER_SINKS = {"list", "tuple", "enumerate"}
+_SORTERS = {"sorted", "sort", "min", "max"}
+_RNG_OK = {"Random", "SystemRandom", "default_rng", "RandomState",
+           "Generator", "seed"}
+
+
+def _is_set_expr(node: ast.AST, set_names: Set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in _SET_FACTORIES):
+        return True
+    if isinstance(node, ast.Name) and node.id in set_names:
+        return True
+    return False
+
+
+def check_determinism(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+
+    parent: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for ch in ast.iter_child_nodes(node):
+            parent[id(ch)] = node
+
+    has_random = any(
+        isinstance(n, ast.Import) and any(a.name == "random"
+                                          for a in n.names)
+        for n in ast.walk(tree))
+    random_froms: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module == "random":
+            for a in n.names:
+                if a.name not in _RNG_OK:
+                    random_froms.add(a.asname or a.name)
+
+    # ------------------------------------------ per-scope set typing
+    scopes = [tree] + list(_functions(tree))
+    for scope in scopes:
+        set_names: Set[str] = set()
+        stmts = list(ast.iter_child_nodes(scope)) if isinstance(
+            scope, ast.Module) else scope.body
+        flat = []
+        stack = list(stmts)
+        while stack:
+            s = stack.pop()
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            flat.append(s)
+            stack.extend(ast.iter_child_nodes(s))
+        for _ in range(2):
+            for s in flat:
+                if isinstance(s, ast.Assign):
+                    if _is_set_expr(s.value, set_names):
+                        for t in s.targets:
+                            if isinstance(t, ast.Name):
+                                set_names.add(t.id)
+                    else:
+                        for t in s.targets:
+                            if isinstance(t, ast.Name):
+                                set_names.discard(t.id)
+                elif (isinstance(s, ast.AugAssign)
+                        and isinstance(s.target, ast.Name)
+                        and _is_set_expr(s.value, set_names)):
+                    set_names.add(s.target.id)
+
+        def flag_iter(node: ast.AST, how: str) -> None:
+            out.append((path, node.lineno, "determinism",
+                        f"{how} iterates an unordered set — order "
+                        "varies per process (hash randomization); "
+                        "wrap in sorted() before it can reach "
+                        "canonical output"))
+
+        for s in flat:
+            if (isinstance(s, (ast.For, ast.AsyncFor))
+                    and _is_set_expr(s.iter, set_names)):
+                flag_iter(s.iter, "for loop")
+            if isinstance(s, ast.Call):
+                cn = _callee_name(s)
+                if (isinstance(s.func, ast.Name)
+                        and cn in _ORDER_SINKS and s.args
+                        and _is_set_expr(s.args[0], set_names)):
+                    flag_iter(s, f"{cn}()")
+                if (isinstance(s.func, ast.Attribute)
+                        and cn == "join" and s.args
+                        and _is_set_expr(s.args[0], set_names)):
+                    flag_iter(s, ".join()")
+
+    # -------------------------------------- global randomness + order
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        cn = _callee_name(n)
+        if (has_random and isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "random" and cn not in _RNG_OK):
+            out.append((path, n.lineno, "determinism",
+                        f"process-global random.{cn}() — unseeded "
+                        "(or cross-thread-shared) RNG state breaks "
+                        "seeded replay; use an explicit "
+                        "random.Random(seed) instance"))
+        if (isinstance(f, ast.Attribute)
+                and _dotted(f.value) in ("np.random", "numpy.random")
+                and cn not in _RNG_OK):
+            out.append((path, n.lineno, "determinism",
+                        f"legacy global np.random.{cn}() — seed an "
+                        "explicit np.random.default_rng(seed)"))
+        if isinstance(f, ast.Name) and f.id in random_froms:
+            out.append((path, n.lineno, "determinism",
+                        f"process-global random {f.id}() (from-import) "
+                        "— use an explicit random.Random(seed)"))
+        if isinstance(f, ast.Name) and f.id == "hash" and n.args:
+            out.append((path, n.lineno, "determinism",
+                        "builtin hash() varies per process "
+                        "(PYTHONHASHSEED) — canonical planes need a "
+                        "stable digest (hashlib) or a total key"))
+        # id()/hash inside a sort: ordering keyed on process layout
+        if cn in _SORTERS:
+            for kw in n.keywords:
+                if (kw.arg == "key" and isinstance(kw.value, ast.Name)
+                        and kw.value.id in ("id", "hash")):
+                    out.append((path, n.lineno, "determinism",
+                                f"sort keyed on builtin {kw.value.id} — "
+                                "per-process ordering leaks into "
+                                "canonical output"))
+            for sub in ast.walk(n):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "id"):
+                    out.append((path, n.lineno, "determinism",
+                                "id() inside a sort expression — "
+                                "per-process ordering leaks into "
+                                "canonical output"))
+        # filesystem enumeration not immediately sorted
+        if cn in _FS_ENUM:
+            p = parent.get(id(n))
+            sorted_wrapped = (isinstance(p, ast.Call)
+                              and isinstance(p.func, ast.Name)
+                              and p.func.id == "sorted")
+            if not sorted_wrapped:
+                out.append((path, n.lineno, "determinism",
+                            f"{cn}() order is filesystem-dependent — "
+                            "wrap the enumeration in sorted()"))
+    return out

@@ -1,0 +1,319 @@
+"""Analyzer driver: pass scoping, the repo walk, suppression
+accounting, JSON findings output, and the CLI.
+
+Eight passes (suppress a finding with `# analyze: ok <pass>` on its
+line, or `# analyze: ok *`):
+
+  lock         lock discipline (*_locked helpers under the lock)
+  cow          COW / snapshot-isolation discipline (state_store.py)
+  purity       JAX purity & donation (ops/, parallel/, wavepipe)
+  thread       thread/process hygiene (top-level handlers, name=)
+  rawtime      injected-timebase discipline (core/, chaos/,
+               scheduler/, state/)
+  lockorder    inter-procedural lock-order graph: deadlock cycles +
+               blocking-under-lock (whole nomad_tpu package)
+  determinism  canonical-plane drift (set order, global RNG, id/hash
+               ordering, fs enumeration) in trace/soak/traffic/
+               timeline/wire/codec
+  wireproto    RPC op-table parity + payload-key drift (workerpool) +
+               the wire-struct manifest/version gate
+
+Stale-suppression accounting: every `# analyze: ok <pass>` comment in
+the scoped files must still suppress at least one raw finding of that
+pass; dead comments are reported (warning by default,
+`--strict-suppressions` fails the run) so the suppression inventory
+cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from common import Finding, PASS_NAMES, ROOT, _suppressed
+from cowpass import check_cow
+from determinism import check_determinism
+from lockorder import check_lockorder
+from lockpass import check_lock
+from puritypass import check_purity
+from rawtimepass import check_rawtime
+from threadpass import check_thread
+import wireproto as _wp
+
+MANIFEST_PATH = Path(__file__).resolve().parent / "wire_manifest.json"
+
+# (path, lineno, pass-token) of a suppression comment that silences
+# nothing
+Stale = Tuple[str, int, str]
+
+
+def _scoped_files() -> Dict[str, List[Path]]:
+    """pass name -> files it runs over."""
+    pkg = ROOT / "nomad_tpu"
+    all_py = sorted(p for p in pkg.rglob("*.py")
+                    if "__pycache__" not in p.parts)
+    purity = sorted((pkg / "ops").glob("*.py")) \
+        + sorted((pkg / "parallel").glob("*.py")) \
+        + [pkg / "core" / "wavepipe.py"]
+    rawtime = sorted((pkg / "core").glob("*.py")) \
+        + sorted((pkg / "chaos").glob("*.py")) \
+        + sorted((pkg / "scheduler").glob("*.py")) \
+        + sorted((pkg / "state").glob("*.py"))
+    determinism = [pkg / "chaos" / "trace.py",
+                   pkg / "chaos" / "soak.py",
+                   pkg / "chaos" / "traffic.py",
+                   pkg / "core" / "timeline.py",
+                   pkg / "core" / "wire.py",
+                   pkg / "structs" / "codec.py"]
+    wireproto = [pkg / "core" / "workerpool.py"]
+    return {
+        "lock": all_py,
+        "cow": [pkg / "state" / "state_store.py"],
+        "purity": purity,
+        "thread": all_py,
+        "rawtime": rawtime,
+        "lockorder": all_py,
+        "determinism": determinism,
+        "wireproto": wireproto,
+    }
+
+
+def _wire_struct_files() -> List[Path]:
+    """Modules whose dataclasses ride the wire codec (the
+    register_module set: nomad_tpu.structs, structs.structs,
+    ops/engine)."""
+    pkg = ROOT / "nomad_tpu"
+    return [pkg / "structs" / "__init__.py",
+            pkg / "structs" / "structs.py",
+            pkg / "ops" / "engine.py"]
+
+
+def _wire_py() -> Path:
+    return ROOT / "nomad_tpu" / "core" / "wire.py"
+
+
+def load_manifest() -> Optional[dict]:
+    if not MANIFEST_PATH.exists():
+        return None
+    try:
+        return json.loads(MANIFEST_PATH.read_text())
+    except ValueError:
+        return None
+
+
+def analyze_source(text: str, path: str = "<memory>",
+                   passes: Iterable[str] = PASS_NAMES) -> List[Finding]:
+    """Run single-module passes over one source blob (selftest + unit
+    tests); whole-program passes run in single-module mode."""
+    tree = ast.parse(text)
+    findings: List[Finding] = []
+    for name in passes:
+        if name == "lock":
+            findings.extend(check_lock(tree, path))
+        elif name == "cow":
+            findings.extend(check_cow(tree, path))
+        elif name == "purity":
+            findings.extend(check_purity({path: tree}))
+        elif name == "thread":
+            findings.extend(check_thread(tree, path))
+        elif name == "rawtime":
+            findings.extend(check_rawtime(tree, path))
+        elif name == "lockorder":
+            findings.extend(check_lockorder({path: tree}))
+        elif name == "determinism":
+            findings.extend(check_determinism(tree, path))
+        elif name == "wireproto":
+            findings.extend(_wp.check_wireproto({path: tree}))
+    lines = text.splitlines()
+    return sorted({f for f in findings
+                   if not _suppressed(lines, f[1], f[2])})
+
+
+def _collect_suppressions(texts: Dict[str, str]
+                          ) -> List[Tuple[str, int, str]]:
+    """(path, lineno, pass-token) for every `# analyze: ok ...`
+    comment in the analyzed files."""
+    out = []
+    for path in sorted(texts):
+        for i, line in enumerate(texts[path].splitlines(), 1):
+            marker = "analyze: ok "
+            at = line.find(marker)
+            if at < 0:
+                continue
+            token = line[at + len(marker):].split()
+            out.append((path, i, token[0] if token else "*"))
+    return out
+
+
+def analyze_repo_full(root: Path = ROOT
+                      ) -> Tuple[List[Finding], List[Stale]]:
+    """(active findings, stale suppression comments) repo-wide."""
+    scopes = _scoped_files()
+    texts: Dict[str, str] = {}
+    trees: Dict[str, ast.Module] = {}
+    raw: List[Finding] = []
+
+    def load(p: Path) -> Optional[str]:
+        key = str(p)
+        if key in trees:
+            return key
+        if not p.exists():
+            return None
+        texts[key] = p.read_text()
+        try:
+            trees[key] = ast.parse(texts[key])
+        except SyntaxError as e:
+            raw.append((key, e.lineno or 0, "parse",
+                        f"syntax error: {e.msg}"))
+            return None
+        return key
+
+    for files in scopes.values():
+        for p in files:
+            load(p)
+    struct_keys = [k for k in (load(p) for p in _wire_struct_files())
+                   if k is not None]
+    wire_key = load(_wire_py())
+
+    single = {"lock": check_lock, "cow": check_cow,
+              "thread": check_thread, "rawtime": check_rawtime,
+              "determinism": check_determinism}
+    for name, checker in single.items():
+        for p in scopes[name]:
+            key = str(p)
+            if key in trees:
+                raw.extend(checker(trees[key], key))
+    purity_files = {str(p): trees[str(p)] for p in scopes["purity"]
+                    if str(p) in trees}
+    raw.extend(check_purity(purity_files))
+    lockorder_files = {str(p): trees[str(p)] for p in scopes["lockorder"]
+                       if str(p) in trees}
+    raw.extend(check_lockorder(lockorder_files))
+    wp_files = {str(p): trees[str(p)] for p in scopes["wireproto"]
+                if str(p) in trees}
+    raw.extend(_wp.check_wireproto(
+        wp_files,
+        struct_files={k: trees[k] for k in struct_keys},
+        manifest=load_manifest(),
+        wire_tree=trees.get(wire_key) if wire_key else None,
+        wire_path=str(_wire_py()),
+        manifest_path=str(MANIFEST_PATH)))
+
+    active = set()
+    suppressed_at: Dict[Tuple[str, int], set] = {}
+    for f in raw:
+        lines = texts.get(f[0], "").splitlines()
+        if _suppressed(lines, f[1], f[2]):
+            suppressed_at.setdefault((f[0], f[1]), set()).add(f[2])
+        else:
+            active.add(f)
+
+    stale: List[Stale] = []
+    for path, lineno, token in _collect_suppressions(texts):
+        used = suppressed_at.get((path, lineno), set())
+        if token == "*":
+            if not used:
+                stale.append((path, lineno, token))
+        elif token not in used:
+            stale.append((path, lineno, token))
+    return sorted(active), stale
+
+
+def analyze_repo(root: Path = ROOT) -> List[Finding]:
+    return analyze_repo_full(root)[0]
+
+
+def _rel(path: str) -> str:
+    p = Path(path)
+    try:
+        return str(p.relative_to(ROOT))
+    except ValueError:
+        return str(p)
+
+
+def update_manifest() -> int:
+    struct_trees: Dict[str, ast.Module] = {}
+    for p in _wire_struct_files():
+        if p.exists():
+            struct_trees[str(p)] = ast.parse(p.read_text())
+    wire_tree = ast.parse(_wire_py().read_text())
+    wire_ver, _ = _wp.wire_schema_version(wire_tree)
+    old = load_manifest()
+    fresh = _wp.compute_struct_manifest(struct_trees, wire_ver or 1)
+    if old is not None:
+        if old.get("structs") == fresh["structs"]:
+            fresh["schema_version"] = old.get("schema_version",
+                                              fresh["schema_version"])
+            print(f"wire manifest unchanged ({len(fresh['structs'])} "
+                  f"structs, schema_version={fresh['schema_version']})")
+        else:
+            fresh["schema_version"] = int(old.get("schema_version", 0)) + 1
+            print(f"wire manifest REGENERATED: schema_version -> "
+                  f"{fresh['schema_version']} — bump SCHEMA_VERSION in "
+                  "core/wire.py to match")
+    else:
+        print(f"wire manifest created ({len(fresh['structs'])} structs, "
+              f"schema_version={fresh['schema_version']})")
+    MANIFEST_PATH.write_text(json.dumps(fresh, indent=1, sort_keys=True)
+                             + "\n")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        from selftests import selftest
+        return selftest()
+    if "--update-manifest" in argv:
+        return update_manifest()
+    strict = "--strict-suppressions" in argv
+    json_path = ""
+    if "--json" in argv:
+        at = argv.index("--json")
+        if at + 1 >= len(argv):
+            print("analyze: --json needs a path (or '-')")
+            return 2
+        json_path = argv[at + 1]
+
+    t0 = time.perf_counter()
+    findings, stale = analyze_repo_full()
+    elapsed = time.perf_counter() - t0
+
+    for path, lineno, name, msg in findings:
+        print(f"{_rel(path)}:{lineno}: [{name}] {msg}")
+    for path, lineno, token in stale:
+        kind = "error" if strict else "warning"
+        print(f"{_rel(path)}:{lineno}: [suppression] {kind}: "
+              f"`# analyze: ok {token}` no longer suppresses any "
+              "finding — remove it (or fix the pass name)")
+    n_files = sum(len(v) for v in _scoped_files().values())
+    print(f"analyze: {len(findings)} finding(s), {len(stale)} stale "
+          f"suppression(s) over {n_files} pass-file runs in "
+          f"{elapsed:.2f}s")
+
+    if json_path:
+        doc = {
+            "schema": "nomad-tpu.analyze.v1",
+            "elapsed_s": round(elapsed, 4),
+            "pass_file_runs": n_files,
+            "findings": [
+                {"path": _rel(p), "line": ln, "pass": nm, "message": m}
+                for p, ln, nm, m in findings],
+            "stale_suppressions": [
+                {"path": _rel(p), "line": ln, "pass": tok}
+                for p, ln, tok in stale],
+        }
+        blob = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+        if json_path == "-":
+            sys.stdout.write(blob)
+        else:
+            Path(json_path).write_text(blob)
+    if findings:
+        return 1
+    if stale and strict:
+        return 1
+    return 0

@@ -1,0 +1,473 @@
+"""Pass `lockorder` — inter-procedural lock-acquisition graph.
+
+Builds a whole-program graph over the repo's lock identities (the store
+RLock, broker lock, plan-queue lock, submission front-end lock,
+`_tick_lock`, the registry/flight/timeline singleton locks, module-level
+locks like wire's replay-cache lock — seeded from the lock pass's
+LOCK_ATTRS plus `threading.Lock/RLock/Condition` constructor sites) and
+reports:
+
+  - lock-order cycles: lock A held while acquiring B somewhere, B held
+    while acquiring A somewhere else — a potential deadlock the moment
+    two threads interleave (exactly the hazard of admitting N workers'
+    plans through one fenced applier pass);
+  - blocking-under-lock: a call that can block indefinitely — socket /
+    pipe send+recv, `wire` RPC round-trips, `queue.get` / `join`,
+    `block_until_ready` / device fetches, subprocess waits, sleeps —
+    made while a lock is held, directly or through a resolved callee.
+
+Call resolution is deliberately conservative: `self.m()` resolves inside
+the class, other receivers only when the method name is defined by
+exactly one class in the analyzed set and is not a generic container /
+stdlib name.  `Condition(self._lock)` aliases collapse onto the wrapped
+lock, so `with self._cv:` and `with self._lock:` are one graph node.
+`cond.wait()` under its OWN lock is the blessed condition-variable
+pattern and is exempt; waiting on anything while holding a DIFFERENT
+lock is flagged (the wait releases only its own lock).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from common import Finding, _dotted
+from lockpass import LOCK_ATTRS
+
+_LOCK_FACTORIES = {"Lock": "Lock", "RLock": "RLock",
+                   "Condition": "Condition", "Semaphore": "Lock",
+                   "BoundedSemaphore": "Lock"}
+
+# attribute calls that block regardless of receiver
+_BLOCKING_ATTRS = {"send_bytes", "recv_bytes", "sendall", "accept",
+                   "connect", "communicate", "block_until_ready",
+                   "device_get", "check_call", "check_output"}
+
+# receiver hints: a `.recv()` on one of these roots is a pipe/socket
+_PIPEY = ("conn", "sock", "chan", "pipe")
+
+# method names too generic to resolve across classes (dict.get, list
+# mutators, file IO, str ops, lock primitives): resolving them by
+# unique definition name would invent edges out of container calls
+_SKIP_METHODS = {
+    "get", "put", "pop", "add", "remove", "discard", "append",
+    "appendleft", "extend", "update", "clear", "copy", "items", "keys",
+    "values", "setdefault", "sort", "join", "split", "strip", "close",
+    "open", "read", "write", "send", "recv", "encode", "decode", "pack",
+    "unpack", "start", "run", "wait", "notify", "notify_all", "acquire",
+    "release", "set", "is_set", "cancel", "result", "done", "flush",
+    "lower", "upper", "replace", "format", "count", "index", "insert",
+    "popitem", "group", "match", "search", "next", "stop",
+}
+
+
+class _Cls:
+    __slots__ = ("name", "stem", "lock_attrs", "cond_wraps", "methods")
+
+    def __init__(self, name: str, stem: str):
+        self.name = name
+        self.stem = stem
+        self.lock_attrs: Dict[str, str] = {}    # attr -> kind
+        self.cond_wraps: Dict[str, str] = {}    # cv attr -> wrapped attr
+        self.methods: Dict[str, ast.AST] = {}
+
+    def canon(self, attr: str) -> str:
+        seen = set()
+        while attr in self.cond_wraps and attr not in seen:
+            seen.add(attr)
+            attr = self.cond_wraps[attr]
+        return attr
+
+    def node(self, attr: str) -> str:
+        return f"{self.name}.{self.canon(attr)}"
+
+
+class _Fn:
+    __slots__ = ("node", "cls", "stem", "path", "acquires", "blocks",
+                 "callees", "aliases")
+
+    def __init__(self, node: ast.AST, cls: Optional[_Cls], stem: str,
+                 path: str):
+        self.node = node
+        self.cls = cls
+        self.stem = stem
+        self.path = path
+        self.acquires: Set[str] = set()
+        # (description, exempt lock node or "", lineno)
+        self.blocks: Set[Tuple[str, str]] = set()
+        self.callees: Set[int] = set()
+        self.aliases: Dict[str, str] = {}
+
+
+def _factory_kind(value: ast.AST) -> Optional[str]:
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return _LOCK_FACTORIES.get(name or "")
+
+
+def check_lockorder(files: Dict[str, ast.Module]) -> List[Finding]:
+    # ---------------------------------------------------- harvest
+    classes: List[_Cls] = []
+    fns: Dict[int, _Fn] = {}
+    methods_by_name: Dict[str, List[_Fn]] = {}
+    module_funcs: Dict[Tuple[str, str], _Fn] = {}
+    module_locks: Dict[str, Dict[str, str]] = {}   # stem -> name -> node
+
+    for path in sorted(files):
+        tree = files[path]
+        stem = Path(path).stem
+        mlocks: Dict[str, str] = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = _factory_kind(stmt.value)
+                if kind:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            mlocks[t.id] = f"{stem}.{t.id}"
+        module_locks[stem] = mlocks
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                f = _Fn(stmt, None, stem, path)
+                fns[id(stmt)] = f
+                module_funcs[(stem, stmt.name)] = f
+            elif isinstance(stmt, ast.ClassDef):
+                ci = _Cls(stmt.name, stem)
+                classes.append(ci)
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        ci.methods[sub.name] = sub
+                        f = _Fn(sub, ci, stem, path)
+                        fns[id(sub)] = f
+                        methods_by_name.setdefault(sub.name,
+                                                   []).append(f)
+                # lock attributes: self.X = threading.Lock()/RLock()/
+                # Condition(self._Y) anywhere in the class body
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    kind = _factory_kind(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        wrapped = None
+                        if kind == "Condition" and sub.value.args:
+                            a0 = sub.value.args[0]
+                            if (isinstance(a0, ast.Attribute)
+                                    and isinstance(a0.value, ast.Name)
+                                    and a0.value.id == "self"):
+                                wrapped = a0.attr
+                        if wrapped:
+                            ci.cond_wraps[t.attr] = wrapped
+                        else:
+                            ci.lock_attrs.setdefault(t.attr, kind)
+
+    kind_of: Dict[str, str] = {}
+    for ci in classes:
+        for attr, kind in ci.lock_attrs.items():
+            kind_of[ci.node(attr)] = kind
+
+    # `.locked()` context accessor: when exactly one analyzed class
+    # defines it, any `with obj.locked():` acquires that class's lock
+    locked_node = ""
+    owners = methods_by_name.get("locked", [])
+    if len(owners) == 1 and owners[0].cls is not None:
+        locked_node = owners[0].cls.node("_lock")
+
+    def lock_node_of(expr: ast.AST, fn: _Fn) -> str:
+        """Lock identity acquired by `with <expr>:`, or ''."""
+        if isinstance(expr, ast.Name):
+            if expr.id in fn.aliases:
+                return fn.aliases[expr.id]
+            return module_locks.get(fn.stem, {}).get(expr.id, "")
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self" and fn.cls is not None):
+                attr = expr.attr
+                if (attr in fn.cls.lock_attrs
+                        or attr in fn.cls.cond_wraps
+                        or attr in LOCK_ATTRS):
+                    return fn.cls.node(attr)
+            return ""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute) and f.attr == "locked":
+                return locked_node
+        if isinstance(expr, ast.IfExp):
+            return (lock_node_of(expr.body, fn)
+                    or lock_node_of(expr.orelse, fn))
+        return ""
+
+    def resolve_call(call: ast.Call, fn: _Fn) -> Optional[_Fn]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            g = module_funcs.get((fn.stem, f.id))
+            if g is not None:
+                return g
+            hits = [v for (_, n), v in module_funcs.items() if n == f.id]
+            return hits[0] if len(hits) == 1 else None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            if (isinstance(f.value, ast.Name) and f.value.id == "self"
+                    and fn.cls is not None and name in fn.cls.methods):
+                return fns[id(fn.cls.methods[name])]
+            if name in _SKIP_METHODS:
+                return None
+            hits = methods_by_name.get(name, [])
+            return hits[0] if len(hits) == 1 else None
+        return None
+
+    def blocking_desc(call: ast.Call, fn: _Fn) -> Tuple[str, str]:
+        """(description, exempt-lock-node) for a potentially-blocking
+        call, or ('', '')."""
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return "", ""
+        a = f.attr
+        recv = _dotted(f.value) or ""
+        low = recv.lower()
+        if a in _BLOCKING_ATTRS:
+            return f"{recv or '?'}.{a}()", ""
+        if a == "recv" and any(h in low for h in _PIPEY):
+            return f"{recv}.recv()", ""
+        if a in ("call", "notify") and "chan" in low:
+            return f"wire RPC {recv}.{a}()", ""
+        if a == "get":
+            # match whole queue-ish names only: `self._dequeues.get(k, 0)`
+            # is a dict of delivery counters, not a Queue — a substring
+            # test on "queue" would flag it
+            last = low.rsplit(".", 1)[-1].lstrip("_")
+            if (last in ("q", "queue", "logq", "inbox", "subq", "workq")
+                    or last.endswith("queue") or last.endswith("_q")):
+                return f"{recv}.get()", ""
+        if a == "join" and not call.args:
+            # thread/process join; str.join always has a positional arg
+            return f"{recv or '?'}.join()", ""
+        if a == "sleep":
+            return f"{recv or '?'}.sleep()", ""
+        if a == "wait":
+            held = lock_node_of(f.value, fn)
+            if held:
+                # cond.wait(): releases its OWN lock while waiting —
+                # blessed under that lock, a hazard under any other
+                return f"{recv}.wait()", held
+            return f"{recv or '?'}.wait()", ""
+        return "", ""
+
+    # ------------------------------------------- per-function harvest
+    for fn in fns.values():
+        body = fn.node
+        # local lock aliases (lk = self._lock / guard = store.locked())
+        for n in ast.walk(body):
+            if isinstance(n, ast.Assign):
+                tgt_names = [t.id for t in n.targets
+                             if isinstance(t, ast.Name)]
+                if not tgt_names:
+                    continue
+                node = lock_node_of(n.value, fn)
+                if node:
+                    for nm in tgt_names:
+                        fn.aliases[nm] = node
+        for n in ast.walk(body):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    node = lock_node_of(item.context_expr, fn)
+                    if node:
+                        fn.acquires.add(node)
+            elif isinstance(n, ast.Call):
+                desc, exempt = blocking_desc(n, fn)
+                if desc:
+                    fn.blocks.add((desc, exempt))
+                g = resolve_call(n, fn)
+                if g is not None and g is not fn:
+                    fn.callees.add(id(g.node))
+
+    # ------------------------------------------------------ fixpoint
+    acq_all: Dict[int, Set[str]] = {
+        fid: set(f.acquires) for fid, f in fns.items()}
+    blk_all: Dict[int, Set[Tuple[str, str]]] = {
+        fid: set(f.blocks) for fid, f in fns.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, f in fns.items():
+            for cid in f.callees:
+                if not acq_all[cid] <= acq_all[fid]:
+                    acq_all[fid] |= acq_all[cid]
+                    changed = True
+                if not blk_all[cid] <= blk_all[fid]:
+                    blk_all[fid] |= blk_all[cid]
+                    changed = True
+
+    # ------------------------- lexical walk: edges + blocking findings
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    out: List[Finding] = []
+    reported_blocks: Set[Tuple[str, int, str]] = set()
+
+    def note_edge(h: str, a: str, path: str, lineno: int,
+                  via: str) -> None:
+        edges.setdefault((h, a), (path, lineno, via))
+
+    def check_call(call: ast.Call, held: List[str], fn: _Fn) -> None:
+        desc, exempt = blocking_desc(call, fn)
+        if desc:
+            bad = sorted(h for h in held if h != exempt)
+            if bad:
+                key = (fn.path, call.lineno, desc)
+                if key not in reported_blocks:
+                    reported_blocks.add(key)
+                    out.append((fn.path, call.lineno, "lockorder",
+                                f"blocking call {desc} while holding "
+                                f"lock {bad[0]} — the lock is pinned "
+                                "for the full stall"))
+        g = resolve_call(call, fn)
+        if g is None or not held:
+            return
+        gid = id(g.node)
+        gname = g.node.name
+        for a in acq_all.get(gid, ()):
+            for h in held:
+                note_edge(h, a, fn.path, call.lineno,
+                          f"via {gname}()")
+        for bdesc, bexempt in blk_all.get(gid, ()):
+            bad = sorted(h for h in held if h != bexempt)
+            if bad:
+                key = (fn.path, call.lineno, bdesc)
+                if key not in reported_blocks:
+                    reported_blocks.add(key)
+                    out.append((fn.path, call.lineno, "lockorder",
+                                f"call into {gname}() may block "
+                                f"({bdesc}) while holding lock "
+                                f"{bad[0]}"))
+
+    def visit(stmts, held: List[str], fn: _Fn) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            here = list(held)
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    node = lock_node_of(item.context_expr, fn)
+                    if node:
+                        for h in here:
+                            note_edge(h, node, fn.path, stmt.lineno, "")
+                        here.append(node)
+            # expressions attached directly to this statement
+            for field, value in ast.iter_fields(stmt):
+                if field in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                vals = value if isinstance(value, list) else [value]
+                for v in vals:
+                    if not isinstance(v, ast.AST):
+                        continue
+                    stack = [v]
+                    while stack:
+                        n = stack.pop()
+                        if isinstance(n, ast.Call):
+                            # a With item's own call runs BEFORE the
+                            # lock is taken, so use the OUTER held set
+                            chk = held if isinstance(
+                                stmt, (ast.With, ast.AsyncWith)) else here
+                            if chk:
+                                check_call(n, chk, fn)
+                        if not isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.ClassDef, ast.Lambda)):
+                            stack.extend(ast.iter_child_nodes(n))
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    visit(sub, here if field == "body" or not isinstance(
+                        stmt, (ast.With, ast.AsyncWith)) else held, fn)
+            for h in getattr(stmt, "handlers", ()):
+                visit(h.body, here, fn)
+
+    for fn in fns.values():
+        visit(fn.node.body, [], fn)
+
+    # ------------------------------------------------ cycle detection
+    adj: Dict[str, Set[str]] = {}
+    for (h, a) in edges:
+        adj.setdefault(h, set()).add(a)
+        adj.setdefault(a, set())
+
+    # self-loops: re-acquiring a non-reentrant Lock deadlocks instantly
+    for (h, a), (path, lineno, via) in sorted(edges.items()):
+        if h == a and kind_of.get(h, "") == "Lock":
+            out.append((path, lineno, "lockorder",
+                        f"non-reentrant Lock {h} may be re-acquired "
+                        f"while already held{' ' + via if via else ''} "
+                        "— instant deadlock"))
+
+    # Tarjan SCC over the acquired-while-holding graph
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstk: Set[str] = set()
+    stk: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stk.append(v)
+        onstk.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stk.append(w)
+                    onstk.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstk:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stk.pop()
+                    onstk.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        cyc_edges = sorted((h, a) for (h, a) in edges
+                           if h in comp and a in comp and h != a)
+        where = [f"{h}->{a} at "
+                 f"{Path(edges[(h, a)][0]).name}:{edges[(h, a)][1]}"
+                 + (f" {edges[(h, a)][2]}" if edges[(h, a)][2] else "")
+                 for h, a in cyc_edges]
+        path, lineno, _ = edges[cyc_edges[0]]
+        out.append((path, lineno, "lockorder",
+                    "lock-order cycle (potential deadlock): "
+                    + " <-> ".join(comp) + "; " + "; ".join(where)))
+    return out

@@ -1,0 +1,100 @@
+"""Pass `lock` — lock discipline.
+
+A `*_locked` / `_writable_*` helper mutates or reads head state that
+only the store/broker lock makes consistent — it may only be called
+from another such helper or from a lexical `with self._lock:` (or
+`.locked()` / condition) scope.  Public entry points must acquire
+before delegating.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from common import Finding, _callee_name, _functions, _walk_skip_defs
+
+LOCK_ATTRS = {"_lock", "lock", "_cv", "_index_cv", "_apply_cv",
+              "_tick_lock"}
+LOCKED_PREFIXES = ("_writable_",)
+
+
+def _is_lock_expr(node: ast.AST, aliases: Set[str]) -> bool:
+    """Expressions that acquire the protecting lock when used in
+    `with ...:` — the lock/condition attribute itself, a `.locked()`
+    accessor, or a local alias of either."""
+    if isinstance(node, ast.Attribute) and node.attr in LOCK_ATTRS:
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "locked":
+            return True
+    if isinstance(node, ast.IfExp):
+        return (_is_lock_expr(node.body, aliases)
+                or _is_lock_expr(node.orelse, aliases))
+    if isinstance(node, ast.Name) and node.id in aliases:
+        return True
+    return False
+
+
+def _needs_lock(name) -> bool:
+    if not name:
+        return False
+    return name.endswith("_locked") or name.startswith(LOCKED_PREFIXES)
+
+
+def check_lock(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        holder = _needs_lock(fn.name)
+        aliases = {
+            t.id
+            for stmt in _walk_skip_defs(fn)
+            if isinstance(stmt, ast.Assign)
+            and _is_lock_expr(stmt.value, set())
+            for t in stmt.targets if isinstance(t, ast.Name)
+        }
+
+        # flag calls attached to each statement's own expressions;
+        # compound bodies recurse with the updated lock state
+        def visit2(stmts, inlock, fn=fn, aliases=aliases, holder=holder):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue      # nested defs get their own analysis
+                here = inlock
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    if any(_is_lock_expr(i.context_expr, aliases)
+                           for i in stmt.items):
+                        here = True
+                # expressions attached directly to this statement
+                # (excluding nested statement bodies)
+                exprs: List[ast.AST] = []
+                for field, value in ast.iter_fields(stmt):
+                    if field in ("body", "orelse", "finalbody",
+                                 "handlers"):
+                        continue
+                    if isinstance(value, ast.AST):
+                        exprs.append(value)
+                    elif isinstance(value, list):
+                        exprs.extend(v for v in value
+                                     if isinstance(v, ast.AST))
+                if not (holder or here):
+                    for e in exprs:
+                        for n in [e, *_walk_skip_defs(e)]:
+                            if (isinstance(n, ast.Call)
+                                    and _needs_lock(_callee_name(n))):
+                                out.append((
+                                    path, n.lineno, "lock",
+                                    f"{_callee_name(n)}() called outside "
+                                    "a lock scope (hold the store lock "
+                                    "or be *_locked yourself)"))
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        visit2(sub, here)
+                for h in getattr(stmt, "handlers", ()):
+                    visit2(h.body, here)
+
+        visit2(fn.body, False)
+    return out

@@ -1,0 +1,327 @@
+"""Pass `purity` — JAX purity & donation (ops/, parallel/,
+core/wavepipe.py).
+
+Host-sync calls (`block_until_ready`, host `np.*`, `float()` / `bool()`
+on traced values, `.item()`) inside jit-traced code break async
+dispatch; heavy `jnp` compute in non-jit host paths pays per-op
+dispatch in the hot loop; and a buffer passed at a `donate_argnums`
+position is DEAD after the call — XLA reuses its memory, so any later
+read of the same expression reads garbage.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Set, Tuple
+
+from common import (Finding, _callee_name, _dotted, _functions,
+                    _root_name, _walk_skip_defs)
+
+HEAVY_JNP = {"where", "sum", "argsort", "sort", "argmax", "argmin",
+             "cumsum", "dot", "matmul", "einsum", "take_along_axis",
+             "top_k", "mean", "prod", "nonzero", "unique"}
+
+NP_ALIASES = {"np", "numpy"}
+JNP_ALIASES = {"jnp"}
+
+
+# transforms that TRACE the function they wrap: a Name passed to one of
+# these runs under jit/trace semantics, not eagerly on the host
+TRACE_WRAPPERS = {"jit", "shard_map", "vmap", "pmap", "scan",
+                  "fori_loop", "while_loop", "cond", "remat",
+                  "checkpoint", "grad", "value_and_grad"}
+
+
+def _jit_call(node: ast.AST) -> bool:
+    """A call to jax.jit / jit."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    if isinstance(f, ast.Name) and f.id == "jit":
+        return True
+    return False
+
+
+def _trace_wrapper_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _callee_name(node)
+    return name in TRACE_WRAPPERS
+
+
+class _ModuleInfo:
+    __slots__ = ("path", "tree", "funcs", "imports", "jit_seeds",
+                 "jit_lambdas", "donated")
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.tree = tree
+        # name -> ALL defs carrying it (mesh.py's jit factories each
+        # define a local `f`; a plain dict would keep only one)
+        self.funcs: Dict[str, List[ast.AST]] = {}
+        for f in _functions(tree):
+            self.funcs.setdefault(f.name, []).append(f)
+        # local name -> (module stem, source name) for from-imports
+        self.imports: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                stem = node.module.split(".")[-1]
+                for a in node.names:
+                    if a.name != "*":
+                        self.imports[a.asname or a.name] = (stem, a.name)
+        self.jit_seeds: Set[str] = set()
+        self.jit_lambdas: List[ast.Lambda] = []
+        # jitted-callable local name -> donated positional indexes
+        self.donated: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            if _trace_wrapper_call(node):
+                # every Name reachable in the wrapper's args is traced —
+                # covers partial(_kernel, ...) indirection too
+                for a in node.args:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Name):
+                            self.jit_seeds.add(sub.id)
+                        elif isinstance(sub, ast.Lambda):
+                            self.jit_lambdas.append(sub)
+            if isinstance(node, ast.FunctionDef):
+                for d in node.decorator_list:
+                    if _jit_call(d) or (
+                            isinstance(d, ast.Attribute)
+                            and d.attr == "jit") or (
+                            isinstance(d, ast.Name) and d.id == "jit"):
+                        self.jit_seeds.add(node.name)
+            # NAME = jax.jit(fn, donate_argnums=(k,...))
+            if isinstance(node, ast.Assign) and _jit_call(node.value):
+                dons: Tuple[int, ...] = ()
+                for kw in node.value.keywords:
+                    if kw.arg == "donate_argnums":
+                        vals = []
+                        for e in ast.walk(kw.value):
+                            if (isinstance(e, ast.Constant)
+                                    and isinstance(e.value, int)):
+                                vals.append(e.value)
+                        dons = tuple(vals)
+                if dons:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.donated[t.id] = dons
+
+
+def _purity_traced_defs(mods: Dict[str, _ModuleInfo]) -> Set[int]:
+    """id()s of every function def reachable from a jax.jit seed —
+    through any NAME REFERENCE inside traced code, not just direct
+    calls: `jax.lax.scan(step, ...)` traces `step` without calling it by
+    name, and a helper imported from a sibling kernel module is traced
+    when a traced function references it.  Defs nested inside a traced
+    def only ever run under trace and count too.  Over-approximation is
+    deliberate: marking a host helper traced can only silence the eager
+    host-path heuristic, never invent a finding."""
+    traced: Set[int] = set()
+    work: List[Tuple[str, ast.AST]] = []
+
+    def mark(stem: str, fn: ast.AST) -> None:
+        if id(fn) in traced:
+            return
+        traced.add(id(fn))
+        work.append((stem, fn))
+        for sub in ast.walk(fn):
+            if (sub is not fn
+                    and isinstance(sub, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))):
+                traced.add(id(sub))
+
+    for stem, mi in mods.items():
+        for name in mi.jit_seeds:
+            for fn in mi.funcs.get(name, ()):
+                mark(stem, fn)
+    while work:
+        stem, fn = work.pop()
+        mi = mods[stem]
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            if n.id in mi.funcs:
+                for f2 in mi.funcs[n.id]:
+                    mark(stem, f2)
+            elif n.id in mi.imports:
+                src_stem, src_name = mi.imports[n.id]
+                if src_stem in mods:
+                    for f2 in mods[src_stem].funcs.get(src_name, ()):
+                        mark(src_stem, f2)
+    return traced
+
+
+def _branch_paths(fn: ast.AST) -> Dict[int, Tuple]:
+    """id(node) -> tuple of (id(branch stmt), arm) ancestors — two nodes
+    whose paths first differ on the same statement with different arms
+    can never execute in the same pass (if/else, try/except)."""
+    paths: Dict[int, Tuple] = {}
+
+    def go(node: ast.AST, path: Tuple) -> None:
+        for field, value in ast.iter_fields(node):
+            kids = value if isinstance(value, list) else [value]
+            for k in kids:
+                if not isinstance(k, ast.AST):
+                    continue
+                sub = path
+                if (isinstance(node, ast.If)
+                        and field in ("body", "orelse")):
+                    sub = path + ((id(node), field),)
+                elif (isinstance(node, ast.Try)
+                        and field in ("body", "handlers", "orelse")):
+                    sub = path + ((id(node), field),)
+                paths[id(k)] = sub
+                go(k, sub)
+
+    paths[id(fn)] = ()
+    go(fn, ())
+    return paths
+
+
+def _exclusive(p1: Tuple, p2: Tuple) -> bool:
+    for e1, e2 in zip(p1, p2):
+        if e1 == e2:
+            continue
+        return e1[0] == e2[0] and e1[1] != e2[1]
+    return False
+
+
+def check_purity(files: Dict[str, ast.Module]) -> List[Finding]:
+    mods: Dict[str, _ModuleInfo] = {}
+    for path, tree in files.items():
+        stem = Path(path).stem
+        mods[stem] = _ModuleInfo(path, tree)
+    traced = _purity_traced_defs(mods)
+    # donated callables visible across the scoped modules by import
+    donated_global: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+    for stem, mi in mods.items():
+        for name, dons in mi.donated.items():
+            donated_global[(stem, name)] = dons
+    out: List[Finding] = []
+
+    def check_traced_body(body: ast.AST, path: str) -> None:
+        for n in ast.walk(body):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if (isinstance(f, ast.Attribute)
+                    and _root_name(f) in NP_ALIASES):
+                out.append((path, n.lineno, "purity",
+                            f"host numpy call np.{f.attr}(...) inside "
+                            "jit-traced code (silent device->host sync "
+                            "or constant fold)"))
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("item", "tolist")):
+                out.append((path, n.lineno, "purity",
+                            f".{f.attr}() inside jit-traced code forces "
+                            "a host sync"))
+            if (isinstance(f, ast.Name) and f.id in ("float", "bool")
+                    and n.args
+                    and not all(isinstance(a, ast.Constant)
+                                for a in n.args)):
+                out.append((path, n.lineno, "purity",
+                            f"{f.id}() on a traced value forces a host "
+                            "sync inside jit"))
+
+    for stem, mi in mods.items():
+        path = mi.path
+        all_defs = [f for fns in mi.funcs.values() for f in fns]
+        # 1. block_until_ready anywhere in the hot-path modules: the
+        # pipeline's ONE deliberate sync point lives in collect() and
+        # carries a suppression; anything else is a stall in disguise
+        for n in ast.walk(mi.tree):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "block_until_ready"):
+                out.append((path, n.lineno, "purity",
+                            "block_until_ready() in the pipeline hot "
+                            "path — host sync defeats async dispatch"))
+        # 2. traced-code checks (outermost traced defs only: their walk
+        # already covers defs nested inside them)
+        nested_in_traced: Set[int] = set()
+        for fn in all_defs:
+            if id(fn) not in traced:
+                continue
+            for sub in ast.walk(fn):
+                if (sub is not fn
+                        and isinstance(sub, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))):
+                    nested_in_traced.add(id(sub))
+        for fn in all_defs:
+            if id(fn) in traced and id(fn) not in nested_in_traced:
+                check_traced_body(fn, path)
+        for lam in mi.jit_lambdas:
+            check_traced_body(lam, path)
+        # 3. heavy eager jnp in host (non-traced) functions
+        for fn in all_defs:
+            if id(fn) in traced:
+                continue
+            for n in _walk_skip_defs(fn):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in HEAVY_JNP
+                        and _root_name(n.func) in JNP_ALIASES):
+                    out.append((path, n.lineno, "purity",
+                                f"eager jnp.{n.func.attr}(...) in a "
+                                "non-jit host path (per-op dispatch in "
+                                "the hot loop; move it under jit)"))
+        # 4. donated-buffer reuse: a read of the donated expression
+        # AFTER the donating call (same execution path only — an
+        # exclusive if/elif arm cannot observe the other arm's donation)
+        for fn in all_defs:
+            calls: List[Tuple[int, str, Tuple]] = []
+            paths_by_id = None
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                cn = n.func.id if isinstance(n.func, ast.Name) else None
+                if cn is None:
+                    continue
+                dons = mi.donated.get(cn)
+                if dons is None and cn in mi.imports:
+                    dons = donated_global.get(mi.imports[cn])
+                if not dons:
+                    continue
+                if paths_by_id is None:
+                    paths_by_id = _branch_paths(fn)
+                for k in dons:
+                    if k < len(n.args):
+                        p = _dotted(n.args[k])
+                        if p:
+                            end = getattr(n, "end_lineno", n.lineno)
+                            calls.append((end, p,
+                                          paths_by_id.get(id(n), ())))
+            if not calls:
+                continue
+            loads: List[Tuple[int, str, Tuple]] = []
+            stores: List[Tuple[int, str]] = []
+            for n in ast.walk(fn):
+                p = None
+                if isinstance(n, (ast.Name, ast.Attribute)):
+                    p = _dotted(n)
+                if p is None:
+                    continue
+                if isinstance(n.ctx, ast.Load):
+                    loads.append((n.lineno, p,
+                                  paths_by_id.get(id(n), ())))
+                elif isinstance(n.ctx, ast.Store):
+                    stores.append((n.lineno, p))
+            for call_end, pth, cpath in calls:
+                for ln, p, lpath in loads:
+                    if p != pth or ln <= call_end:
+                        continue
+                    if _exclusive(cpath, lpath):
+                        continue
+                    rebound = any(call_end < s_ln <= ln and s_p == pth
+                                  for s_ln, s_p in stores)
+                    if not rebound:
+                        out.append((path, ln, "purity",
+                                    f"`{pth}` read after being DONATED "
+                                    f"to a chained dispatch on line "
+                                    f"{call_end} — the buffer is dead "
+                                    "(XLA reuses its memory)"))
+    return out

@@ -1,0 +1,72 @@
+"""Pass `rawtime` — injected-timebase discipline (nomad_tpu/core/,
+chaos/, scheduler/, state/).
+
+A raw `time.time()` / `time.monotonic()` / `time.sleep()` call in the
+cluster plane bypasses the chaos Clock seam (chaos/clock.py), so a
+virtual-time soak silently mixes wall and virtual timelines —
+heartbeat TTLs fire early, SLO windows span the wrong samples, and the
+same seed stops replaying.  Route through `self.clock` / a module-level
+bound Clock instead (`time.perf_counter()` stays legal: host-side
+duration measurement is not cluster time).
+
+The alias table is hoisted over the WHOLE module before any call is
+checked, so both re-import shapes are caught no matter where the import
+statement sits (module top or nested inside a function body):
+
+  - `from time import time as _t` / `from time import monotonic` —
+    from-import aliases of the banned callables
+  - `import time as _clock` — a module alias; `_clock.time()` is the
+    same raw call wearing a different root name
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from common import Finding
+
+# cluster-plane time must flow through the injected chaos Clock; these
+# raw calls each pin a timeline to the wall clock.  perf_counter is
+# deliberately absent: host-side duration measurement (wavepipe stage
+# timers) is not cluster time and stays legal.
+_RAWTIME_BANNED = ("time", "monotonic", "sleep")
+
+
+def check_rawtime(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    # hoisted alias tables: one ast.walk sees every import statement in
+    # the module, INCLUDING ones nested in function bodies (a lazy
+    # `import time as _t` inside a helper is the shape the pre-package
+    # pass missed — its call check only matched the literal root name
+    # `time`)
+    from_imports: Dict[str, str] = {}    # local name -> banned callable
+    mod_aliases: Set[str] = {"time"}     # names bound to the time module
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module == "time":
+            for a in n.names:
+                if a.name in _RAWTIME_BANNED:
+                    from_imports[a.asname or a.name] = a.name
+        elif isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == "time":
+                    mod_aliases.add(a.asname or a.name)
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        fn = n.func
+        banned = ""
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in mod_aliases
+                and fn.attr in _RAWTIME_BANNED):
+            banned = fn.attr
+        elif isinstance(fn, ast.Name) and fn.id in from_imports:
+            banned = from_imports[fn.id]
+        if banned:
+            out.append((path, n.lineno, "rawtime",
+                        f"raw `time.{banned}()` bypasses the injected "
+                        "Clock — a virtual-time soak mixes wall and "
+                        "virtual timelines; route through the bound "
+                        "chaos Clock (clock.time()/monotonic()/sleep())"))
+    return out

@@ -1,0 +1,395 @@
+"""Injected-violation fixtures for every analyzer pass.
+
+Each fixture plants known violations next to clean shapes; `selftest()`
+asserts the exact finding count and a marker substring per pass, plus
+that a `# analyze: ok <pass>` annotation silences its line.  CI runs
+this before the repo-wide sweep so a broken pass can never silently
+pass the tree.
+"""
+
+from __future__ import annotations
+
+SELFTEST_LOCK = '''
+class StateStore:
+    def upsert_thing(self, x):
+        with self._lock:
+            self._insert_thing_locked(x)      # ok: under the lock
+
+    def _merge_locked(self, x):
+        self._insert_thing_locked(x)          # ok: *_locked caller
+
+    def broken_entry(self, x):
+        self._insert_thing_locked(x)          # VIOLATION: no lock
+
+    def broken_helper(self, key):
+        vol = self._writable_claim_vol(key)   # VIOLATION: no lock
+        return vol
+
+
+class MetricsRegistry:
+    # the telemetry registry's locked paths (core/telemetry.py): the
+    # histogram mutator is *_locked and every caller must hold the
+    # registry lock — a bare call is exactly the unsynchronized
+    # stats-dict increment this PR removed from broker/worker
+    def observe(self, key, value):
+        with self._lock:
+            self._observe_locked(key, value)  # ok: under the lock
+
+    def broken_observe(self, key, value):
+        self._observe_locked(key, value)      # VIOLATION: no lock
+'''
+
+SELFTEST_COW = '''
+class StateStore:
+    def _materialize_block_locked(self, block):
+        key = (block.namespace, block.source)
+        vol = self._csi_volumes.get(key)          # snapshot-shared
+        if vol is None or block.id not in vol.read_blocks:
+            return
+        vol.read_blocks.pop(block.id, None)       # VIOLATION (the leak)
+        vol.read_allocs.update({a: "" for a in block.ids})  # VIOLATION
+
+    def _claim_ok_locked(self, key, alloc):
+        vol = self._writable_claim_vol(key)       # head-private copy
+        if vol is None:
+            return
+        vol.read_allocs[alloc.id] = alloc.node_id  # ok: blessed
+
+    def delete_thing(self, key):
+        self._csi_volumes.pop(key, None)          # VIOLATION: direct
+
+    def _release_claims_locked(self, key, aid):
+        import dataclasses
+        vol = self._csi_volumes.get(key)
+        v = dataclasses.replace(vol)              # shallow: dicts shared
+        v.modify_index = 7                        # ok: fresh outer object
+        v.read_allocs.pop(aid, None)              # VIOLATION: inner dict
+
+    def snapshot_restore(self, doc):
+        self._csi_volumes = {}
+        self._csi_volumes[("ns", "v")] = doc      # ok: fresh rebind
+'''
+
+SELFTEST_PURITY = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(used, cap):
+    free = cap - used
+    total = np.asarray(free)                  # VIOLATION: np inside jit
+    return jnp.sum(free) + float(total.sum())  # VIOLATION: float(traced)
+
+
+kernel_jit = jax.jit(kernel, donate_argnums=(0,))
+
+
+def host_loop(used, cap):
+    out = kernel_jit(used, cap)
+    best = jnp.argmax(out)                    # VIOLATION: eager jnp
+    stale = used + 1                          # VIOLATION: donated reuse
+    return best, stale
+
+
+def collect(buf):
+    buf.block_until_ready()                   # VIOLATION: host sync
+    return buf
+'''
+
+SELFTEST_THREAD = '''
+import threading
+
+
+class ClusterServer:
+    def _on_raft_leader(self):
+        self.establish_leadership()           # VIOLATION: dies silently
+
+    def _guarded_loop(self):
+        while True:
+            try:
+                self.tick()
+            except Exception:
+                pass
+
+    def start(self):
+        RaftNode(on_leader=self._on_raft_leader)
+        threading.Thread(target=self._guarded_loop).start()   # ok
+
+    def run_scenario(self):
+        # ok: chaos-managed wrapper (runner joins it and surfaces the
+        # death via failed_ops), recognized by its name= prefix
+        threading.Thread(target=self._workload_loop, daemon=True,
+                         name=f"chaos-workload-{self.name}").start()
+
+    def _workload_loop(self):
+        self.drive()                          # no handler, but managed
+'''
+
+SELFTEST_PROC = '''
+import multiprocessing as mp
+
+
+def pool_main(idx):
+    run(idx)                                  # VIOLATION: no handler
+
+
+def pool_main_ok(idx):
+    try:
+        run(idx)
+    except Exception:
+        pass
+
+
+class Pool:
+    def spawn(self, ctx):
+        ctx.Process(target=pool_main).start()         # VIOLATION: unnamed
+        p = mp.Process(target=pool_main_ok,
+                       name="pool-worker-0")          # ok: named + handled
+        p.start()
+'''
+
+SELFTEST_RAWTIME = '''
+import time
+from time import monotonic as mono
+
+
+class HeartbeatTimers:
+    def expire(self, now=None):
+        t = now if now is not None else time.time()   # VIOLATION
+        return t
+
+    def backoff(self):
+        time.sleep(0.25)                              # VIOLATION
+
+    def deadline(self):
+        return mono() + 30.0                          # VIOLATION: alias
+
+    def lazy_from_alias(self):
+        from time import time as _t
+        return _t()                  # VIOLATION: nested from-import alias
+
+    def lazy_mod_alias(self):
+        import time as _clock
+        return _clock.time()         # VIOLATION: nested module alias
+
+    def ok_paths(self):
+        start = time.perf_counter()                   # ok: host duration
+        t = self.clock.time()                         # ok: injected seam
+        self.clock.sleep(0.1)                         # ok: injected seam
+        return start, t
+'''
+
+SELFTEST_LOCKORDER = '''
+import threading
+
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock = threading.Lock()
+        self.beta = beta
+
+    def enter_alpha(self):
+        with self._lock:
+            return 1
+
+    def step(self):
+        with self._lock:
+            # VIOLATION x2: closes the 3-lock cycle AND transitively
+            # re-enters Alpha._lock (non-reentrant) via the chain
+            self.beta.enter_beta()
+
+
+class Beta:
+    def __init__(self, gamma):
+        self._lock = threading.Lock()
+        self.gamma = gamma
+
+    def enter_beta(self):
+        with self._lock:
+            self.gamma.enter_gamma()          # edge Beta -> Gamma
+
+
+class Gamma:
+    def __init__(self, alpha):
+        self._lock = threading.Lock()
+        self.alpha = alpha
+
+    def enter_gamma(self):
+        with self._lock:
+            self.alpha.enter_alpha()          # edge Gamma -> Alpha
+
+
+class Sender:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self._conn = conn
+
+    def send_under_lock(self, buf):
+        with self._lock:
+            self._conn.send_bytes(buf)        # VIOLATION: blocks held
+
+    def send_clean(self, buf):
+        with self._lock:
+            payload = self._pack(buf)
+        self._conn.send_bytes(payload)        # ok: lock released first
+'''
+
+SELFTEST_LOCKORDER_CLEAN = '''
+import threading
+
+
+class Ordered:
+    def __init__(self, store):
+        self._lock = threading.Lock()
+        self.store = store
+
+    def step(self):
+        with self._lock:
+            self.compute_step()               # ok: A -> B, one direction
+
+    def compute_step(self):
+        return 1
+
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items = []
+
+    def dequeue(self, timeout):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(timeout)        # ok: waits on its OWN lock
+            return self._items.pop()
+'''
+
+SELFTEST_DETERMINISM = '''
+import os
+import random
+
+
+def canonical_trace(events, tags, path):
+    order = set(tags)
+    for t in order:                           # VIOLATION: set iteration
+        events.append(t)
+    names = ",".join({e.name for e in events})  # VIOLATION: set join
+    jitter = random.random()                  # VIOLATION: global RNG
+    events.sort(key=id)                       # VIOLATION: id-keyed sort
+    files = os.listdir(path)                  # VIOLATION: fs order
+    return names, jitter, files
+
+
+def canonical_clean(events, tags, path, rng):
+    for t in sorted(set(tags)):               # ok: sorted first
+        events.append(t)
+    jitter = rng.random()                     # ok: explicit instance
+    files = sorted(os.listdir(path))          # ok: sorted enumeration
+    return jitter, files
+'''
+
+SELFTEST_WIREPROTO = '''
+class Pool:
+    def _handle(self, child, op, payload):
+        if op == "deq":
+            return self._handle_deq(child, payload)
+        if op == "ack":
+            return payload["job"]     # VIOLATION: senders provide "id"
+        if op == "ghost":                     # VIOLATION: dead arm
+            return None
+        return None
+
+    def _handle_deq(self, child, payload):
+        return payload["n"]                   # ok: senders provide "n"
+
+
+class Proxy:
+    def __init__(self, chan):
+        self._chan = chan
+
+    def deq(self):
+        return self._chan.call("deq", {"n": 4})
+
+    def ack(self):
+        return self._chan.call("ack", {"id": 7})
+
+    def drop(self):
+        self._chan.notify("orphan", {})       # VIOLATION: no arm
+'''
+
+SELFTEST_WIREPROTO_CLEAN = '''
+class Pool:
+    def _handle(self, child, op, payload):
+        if op == "deq":
+            return self._handle_deq(child, payload)
+        if op == "ack":
+            return payload.get("job")         # ok: tolerant read
+        return None
+
+    def _handle_deq(self, child, payload):
+        return payload["n"]
+
+
+class Proxy:
+    def __init__(self, chan):
+        self._chan = chan
+
+    def deq(self):
+        return self._chan.call("deq", {"n": 4})
+
+    def ack(self):
+        return self._chan.call("ack", {"id": 7})
+'''
+
+
+def selftest() -> int:
+    from driver import analyze_source
+    ok = True
+
+    def expect(name: str, text: str, want: int, must_contain: str = ""
+               ) -> None:
+        nonlocal ok
+        got = [f for f in analyze_source(text, passes=(name,))
+               if f[2] == name]
+        if len(got) != want:
+            print(f"analyze selftest FAILED [{name}]: expected {want} "
+                  f"finding(s), got {len(got)}: {got}")
+            ok = False
+            return
+        if must_contain and not any(must_contain in f[3] for f in got):
+            print(f"analyze selftest FAILED [{name}]: no finding "
+                  f"mentions {must_contain!r}: {got}")
+            ok = False
+
+    expect("lock", SELFTEST_LOCK, 3, "outside")
+    expect("cow", SELFTEST_COW, 4, "_writable_")
+    expect("purity", SELFTEST_PURITY, 5, "DONATED")
+    expect("thread", SELFTEST_THREAD, 1, "_on_raft_leader")
+    expect("thread", SELFTEST_PROC, 2, "name=")
+    expect("rawtime", SELFTEST_RAWTIME, 5, "bypasses the injected")
+    expect("lockorder", SELFTEST_LOCKORDER, 3, "lock-order cycle")
+    expect("lockorder", SELFTEST_LOCKORDER, 3, "blocking call")
+    expect("lockorder", SELFTEST_LOCKORDER, 3, "re-acquired")
+    expect("lockorder", SELFTEST_LOCKORDER_CLEAN, 0)
+    expect("determinism", SELFTEST_DETERMINISM, 5, "unordered set")
+    expect("determinism", SELFTEST_DETERMINISM, 5, "filesystem")
+    expect("wireproto", SELFTEST_WIREPROTO, 3, "no dispatch")
+    expect("wireproto", SELFTEST_WIREPROTO, 3, "no send")
+    expect("wireproto", SELFTEST_WIREPROTO_CLEAN, 0)
+    # suppression: the same violations annotated away must go quiet
+    suppressed = SELFTEST_THREAD.replace(
+        "def _on_raft_leader(self):",
+        "def _on_raft_leader(self):  # analyze: ok thread")
+    expect("thread", suppressed, 0)
+    suppressed_lo = SELFTEST_LOCKORDER.replace(
+        "self._conn.send_bytes(buf)        # VIOLATION: blocks held",
+        "self._conn.send_bytes(buf)  # analyze: ok lockorder")
+    expect("lockorder", suppressed_lo, 2)
+    if ok:
+        print("analyze selftest ok: every pass caught its injected "
+              "violations (lock=3 cow=4 purity=5 thread=1+2 rawtime=5 "
+              "lockorder=3 determinism=5 wireproto=3, suppression "
+              "honored)")
+        return 0
+    return 1

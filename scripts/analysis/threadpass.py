@@ -1,0 +1,101 @@
+"""Pass `thread` — thread hygiene.
+
+A `threading.Thread(target=...)` target (or a raft `on_leader=` /
+`on_follower=` callback, which runs on a daemon thread) without
+top-level exception handling dies silently — a leadership callback that
+dies on `NotLeaderError` is how state desync starts.  The same rule
+covers `multiprocessing.Process(target=...)` (core/workerpool
+children): the target needs a top-level handler (an unhandled exception
+is only a one-line stderr trace in another process), and the Process
+needs a `name=` — unnamed workers are invisible in ps output and crash
+triage.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from common import Finding, _callee_name, _functions
+
+
+def _has_toplevel_handler(fn: ast.AST) -> bool:
+    """True when the function body protects its thread: a try/except at
+    body level, or directly inside While/For/With wrappers (a loop-body
+    try = per-iteration protection)."""
+    def scan(stmts, depth: int) -> bool:
+        for s in stmts:
+            if isinstance(s, ast.Try) and s.handlers:
+                return True
+            if (isinstance(s, (ast.While, ast.For, ast.With,
+                               ast.AsyncWith, ast.AsyncFor))
+                    and depth < 3 and scan(s.body, depth + 1)):
+                return True
+        return False
+    return scan(fn.body, 0)
+
+
+def check_thread(tree: ast.Module, path: str) -> List[Finding]:
+    funcs = {f.name: f for f in _functions(tree)}
+    out: List[Finding] = []
+    seen: Set[int] = set()
+
+    def resolve(expr: ast.AST):
+        if isinstance(expr, ast.Name):
+            return funcs.get(expr.id)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return funcs.get(expr.attr)
+        return None
+
+    def require(expr: ast.AST, kind: str) -> None:
+        target = resolve(expr)
+        if target is None or id(target) in seen:
+            return
+        seen.add(id(target))
+        if not _has_toplevel_handler(target):
+            out.append((path, target.lineno, "thread",
+                        f"{kind} `{target.name}` has no top-level "
+                        "exception handling — an unhandled exception "
+                        "kills the daemon thread silently"))
+
+    def chaos_managed(call: ast.Call) -> bool:
+        """Thread(..., name="chaos-...") wrappers are scenario-managed:
+        the chaos runner joins them with a timeout and surfaces failure
+        through failed_ops / the convergence verdict, so "dies silently"
+        does not apply — the death IS observed."""
+        for kw in call.keywords:
+            if kw.arg != "name":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return v.value.startswith("chaos-")
+            if isinstance(v, ast.JoinedStr) and v.values:
+                head = v.values[0]
+                return (isinstance(head, ast.Constant)
+                        and isinstance(head.value, str)
+                        and head.value.startswith("chaos-"))
+        return False
+
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        cn = _callee_name(n)
+        if cn == "Thread" and not chaos_managed(n):
+            for kw in n.keywords:
+                if kw.arg == "target":
+                    require(kw.value, "thread target")
+        if cn == "Process":
+            if not any(kw.arg == "name" for kw in n.keywords):
+                out.append((path, n.lineno, "thread",
+                            "Process(...) without a name= — unnamed "
+                            "worker processes are invisible in ps "
+                            "output and crash triage"))
+            for kw in n.keywords:
+                if kw.arg == "target":
+                    require(kw.value, "process target")
+        for kw in n.keywords:
+            if kw.arg in ("on_leader", "on_follower"):
+                require(kw.value, f"daemon callback ({kw.arg}=)")
+    return out

@@ -1,0 +1,327 @@
+"""Pass `wireproto` — codec / RPC drift detection.
+
+Three sub-checks:
+
+  1. RPC op-table parity (core/workerpool.py): every op name a child
+     channel sends (`chan.call("op", ...)` / `chan.notify("op", ...)`)
+     must have a matching `if op == "op":` arm in the parent dispatch,
+     and every dispatch arm must have at least one sender — a dead arm
+     is a renamed/removed op waiting to desync a mixed build.
+  2. Payload-key drift: for each op whose send sites build a dict
+     literal, every key the handler reads STRICTLY (`payload["k"]`,
+     following one level into `self._handle_*` helpers) must be
+     provided by some send site.  `.get("k")` reads are tolerant by
+     contract and exempt.
+  3. Wire-struct manifest: the field set of every dataclass that rides
+     the wire codec (nomad_tpu.structs + ops/engine — the modules
+     `register_module` feeds) is pinned in
+     scripts/analysis/wire_manifest.json.  Field drift without
+     regenerating the manifest fails; regeneration bumps the manifest
+     version, which must then match `SCHEMA_VERSION` in core/wire.py —
+     so a field-set change cannot land without a frame version bump.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from common import Finding, _dotted, _functions
+
+
+# ------------------------------------------------- RPC table parity
+
+def _dispatch_funcs(tree: ast.Module):
+    """Functions that dispatch on an `op` parameter."""
+    for fn in _functions(tree):
+        args = [a.arg for a in fn.args.args]
+        if "op" in args:
+            yield fn, args
+
+
+def _op_arms(fn: ast.AST) -> List[Tuple[str, ast.If]]:
+    """(op literal, If node) for every `op == "lit"` compare arm."""
+    arms = []
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.If):
+            continue
+        tests = [n.test]
+        if isinstance(n.test, ast.BoolOp):
+            tests = list(n.test.values)
+        for t in tests:
+            if not (isinstance(t, ast.Compare)
+                    and isinstance(t.left, ast.Name)
+                    and t.left.id == "op"
+                    and len(t.ops) == 1):
+                continue
+            cmp = t.comparators[0]
+            if (isinstance(t.ops[0], ast.Eq)
+                    and isinstance(cmp, ast.Constant)
+                    and isinstance(cmp.value, str)):
+                arms.append((cmp.value, n))
+            elif (isinstance(t.ops[0], ast.In)
+                    and isinstance(cmp, (ast.Tuple, ast.List, ast.Set))):
+                # `op in ("ready", "pull"):` — one arm per member
+                for el in cmp.elts:
+                    if (isinstance(el, ast.Constant)
+                            and isinstance(el.value, str)):
+                        arms.append((el.value, n))
+    return arms
+
+
+def _send_sites(tree: ast.Module) -> List[Tuple[str, ast.Call]]:
+    """(op literal, call node) for chan.call / chan.notify sends."""
+    sites = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("call", "notify")):
+            continue
+        recv = (_dotted(f.value) or "").lower()
+        if "chan" not in recv:
+            continue
+        if n.args and isinstance(n.args[0], ast.Constant) \
+                and isinstance(n.args[0].value, str):
+            sites.append((n.args[0].value, n))
+    return sites
+
+
+def _strict_payload_reads(body: List[ast.AST], payload_name: str,
+                          tree: ast.Module, funcs: Dict[str, ast.AST],
+                          depth: int = 0) -> List[Tuple[str, int]]:
+    """Keys read as `payload["k"]` in an arm body, following one level
+    into `self._handle_*(…, payload)` helper calls."""
+    reads: List[Tuple[str, int]] = []
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if (isinstance(n, ast.Subscript)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == payload_name
+                    and isinstance(n.slice, ast.Constant)
+                    and isinstance(n.slice.value, str)):
+                reads.append((n.slice.value, n.lineno))
+            if depth == 0 and isinstance(n, ast.Call):
+                cn = None
+                if isinstance(n.func, ast.Attribute):
+                    cn = n.func.attr
+                elif isinstance(n.func, ast.Name):
+                    cn = n.func.id
+                helper = funcs.get(cn or "")
+                if helper is None:
+                    continue
+                # position of the forwarded payload among the args
+                for i, a in enumerate(n.args):
+                    if (isinstance(a, ast.Name)
+                            and a.id == payload_name):
+                        params = [p.arg for p in helper.args.args]
+                        if params and params[0] == "self":
+                            params = params[1:]
+                        if i < len(params):
+                            reads.extend(_strict_payload_reads(
+                                helper.body, params[i], tree, funcs,
+                                depth=1))
+    return reads
+
+
+def _check_rpc(tree: ast.Module, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    funcs = {f.name: f for f in _functions(tree)}
+    handled: Dict[str, Tuple[ast.AST, List[ast.AST], str]] = {}
+    for fn, args in _dispatch_funcs(tree):
+        payload_name = "payload" if "payload" in args else ""
+        for op, arm in _op_arms(fn):
+            prev = handled.get(op)
+            body = list(arm.body)
+            if prev is not None:
+                prev[1].extend(body)
+            else:
+                handled[op] = (arm, body, payload_name)
+    sites = _send_sites(tree)
+    if not handled and not sites:
+        return out
+    sent_ops: Dict[str, List[ast.Call]] = {}
+    for op, call in sites:
+        sent_ops.setdefault(op, []).append(call)
+
+    for op, calls in sorted(sent_ops.items()):
+        if op not in handled:
+            out.append((path, calls[0].lineno, "wireproto",
+                        f"RPC op {op!r} is sent but has no dispatch "
+                        "arm — the receiver will reject or drop it"))
+    for op, (arm, _, _) in sorted(handled.items()):
+        if op not in sent_ops:
+            out.append((path, arm.lineno, "wireproto",
+                        f"RPC dispatch arm for op {op!r} has no send "
+                        "site — dead handler or renamed sender"))
+
+    # payload-key drift (only for ops with at least one dict-literal
+    # send — a variable payload is opaque to static analysis)
+    for op, calls in sorted(sent_ops.items()):
+        info = handled.get(op)
+        if info is None or not info[2]:
+            continue
+        arm, body, payload_name = info
+        sent_keys: Set[str] = set()
+        opaque = True
+        for c in calls:
+            if len(c.args) < 2:
+                continue
+            d = c.args[1]
+            if isinstance(d, ast.Dict):
+                opaque = False
+                for k in d.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        sent_keys.add(k.value)
+                    else:
+                        opaque = True   # **spread / computed key
+            else:
+                opaque = True
+        if opaque:
+            continue
+        for key, lineno in _strict_payload_reads(body, payload_name,
+                                                 tree, funcs):
+            if key not in sent_keys:
+                out.append((path, lineno, "wireproto",
+                            f"handler for op {op!r} reads "
+                            f"payload[{key!r}] but no send site "
+                            "provides that key — KeyError on the "
+                            "attendant thread at runtime"))
+    return out
+
+
+# ---------------------------------------------- wire-struct manifest
+
+def _dataclass_fields(tree: ast.Module) -> Dict[str, List[str]]:
+    """ClassName -> sorted field names for every @dataclass in the
+    module (annotated class-level assignments; ClassVar excluded)."""
+    structs: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        is_dc = False
+        for d in node.decorator_list:
+            name = None
+            if isinstance(d, ast.Name):
+                name = d.id
+            elif isinstance(d, ast.Attribute):
+                name = d.attr
+            elif isinstance(d, ast.Call):
+                f = d.func
+                name = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+            if name == "dataclass":
+                is_dc = True
+        if not is_dc:
+            continue
+        fields: List[str] = []
+        for stmt in node.body:
+            if (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)):
+                ann = ast.dump(stmt.annotation)
+                if "ClassVar" in ann:
+                    continue
+                fields.append(stmt.target.id)
+        structs[node.name] = sorted(fields)
+    return structs
+
+
+def compute_struct_manifest(struct_files: Dict[str, ast.Module],
+                            version: int) -> dict:
+    structs: Dict[str, List[str]] = {}
+    for _, tree in sorted(struct_files.items()):
+        for name, fields in _dataclass_fields(tree).items():
+            structs.setdefault(name, fields)
+    return {"schema_version": version,
+            "structs": {k: structs[k] for k in sorted(structs)}}
+
+
+def wire_schema_version(wire_tree: ast.Module) -> Tuple[int, int]:
+    """(value, lineno) of `SCHEMA_VERSION = <int>` in core/wire.py, or
+    (0, 0) when absent."""
+    for node in wire_tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "SCHEMA_VERSION"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            return node.value.value, node.lineno
+    return 0, 0
+
+
+def check_manifest(struct_files: Dict[str, ast.Module],
+                   manifest: Optional[dict],
+                   wire_tree: Optional[ast.Module],
+                   wire_path: str,
+                   manifest_path: str) -> List[Finding]:
+    out: List[Finding] = []
+    if manifest is None:
+        anchor = sorted(struct_files)[0] if struct_files else wire_path
+        out.append((anchor, 1, "wireproto",
+                    f"wire-struct manifest missing at {manifest_path} "
+                    "— run analyze.py --update-manifest"))
+        return out
+    pinned = manifest.get("structs", {})
+    # class def line index for anchoring drift findings
+    def_lines: Dict[str, Tuple[str, int]] = {}
+    live: Dict[str, List[str]] = {}
+    for path, tree in sorted(struct_files.items()):
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                def_lines.setdefault(node.name, (path, node.lineno))
+        for name, fields in _dataclass_fields(tree).items():
+            live.setdefault(name, fields)
+    drift = False
+    for name in sorted(set(pinned) | set(live)):
+        if name not in live:
+            anchor = sorted(struct_files)[0]
+            out.append((anchor, 1, "wireproto",
+                        f"wire struct {name!r} pinned in the manifest "
+                        "no longer exists — run --update-manifest "
+                        "(and bump SCHEMA_VERSION in core/wire.py)"))
+            drift = True
+        elif name not in pinned:
+            path, lineno = def_lines[name]
+            out.append((path, lineno, "wireproto",
+                        f"wire struct {name!r} is not pinned in the "
+                        "manifest — run --update-manifest (and bump "
+                        "SCHEMA_VERSION in core/wire.py)"))
+            drift = True
+        elif sorted(pinned[name]) != live[name]:
+            path, lineno = def_lines[name]
+            added = sorted(set(live[name]) - set(pinned[name]))
+            gone = sorted(set(pinned[name]) - set(live[name]))
+            out.append((path, lineno, "wireproto",
+                        f"wire struct {name!r} field set drifted from "
+                        f"the manifest (added={added} removed={gone}) "
+                        "— run --update-manifest and bump "
+                        "SCHEMA_VERSION in core/wire.py"))
+            drift = True
+    if wire_tree is not None and not drift:
+        ver, lineno = wire_schema_version(wire_tree)
+        pin_ver = int(manifest.get("schema_version", 0))
+        if ver != pin_ver:
+            out.append((wire_path, lineno or 1, "wireproto",
+                        f"manifest schema_version={pin_ver} but "
+                        f"core/wire.py SCHEMA_VERSION={ver} — the "
+                        "struct field sets changed without a frame "
+                        "version bump (set them equal)"))
+    return out
+
+
+def check_wireproto(files: Dict[str, ast.Module],
+                    struct_files: Optional[Dict[str, ast.Module]] = None,
+                    manifest: Optional[dict] = None,
+                    wire_tree: Optional[ast.Module] = None,
+                    wire_path: str = "",
+                    manifest_path: str = "") -> List[Finding]:
+    out: List[Finding] = []
+    for path in sorted(files):
+        out.extend(_check_rpc(files[path], path))
+    if struct_files is not None:
+        out.extend(check_manifest(struct_files, manifest, wire_tree,
+                                  wire_path, manifest_path))
+    return out

@@ -1,1156 +1,45 @@
-#!/usr/bin/env python
-"""Invariant analyzer: project-specific static-analysis passes over the
-repo's ASTs (zero external dependencies, like scripts/lint.py — lint
-catches generic mistakes, THIS tool encodes the invariants whose
-violations keep recurring as real bugs here).
+"""Static invariant analyzer — compatibility shim.
 
-Passes (suppress a finding with `# analyze: ok <pass>` on its line):
+The implementation lives in scripts/analysis/ (one module per pass plus
+common.py, driver.py, selftests.py).  This shim keeps the historical
+entry points working unchanged:
 
-  lock    Lock discipline.  A `*_locked` / `_writable_*` helper mutates
-          or reads head state that only the store/broker lock makes
-          consistent — it may only be called from another such helper or
-          from a lexical `with self._lock:` (or `.locked()` / condition)
-          scope.  Public entry points must acquire before delegating.
+    python scripts/analyze.py [--selftest] [--json PATH]
+                              [--strict-suppressions] [--update-manifest]
 
-  cow     COW / snapshot-isolation discipline (state_store.py).  Objects
-          reachable from a snapshot are immutable: in-place writes to
-          the claim-vol / alloc / block / eval tables (or to objects
-          fetched from them) must flow through a `_writable_*` helper
-          whose returned copy is private to the head for this snapshot
-          cycle.  Mutating a table object obtained any other way — or a
-          `dataclasses.replace` shallow copy, whose inner dicts are
-          still shared — is exactly the `_materialize_block_locked`
-          snapshot leak fixed twice before this pass existed.
+    sys.path.insert(0, "scripts"); from analyze import analyze_source
 
-  purity  JAX purity & donation (ops/, parallel/, core/wavepipe.py).
-          Host-sync calls (`block_until_ready`, host `np.*`, `float()` /
-          `bool()` on traced values, `.item()`) inside jit-traced code
-          break async dispatch; heavy `jnp` compute in non-jit host
-          paths pays per-op dispatch in the hot loop; and a buffer
-          passed at a `donate_argnums` position is DEAD after the call —
-          XLA reuses its memory, so any later read of the same
-          expression reads garbage.
+    importlib.util.spec_from_file_location("analyze", ".../analyze.py")
 
-  thread  Thread hygiene.  A `threading.Thread(target=...)` target (or a
-          raft `on_leader=` / `on_follower=` callback, which runs on a
-          daemon thread) without top-level exception handling dies
-          silently — a leadership callback that dies on `NotLeaderError`
-          is how state desync starts (VERDICT weak #6).  The same rule
-          covers `multiprocessing.Process(target=...)` (core/workerpool
-          children): the target needs a top-level handler (an unhandled
-          exception is only a one-line stderr trace in another process),
-          and the Process needs a `name=` — unnamed workers are
-          invisible in ps output and crash triage.
-
-  rawtime Injected-timebase discipline (nomad_tpu/core/).  A raw
-          `time.time()` / `time.monotonic()` / `time.sleep()` call in
-          the cluster plane bypasses the chaos Clock seam
-          (chaos/clock.py), so a virtual-time soak silently mixes wall
-          and virtual timelines — heartbeat TTLs fire early, SLO
-          windows span the wrong samples, and the same seed stops
-          replaying.  Route through `self.clock` / a module-level bound
-          Clock instead (`time.perf_counter()` stays legal: host-side
-          duration measurement is not cluster time).
-
-`--selftest` runs every pass against an injected violation of its exact
-bug class and exits 0 only when each pass catches its own and stays
-quiet on the clean shapes — the CI stage proving the net has no hole.
+Passes: lock, cow, purity, thread, rawtime, lockorder, determinism,
+wireproto.  Suppress a finding with `# analyze: ok <pass>` (or
+`# analyze: ok *`) on its line; stale suppressions are reported and
+fail the run under --strict-suppressions.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-ROOT = Path(__file__).resolve().parent.parent
-
-Finding = Tuple[str, int, str, str]        # (path, lineno, pass, message)
-
-PASS_NAMES = ("lock", "cow", "purity", "thread", "rawtime")
-
-
-# --------------------------------------------------------------- helpers
-
-def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
-    """ast.walk that does not descend into nested function/class defs
-    (their bodies run in a different dynamic context)."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        n = stack.pop()
-        yield n
-        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.ClassDef, ast.Lambda)):
-            stack.extend(ast.iter_child_nodes(n))
-
-
-def _functions(tree: ast.Module):
-    """Every function/method def in the module (flat)."""
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    """The first attribute name hanging off `self` in an access chain
-    (`self._allocs[k].x.pop` -> '_allocs'), or None."""
-    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
-        if (isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "self"):
-            return node.attr
-        node = node.func if isinstance(node, ast.Call) else node.value
-    return None
-
-
-def _root_name(node: ast.AST) -> Optional[str]:
-    """The root Name of an access chain (`vol.read_allocs.pop` -> 'vol'),
-    or None when the chain roots elsewhere (a call result, self, ...)."""
-    while isinstance(node, (ast.Attribute, ast.Subscript)):
-        node = node.value
-    return node.id if isinstance(node, ast.Name) else None
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    """Dotted path of a pure Name/Attribute chain ('inp.used0'), else
-    None (subscripts and calls are not stable paths)."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if not isinstance(node, ast.Name):
-        return None
-    parts.append(node.id)
-    return ".".join(reversed(parts))
-
-
-def _callee_name(call: ast.Call) -> Optional[str]:
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    if isinstance(f, ast.Name):
-        return f.id
-    return None
-
-
-# ------------------------------------------------------- pass A: lock
-
-LOCK_ATTRS = {"_lock", "lock", "_cv", "_index_cv", "_apply_cv",
-              "_tick_lock"}
-LOCKED_PREFIXES = ("_writable_",)
-
-
-def _is_lock_expr(node: ast.AST, aliases: Set[str]) -> bool:
-    """Expressions that acquire the protecting lock when used in
-    `with ...:` — the lock/condition attribute itself, a `.locked()`
-    accessor, or a local alias of either."""
-    if isinstance(node, ast.Attribute) and node.attr in LOCK_ATTRS:
-        return True
-    if isinstance(node, ast.Call):
-        f = node.func
-        if isinstance(f, ast.Attribute) and f.attr == "locked":
-            return True
-    if isinstance(node, ast.IfExp):
-        return (_is_lock_expr(node.body, aliases)
-                or _is_lock_expr(node.orelse, aliases))
-    if isinstance(node, ast.Name) and node.id in aliases:
-        return True
-    return False
-
-
-def _needs_lock(name: Optional[str]) -> bool:
-    if not name:
-        return False
-    return name.endswith("_locked") or name.startswith(LOCKED_PREFIXES)
-
-
-def check_lock(tree: ast.Module, path: str) -> List[Finding]:
-    out: List[Finding] = []
-    for fn in _functions(tree):
-        holder = _needs_lock(fn.name)
-        aliases = {
-            t.id
-            for stmt in _walk_skip_defs(fn)
-            if isinstance(stmt, ast.Assign)
-            and _is_lock_expr(stmt.value, set())
-            for t in stmt.targets if isinstance(t, ast.Name)
-        }
-
-        # flag calls attached to each statement's own expressions;
-        # compound bodies recurse with the updated lock state
-        def visit2(stmts, inlock, fn=fn, aliases=aliases, holder=holder):
-            for stmt in stmts:
-                if isinstance(stmt, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef, ast.ClassDef)):
-                    continue      # nested defs get their own analysis
-                here = inlock
-                if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                    if any(_is_lock_expr(i.context_expr, aliases)
-                           for i in stmt.items):
-                        here = True
-                # expressions attached directly to this statement
-                # (excluding nested statement bodies)
-                exprs: List[ast.AST] = []
-                for field, value in ast.iter_fields(stmt):
-                    if field in ("body", "orelse", "finalbody",
-                                 "handlers"):
-                        continue
-                    if isinstance(value, ast.AST):
-                        exprs.append(value)
-                    elif isinstance(value, list):
-                        exprs.extend(v for v in value
-                                     if isinstance(v, ast.AST))
-                if not (holder or here):
-                    for e in exprs:
-                        for n in [e, *_walk_skip_defs(e)]:
-                            if (isinstance(n, ast.Call)
-                                    and _needs_lock(_callee_name(n))):
-                                out.append((
-                                    path, n.lineno, "lock",
-                                    f"{_callee_name(n)}() called outside "
-                                    "a lock scope (hold the store lock "
-                                    "or be *_locked yourself)"))
-                for field in ("body", "orelse", "finalbody"):
-                    sub = getattr(stmt, field, None)
-                    if sub:
-                        visit2(sub, here)
-                for h in getattr(stmt, "handlers", ()):
-                    visit2(h.body, here)
-
-        visit2(fn.body, False)
-    return out
-
-
-# -------------------------------------------------------- pass B: cow
-
-# tables reachable from a StateSnapshot (or published like them): the
-# head may only mutate PRIVATE copies of these
-SNAP_TABLES = {
-    "_nodes", "_jobs", "_job_versions", "_evals", "_allocs",
-    "_deployments", "_namespaces", "_node_pools", "_csi_volumes",
-    "_acl_policies", "_acl_tokens", "_acl_by_secret",
-    "_acl_auth_methods", "_acl_binding_rules", "_variables", "_services",
-    "_allocs_by_node", "_allocs_by_job", "_evals_by_job",
-    "_alloc_blocks", "_blocks_by_job", "_blocks_by_node",
-}
-
-MUTATORS = {"pop", "update", "setdefault", "clear", "add", "remove",
-            "discard", "append", "extend", "insert", "popitem"}
-
-FRESH_CALLS = {"dict", "list", "set", "frozenset", "sorted"}
-
-
-def _is_fresh_expr(node: ast.AST) -> bool:
-    """A brand-new container private to this frame."""
-    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
-                         ast.DictComp, ast.SetComp)):
-        return True
-    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
-            and node.func.id in FRESH_CALLS):
-        return True
-    return False
-
-
-def _is_writable_call(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and _callee_name(node) is not None
-            and _callee_name(node).startswith("_writable_"))
-
-
-def _is_replace_call(node: ast.AST) -> bool:
-    """dataclasses.replace(...) — a SHALLOW copy: inner claim dicts are
-    still the snapshot's unless explicitly replaced, so the result stays
-    snapshot-tainted for in-place mutation purposes."""
-    return (isinstance(node, ast.Call)
-            and _callee_name(node) == "replace")
-
-
-def _snap_rooted(node: ast.AST) -> bool:
-    """Expression that reads out of a snapshot-shared table:
-    self.<SNAP>..., self.<SNAP>.get(...), self.<SNAP>.values(), ..."""
-    attr = _self_attr(node)
-    return attr in SNAP_TABLES
-
-
-def check_cow(tree: ast.Module, path: str) -> List[Finding]:
-    """Two taint grades: `snap` objects came straight out of a
-    snapshot-shared table (NO mutation allowed), `shallow` objects are
-    dataclasses.replace copies — a fresh outer object whose inner
-    containers are still the snapshot's, so scalar attribute writes are
-    fine but inner-container mutation is the leak."""
-    out: List[Finding] = []
-    for fn in _functions(tree):
-        blessed: Set[str] = set()
-        tainted: Set[str] = set()       # snap grade
-        shallow: Set[str] = set()
-        fresh_attrs: Set[str] = set()
-
-        stmts = list(_walk_skip_defs(fn))
-        # attributes wholesale-reassigned to a fresh container in this
-        # function (snapshot_restore's reset-then-fill shape): in-place
-        # writes to them cannot reach a snapshot taken before the call
-        for s in stmts:
-            if isinstance(s, ast.Assign) and _is_fresh_expr(s.value):
-                for t in s.targets:
-                    if (isinstance(t, ast.Attribute)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id == "self"):
-                        fresh_attrs.add(t.attr)
-            if isinstance(s, ast.Assign) and isinstance(s.value, ast.Dict):
-                for t in s.targets:
-                    if (isinstance(t, ast.Attribute)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id == "self"):
-                        fresh_attrs.add(t.attr)
-
-        def classify(value: ast.AST) -> Optional[str]:
-            if _is_writable_call(value) or _is_fresh_expr(value):
-                return "blessed"
-            if _is_replace_call(value):
-                return "shallow"
-            if _snap_rooted(value):
-                return "tainted"
-            root = _root_name(value)
-            if isinstance(value, ast.Call):
-                return None          # other call results: neutral copy
-            if root in blessed:
-                return "blessed"
-            if root in tainted:
-                return "tainted"
-            if root in shallow:
-                return "shallow"
-            return None
-
-        def bind(target: ast.AST, klass: Optional[str]) -> None:
-            names = [n.id for n in ast.walk(target)
-                     if isinstance(n, ast.Name)
-                     and isinstance(n.ctx, ast.Store)]
-            for nm in names:
-                if klass == "blessed":
-                    blessed.add(nm)
-                    tainted.discard(nm)
-                    shallow.discard(nm)
-                elif klass == "tainted" and nm not in blessed:
-                    tainted.add(nm)
-                elif klass == "shallow" and nm not in blessed:
-                    shallow.add(nm)
-
-        # fixed-point propagation over the function's assignments
-        for _ in range(4):
-            before = (len(blessed), len(tainted), len(shallow))
-            for s in stmts:
-                if isinstance(s, ast.Assign):
-                    k = classify(s.value)
-                    for t in s.targets:
-                        bind(t, k)
-                elif isinstance(s, (ast.For, ast.AsyncFor)):
-                    it = s.iter
-                    k = None
-                    if _snap_rooted(it):
-                        k = "tainted"
-                    elif (_root_name(it) in tainted
-                          and not isinstance(it, ast.Call)):
-                        k = "tainted"
-                    elif (isinstance(it, ast.Call)
-                          and _root_name(it.func) in tainted):
-                        k = "tainted"       # tainted.values()/.items()
-                    bind(s.target, k)
-            if (len(blessed), len(tainted), len(shallow)) == before:
-                break
-
-        def flag(node: ast.AST, what: str) -> None:
-            out.append((path, node.lineno, "cow",
-                        f"{what} — snapshot-shared state must be "
-                        "mutated only through a _writable_* copy"))
-
-        for n in stmts:
-            # subscript / attribute stores
-            if isinstance(n, (ast.Assign, ast.AugAssign)):
-                targets = (n.targets if isinstance(n, ast.Assign)
-                           else [n.target])
-                for t in targets:
-                    if isinstance(t, ast.Subscript):
-                        attr = _self_attr(t.value)
-                        root = _root_name(t.value)
-                        if (attr in SNAP_TABLES
-                                and attr not in fresh_attrs):
-                            flag(t, f"direct write into self.{attr}[...]")
-                        elif root in tainted:
-                            flag(t, "item write on a snapshot-fetched "
-                                    "object")
-                        elif (root in shallow
-                              and isinstance(t.value, ast.Attribute)):
-                            flag(t, "item write into an inner container "
-                                    "of a dataclasses.replace shallow "
-                                    "copy (still the snapshot's dict)")
-                    elif isinstance(t, ast.Attribute):
-                        if _root_name(t.value) in tainted:
-                            flag(t, "attribute write on a "
-                                    "snapshot-fetched object")
-            if isinstance(n, ast.Delete):
-                for t in n.targets:
-                    if isinstance(t, ast.Subscript):
-                        attr = _self_attr(t.value)
-                        if attr in SNAP_TABLES and attr not in fresh_attrs:
-                            flag(t, f"del on self.{attr}[...]")
-                        elif _root_name(t.value) in tainted:
-                            flag(t, "del on a snapshot-fetched object")
-            # mutator method calls
-            if (isinstance(n, ast.Call)
-                    and isinstance(n.func, ast.Attribute)
-                    and n.func.attr in MUTATORS):
-                obj = n.func.value
-                attr = _self_attr(obj)
-                root = _root_name(obj)
-                if attr in SNAP_TABLES and attr not in fresh_attrs:
-                    flag(n, f"self.{attr}.{n.func.attr}(...) in place")
-                elif root in tainted:
-                    flag(n, f".{n.func.attr}(...) on a snapshot-fetched "
-                            "object")
-                elif (root in shallow
-                      and isinstance(obj, (ast.Attribute, ast.Subscript))):
-                    flag(n, f".{n.func.attr}(...) on an inner container "
-                            "of a dataclasses.replace shallow copy "
-                            "(still the snapshot's dict)")
-    return out
-
-
-# ----------------------------------------------------- pass C: purity
-
-HEAVY_JNP = {"where", "sum", "argsort", "sort", "argmax", "argmin",
-             "cumsum", "dot", "matmul", "einsum", "take_along_axis",
-             "top_k", "mean", "prod", "nonzero", "unique"}
-
-NP_ALIASES = {"np", "numpy"}
-JNP_ALIASES = {"jnp"}
-
-
-# transforms that TRACE the function they wrap: a Name passed to one of
-# these runs under jit/trace semantics, not eagerly on the host
-TRACE_WRAPPERS = {"jit", "shard_map", "vmap", "pmap", "scan",
-                  "fori_loop", "while_loop", "cond", "remat",
-                  "checkpoint", "grad", "value_and_grad"}
-
-
-def _jit_call(node: ast.AST) -> bool:
-    """A call to jax.jit / jit."""
-    if not isinstance(node, ast.Call):
-        return False
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr == "jit":
-        return True
-    if isinstance(f, ast.Name) and f.id == "jit":
-        return True
-    return False
-
-
-def _trace_wrapper_call(node: ast.AST) -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    name = _callee_name(node)
-    return name in TRACE_WRAPPERS
-
-
-class _ModuleInfo:
-    __slots__ = ("path", "tree", "funcs", "imports", "jit_seeds",
-                 "jit_lambdas", "donated")
-
-    def __init__(self, path: str, tree: ast.Module):
-        self.path = path
-        self.tree = tree
-        # name -> ALL defs carrying it (mesh.py's jit factories each
-        # define a local `f`; a plain dict would keep only one)
-        self.funcs: Dict[str, List[ast.AST]] = {}
-        for f in _functions(tree):
-            self.funcs.setdefault(f.name, []).append(f)
-        # local name -> (module stem, source name) for from-imports
-        self.imports: Dict[str, Tuple[str, str]] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ImportFrom) and node.module:
-                stem = node.module.split(".")[-1]
-                for a in node.names:
-                    if a.name != "*":
-                        self.imports[a.asname or a.name] = (stem, a.name)
-        self.jit_seeds: Set[str] = set()
-        self.jit_lambdas: List[ast.Lambda] = []
-        # jitted-callable local name -> donated positional indexes
-        self.donated: Dict[str, Tuple[int, ...]] = {}
-        for node in ast.walk(tree):
-            if _trace_wrapper_call(node):
-                # every Name reachable in the wrapper's args is traced —
-                # covers partial(_kernel, ...) indirection too
-                for a in node.args:
-                    for sub in ast.walk(a):
-                        if isinstance(sub, ast.Name):
-                            self.jit_seeds.add(sub.id)
-                        elif isinstance(sub, ast.Lambda):
-                            self.jit_lambdas.append(sub)
-            if isinstance(node, ast.FunctionDef):
-                for d in node.decorator_list:
-                    if _jit_call(d) or (
-                            isinstance(d, ast.Attribute)
-                            and d.attr == "jit") or (
-                            isinstance(d, ast.Name) and d.id == "jit"):
-                        self.jit_seeds.add(node.name)
-            # NAME = jax.jit(fn, donate_argnums=(k,...))
-            if isinstance(node, ast.Assign) and _jit_call(node.value):
-                dons: Tuple[int, ...] = ()
-                for kw in node.value.keywords:
-                    if kw.arg == "donate_argnums":
-                        vals = []
-                        for e in ast.walk(kw.value):
-                            if (isinstance(e, ast.Constant)
-                                    and isinstance(e.value, int)):
-                                vals.append(e.value)
-                        dons = tuple(vals)
-                if dons:
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            self.donated[t.id] = dons
-
-
-def _purity_traced_defs(mods: Dict[str, _ModuleInfo]) -> Set[int]:
-    """id()s of every function def reachable from a jax.jit seed —
-    through any NAME REFERENCE inside traced code, not just direct
-    calls: `jax.lax.scan(step, ...)` traces `step` without calling it by
-    name, and a helper imported from a sibling kernel module is traced
-    when a traced function references it.  Defs nested inside a traced
-    def only ever run under trace and count too.  Over-approximation is
-    deliberate: marking a host helper traced can only silence the eager
-    host-path heuristic, never invent a finding."""
-    traced: Set[int] = set()
-    work: List[Tuple[str, ast.AST]] = []
-
-    def mark(stem: str, fn: ast.AST) -> None:
-        if id(fn) in traced:
-            return
-        traced.add(id(fn))
-        work.append((stem, fn))
-        for sub in ast.walk(fn):
-            if (sub is not fn
-                    and isinstance(sub, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef))):
-                traced.add(id(sub))
-
-    for stem, mi in mods.items():
-        for name in mi.jit_seeds:
-            for fn in mi.funcs.get(name, ()):
-                mark(stem, fn)
-    while work:
-        stem, fn = work.pop()
-        mi = mods[stem]
-        for n in ast.walk(fn):
-            if not (isinstance(n, ast.Name)
-                    and isinstance(n.ctx, ast.Load)):
-                continue
-            if n.id in mi.funcs:
-                for f2 in mi.funcs[n.id]:
-                    mark(stem, f2)
-            elif n.id in mi.imports:
-                src_stem, src_name = mi.imports[n.id]
-                if src_stem in mods:
-                    for f2 in mods[src_stem].funcs.get(src_name, ()):
-                        mark(src_stem, f2)
-    return traced
-
-
-def _branch_paths(fn: ast.AST) -> Dict[int, Tuple]:
-    """id(node) -> tuple of (id(branch stmt), arm) ancestors — two nodes
-    whose paths first differ on the same statement with different arms
-    can never execute in the same pass (if/else, try/except)."""
-    paths: Dict[int, Tuple] = {}
-
-    def go(node: ast.AST, path: Tuple) -> None:
-        for field, value in ast.iter_fields(node):
-            kids = value if isinstance(value, list) else [value]
-            for k in kids:
-                if not isinstance(k, ast.AST):
-                    continue
-                sub = path
-                if (isinstance(node, ast.If)
-                        and field in ("body", "orelse")):
-                    sub = path + ((id(node), field),)
-                elif (isinstance(node, ast.Try)
-                        and field in ("body", "handlers", "orelse")):
-                    sub = path + ((id(node), field),)
-                paths[id(k)] = sub
-                go(k, sub)
-
-    paths[id(fn)] = ()
-    go(fn, ())
-    return paths
-
-
-def _exclusive(p1: Tuple, p2: Tuple) -> bool:
-    for e1, e2 in zip(p1, p2):
-        if e1 == e2:
-            continue
-        return e1[0] == e2[0] and e1[1] != e2[1]
-    return False
-
-
-def check_purity(files: Dict[str, ast.Module]) -> List[Finding]:
-    mods: Dict[str, _ModuleInfo] = {}
-    for path, tree in files.items():
-        stem = Path(path).stem
-        mods[stem] = _ModuleInfo(path, tree)
-    traced = _purity_traced_defs(mods)
-    # donated callables visible across the scoped modules by import
-    donated_global: Dict[Tuple[str, str], Tuple[int, ...]] = {}
-    for stem, mi in mods.items():
-        for name, dons in mi.donated.items():
-            donated_global[(stem, name)] = dons
-    out: List[Finding] = []
-
-    def check_traced_body(body: ast.AST, path: str) -> None:
-        for n in ast.walk(body):
-            if not isinstance(n, ast.Call):
-                continue
-            f = n.func
-            if (isinstance(f, ast.Attribute)
-                    and _root_name(f) in NP_ALIASES):
-                out.append((path, n.lineno, "purity",
-                            f"host numpy call np.{f.attr}(...) inside "
-                            "jit-traced code (silent device->host sync "
-                            "or constant fold)"))
-            if (isinstance(f, ast.Attribute)
-                    and f.attr in ("item", "tolist")):
-                out.append((path, n.lineno, "purity",
-                            f".{f.attr}() inside jit-traced code forces "
-                            "a host sync"))
-            if (isinstance(f, ast.Name) and f.id in ("float", "bool")
-                    and n.args
-                    and not all(isinstance(a, ast.Constant)
-                                for a in n.args)):
-                out.append((path, n.lineno, "purity",
-                            f"{f.id}() on a traced value forces a host "
-                            "sync inside jit"))
-
-    for stem, mi in mods.items():
-        path = mi.path
-        all_defs = [f for fns in mi.funcs.values() for f in fns]
-        # 1. block_until_ready anywhere in the hot-path modules: the
-        # pipeline's ONE deliberate sync point lives in collect() and
-        # carries a suppression; anything else is a stall in disguise
-        for n in ast.walk(mi.tree):
-            if (isinstance(n, ast.Call)
-                    and isinstance(n.func, ast.Attribute)
-                    and n.func.attr == "block_until_ready"):
-                out.append((path, n.lineno, "purity",
-                            "block_until_ready() in the pipeline hot "
-                            "path — host sync defeats async dispatch"))
-        # 2. traced-code checks (outermost traced defs only: their walk
-        # already covers defs nested inside them)
-        nested_in_traced: Set[int] = set()
-        for fn in all_defs:
-            if id(fn) not in traced:
-                continue
-            for sub in ast.walk(fn):
-                if (sub is not fn
-                        and isinstance(sub, (ast.FunctionDef,
-                                             ast.AsyncFunctionDef))):
-                    nested_in_traced.add(id(sub))
-        for fn in all_defs:
-            if id(fn) in traced and id(fn) not in nested_in_traced:
-                check_traced_body(fn, path)
-        for lam in mi.jit_lambdas:
-            check_traced_body(lam, path)
-        # 3. heavy eager jnp in host (non-traced) functions
-        for fn in all_defs:
-            if id(fn) in traced:
-                continue
-            for n in _walk_skip_defs(fn):
-                if (isinstance(n, ast.Call)
-                        and isinstance(n.func, ast.Attribute)
-                        and n.func.attr in HEAVY_JNP
-                        and _root_name(n.func) in JNP_ALIASES):
-                    out.append((path, n.lineno, "purity",
-                                f"eager jnp.{n.func.attr}(...) in a "
-                                "non-jit host path (per-op dispatch in "
-                                "the hot loop; move it under jit)"))
-        # 4. donated-buffer reuse: a read of the donated expression
-        # AFTER the donating call (same execution path only — an
-        # exclusive if/elif arm cannot observe the other arm's donation)
-        for fn in all_defs:
-            calls: List[Tuple[int, str, Tuple]] = []
-            paths_by_id = None
-            for n in ast.walk(fn):
-                if not isinstance(n, ast.Call):
-                    continue
-                cn = n.func.id if isinstance(n.func, ast.Name) else None
-                if cn is None:
-                    continue
-                dons = mi.donated.get(cn)
-                if dons is None and cn in mi.imports:
-                    dons = donated_global.get(mi.imports[cn])
-                if not dons:
-                    continue
-                if paths_by_id is None:
-                    paths_by_id = _branch_paths(fn)
-                for k in dons:
-                    if k < len(n.args):
-                        p = _dotted(n.args[k])
-                        if p:
-                            end = getattr(n, "end_lineno", n.lineno)
-                            calls.append((end, p,
-                                          paths_by_id.get(id(n), ())))
-            if not calls:
-                continue
-            loads: List[Tuple[int, str, Tuple]] = []
-            stores: List[Tuple[int, str]] = []
-            for n in ast.walk(fn):
-                p = None
-                if isinstance(n, (ast.Name, ast.Attribute)):
-                    p = _dotted(n)
-                if p is None:
-                    continue
-                if isinstance(n.ctx, ast.Load):
-                    loads.append((n.lineno, p,
-                                  paths_by_id.get(id(n), ())))
-                elif isinstance(n.ctx, ast.Store):
-                    stores.append((n.lineno, p))
-            for call_end, pth, cpath in calls:
-                for ln, p, lpath in loads:
-                    if p != pth or ln <= call_end:
-                        continue
-                    if _exclusive(cpath, lpath):
-                        continue
-                    rebound = any(call_end < s_ln <= ln and s_p == pth
-                                  for s_ln, s_p in stores)
-                    if not rebound:
-                        out.append((path, ln, "purity",
-                                    f"`{pth}` read after being DONATED "
-                                    f"to a chained dispatch on line "
-                                    f"{call_end} — the buffer is dead "
-                                    "(XLA reuses its memory)"))
-    return out
-
-
-# ----------------------------------------------------- pass D: thread
-
-def _has_toplevel_handler(fn: ast.AST) -> bool:
-    """True when the function body protects its thread: a try/except at
-    body level, or directly inside While/For/With wrappers (a loop-body
-    try = per-iteration protection)."""
-    def scan(stmts, depth: int) -> bool:
-        for s in stmts:
-            if isinstance(s, ast.Try) and s.handlers:
-                return True
-            if (isinstance(s, (ast.While, ast.For, ast.With,
-                               ast.AsyncWith, ast.AsyncFor))
-                    and depth < 3 and scan(s.body, depth + 1)):
-                return True
-        return False
-    return scan(fn.body, 0)
-
-
-def check_thread(tree: ast.Module, path: str) -> List[Finding]:
-    funcs = {f.name: f for f in _functions(tree)}
-    out: List[Finding] = []
-    seen: Set[int] = set()
-
-    def resolve(expr: ast.AST):
-        if isinstance(expr, ast.Name):
-            return funcs.get(expr.id)
-        if (isinstance(expr, ast.Attribute)
-                and isinstance(expr.value, ast.Name)
-                and expr.value.id == "self"):
-            return funcs.get(expr.attr)
-        return None
-
-    def require(expr: ast.AST, kind: str) -> None:
-        target = resolve(expr)
-        if target is None or id(target) in seen:
-            return
-        seen.add(id(target))
-        if not _has_toplevel_handler(target):
-            out.append((path, target.lineno, "thread",
-                        f"{kind} `{target.name}` has no top-level "
-                        "exception handling — an unhandled exception "
-                        "kills the daemon thread silently"))
-
-    def chaos_managed(call: ast.Call) -> bool:
-        """Thread(..., name="chaos-...") wrappers are scenario-managed:
-        the chaos runner joins them with a timeout and surfaces failure
-        through failed_ops / the convergence verdict, so "dies silently"
-        does not apply — the death IS observed."""
-        for kw in call.keywords:
-            if kw.arg != "name":
-                continue
-            v = kw.value
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                return v.value.startswith("chaos-")
-            if isinstance(v, ast.JoinedStr) and v.values:
-                head = v.values[0]
-                return (isinstance(head, ast.Constant)
-                        and isinstance(head.value, str)
-                        and head.value.startswith("chaos-"))
-        return False
-
-    for n in ast.walk(tree):
-        if not isinstance(n, ast.Call):
-            continue
-        cn = _callee_name(n)
-        if cn == "Thread" and not chaos_managed(n):
-            for kw in n.keywords:
-                if kw.arg == "target":
-                    require(kw.value, "thread target")
-        if cn == "Process":
-            if not any(kw.arg == "name" for kw in n.keywords):
-                out.append((path, n.lineno, "thread",
-                            "Process(...) without a name= — unnamed "
-                            "worker processes are invisible in ps "
-                            "output and crash triage"))
-            for kw in n.keywords:
-                if kw.arg == "target":
-                    require(kw.value, "process target")
-        for kw in n.keywords:
-            if kw.arg in ("on_leader", "on_follower"):
-                require(kw.value, f"daemon callback ({kw.arg}=)")
-    return out
-
-
-# ---------------------------------------------------- pass E: rawtime
-
-# cluster-plane time must flow through the injected chaos Clock; these
-# raw calls each pin a timeline to the wall clock.  perf_counter is
-# deliberately absent: host-side duration measurement (wavepipe stage
-# timers) is not cluster time and stays legal.
-_RAWTIME_BANNED = ("time", "monotonic", "sleep")
-
-
-def check_rawtime(tree: ast.Module, path: str) -> List[Finding]:
-    out: List[Finding] = []
-    # names pulled in via `from time import ...` (aliases included)
-    from_imports: Dict[str, str] = {}
-    for n in ast.walk(tree):
-        if isinstance(n, ast.ImportFrom) and n.module == "time":
-            for a in n.names:
-                if a.name in _RAWTIME_BANNED:
-                    from_imports[a.asname or a.name] = a.name
-    for n in ast.walk(tree):
-        if not isinstance(n, ast.Call):
-            continue
-        fn = n.func
-        banned = ""
-        if (isinstance(fn, ast.Attribute)
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id == "time"
-                and fn.attr in _RAWTIME_BANNED):
-            banned = fn.attr
-        elif isinstance(fn, ast.Name) and fn.id in from_imports:
-            banned = from_imports[fn.id]
-        if banned:
-            out.append((path, n.lineno, "rawtime",
-                        f"raw `time.{banned}()` bypasses the injected "
-                        "Clock — a virtual-time soak mixes wall and "
-                        "virtual timelines; route through the bound "
-                        "chaos Clock (clock.time()/monotonic()/sleep())"))
-    return out
-
-
-# ----------------------------------------------------------- plumbing
-
-def _scoped_files() -> Dict[str, List[Path]]:
-    """pass name -> files it runs over."""
-    pkg = ROOT / "nomad_tpu"
-    all_py = sorted(p for p in pkg.rglob("*.py")
-                    if "__pycache__" not in p.parts)
-    purity = sorted((pkg / "ops").glob("*.py")) \
-        + sorted((pkg / "parallel").glob("*.py")) \
-        + [pkg / "core" / "wavepipe.py"]
-    return {
-        "lock": all_py,
-        "cow": [pkg / "state" / "state_store.py"],
-        "purity": purity,
-        "thread": all_py,
-        "rawtime": sorted((pkg / "core").glob("*.py")),
-    }
-
-
-def _suppressed(text_lines: List[str], lineno: int, pass_name: str
-                ) -> bool:
-    if not (1 <= lineno <= len(text_lines)):
-        return False
-    line = text_lines[lineno - 1]
-    return (f"analyze: ok {pass_name}" in line
-            or "analyze: ok *" in line)
-
-
-def analyze_source(text: str, path: str = "<memory>",
-                   passes: Iterable[str] = PASS_NAMES) -> List[Finding]:
-    """Run single-module passes over one source blob (selftest + unit
-    tests); `purity` runs in single-module mode."""
-    tree = ast.parse(text)
-    findings: List[Finding] = []
-    for name in passes:
-        if name == "lock":
-            findings.extend(check_lock(tree, path))
-        elif name == "cow":
-            findings.extend(check_cow(tree, path))
-        elif name == "purity":
-            findings.extend(check_purity({path: tree}))
-        elif name == "thread":
-            findings.extend(check_thread(tree, path))
-        elif name == "rawtime":
-            findings.extend(check_rawtime(tree, path))
-    lines = text.splitlines()
-    return sorted({f for f in findings
-                   if not _suppressed(lines, f[1], f[2])})
-
-
-def analyze_repo(root: Path = ROOT) -> List[Finding]:
-    scopes = _scoped_files()
-    texts: Dict[str, str] = {}
-    trees: Dict[str, ast.Module] = {}
-    findings: List[Finding] = []
-    for files in scopes.values():
-        for p in files:
-            key = str(p)
-            if key in trees or not p.exists():
-                continue
-            texts[key] = p.read_text()
-            try:
-                trees[key] = ast.parse(texts[key])
-            except SyntaxError as e:
-                findings.append((key, e.lineno or 0, "parse",
-                                 f"syntax error: {e.msg}"))
-    single = {"lock": check_lock, "cow": check_cow,
-              "thread": check_thread, "rawtime": check_rawtime}
-    for name, checker in single.items():
-        for p in scopes[name]:
-            key = str(p)
-            if key not in trees:
-                continue
-            findings.extend(checker(trees[key], key))
-    purity_files = {str(p): trees[str(p)] for p in scopes["purity"]
-                    if str(p) in trees}
-    findings.extend(check_purity(purity_files))
-    out = set()
-    for f in findings:
-        lines = texts.get(f[0], "").splitlines()
-        if not _suppressed(lines, f[1], f[2]):
-            out.add(f)
-    return sorted(out)
-
-
-# ----------------------------------------------------------- selftest
-
-SELFTEST_LOCK = '''
-class StateStore:
-    def upsert_thing(self, x):
-        with self._lock:
-            self._insert_thing_locked(x)      # ok: under the lock
-
-    def _merge_locked(self, x):
-        self._insert_thing_locked(x)          # ok: *_locked caller
-
-    def broken_entry(self, x):
-        self._insert_thing_locked(x)          # VIOLATION: no lock
-
-    def broken_helper(self, key):
-        vol = self._writable_claim_vol(key)   # VIOLATION: no lock
-        return vol
-
-
-class MetricsRegistry:
-    # the telemetry registry's locked paths (core/telemetry.py): the
-    # histogram mutator is *_locked and every caller must hold the
-    # registry lock — a bare call is exactly the unsynchronized
-    # stats-dict increment this PR removed from broker/worker
-    def observe(self, key, value):
-        with self._lock:
-            self._observe_locked(key, value)  # ok: under the lock
-
-    def broken_observe(self, key, value):
-        self._observe_locked(key, value)      # VIOLATION: no lock
-'''
-
-SELFTEST_COW = '''
-class StateStore:
-    def _materialize_block_locked(self, block):
-        key = (block.namespace, block.source)
-        vol = self._csi_volumes.get(key)          # snapshot-shared
-        if vol is None or block.id not in vol.read_blocks:
-            return
-        vol.read_blocks.pop(block.id, None)       # VIOLATION (the leak)
-        vol.read_allocs.update({a: "" for a in block.ids})  # VIOLATION
-
-    def _claim_ok_locked(self, key, alloc):
-        vol = self._writable_claim_vol(key)       # head-private copy
-        if vol is None:
-            return
-        vol.read_allocs[alloc.id] = alloc.node_id  # ok: blessed
-
-    def delete_thing(self, key):
-        self._csi_volumes.pop(key, None)          # VIOLATION: direct
-
-    def _release_claims_locked(self, key, aid):
-        import dataclasses
-        vol = self._csi_volumes.get(key)
-        v = dataclasses.replace(vol)              # shallow: dicts shared
-        v.modify_index = 7                        # ok: fresh outer object
-        v.read_allocs.pop(aid, None)              # VIOLATION: inner dict
-
-    def snapshot_restore(self, doc):
-        self._csi_volumes = {}
-        self._csi_volumes[("ns", "v")] = doc      # ok: fresh rebind
-'''
-
-SELFTEST_PURITY = '''
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-
-def kernel(used, cap):
-    free = cap - used
-    total = np.asarray(free)                  # VIOLATION: np inside jit
-    return jnp.sum(free) + float(total.sum())  # VIOLATION: float(traced)
-
-
-kernel_jit = jax.jit(kernel, donate_argnums=(0,))
-
-
-def host_loop(used, cap):
-    out = kernel_jit(used, cap)
-    best = jnp.argmax(out)                    # VIOLATION: eager jnp
-    stale = used + 1                          # VIOLATION: donated reuse
-    return best, stale
-
-
-def collect(buf):
-    buf.block_until_ready()                   # VIOLATION: host sync
-    return buf
-'''
-
-SELFTEST_THREAD = '''
-import threading
-
-
-class ClusterServer:
-    def _on_raft_leader(self):
-        self.establish_leadership()           # VIOLATION: dies silently
-
-    def _guarded_loop(self):
-        while True:
-            try:
-                self.tick()
-            except Exception:
-                pass
-
-    def start(self):
-        RaftNode(on_leader=self._on_raft_leader)
-        threading.Thread(target=self._guarded_loop).start()   # ok
-
-    def run_scenario(self):
-        # ok: chaos-managed wrapper (runner joins it and surfaces the
-        # death via failed_ops), recognized by its name= prefix
-        threading.Thread(target=self._workload_loop, daemon=True,
-                         name=f"chaos-workload-{self.name}").start()
-
-    def _workload_loop(self):
-        self.drive()                          # no handler, but managed
-'''
-
-SELFTEST_PROC = '''
-import multiprocessing as mp
-
-
-def pool_main(idx):
-    run(idx)                                  # VIOLATION: no handler
-
-
-def pool_main_ok(idx):
-    try:
-        run(idx)
-    except Exception:
-        pass
-
-
-class Pool:
-    def spawn(self, ctx):
-        ctx.Process(target=pool_main).start()         # VIOLATION: unnamed
-        p = mp.Process(target=pool_main_ok,
-                       name="pool-worker-0")          # ok: named + handled
-        p.start()
-'''
-
-SELFTEST_RAWTIME = '''
-import time
-from time import monotonic as mono
-
-
-class HeartbeatTimers:
-    def expire(self, now=None):
-        t = now if now is not None else time.time()   # VIOLATION
-        return t
-
-    def backoff(self):
-        time.sleep(0.25)                              # VIOLATION
-
-    def deadline(self):
-        return mono() + 30.0                          # VIOLATION: alias
-
-    def ok_paths(self):
-        start = time.perf_counter()                   # ok: host duration
-        t = self.clock.time()                         # ok: injected seam
-        self.clock.sleep(0.1)                         # ok: injected seam
-        return start, t
-'''
-
-
-def selftest() -> int:
-    ok = True
-
-    def expect(name: str, text: str, want: int, must_contain: str = ""
-               ) -> None:
-        nonlocal ok
-        got = [f for f in analyze_source(text, passes=(name,))
-               if f[2] == name]
-        if len(got) != want:
-            print(f"analyze selftest FAILED [{name}]: expected {want} "
-                  f"finding(s), got {len(got)}: {got}")
-            ok = False
-            return
-        if must_contain and not any(must_contain in f[3] for f in got):
-            print(f"analyze selftest FAILED [{name}]: no finding "
-                  f"mentions {must_contain!r}: {got}")
-            ok = False
-
-    expect("lock", SELFTEST_LOCK, 3, "outside")
-    expect("cow", SELFTEST_COW, 4, "_writable_")
-    expect("purity", SELFTEST_PURITY, 5, "DONATED")
-    expect("thread", SELFTEST_THREAD, 1, "_on_raft_leader")
-    expect("thread", SELFTEST_PROC, 2, "name=")
-    expect("rawtime", SELFTEST_RAWTIME, 3, "bypasses the injected")
-    # suppression: the same violations annotated away must go quiet
-    suppressed = SELFTEST_THREAD.replace(
-        "def _on_raft_leader(self):",
-        "def _on_raft_leader(self):  # analyze: ok thread")
-    expect("thread", suppressed, 0)
-    if ok:
-        print("analyze selftest ok: every pass caught its injected "
-              "violation (lock=3 cow=4 purity=5 thread=1+2 rawtime=3, "
-              "suppression honored)")
-        return 0
-    return 1
-
-
-def main() -> int:
-    if "--selftest" in sys.argv:
-        return selftest()
-    findings = analyze_repo()
-    for path, lineno, name, msg in findings:
-        rel = str(Path(path)) if not str(path).startswith(str(ROOT)) \
-            else str(Path(path).relative_to(ROOT))
-        print(f"{rel}:{lineno}: [{name}] {msg}")
-    n_files = sum(len(v) for v in _scoped_files().values())
-    print(f"analyze: {len(findings)} finding(s) over {n_files} "
-          "pass-file runs")
-    return 1 if findings else 0
-
+_ANALYSIS = Path(__file__).resolve().parent / "analysis"
+if str(_ANALYSIS) not in sys.path:
+    sys.path.insert(0, str(_ANALYSIS))
+
+import common as _common
+import driver as _driver
+import selftests as _selftests
+
+ROOT = _common.ROOT
+Finding = _common.Finding
+PASS_NAMES = _common.PASS_NAMES
+analyze_source = _driver.analyze_source
+analyze_repo = _driver.analyze_repo
+analyze_repo_full = _driver.analyze_repo_full
+update_manifest = _driver.update_manifest
+main = _driver.main
+selftest = _selftests.selftest
+_scoped_files = _driver._scoped_files
 
 if __name__ == "__main__":
     sys.exit(main())

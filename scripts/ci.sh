@@ -3,10 +3,15 @@
 # (reference: .github/workflows/test-core.yaml).  Stages:
 #   lint     — scripts/lint.py (AST checks: syntax, unused imports,
 #              stray prints, whitespace; no external linters required)
-#   analyze  — scripts/analyze.py: the project-invariant passes (lock
-#              discipline, COW/snapshot isolation, JAX purity/donation,
-#              thread hygiene); selftest first (each pass must catch
-#              its injected violation), then a repo-wide clean run
+#   analyze  — scripts/analyze.py (scripts/analysis/ package): the
+#              eight project-invariant passes (lock discipline,
+#              COW/snapshot isolation, JAX purity/donation, thread
+#              hygiene, injected-timebase, lock-order graph +
+#              blocking-under-lock, canonical-plane determinism, wire
+#              proto/struct drift); selftest first (each pass must
+#              catch its injected violations), then a repo-wide clean
+#              run with stale-suppression accounting strict and the
+#              findings archived as JSON
 #   test     — the full pytest suite on the 8-virtual-device CPU mesh
 #              (tests/conftest.py forces JAX_PLATFORMS=cpu +
 #              xla_force_host_platform_device_count=8, so the sharded
@@ -32,11 +37,11 @@ echo "== lint =="
 # tests/, scripts/, bench.py
 python scripts/lint.py
 
-echo "== analyze selftest (each pass must catch its injected violation) =="
+echo "== analyze selftest (each pass must catch its injected violations) =="
 python scripts/analyze.py --selftest
 
-echo "== analyze (project invariants: lock/cow/purity/thread) =="
-python scripts/analyze.py
+echo "== analyze (lock/cow/purity/thread/rawtime/lockorder/determinism/wireproto) =="
+python scripts/analyze.py --strict-suppressions --json analyze_findings.json
 
 echo "== wavepipe fast smoke (pipelined engine, CPU mesh) =="
 # the async dispatch/collect path first and fast: a regression in the

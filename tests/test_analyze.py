@@ -1,12 +1,14 @@
-"""Invariant-analyzer coverage (scripts/analyze.py).
+"""Invariant-analyzer coverage (scripts/analyze.py ->
+scripts/analysis/).
 
 Each pass gets positive fixtures (the exact bug class it exists to
 catch, including the pre-fix shape of the round-5
 `_materialize_block_locked` snapshot leak) and negative fixtures (the
 blessed shapes the codebase actually uses — `with self._lock:` scopes,
-`_writable_*` copies, rebound donated buffers).  Plus: suppression
-comments silence exactly their pass, the selftest is green, and the
-WHOLE repo is violation-free (the same gate CI runs).
+`_writable_*` copies, rebound donated buffers, cond-wait under its own
+lock).  Plus: suppression comments silence exactly their pass, stale
+suppressions are reported, the selftest is green, and the WHOLE repo is
+violation-free across all eight passes (the same gate CI runs).
 """
 
 import importlib.util
@@ -303,6 +305,322 @@ def test_suppression_is_per_line():
     assert len(got) == 1, got
 
 
+# --------------------------------------------------- pass E: lockorder
+
+LOCKORDER_CYCLE = '''
+import threading
+
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock = threading.Lock()
+        self.beta = beta
+
+    def enter_alpha(self):
+        with self._lock:
+            return 1
+
+    def step(self):
+        with self._lock:
+            self.beta.enter_beta()
+
+
+class Beta:
+    def __init__(self, gamma):
+        self._lock = threading.Lock()
+        self.gamma = gamma
+
+    def enter_beta(self):
+        with self._lock:
+            self.gamma.enter_gamma()
+
+
+class Gamma:
+    def __init__(self, alpha):
+        self._lock = threading.Lock()
+        self.alpha = alpha
+
+    def enter_gamma(self):
+        with self._lock:
+            self.alpha.enter_alpha()
+'''
+
+LOCKORDER_BLOCKING = '''
+import threading
+
+
+class Sender:
+    def __init__(self, conn):
+        self._lock = threading.Lock()
+        self._conn = conn
+
+    def send_under_lock(self, buf):
+        with self._lock:
+            self._conn.send_bytes(buf)
+
+    def send_clean(self, buf):
+        with self._lock:
+            payload = self._pack(buf)
+        self._conn.send_bytes(payload)
+'''
+
+LOCKORDER_GOOD = '''
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            self.compute()
+
+    def compute(self):
+        return 1
+
+
+class Waiter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items = []
+
+    def dequeue(self, timeout):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(timeout)
+            return self._items.pop()
+
+    def counters(self):
+        # dict named like a queue must NOT read as Queue.get()
+        with self._lock:
+            return self._dequeues.get("k", 0)
+'''
+
+
+def test_lockorder_finds_three_lock_cycle():
+    got = findings(LOCKORDER_CYCLE, ("lockorder",))
+    cycles = [m for m in msgs(LOCKORDER_CYCLE, ("lockorder",))
+              if "lock-order cycle" in m]
+    assert len(cycles) == 1, got
+    # the cycle names all three lock nodes
+    assert all(n in cycles[0] for n in
+               ("Alpha._lock", "Beta._lock", "Gamma._lock")), cycles
+
+
+def test_lockorder_finds_transitive_self_reacquire():
+    # step() holds Alpha._lock and transitively reaches enter_alpha(),
+    # which re-takes the same non-reentrant Lock
+    got = msgs(LOCKORDER_CYCLE, ("lockorder",))
+    assert any("re-acquired" in m for m in got), got
+
+
+def test_lockorder_flags_blocking_under_lock_only():
+    got = findings(LOCKORDER_BLOCKING, ("lockorder",))
+    assert len(got) == 1, got
+    assert "send_bytes" in got[0][3]
+    # the clean variant sends after the with-block closes: the finding
+    # must anchor on the locked send, not the unlocked one
+    assert "send_under_lock" not in got[0][3]
+
+
+def test_lockorder_accepts_order_and_cond_wait():
+    assert findings(LOCKORDER_GOOD, ("lockorder",)) == []
+
+
+def test_lockorder_suppression():
+    suppressed = LOCKORDER_BLOCKING.replace(
+        "self._conn.send_bytes(buf)",
+        "self._conn.send_bytes(buf)  # analyze: ok lockorder")
+    assert findings(suppressed, ("lockorder",)) == []
+
+
+# ------------------------------------------------- pass F: determinism
+
+DETERMINISM_BAD = '''
+import os
+import random
+
+
+def canonical_trace(events, tags, path):
+    order = set(tags)
+    for t in order:
+        events.append(t)
+    names = ",".join({e.name for e in events})
+    jitter = random.random()
+    events.sort(key=id)
+    files = os.listdir(path)
+    return names, jitter, files
+'''
+
+DETERMINISM_GOOD = '''
+import os
+
+
+def canonical_trace(events, tags, path, rng):
+    for t in sorted(set(tags)):
+        events.append(t)
+    names = ",".join(sorted({e.name for e in events}))
+    jitter = rng.random()
+    events.sort(key=lambda e: e.id)
+    files = sorted(os.listdir(path))
+    by_kind = {}
+    for kind, evs in by_kind.items():   # dict iteration is ordered
+        events.extend(evs)
+    return names, jitter, files
+'''
+
+
+def test_determinism_flags_drift_sources():
+    got = msgs(DETERMINISM_BAD, ("determinism",))
+    assert len(got) == 5, got
+    assert any("unordered set" in m for m in got)
+    assert any("random.random" in m for m in got)
+    assert any("keyed on builtin id" in m for m in got)
+    assert any("filesystem" in m for m in got)
+
+
+def test_determinism_accepts_sorted_and_seeded_shapes():
+    assert findings(DETERMINISM_GOOD, ("determinism",)) == []
+
+
+# --------------------------------------------------- pass G: wireproto
+
+WIREPROTO_DRIFT = '''
+class Pool:
+    def _handle(self, child, op, payload):
+        if op == "deq":
+            return self._handle_deq(child, payload)
+        if op == "ack":
+            return payload["job"]
+        if op == "ghost":
+            return None
+        return None
+
+    def _handle_deq(self, child, payload):
+        return payload["n"]
+
+
+class Proxy:
+    def __init__(self, chan):
+        self._chan = chan
+
+    def deq(self):
+        return self._chan.call("deq", {"n": 4})
+
+    def ack(self):
+        return self._chan.call("ack", {"id": 7})
+
+    def drop(self):
+        self._chan.notify("orphan", {})
+'''
+
+WIREPROTO_ROUNDTRIP = '''
+class Pool:
+    def _handle(self, child, op, payload):
+        if op == "deq":
+            return self._handle_deq(child, payload)
+        if op in ("ready", "pull"):
+            if op == "pull":
+                return payload.get("since")
+            return {"ok": True}
+        return None
+
+    def _handle_deq(self, child, payload):
+        return payload["n"]
+
+
+class Proxy:
+    def __init__(self, chan):
+        self._chan = chan
+
+    def deq(self):
+        return self._chan.call("deq", {"n": 4})
+
+    def handshake(self, idx):
+        self._chan.call("ready", {"idx": idx})
+        return self._chan.call("pull", {"since": 0})
+'''
+
+
+def test_wireproto_flags_op_and_payload_drift():
+    got = msgs(WIREPROTO_DRIFT, ("wireproto",))
+    assert len(got) == 3, got
+    assert any("'orphan' is sent but has no dispatch" in m
+               for m in got)
+    assert any("'ghost' has no send site" in m for m in got)
+    assert any("payload['job']" in m for m in got)
+
+
+def test_wireproto_accepts_consistent_table():
+    # membership arms (`op in (...)`), tolerant .get() reads, and
+    # helper-forwarded strict reads all round-trip clean
+    assert findings(WIREPROTO_ROUNDTRIP, ("wireproto",)) == []
+
+
+def test_wireproto_manifest_detects_field_drift():
+    import ast as _ast
+    import wireproto as wp
+    src = ("from dataclasses import dataclass\n"
+           "@dataclass\n"
+           "class Job:\n"
+           "    id: str\n"
+           "    priority: int\n")
+    files = {"structs.py": _ast.parse(src)}
+    manifest = wp.compute_struct_manifest(files, version=1)
+    assert manifest["structs"] == {"Job": ["id", "priority"]}
+    # no drift, matching version: clean
+    wire_tree = _ast.parse("SCHEMA_VERSION = 1\n")
+    assert wp.check_manifest(files, manifest, wire_tree,
+                             "wire.py", "m.json") == []
+    # grow a field without regenerating: drift finding
+    drifted = {"structs.py": _ast.parse(src + "    affinity: str\n")}
+    got = wp.check_manifest(drifted, manifest, wire_tree,
+                            "wire.py", "m.json")
+    assert len(got) == 1 and "drifted" in got[0][3], got
+    # regenerated manifest but stale wire constant: version finding
+    manifest2 = wp.compute_struct_manifest(drifted, version=2)
+    got = wp.check_manifest(drifted, manifest2, wire_tree,
+                            "wire.py", "m.json")
+    assert len(got) == 1 and "SCHEMA_VERSION" in got[0][3], got
+    # bumped constant: clean again
+    wire_tree2 = _ast.parse("SCHEMA_VERSION = 2\n")
+    assert wp.check_manifest(drifted, manifest2, wire_tree2,
+                             "wire.py", "m.json") == []
+
+
+# --------------------------------------------------- rawtime re-import
+
+RAWTIME_NESTED = '''
+class Timers:
+    def lazy_from_alias(self):
+        from time import time as _t
+        return _t()
+
+    def lazy_mod_alias(self):
+        import time as _clock
+        return _clock.time()
+
+    def clean(self):
+        return self.clock.time()
+'''
+
+
+def test_rawtime_catches_nested_aliased_reimports():
+    got = findings(RAWTIME_NESTED, ("rawtime",))
+    assert len(got) == 2, got
+
+
+# ------------------------------------------ stale-suppression account
+
+def test_stale_suppressions_reported_repo_wide():
+    findings_repo, stale = analyze.analyze_repo_full()
+    assert findings_repo == []
+    assert stale == [], "\n".join(
+        f"{p}:{ln}: stale `# analyze: ok {tok}`" for p, ln, tok in stale)
+
+
 # ----------------------------------------------------- selftest + repo
 
 def test_selftest_green():
@@ -310,9 +628,9 @@ def test_selftest_green():
 
 
 def test_repo_is_violation_free():
-    """The same gate scripts/ci.sh runs: every pass over its scoped
-    files, zero findings.  A true positive introduced by a future PR
-    fails HERE with the file:line in the assertion message."""
+    """The same gate scripts/ci.sh runs: all eight passes over their
+    scoped files, zero findings.  A true positive introduced by a
+    future PR fails HERE with the file:line in the assertion message."""
     got = analyze.analyze_repo()
     assert got == [], "\n".join(
         f"{p}:{ln}: [{name}] {m}" for p, ln, name, m in got)
