@@ -1627,6 +1627,330 @@ def run_networked(args):
             "wall_s": round(dt, 3)}
 
 
+def run_watchers(args):
+    """--watchers: read-path fanout at watcher scale (core/fanout.py).
+    Parks a fleet of concurrent blocking queries + stream subscribers
+    against a LIVE agent and measures commit-to-wake latency over
+    several write rounds, plus two in-run A/Bs:
+
+      * write-throughput ratio — the same write burst with the whole
+        fleet parked vs with nobody watching.  This is the
+        machine-independent stand-in for "scheduler throughput must not
+        regress vs BENCH_r05": parked watchers taxing the commit path
+        is exactly HOW the fanout plane would slow the scheduler, and a
+        ratio gate travels across hosts where an absolute evals/sec
+        comparison cannot.
+      * hub-vs-legacy p99 — the same HTTP fleet against the per-client
+        re-arm loop (`server.watch_hub = None`), the PERF.md §20 pair.
+
+    The fleet splits into an HTTP tier (real sockets, bounded by the
+    fd rlimit — each parked connection costs client+server fds and a
+    ThreadingHTTPServer thread) and an in-process tier parked directly
+    on the agent's WatchHub; the split is LOGGED, never silently
+    capped.  Stale-read audit: every woken watcher must observe a
+    result index past the index it armed at (X-Nomad-Index on the HTTP
+    tier, the hub's changed-verdict in-process)."""
+    import http.client
+    import resource
+    import threading
+
+    from nomad_tpu.agent import Agent
+    from nomad_tpu.structs import Node
+
+    quick = getattr(args, "quick", False)
+    rounds = 3 if quick else 5
+    target_total = args.watchers_n or (600 if quick else 10000)
+    stream_subs = 16 if quick else 64
+    churn_writes = 1000 if quick else 3000
+    churn_bursts = 3
+
+    soft_fd, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    # each parked HTTP watcher holds ~1 client socket + 1 server socket
+    # + headroom for the agent itself; stay under half the soft limit
+    fd_budget = max((soft_fd - 512) // 4, 64)
+    http_tier = min(500 if quick else 2000, fd_budget, target_total)
+    inproc_tier = target_total - http_tier
+    print(f"watcher split: {http_tier} HTTP (fd soft limit {soft_fd}, "
+          f"budget {fd_budget}) + {inproc_tier} in-process on the hub + "
+          f"{stream_subs} stream subscribers", file=sys.stderr)
+
+    # 50ms GIL quantum for the duration of the run: with 10k+ mostly-
+    # parked threads the default 5ms interval preempts the few RUNNING
+    # threads (arming watchers mid-lock-handoff) thousands of times per
+    # second, and the fleet can fall into a metastable convoy where a
+    # round's arm phase takes an hour instead of seconds.  Parked
+    # threads never want the GIL, so the longer quantum costs nothing;
+    # it just lets each arming thread reach its parking point in one
+    # slice.  Restored before return (on an exception the bench process
+    # is exiting anyway).
+    old_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.05)
+
+    ag = Agent(num_clients=0, num_workers=1, heartbeat_ttl=1e9)
+    ag.start()
+    host, port = ag.address.replace("http://", "").split(":")
+    state = ag.server.state
+    hub = ag.server.watch_hub
+    node = Node()
+    state.upsert_node(node)
+
+    lat_lock = threading.Lock()
+
+    def _percentiles(samples):
+        if not samples:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        xs = sorted(samples)
+
+        def q(p):
+            return round(xs[min(int(len(xs) * p), len(xs) - 1)] * 1e3, 2)
+
+        return {"p50_ms": q(0.50), "p95_ms": q(0.95), "p99_ms": q(0.99)}
+
+    def _run_rounds(n_http, n_inproc, n_rounds, use_hub=True):
+        """One measured fleet: barrier-per-round, one write per round,
+        every watcher records commit-to-wake seconds.  Returns
+        (latencies, http_latencies, stale_reads, armed_shortfall).
+        `use_hub=False` = the legacy per-client re-arm A/B leg (the hub
+        census is unavailable; the round settles on a fixed delay)."""
+        total = n_http + n_inproc
+        lats, http_lats = [], []
+        stale = [0]
+        errors = [0]
+        shortfall = [0]
+        round_idx = [0]
+        write_t = [0.0]
+        barrier = threading.Barrier(total + 1)
+        done = threading.Semaphore(0)
+
+        def watcher(is_http, conn=None):
+            dead = False
+            for _ in range(n_rounds):
+                try:
+                    barrier.wait(timeout=300)
+                except threading.BrokenBarrierError:
+                    return
+                try:
+                    if dead:
+                        continue
+                    armed_at = round_idx[0]
+                    # wait=240 comfortably outlasts the worst arm
+                    # census + wake herd, so an unchanged response can
+                    # only mean a stale wake, never a benign timeout
+                    if is_http:
+                        conn.request(
+                            "GET", f"/v1/nodes?index={armed_at}&wait=240")
+                        resp = conn.getresponse()
+                        resp.read()
+                        t = time.perf_counter() - write_t[0]
+                        got = int(resp.getheader("X-Nomad-Index", "0"))
+                        changed = got > armed_at
+                    else:
+                        changed = hub.block(
+                            ("nodes",),
+                            lambda: state.latest_index(), armed_at, 240.0)
+                        t = time.perf_counter() - write_t[0]
+                    with lat_lock:
+                        lats.append(t)
+                        if is_http:
+                            http_lats.append(t)
+                        if not changed:
+                            stale[0] += 1
+                except Exception:  # noqa: BLE001 - tally, keep the fleet
+                    with lat_lock:
+                        errors[0] += 1
+                    dead = True     # keep joining barriers, stop arming
+                finally:
+                    done.release()
+
+        old_stack = threading.stack_size()
+        threading.stack_size(256 * 1024)
+        threads = []
+        conns = []
+        try:
+            for _ in range(n_http):
+                c = http.client.HTTPConnection(host, int(port),
+                                               timeout=300)
+                conns.append(c)
+                threads.append(threading.Thread(
+                    target=watcher, args=(True, c), daemon=True))
+            for _ in range(n_inproc):
+                threads.append(threading.Thread(
+                    target=watcher, args=(False,), daemon=True))
+        finally:
+            threading.stack_size(old_stack)
+        for t in threads:
+            t.start()
+        for r in range(n_rounds):
+            round_idx[0] = state.latest_index()
+            barrier.wait(timeout=300)
+            # let the fleet park before committing (arming 10k threads
+            # on one core is a herd; give it room, then accept a
+            # shortfall after the deadline rather than deadlocking the
+            # round — a late-arming watcher past the write returns
+            # immediately and still reports)
+            deadline = time.perf_counter() + 120.0
+            want = total if use_hub else 0
+            while use_hub and time.perf_counter() < deadline:
+                if hub.stats()["waiters"] >= want:
+                    break
+                time.sleep(0.01)
+            if use_hub:
+                got = hub.stats()["waiters"]
+                if got < want:
+                    shortfall[0] += want - got
+            else:
+                time.sleep(0.5 if quick else 1.5)   # legacy: no census
+            write_t[0] = time.perf_counter()
+            state.upsert_node(node)
+            grabbed = 0
+            deadline = time.perf_counter() + 300
+            while grabbed < total and time.perf_counter() < deadline:
+                if done.acquire(timeout=1.0):
+                    grabbed += 1
+            if grabbed < total:
+                barrier.abort()
+                raise RuntimeError(
+                    f"round {r}: {total - grabbed} watchers never "
+                    "reported (fleet wedged)")
+        for t in threads:
+            t.join(timeout=30)
+        for c in conns:
+            c.close()
+        assert errors[0] == 0, f"{errors[0]} watcher errors in the fleet"
+        return lats, http_lats, stale[0], shortfall[0]
+
+    # ------------------------------------------------------ stream tier
+    sub_events = [0]
+    subs = [ag.server.events.subscribe({"Node": ["*"]})
+            for _ in range(stream_subs)]
+    sub_stop = threading.Event()
+
+    def consume(sub):
+        while not sub_stop.is_set():
+            ev = sub.next(timeout=0.5)
+            if ev is not None:
+                with lat_lock:
+                    sub_events[0] += 1
+
+    sub_threads = [threading.Thread(target=consume, args=(s,), daemon=True)
+                   for s in subs]
+    for t in sub_threads:
+        t.start()
+
+    def _write_burst():
+        """Median of several bursts, each preceded by a collect: a GC
+        pause inside one 150ms burst must not swing the A/B ratio."""
+        import gc
+        rates = []
+        for _ in range(churn_bursts):
+            gc.collect()
+            t0 = time.perf_counter()
+            for _ in range(churn_writes):
+                state.upsert_node(node)
+            rates.append(churn_writes / (time.perf_counter() - t0))
+        return sorted(rates)[len(rates) // 2]
+
+    # ------------------------------------------------- hub-backed fleet
+    evals0 = hub.stats()["evals"]
+    lats, http_lats, stale_reads, shortfall = _run_rounds(
+        http_tier, inproc_tier, rounds)
+    hub_stats = hub.stats()
+
+    # ------------------------------------- throughput A/B/A: the fleet
+    # parks on a QUIET shape (watchers of a table the churn never
+    # touches — the steady-state posture of a 10k-watcher fleet while
+    # the scheduler commits elsewhere): every churn write must cost one
+    # leader wake + one memoized eval, never a fleet broadcast.  The
+    # loaded burst is STRADDLED by two idle bursts so process-warmth
+    # drift lands on both sides of the ratio.
+    parked_stop = threading.Event()
+    unpark = [0]
+
+    def parked():
+        # 60s wait: nothing expires mid-burst (a production fleet parks
+        # for 30s+ staggered waits; an all-at-once re-arm herd is a
+        # bench artifact, not the steady state being measured).  The
+        # teardown flips `unpark` and bumps the store so the shape's
+        # leader sees a result change and broadcasts everyone out.
+        while not parked_stop.is_set():
+            hub.block(("parked-jobs",), lambda: unpark[0], 0, 60.0)
+
+    idle_a = _write_burst()
+    old_stack = threading.stack_size()
+    threading.stack_size(256 * 1024)
+    park_threads = [threading.Thread(target=parked, daemon=True)
+                    for _ in range(max(inproc_tier, http_tier))]
+    threading.stack_size(old_stack)
+    for t in park_threads:
+        t.start()
+    deadline = time.perf_counter() + 60
+    while (hub.stats()["waiters"] < len(park_threads) * 0.9
+           and time.perf_counter() < deadline):
+        time.sleep(0.01)
+    loaded_rate = _write_burst()
+    parked_stop.set()
+    unpark[0] = 1
+    state.upsert_node(node)
+    for t in park_threads:
+        t.join(timeout=30)
+    idle_b = _write_burst()
+    idle_rate = (idle_a + idle_b) / 2.0
+
+    # --------------------------------- legacy per-client re-arm A/B leg
+    # SAME HTTP fleet size as the hub leg, so http_wake vs
+    # legacy_http_wake is an apples-to-apples pair (PERF.md §20)
+    ab_rounds = 2
+    ab_http = http_tier
+    ag.server.watch_hub = None
+    legacy_lats, _, _, _ = _run_rounds(ab_http, 0, ab_rounds,
+                                       use_hub=False)
+    ag.server.watch_hub = hub
+
+    sub_stop.set()
+    for t in sub_threads:
+        t.join(timeout=10)
+    broker_stats = ag.server.events.stats()
+    for s in subs:
+        ag.server.events.unsubscribe(s)
+    ag.shutdown()
+
+    ratio = round(loaded_rate / idle_rate, 3) if idle_rate else None
+    out = {
+        "bench": "watchers",
+        "watchers_total": http_tier + inproc_tier,
+        "http_watchers": http_tier,
+        "inproc_watchers": inproc_tier,
+        "stream_subscribers": stream_subs,
+        "rounds": rounds,
+        "wake": _percentiles(lats),
+        "http_wake": _percentiles(http_lats),
+        "wake_p99_ms": _percentiles(lats)["p99_ms"],
+        "stale_reads": stale_reads,
+        "armed_shortfall": shortfall,
+        "hub_evals": hub_stats["evals"] - evals0,
+        "hub_coalesced": hub_stats["coalesced"],
+        "stream_events_delivered": sub_events[0],
+        "stream_dropped": broker_stats["DroppedTotal"],
+        "write_throughput_idle_per_s": round(idle_rate, 1),
+        "write_throughput_idle_a_per_s": round(idle_a, 1),
+        "write_throughput_idle_b_per_s": round(idle_b, 1),
+        "write_throughput_loaded_per_s": round(loaded_rate, 1),
+        "write_throughput_ratio": ratio,
+        "legacy_http_wake": _percentiles(legacy_lats),
+        "legacy_ab_watchers": ab_http,
+        "fd_soft_limit": soft_fd,
+        "quick": bool(quick),
+    }
+    # hard in-run gates (the CI smoke relies on these): a woken watcher
+    # must never observe a pre-write result index, and the stream tier
+    # must deliver every round's event to every subscriber
+    assert stale_reads == 0, f"{stale_reads} stale watcher wakes"
+    assert sub_events[0] >= rounds * stream_subs, \
+        f"stream tier delivered {sub_events[0]} < {rounds * stream_subs}"
+    sys.setswitchinterval(old_switch)
+    return out
+
+
 def run_kernel(args):
     """--kernel: the production multi-eval kernel's device-only rate at
     bench scale (round-5 verdict #3's published microbench): amortize
@@ -1998,6 +2322,18 @@ def main():
     ap.add_argument("--networked", action="store_true",
                     help="batched networked-job throughput + global "
                          "(node, port) uniqueness audit")
+    ap.add_argument("--watchers", action="store_true",
+                    help="read-path fanout at watcher scale: concurrent "
+                         "blocking queries + stream subscribers against "
+                         "a live agent (core/fanout.py), with p99 wake "
+                         "latency, a zero-stale-reads audit, and the "
+                         "parked-fleet write-throughput A/B; --quick "
+                         "shrinks the fleet for the CI smoke")
+    ap.add_argument("--watchers-n", dest="watchers_n", type=int,
+                    default=0,
+                    help="--watchers: total blocking watchers "
+                         "(default 10000, quick 600); the HTTP/"
+                         "in-process split is fd-budgeted and logged")
     ap.add_argument("--kernel", action="store_true",
                     help="kernel-only microbench: the production "
                          "multi-eval kernel's device rate at bench scale "
@@ -2044,6 +2380,10 @@ def main():
 
     if args.networked:
         print(json.dumps(run_networked(args)))
+        return
+
+    if args.watchers:
+        print(json.dumps(run_watchers(args)))
         return
 
     if args.kernel:

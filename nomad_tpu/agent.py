@@ -39,7 +39,8 @@ class Agent:
                  device_executor: str = "jax",
                  slo: Optional[Dict[str, float]] = None,
                  profile_hz: Optional[float] = None,
-                 worker_mode: str = "thread") -> None:
+                 worker_mode: str = "thread",
+                 follow: str = "") -> None:
         # producer-side log gate (agent_config log_level): records below
         # this level never reach the ring or its subscribers.  Only set
         # when explicitly configured — the process-wide ring default
@@ -84,6 +85,23 @@ class Agent:
         # instances pass through for embedding scenarios directly.
         from nomad_tpu.chaos import resolve_clock, resolve_transport
         self.clock = resolve_clock(clock)
+        # read-follower role (core/fanout.ReadFollower): `follow` is a
+        # comma-separated candidate list of upstream HTTP addresses.  A
+        # follower embeds a normal server whose store is the replica
+        # target, but NEVER establishes leadership (no schedulers, no
+        # tick-driven expiry — replicated writes land via apply_export)
+        # and runs no clients (an in-process client would write to the
+        # non-authoritative local store).  Writes proxy to the upstream
+        # through the HTTP router.  ACL/variable tables only replicate
+        # via full exports, so follower mode pairs with the upstream's
+        # enforcement (writes + consistent reads) rather than local ACLs.
+        self.follow = [u.strip() for u in follow.split(",") if u.strip()]
+        self.follower = None
+        if self.follow:
+            if server_name or join or bootstrap_expect > 1:
+                raise ValueError("follow= is exclusive with cluster mode "
+                                 "(a raft member replicates via raft)")
+            client_enabled = False
         cluster_mode = bool(server_name or join or bootstrap_expect > 1)
         if cluster_mode:
             # multi-server: raft-replicated state + gossip membership
@@ -143,6 +161,10 @@ class Agent:
                 os.makedirs(cdir, exist_ok=True)
                 self.clients.append(Client(rpc, node=node, data_dir=cdir,
                                            plugin_dir=plugin_dir))
+        if self.follow:
+            from nomad_tpu.core.fanout import ReadFollower
+            self.follower = ReadFollower(self.server.state, self.clock,
+                                         self.follow)
         self.http = HTTPAPIServer(self, host=http_host, port=http_port)
         # multi-region federation (reference: nomad/regions.go + WAN serf):
         # this agent's region + the push-pull address table; ?region=X
@@ -158,7 +180,14 @@ class Agent:
     # ------------------------------------------------------------ control
 
     def start(self) -> "Agent":
-        self.server.start()
+        if self.follower is not None:
+            # follower role: serve reads, never schedule — leadership
+            # stays with the upstream (establish=False keeps the broker,
+            # plan queue, and blocked-eval machinery disabled)
+            self.server.start(establish=False)
+            self.follower.start()
+        else:
+            self.server.start()
         for c in self.clients:
             c.start()
         self.http.start()
@@ -167,6 +196,8 @@ class Agent:
         return self
 
     def shutdown(self) -> None:
+        if self.follower is not None:
+            self.follower.stop()
         self.http.shutdown()
         for c in self.clients:
             c.shutdown()
@@ -185,7 +216,7 @@ class Agent:
 
     def stats(self) -> Dict:
         s = self.server
-        return {
+        out = {
             "uptime_s": round(time.time() - self._started_at, 1),
             "state_index": s.state.latest_index(),
             "broker": dict(s.eval_broker.stats),
@@ -194,6 +225,9 @@ class Agent:
             "clients": len(self.clients),
             "threads": threading.active_count(),
         }
+        if self.follower is not None:
+            out["follower"] = self.follower.stats()
+        return out
 
     def _refresh_gauges(self) -> None:
         """Point-in-time gauges the registry cannot accumulate itself.

@@ -93,6 +93,11 @@ class AgentConfig:
     # only the keys present here override the defaults, and a negative
     # threshold disables its rule
     slo: Dict[str, float] = field(default_factory=dict)
+    # read-path follower mode (core/fanout.py ReadFollower): a
+    # comma-separated list of upstream HTTP addresses whose journal this
+    # agent tails via /v1/operator/export, serving stale-bounded reads
+    # locally.  Exclusive with cluster mode; empty = normal agent.
+    follow: str = ""
 
     def merge(self, other: "AgentConfig",
               set_fields: set) -> "AgentConfig":
@@ -144,6 +149,8 @@ def parse_agent_config(src: str):
                 put("encrypt", str(v))
             elif node.name == "region":
                 put("region", str(v))
+            elif node.name == "follow":
+                put("follow", str(v))
             else:
                 raise ValueError(f"unknown agent setting {node.name!r}")
         elif isinstance(node, Block):

@@ -47,6 +47,14 @@ class TextResponse(str):
     content_type = "text/plain; version=0.0.4; charset=utf-8"
 
 
+class BytesResponse(bytes):
+    """A handler return value shipped verbatim — msgpack bodies (the
+    /v1/operator/export journal frames ride core/wire.packb, which JSON
+    cannot carry)."""
+
+    content_type = "application/msgpack"
+
+
 def _decode_job(wire: Dict, ns: str) -> Job:
     """Wire Job -> struct; an ABSENT Namespace falls back to the request's
     ?namespace= (the decoder's default-namespace output can't distinguish
@@ -111,6 +119,25 @@ class Router:
         # hop): a foreign ?region= proxies the request verbatim to that
         # region's agent BEFORE local enforcement — the target region
         # authenticates the forwarded token against ITS own ACL state
+        # read-follower hop (core/fanout.ReadFollower): a follower agent
+        # serves stale-bounded GETs from its replica and proxies every
+        # write — plus ?stale=false consistent reads — to its upstream,
+        # which enforces the forwarded token against the authoritative
+        # ACL state (delta exports do not replicate tokens/variables)
+        follower = getattr(self.agent, "follower", None)
+        if follower is not None and (
+                method != "GET"
+                or (qs.get("stale") or ["true"])[0] == "false"):
+            clean = {k: v for k, v in qs.items() if k != "stale"}
+            qs_str = urllib.parse.urlencode(clean, doseq=True)
+            raw = (json.dumps(body).encode()
+                   if body is not None else None)
+            status, data = follower.proxy(method, path, qs_str, raw,
+                                          token=token)
+            payload, err = self._decode_forwarded(status, data)
+            if err:
+                raise APIError(status, err)
+            return status, payload
         fed = getattr(self.agent, "federation", None)
         region = (qs.get("region") or [""])[0]
         if fed is not None and region and region != fed.region:
@@ -237,10 +264,11 @@ class Router:
             if not acl.is_management():
                 raise APIError(403, "permission denied: management required")
             return acl
-        if head == "operator" and p[1:2] == ["snapshot"]:
+        if head == "operator" and p[1:2] in (["snapshot"], ["export"]):
             # a snapshot carries every token secret + all variables:
             # management only, both directions (reference gates snapshot
-            # RPCs behind management tokens)
+            # RPCs behind management tokens).  Exports inherit the rule —
+            # a full export embeds the snapshot doc
             if not acl.is_management():
                 raise APIError(403, "permission denied: management required")
             return acl
@@ -306,7 +334,8 @@ class Router:
             if method == "GET":
                 self._block(qs, result_index=lambda: max(
                     (j.modify_index for j in s.state.snapshot().jobs()
-                     if j.namespace == ns or ns == "*"), default=0))
+                     if j.namespace == ns or ns == "*"), default=0),
+                    shape=("jobs", ns))
                 snap = s.state.snapshot()
                 out = [_stub(j) for j in snap.jobs()
                        if j.namespace == ns or ns == "*"]
@@ -353,7 +382,8 @@ class Router:
             if method == "GET":
                 self._block(qs, result_index=lambda: max(
                     (n.modify_index
-                     for n in s.state.snapshot().nodes()), default=0))
+                     for n in s.state.snapshot().nodes()), default=0),
+                    shape=("nodes",))
                 return sorted((_node_stub(n)
                                for n in s.state.snapshot().nodes()),
                               key=lambda n: n["ID"])
@@ -374,6 +404,8 @@ class Router:
             if method == "GET":
                 self._block(qs)
                 snap = s.state.snapshot()
+                if (qs.get("columnar") or ["false"])[0] == "true":
+                    return self._allocations_columnar(snap, ns)
                 out = []
                 for j in snap.jobs():
                     if not (j.namespace == ns or ns == "*"):
@@ -487,6 +519,22 @@ class Router:
                 if method in ("PUT", "POST"):
                     s.restore_snapshot(body or {})
                     return {"Restored": True}
+            if p[1:2] == ["export"] and method == "GET":
+                # the read-follower tail (core/fanout.ReadFollower):
+                # journal deltas since ?since=, long-polled via ?wait=.
+                # msgpack over core/wire (struct payloads; JSON can't
+                # carry them) — management-only under ACLs, same rule as
+                # operator/snapshot (a full export embeds the snapshot
+                # doc: token secrets + variables)
+                try:
+                    since = int((qs.get("since") or ["0"])[0])
+                    wait = min(float((qs.get("wait") or ["0"])[0]), 30.0)
+                except ValueError:
+                    raise APIError(400, "bad since/wait")
+                if wait > 0 and s.state.latest_index() <= since:
+                    s.state.wait_for_index(since + 1, timeout=wait)
+                from nomad_tpu.core import wire
+                return BytesResponse(wire.packb(s.state.export_since(since)))
             if p[1:2] == ["raft"] and p[2:3] == ["configuration"]:
                 # reference: Operator.RaftGetConfiguration /
                 # `nomad operator raft list-peers`
@@ -629,6 +677,17 @@ class Router:
                             tl_win[1]) if tl_win else None),
                     },
                     "DeviceLedger": s.executor.ledger(),
+                    # read-path fanout plane (core/fanout.py): coalesced
+                    # watch shapes, the event ring's cursor/drop ledger
+                    # (nomad.stream.dropped per subscriber), and the
+                    # follower tail when this agent is one
+                    "WatchHub": (s.watch_hub.stats()
+                                 if getattr(s, "watch_hub", None)
+                                 is not None else None),
+                    "EventBroker": s.events.stats(),
+                    "Follower": (self.agent.follower.stats()
+                                 if getattr(self.agent, "follower", None)
+                                 is not None else None),
                     "Threads": [
                         {"Name": t.name, "Daemon": t.daemon,
                          "Alive": t.is_alive()}
@@ -1113,7 +1172,7 @@ class Router:
                     type=(body or {}).get("Type", "client"),
                     policies=list((body or {}).get("Policies", [])),
                     global_=(body or {}).get("Global", False),
-                    create_time=__import__("time").time())
+                    create_time=s.clock.time())
                 s.state.upsert_acl_token(t)
                 return codec.encode(t)
             accessor = p[1]
@@ -1436,17 +1495,23 @@ class Router:
         issued right after a write can miss it — poll briefly for the
         local store to catch up (the reference achieves this with the
         write's raft index + blocking query; the forwarded result here
-        doesn't carry the index)."""
+        doesn't carry the index).  Deadlines ride the injected clock
+        with a perf_counter liveness cap: the HTTP connection is real
+        even when the timebase is virtual."""
         import time as _time
-        deadline = _time.time() + timeout
+        clock = self.server.clock
+        deadline = clock.monotonic() + timeout
+        cap = _time.perf_counter() + timeout
         while True:
             v = read()
-            if v is not None or _time.time() >= deadline:
+            if v is not None or clock.monotonic() >= deadline \
+                    or _time.perf_counter() >= cap:
                 return v
-            _time.sleep(0.02)
+            self.server.state.wait_for_index(
+                self.server.state.latest_index() + 1, timeout=0.02)
 
     def _block(self, qs: Dict[str, List[str]],
-               result_index=None) -> None:
+               result_index=None, shape=None) -> None:
         """Minimal blocking-query support (reference: blockingRPC).
         With `result_index` — a callable returning the watched result
         set's max modify index — the wait re-arms until THAT passes the
@@ -1454,26 +1519,96 @@ class Router:
         jobs watcher with an unchanged jobs list (the reference blocks
         on the queried table's index, not the global one).  A deletion
         can't raise the result's max index, so pure-removal changes ride
-        the wait timeout; blocking clients re-poll on timeout anyway."""
+        the wait timeout; blocking clients re-poll on timeout anyway.
+
+        `shape` fingerprints the watched set (table + key filter): all
+        clients sharing a shape park on ONE store wait in the server's
+        WatchHub (core/fanout.py), and one result-index evaluation per
+        commit batch wakes them together.  Without a shape the request
+        gets a private one.  When the hub is disabled (bench A/B
+        baseline: server.watch_hub = None) the legacy per-client re-arm
+        loop runs instead, now routed through the Clock seam."""
         idx = qs.get("index")
         if not idx:
             return
         n = int(idx[0])
-        wait = min(float((qs.get("wait") or ["5"])[0]), 30.0)
+        # 300s cap mirrors the reference's max_query_time default (5min
+        # blocking queries); clients re-poll on timeout
+        wait = min(float((qs.get("wait") or ["5"])[0]), 300.0)
         state = self.server.state
         if result_index is None:
-            state.wait_for_index(n + 1, timeout=wait)
+            # plain store-index wait: still a shape ("any write"), so N
+            # idle list watchers share one store wait too
+            result_index = state.latest_index
+            shape = ("__index__",)
+        hub = getattr(self.server, "watch_hub", None)
+        if hub is not None:
+            hub.block(shape if shape is not None
+                      else ("__request__", id(result_index)),
+                      result_index, n, wait)
             return
         import time as _time
-        deadline = _time.time() + wait
+        clock = self.server.clock
+        deadline = clock.monotonic() + wait
+        cap = _time.perf_counter() + wait
         while result_index() <= n:
-            remaining = deadline - _time.time()
+            remaining = min(deadline - clock.monotonic(),
+                            cap - _time.perf_counter())
             if remaining <= 0:
                 return
             # wake on the next store write, re-check the RESULT's index
             # (1s re-arm slice bounds the unrelated-write wakeup churn)
             state.wait_for_index(state.latest_index() + 1,
                                  timeout=min(remaining, 1.0))
+
+    @staticmethod
+    def _allocations_columnar(snap, ns: str) -> Dict[str, Any]:
+        """/v1/allocations?columnar=true — parallel column arrays served
+        straight off AllocBlock storage (ids / picks / node_table /
+        indexes) plus the loose per-alloc rows; no per-row wire dict is
+        built (the follower-dashboard list path at 100k allocs).  Rows
+        are filtered to live jobs so the columnar and per-row modes
+        return the same answer (the per-row path walks jobs; allocs
+        orphaned by a purge must not appear in one mode only)."""
+        live = {(j.namespace, j.id) for j in snap.jobs()}
+        ids: List[str] = []
+        names: List[str] = []
+        jobs_: List[str] = []
+        nodes_: List[str] = []
+        status: List[str] = []
+        indexes: List[int] = []
+        blocks = 0
+        for b in snap.alloc_blocks():
+            t = b.template
+            if not (ns == "*" or t.namespace == ns):
+                continue
+            if (t.namespace, t.job_id) not in live:
+                continue
+            blocks += 1
+            ids.extend(b.ids)
+            prefix = b.name_prefix
+            names.extend(prefix + str(i) + "]" for i in b.indexes)
+            nt = b.node_table
+            if b.picks is not None:
+                nodes_.extend(nt[p] for p in b.picks.tolist())
+            jobs_.extend([t.job_id] * b.count)
+            status.extend([t.client_status] * b.count)
+            indexes.extend([b.modify_index] * b.count)
+        for a in snap.allocs():
+            if not (ns == "*" or a.namespace == ns):
+                continue
+            if (a.namespace, a.job_id) not in live:
+                continue
+            ids.append(a.id)
+            names.append(a.name)
+            jobs_.append(a.job_id)
+            nodes_.append(a.node_id)
+            status.append(a.client_status)
+            indexes.append(a.modify_index)
+        return {"Columnar": True, "Count": len(ids), "Blocks": blocks,
+                "Columns": {"ID": ids, "Name": names, "JobID": jobs_,
+                            "NodeID": nodes_, "ClientStatus": status,
+                            "ModifyIndex": indexes}}
 
     def _plan(self, job: Job, diff: bool) -> Dict[str, Any]:
         """Dry-run the scheduler on a snapshot with a no-op planner
@@ -1586,7 +1721,10 @@ class HTTPAPIServer:
 
             def _respond(self, status: int, payload: Any,
                          index: Optional[int] = None) -> None:
-                if isinstance(payload, TextResponse):
+                if isinstance(payload, BytesResponse):
+                    data = bytes(payload)
+                    ctype = payload.content_type
+                elif isinstance(payload, TextResponse):
                     data = str(payload).encode()
                     ctype = payload.content_type
                 else:
@@ -1598,6 +1736,20 @@ class HTTPAPIServer:
                 self.send_header("X-Nomad-Index", str(
                     index if index is not None
                     else router.server.state.latest_index()))
+                # consistency headers (reference: setMeta): a leader
+                # always knows itself; a follower reports its tail
+                # health so clients can bound staleness
+                follower = getattr(router.agent, "follower", None)
+                if follower is None:
+                    known, contact_ms = "true", "0"
+                else:
+                    known = ("true" if follower.known_leader
+                             else "false")
+                    age = follower.last_contact_s()
+                    contact_ms = str(int((age if age is not None
+                                          else -1) * 1000))
+                self.send_header("X-Nomad-KnownLeader", known)
+                self.send_header("X-Nomad-LastContact", contact_ms)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -1665,7 +1817,10 @@ class HTTPAPIServer:
                 monitor streams.  `pull(timeout) -> (line_bytes|None,
                 ended)`; 10s idle heartbeats detect dead clients; a
                 graceful end terminates the chunked body; `cleanup` always
-                runs (including on pre-body write failures)."""
+                runs (including on pre-body write failures).  Heartbeat
+                pacing is an interval measurement on a real TCP
+                connection — perf_counter, the sanctioned raw
+                primitive, not the injected timebase."""
                 import time as _time
 
                 def chunk(data: bytes) -> None:
@@ -1678,7 +1833,7 @@ class HTTPAPIServer:
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
-                    last_write = _time.time()
+                    last_write = _time.perf_counter()
                     while True:
                         line, ended = pull(0.5)
                         if ended:
@@ -1687,10 +1842,10 @@ class HTTPAPIServer:
                             break
                         if line is not None:
                             chunk(line)
-                            last_write = _time.time()
-                        elif _time.time() - last_write > 10:
+                            last_write = _time.perf_counter()
+                        elif _time.perf_counter() - last_write > 10:
                             chunk(b"{}\n")   # idle: detect disconnects
-                            last_write = _time.time()
+                            last_write = _time.perf_counter()
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
@@ -1763,7 +1918,14 @@ class HTTPAPIServer:
             def do_DELETE(self):
                 self._handle("DELETE")
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class _FanoutHTTPServer(ThreadingHTTPServer):
+            # the socketserver default backlog (5) refuses connections
+            # when a watcher fleet connects in a burst (bench --watchers
+            # arms hundreds of blocking queries at once); size the
+            # accept queue for the read-path fanout plane instead
+            request_queue_size = 1024
+
+        self.httpd = _FanoutHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
         self.addr = f"http://{host}:{self.httpd.server_port}"
         self._thread: Optional[threading.Thread] = None

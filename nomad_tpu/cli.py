@@ -98,6 +98,8 @@ def cmd_agent(args) -> int:
         cfg.num_workers = args.workers
     if getattr(args, "worker_mode", None):
         cfg.worker_mode = args.worker_mode
+    if getattr(args, "follow", None):
+        cfg.follow = args.follow
 
     if not cfg.server_enabled:
         print("Error: client-only agents need a remote RPC transport; "
@@ -135,10 +137,13 @@ def cmd_agent(args) -> int:
                   device_executor=cfg.device_executor,
                   slo=cfg.slo or None,
                   profile_hz=cfg.profile_hz,
-                  worker_mode=cfg.worker_mode)
+                  worker_mode=cfg.worker_mode,
+                  follow=cfg.follow)
     agent.start()
     print(f"==> agent started; HTTP API at {agent.address} "
           f"(region {agent.federation.region})")
+    if agent.follower is not None:
+        print(f"==> read follower tailing {', '.join(agent.follow)}")
     srv = agent.server
     if hasattr(srv, "gossip"):
         print(f"==> cluster server {srv.name}: rpc={srv.rpc.addr} "
@@ -1302,6 +1307,11 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-join-wan-token", dest="join_wan_token", default="",
                     help="management token for the -join-wan peer "
                          "(required when the peer enforces ACLs)")
+    ag.add_argument("-follow", dest="follow", default="",
+                    help="comma-separated upstream HTTP addresses: run "
+                         "as a read follower tailing the leader journal "
+                         "and serving stale-bounded reads locally "
+                         "(exclusive with cluster mode)")
     ag.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(

@@ -133,6 +133,12 @@ class Server:
         self.volumes = VolumeWatcher(self)
         self.events = EventBroker()
         self.events.attach(self.state)
+        # read-path fanout (core/fanout.py): one store wait per watched
+        # shape for every blocking HTTP query; the API's _block parks
+        # here.  Set to None to fall back to per-client re-arm loops
+        # (the bench watcher A/B baseline).
+        from nomad_tpu.core.fanout import WatchHub
+        self.watch_hub = WatchHub(self.state, self.clock)
         # `mesh`: None = auto (shard the node axis when the runtime
         # exposes >1 device), False = force single-device, or an
         # explicit jax.sharding.Mesh — forwarded to PlacementEngine

@@ -1,30 +1,42 @@
 """Event broker: in-memory pub/sub of state-change events
 (reference: nomad/stream/event_broker.go + nomad/state/events.go).
 
-The state store emits one callback per commit; this broker records raw
-(topic, index, payload) entries in a bounded replay buffer and fans out
-wire-shaped event records — `{Topic, Type, Key, Index, Payload}` — to
-subscribers with topic/key filtering.  Backs the HTTP `/v1/event/stream`
-endpoint and in-process consumers.
+The state store emits one callback per commit; this broker appends ONE
+entry per commit to a single shared EventRing (core/fanout.py) and
+subscribers pull through per-subscriber topic CURSORS — the read-path
+fanout design:
+
+  * a commit is O(ring append + wake), not O(subs × events) match/offer
+    under a broker lock;
+  * slow consumers fall behind on their own cursor — counted into
+    `nomad.stream.dropped` and the per-subscriber lag ledger, never
+    blocking the publisher;
+  * late subscribers replay by cursor seek over the already-expanded
+    ring instead of re-expanding the whole raw buffer per subscribe.
 
 Hot-path note: the store's commit callback runs under the store write
-lock (plan apply at bench scale lands here), so the callback only appends
-ONE raw tuple per commit — per-alloc Event expansion happens lazily, and
-only when subscribers exist.
+lock (plan apply at bench scale lands here), so the callback only
+appends ONE raw entry per commit — per-alloc Event expansion happens
+lazily on first read, cached on the ring entry so K subscribers cost
+one expansion.
 
 Filter semantics (reference: SubscribeRequest): `topics` maps topic name
 to a list of keys; `"*"` as a topic or key matches everything.  Events
-older than the buffer are dropped silently (subscribers start at the
-buffer head; the reference behaves the same once its buffer wraps).
+older than the ring are dropped and counted (the reference behaves the
+same once its buffer wraps).  Allocation events always carry the key
+with a NULL payload — consumers re-fetch current state — so live
+delivery and replay are identical regardless of who was subscribed at
+commit time (a 100k-alloc plan apply must not pin full payloads in the
+ring either).
 """
 
 from __future__ import annotations
 
-import queue
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
+from nomad_tpu.core.fanout import EventRing
 from nomad_tpu.structs import codec
 
 TOPIC_ALL = "*"
@@ -61,12 +73,11 @@ class Event:
 
 
 class _AllocIds:
-    """Replay stub kept in the buffer instead of a full alloc list: a
-    100k-alloc plan apply must not stay pinned in the replay buffer.
-    Live fan-out still delivers full payloads; REPLAYED alloc events
-    always carry the key with a null payload (consumers re-fetch current
-    state) — deterministic regardless of who was subscribed at commit
-    time."""
+    """Alloc commits buffer as an id stub, never the full alloc list: a
+    100k-alloc plan apply must not stay pinned in the ring.  Alloc
+    events always carry the key with a null payload (consumers re-fetch
+    current state) — deterministic regardless of who was subscribed at
+    commit time."""
 
     __slots__ = ("ids",)
 
@@ -79,7 +90,7 @@ def _expand(topic: str, index: int, payload) -> List[Event]:
         if isinstance(payload, _AllocIds):
             return [Event("Allocation", "AllocationUpdated", aid, index,
                           None) for aid in payload.ids]
-        return [Event("Allocation", "AllocationUpdated", a.id, index, a)
+        return [Event("Allocation", "AllocationUpdated", a.id, index, None)
                 for a in payload]
     if topic not in _TYPE_BY_TOPIC:
         return []
@@ -96,16 +107,40 @@ def _expand(topic: str, index: int, payload) -> List[Event]:
         # /v1/event/stream see it live, keyed by job id so a watcher can
         # filter to its job.  The payload (the eval) carries the
         # failed_tg_allocs rollups that explain WHY it is pending.
-        # Derived here so replay from the buffer reproduces it too.
+        # Derived here so replay from the ring reproduces it too.
         events.append(Event("PlacementFailure", "PlacementFailure",
                             getattr(payload, "job_id", ""), index, payload))
     return events
 
 
+def _expected_count(topic: str, payload) -> int:
+    """Exact `_expand` output size, computed O(1) at append time (the
+    drop ledger needs event counts for entries trimmed before any
+    reader expanded them) — keep in lockstep with `_expand`."""
+    if topic == "Allocations":
+        return (len(payload.ids) if isinstance(payload, _AllocIds)
+                else len(payload))
+    if topic == "HealthBreach" or isinstance(payload, (str, tuple)):
+        return 1
+    if topic == "Evaluation" and getattr(payload, "status", "") == "blocked":
+        return 2
+    return 1
+
+
 class Subscription:
-    def __init__(self, topics: Dict[str, List[str]], maxsize: int) -> None:
+    """A cursor over the shared ring: (entry seq, intra-entry offset)
+    plus the absolute event position `abs_pos` that the drop ledger
+    differences against the ring's cum ledger when the cursor falls off
+    the tail.  Pull-only; the publisher never touches a subscription."""
+
+    def __init__(self, topics: Dict[str, List[str]], ring: EventRing,
+                 seq: int, abs_pos: int) -> None:
         self.topics = topics
-        self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize)
+        self._ring = ring
+        self._seq = seq
+        self._intra = 0
+        self._abs_pos = abs_pos
+        self.dropped = 0           # events lost to cursor lag
         self.closed = False
 
     def matches(self, ev: Event) -> bool:
@@ -116,26 +151,53 @@ class Subscription:
                 return True
         return False
 
-    def offer(self, ev: Optional[Event]) -> None:
-        try:
-            self._q.put_nowait(ev)
-        except queue.Full:
-            # slow consumer: drop oldest to keep the stream live
-            try:
-                self._q.get_nowait()
-                self._q.put_nowait(ev)
-            except queue.Empty:
-                pass
+    def lag(self) -> int:
+        """Entries between this cursor and the ring head."""
+        return max(self._ring.stats()["next_seq"] - self._seq, 0)
+
+    def _scan(self) -> Optional[Event]:
+        """Advance the cursor to the next matching event without
+        parking; None at the head.  Expansion happens OUTSIDE the ring
+        lock and is cached on the entry (idempotent, GIL-safe single
+        store) so K subscribers cost one expansion per entry."""
+        while True:
+            probe = self._ring.fetch(self._seq)
+            if probe[0] == "behind":
+                _, base_seq, cum_base = probe
+                lost = max(cum_base - self._abs_pos, 0)
+                if lost:
+                    self.dropped += lost
+                    self._ring.note_dropped(lost)
+                self._seq, self._intra, self._abs_pos = base_seq, 0, cum_base
+                continue
+            if probe[0] == "head":
+                return None
+            entry = probe[1]
+            evs = entry.expanded
+            if evs is None:
+                evs = _expand(entry.topic, entry.index, entry.payload)
+                entry.expanded = evs
+            while self._intra < len(evs):
+                ev = evs[self._intra]
+                self._intra += 1
+                if self.matches(ev):
+                    return ev
+            self._seq += 1
+            self._intra = 0
+            self._abs_pos = entry.cum_end
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
-        """Blocking pull; None on close sentinel or timeout."""
-        try:
-            ev = self._q.get(timeout=timeout)
-        except queue.Empty:
+        """Blocking pull; None on close or timeout.  A single bounded
+        park per call keeps the old queue-get semantics (callers loop)."""
+        ev = self._scan()
+        if ev is not None or self.closed:
+            return ev
+        self._ring.wait_for(self._seq,
+                            timeout if timeout is not None else 0.5,
+                            lambda: self.closed)
+        if self.closed:
             return None
-        if ev is None:
-            self.closed = True
-        return ev
+        return self._scan()
 
     def __iter__(self):
         while not self.closed:
@@ -143,13 +205,15 @@ class Subscription:
             if ev is not None:
                 yield ev
 
+    def stats(self) -> Dict:
+        return {"Topics": {t: list(k) for t, k in self.topics.items()},
+                "Lag": self.lag(), "Dropped": self.dropped}
+
 
 class EventBroker:
     def __init__(self, buffer_size: int = 4096) -> None:
         self._lock = threading.Lock()
-        # raw (topic, index, payload) commit records; one per store commit
-        self._buffer: List[Tuple[str, int, object]] = []
-        self._buffer_size = buffer_size
+        self._ring = EventRing(capacity=buffer_size)
         self._subs: List[Subscription] = []
 
     # ------------------------------------------------------------- attach
@@ -167,39 +231,25 @@ class EventBroker:
             topic, payload = "Allocations", _AllocIds(payload.ids)
         if topic not in _TYPE_BY_TOPIC:
             return
-        with self._lock:
-            subs = list(self._subs)
-            buffered = payload
-            if topic == "Allocations":
-                buffered = _AllocIds([a.id for a in payload]) \
-                    if not isinstance(payload, _AllocIds) else payload
-            self._buffer.append((topic, index, buffered))
-            if len(self._buffer) > self._buffer_size:
-                del self._buffer[:len(self._buffer) - self._buffer_size]
-        if not subs:
-            return
-        events = _expand(topic, index, payload)
-        for sub in subs:
-            for ev in events:
-                if sub.matches(ev):
-                    sub.offer(ev)
+        if topic == "Allocations" and not isinstance(payload, _AllocIds):
+            payload = _AllocIds([a.id for a in payload])
+        self._ring.append(topic, index, payload,
+                          _expected_count(topic, payload))
 
     # ------------------------------------------------------------ pub/sub
 
     def subscribe(self, topics: Optional[Dict[str, List[str]]] = None,
                   from_index: int = 0, maxsize: int = 1024) -> Subscription:
         """`topics={"Allocation": ["*"]}`; None/empty = everything.
-        Buffered events with index > from_index replay first.  The backlog
-        is offered while holding the broker lock so a concurrent publish
-        cannot enqueue a newer event ahead of the replay."""
-        sub = Subscription(topics or {TOPIC_ALL: [TOPIC_ALL]}, maxsize)
+        Ring entries with index > from_index replay first, by cursor
+        seek.  `maxsize` is accepted for API compatibility; backpressure
+        is now cursor lag bounded by the ring capacity, not a
+        per-subscriber queue."""
+        del maxsize
+        seq, abs_pos = self._ring.seek(from_index)
+        sub = Subscription(topics or {TOPIC_ALL: [TOPIC_ALL]},
+                           self._ring, seq, abs_pos)
         with self._lock:
-            for topic, index, payload in self._buffer:
-                if index <= from_index:
-                    continue
-                for ev in _expand(topic, index, payload):
-                    if sub.matches(ev):
-                        sub.offer(ev)
             self._subs.append(sub)
         return sub
 
@@ -208,6 +258,8 @@ class EventBroker:
         with self._lock:
             if sub in self._subs:
                 self._subs.remove(sub)
+        # wake any parked next() so the close is observed promptly
+        self._ring.wake()
 
     def close(self) -> None:
         """Wake and end every subscriber (server shutdown)."""
@@ -215,4 +267,20 @@ class EventBroker:
             subs = list(self._subs)
             self._subs.clear()
         for sub in subs:
-            sub.offer(None)
+            sub.closed = True
+        self._ring.close()
+
+    # -------------------------------------------------------------- intro
+
+    def stats(self) -> Dict:
+        """Ring + per-subscriber cursor/drop ledger, surfaced in
+        /v1/operator/debug."""
+        with self._lock:
+            subs = list(self._subs)
+        ring = self._ring.stats()
+        return {
+            "Subscribers": len(subs),
+            "Ring": ring,
+            "DroppedTotal": ring["dropped_total"],
+            "Cursors": [s.stats() for s in subs],
+        }

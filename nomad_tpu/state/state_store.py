@@ -2207,6 +2207,19 @@ class StateSnapshot:
     def scheduler_config(self) -> SchedulerConfiguration:
         return self._scheduler_config
 
+    # --- columnar read-path surface (api list endpoints) ---
+
+    def alloc_blocks(self) -> List:
+        """Live columnar blocks AT this snapshot.  The API's columnar
+        list endpoints serve straight off these arrays — pair with
+        `allocs()` for full coverage WITHOUT materialize_all()."""
+        return list(self._alloc_blocks.values())
+
+    def allocs(self) -> List[Allocation]:
+        """Loose per-alloc table rows only (block members excluded —
+        they live in alloc_blocks() until materialized)."""
+        return list(self._allocs.values())
+
 
 def _job_initial_status(job: Job) -> str:
     if job.stop:

@@ -9,7 +9,7 @@ line, or `# analyze: ok *`):
   purity       JAX purity & donation (ops/, parallel/, wavepipe)
   thread       thread/process hygiene (top-level handlers, name=)
   rawtime      injected-timebase discipline (core/, chaos/,
-               scheduler/, state/)
+               scheduler/, state/, api/)
   lockorder    inter-procedural lock-order graph: deadlock cycles +
                blocking-under-lock (whole nomad_tpu package)
   determinism  canonical-plane drift (set order, global RNG, id/hash
@@ -62,7 +62,8 @@ def _scoped_files() -> Dict[str, List[Path]]:
     rawtime = sorted((pkg / "core").glob("*.py")) \
         + sorted((pkg / "chaos").glob("*.py")) \
         + sorted((pkg / "scheduler").glob("*.py")) \
-        + sorted((pkg / "state").glob("*.py"))
+        + sorted((pkg / "state").glob("*.py")) \
+        + sorted((pkg / "api").glob("*.py"))
     determinism = [pkg / "chaos" / "trace.py",
                    pkg / "chaos" / "soak.py",
                    pkg / "chaos" / "traffic.py",

@@ -1,5 +1,5 @@
 """Pass `rawtime` — injected-timebase discipline (nomad_tpu/core/,
-chaos/, scheduler/, state/).
+chaos/, scheduler/, state/, api/).
 
 A raw `time.time()` / `time.monotonic()` / `time.sleep()` call in the
 cluster plane bypasses the chaos Clock seam (chaos/clock.py), so a

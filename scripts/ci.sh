@@ -502,6 +502,19 @@ JAX_PLATFORMS=cpu python bench.py --config 5 --nodes 400 --evals 8 \
     --workers 2 --worker-mode process --mesh off > BENCH_pool.json
 python scripts/perfcheck.py --kind workers --fresh BENCH_pool.json
 
+echo "== fanout (read-path plane: hub/ring/follower suite + watcher smoke) =="
+# the read-path fanout plane (ISSUE 18): the WatchHub coalescing /
+# EventRing cursor / ReadFollower no-stale-reads suite, then a
+# --watchers --quick smoke (in-run asserts already fail the run on any
+# stale wake or undelivered stream round) judged by the watchers-kind
+# perfcheck gates: scale-aware p99 wake band, O(rounds) eval
+# coalescing, zero drops, and the parked-vs-idle write-throughput
+# ratio floor that stands in for "scheduler throughput must not
+# regress under a parked 10k fleet"
+JAX_PLATFORMS=cpu python -m pytest tests/test_fanout.py -q
+JAX_PLATFORMS=cpu python bench.py --watchers --quick > BENCH_watchers.json
+python scripts/perfcheck.py --kind watchers --fresh BENCH_watchers.json
+
 echo "== bench smoke (CPU backend, reduced scale) =="
 JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \
     --placements 2000 --iters 1 | python -c '
