@@ -1220,6 +1220,13 @@ def run_config_5(args):
                                [r for r in health["Rules"]
                                 if not r["Ok"]])
     flight_occupancy = len(FLIGHT.waves())
+    # memory & footprint plane (core/memledger.py): one fresh scrape
+    # while the server's planes are still registered — headline RSS
+    # high-water + export-journal footprint ride the bench doc so the
+    # trajectory catches a footprint regression like any other metric
+    from nomad_tpu.core.memledger import MEMLEDGER as _ML
+    mem_doc = _ML.scrape()
+    mem_jstats = s.state.journal_stats()
     s.shutdown()
     # worker A/B (ISSUE 14): when the run asked for >1 workers, measure
     # the SAME sustained shape once more on a fresh 1-worker server in
@@ -1334,6 +1341,15 @@ def run_config_5(args):
             "timeline_points": tl_stats["points"],
             "timeline_annotations": tl_stats["annotations"],
             "timeline_overhead_fraction": tl_overhead,
+            # memory & footprint plane (ISSUE 19): process RSS
+            # high-water, export-journal footprint/compaction work, and
+            # the ledger's own scrape cost — volatile host facts, so
+            # perfcheck reads them via baseline-free absolute gates
+            # (--kind memory), never cross-run bands
+            "rss_peak_bytes": int(mem_doc["RSSPeakBytes"]),
+            "journal_bytes": int(mem_jstats["bytes"]),
+            "journal_compactions": int(mem_jstats["compactions"]),
+            "mem_scrape_us": float(mem_doc["ScrapeMeanMicros"]),
             # mesh deployment (nomad_tpu/parallel): device count, the
             # fraction of kernel rows that are mesh padding, the
             # per-wave cross-shard collective payload (O(top-k ·

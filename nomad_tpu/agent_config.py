@@ -228,7 +228,7 @@ def parse_agent_config(src: str):
                     known_slo = {"p99_plan_queue_ms", "refute_rate",
                                  "invalidations_per_s",
                                  "networked_ratio", "heartbeat_misses",
-                                 "window_s", "interval_s"}
+                                 "rss_mb", "window_s", "interval_s"}
                     slo = {}
                     for a in b.body:
                         if not isinstance(a, Attr):

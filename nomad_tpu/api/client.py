@@ -325,6 +325,14 @@ class Operator(_Endpoint):
         return self.c.request("GET", "/v1/operator/health",
                               params=params)
 
+    def memory(self, cached: bool = False) -> Dict:
+        """The memory ledger document (core/memledger.py): per-plane
+        byte/entry/eviction table + process RSS.  `cached=True` returns
+        the last tick sample instead of forcing a fresh scrape."""
+        params = {"cached": "true"} if cached else {}
+        return self.c.request("GET", "/v1/operator/memory",
+                              params=params)
+
     def flight_recorder(self, n: Optional[int] = None) -> Dict:
         """The flight recorder's recent per-wave / per-eval / event
         rings; `n` caps each ring's tail."""

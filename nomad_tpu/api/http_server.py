@@ -553,6 +553,14 @@ class Router:
                         "Leader": raft.leader_name == name,
                         "Voter": True})
                 return {"Servers": servers}
+            if p[1:2] == ["memory"] and method == "GET":
+                # memory & footprint plane (core/memledger.py): fresh
+                # per-plane byte ledger + process RSS.  ?cached=true
+                # returns the last tick sample without re-scraping
+                from nomad_tpu.core.memledger import MEMLEDGER
+                if (qs.get("cached") or ["false"])[0] == "true":
+                    return MEMLEDGER.doc()
+                return MEMLEDGER.scrape()
             if p[1:2] == ["health"] and method == "GET":
                 # SLO verdicts, observed-vs-threshold (the health
                 # watchdog re-evaluates on demand; ?dumps=true folds the
@@ -643,10 +651,12 @@ class Router:
                 import threading as _threading
                 from nomad_tpu.core.flightrec import FLIGHT
                 from nomad_tpu.core.logging import RING
+                from nomad_tpu.core.memledger import MEMLEDGER
                 from nomad_tpu.core.profiling import PROFILER
                 from nomad_tpu.core.telemetry import TRACER
                 from nomad_tpu.core.timeline import TIMELINE
                 tl_win = TIMELINE.window()
+                mem_doc = MEMLEDGER.scrape()
                 return {
                     "Stats": self.agent.stats(),
                     "Metrics": self.agent.metrics(),
@@ -688,6 +698,12 @@ class Router:
                     "Follower": (self.agent.follower.stats()
                                  if getattr(self.agent, "follower", None)
                                  is not None else None),
+                    # memory & footprint plane (core/memledger.py):
+                    # per-plane byte ledger + RSS, and the unified
+                    # eviction/drop counters — one key per plane, the
+                    # single place to answer "who is dropping data"
+                    "Memory": mem_doc,
+                    "Evictions": MEMLEDGER.evictions(),
                     "Threads": [
                         {"Name": t.name, "Daemon": t.daemon,
                          "Alive": t.is_alive()}

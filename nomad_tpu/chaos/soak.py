@@ -137,7 +137,8 @@ class SoakRunner:
                  sweep_interval: float = 8.0,
                  heartbeat_ttl: float = 30.0,
                  converge_budget_v: float = 900.0,
-                 slo: Optional[Dict[str, float]] = None) -> None:
+                 slo: Optional[Dict[str, float]] = None,
+                 rss_ceiling_mb: float = -1.0) -> None:
         self.seed = seed
         self.profile = profile or TrafficProfile()
         self.step_v = step_v
@@ -145,6 +146,11 @@ class SoakRunner:
         self.sweep_interval = sweep_interval
         self.heartbeat_ttl = heartbeat_ttl
         self.converge_budget_v = converge_budget_v
+        # RSS gate (core/memledger): fail the soak when the process
+        # high-water mark crosses this many MiB; < 0 disables.  A wall
+        # fact, so it gates the verdict but stays out of the canonical
+        # trace/digests (same-seed runs on different hosts still match)
+        self.rss_ceiling_mb = float(rss_ceiling_mb)
         self.slo = dict(SOAK_SLO)
         self.slo.update(slo or {})
         # runtime state
@@ -302,7 +308,7 @@ class SoakRunner:
                 for v in (res.violations or ["did not converge"]))
 
     def _rebind_clock(self) -> None:
-        from nomad_tpu.core import flightrec, identity, telemetry
+        from nomad_tpu.core import flightrec, identity, memledger, telemetry
         from nomad_tpu.core import logging as logging_mod
         from nomad_tpu.core import timeline as timeline_mod
         telemetry.configure(self.clock)
@@ -310,6 +316,7 @@ class SoakRunner:
         logging_mod.configure(self.clock)
         identity.configure(self.clock)
         timeline_mod.configure(self.clock)
+        memledger.configure(self.clock)
 
     # -------------------------------------------------- synthetic fleet
 
@@ -495,6 +502,10 @@ class SoakRunner:
         telemetry_mod.REGISTRY.clear_series("nomad.plan.queue_wait_s")
         telemetry_mod.REGISTRY.clear_series("nomad.quality.")
         timeline_mod.TIMELINE.reset()
+        # ledger-cost baseline: MEMLEDGER is process-global, so the
+        # overhead fraction must charge only THIS run's scrapes
+        from nomad_tpu.core.memledger import MEMLEDGER as _ml
+        mem_total0 = _ml.stats()["scrape_total_s"]
         self.agent = Agent(client_enabled=False, num_workers=2,
                            heartbeat_ttl=self.heartbeat_ttl,
                            clock=self.clock, slo=self.slo).start()
@@ -571,6 +582,26 @@ class SoakRunner:
             self.violations += self._converged(snap)
             self.violations += self._invariants(snap)
             self.violations += self._health_gates()
+            # ---- memory gates (core/memledger) ----
+            # final fresh scrape so the summary carries end-of-run
+            # footprint; all values are volatile wall facts — they gate
+            # the verdict, never the canonical trace
+            from nomad_tpu.core.memledger import MEMLEDGER
+            # overhead charges TICK sampling only (the 0.1% budget is
+            # about the cadence riding Server.tick): snapshot the
+            # metered total before the explicit end-of-run gate scrape,
+            # whose cost is this verdict's to pay, not the soak's
+            mem_sampling_s = (MEMLEDGER.stats()["scrape_total_s"]
+                              - mem_total0)
+            mem_doc = MEMLEDGER.scrape()
+            jstats = self.agent.server.state.journal_stats()
+            ring_evictions = sum(MEMLEDGER.evictions().values())
+            if self.rss_ceiling_mb >= 0:
+                peak_mb = mem_doc["RSSPeakBytes"] / (1024.0 * 1024.0)
+                if peak_mb > self.rss_ceiling_mb:
+                    self.violations.append(
+                        f"rss peak {peak_mb:.1f} MiB exceeds ceiling "
+                        f"{self.rss_ceiling_mb:g} MiB")
             fingerprint = coarse_fingerprint(snap)
             ok = not self.violations and self._chaos_ok
             self.trace.record(end_v, "verdict", ok=bool(ok),
@@ -616,6 +647,26 @@ class SoakRunner:
                 # sha256 of the canonical dump: the same-seed double-run
                 # test compares these (and the full bytes)
                 "timeline_digest": tl.canonical_digest(),
+                # memory & footprint plane (core/memledger): volatile
+                # wall facts — reported and gated (rss_ceiling_mb,
+                # perfcheck --kind memory), excluded from determinism
+                # comparison and the canonical digests above
+                "rss_bytes": int(mem_doc["RSSBytes"]),
+                "rss_peak_bytes": int(mem_doc["RSSPeakBytes"]),
+                "journal_bytes": int(jstats["bytes"]),
+                "journal_entries": int(jstats["entries"]),
+                "journal_compactions": int(jstats["compactions"]),
+                "journal_bytes_reclaimed":
+                    int(jstats["bytes_reclaimed"]),
+                "journal_floor_fallbacks":
+                    int(jstats["floor_fallbacks"]),
+                "ring_evictions": int(ring_evictions),
+                "mem_scrape_us": float(mem_doc["ScrapeMeanMicros"]),
+                # ledger cost over the run's wall time (perfcheck gates
+                # this at <= 0.001 — the 0.1% soak-overhead budget)
+                "mem_overhead_fraction":
+                    round(mem_sampling_s / wall_s, 6)
+                    if wall_s > 0 else 0.0,
                 "ok": bool(ok),
             }
             return SoakResult(ok, self.violations, self.trace,

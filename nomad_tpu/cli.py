@@ -910,6 +910,40 @@ def cmd_health(args) -> int:
     return 0 if doc.get("Healthy") else 1
 
 
+def cmd_mem(args) -> int:
+    """The memory ledger (`nomad mem`): per-plane byte/entry/eviction
+    table + process RSS from core/memledger.py.  `-cached` reads the
+    last tick sample instead of forcing a scrape; `-json` dumps the
+    raw operator document."""
+    doc = _client(args).operator.memory(cached=args.cached)
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+
+    def _mb(n: float) -> str:
+        return f"{n / (1024.0 * 1024.0):.1f}M"
+
+    print(f"rss          = {_mb(doc.get('RSSBytes', 0))} "
+          f"(peak {_mb(doc.get('RSSPeakBytes', 0))})")
+    print(f"tracked      = {_mb(doc.get('TrackedBytes', 0))} across "
+          f"{len(doc.get('Planes', {}))} planes")
+    print(f"scrape       = {doc.get('ScrapeMicros', 0):g}µs last, "
+          f"{doc.get('ScrapeMeanMicros', 0):g}µs mean "
+          f"({doc.get('Scrapes', 0)} scrapes)")
+    print(f"{'Plane':<12} {'Bytes':>10} {'Entries':>9} {'Cap':>7} "
+          f"{'Occ':>6} {'Evictions':>10}")
+    for name, p in sorted(doc.get("Planes", {}).items()):
+        cap = int(p.get("cap", 0) or 0)
+        entries = int(p.get("entries", 0) or 0)
+        occ = f"{entries / cap:.0%}" if cap else "-"
+        print(f"{name:<12} {int(p.get('bytes', 0)):>10} "
+              f"{entries:>9} {cap if cap else '-':>7} {occ:>6} "
+              f"{int(p.get('evictions', 0)):>10}")
+        if p.get("error"):
+            print(f"  ! sizer error: {p['error']}")
+    return 0
+
+
 def cmd_profile(args) -> int:
     """On-demand profile capture (`nomad profile`): ask the agent for a
     timed capture — folded host stacks, time-bucket breakdown, GIL-wait
@@ -1007,7 +1041,8 @@ def cmd_soak(args) -> int:
     for i in range(runs):
         if runs > 1:
             print(f"== soak run {i + 1}/{runs} (seed {args.seed}) ==")
-        results.append(run_soak(seed=args.seed, profile=profile))
+        results.append(run_soak(seed=args.seed, profile=profile,
+                                rss_ceiling_mb=args.rss_ceiling_mb))
     r = results[0]
     s = r.summary
     print(f"seed                  = {s['seed']}")
@@ -1029,6 +1064,13 @@ def cmd_soak(args) -> int:
           f"{s['timeline_annotations']} annotations "
           f"(overhead {s['timeline_overhead_fraction']:.4f}, "
           f"digest {s['timeline_digest'][:16]}…)")
+    print(f"memory                = rss peak "
+          f"{s['rss_peak_bytes'] / 1048576.0:.1f}M, journal "
+          f"{s['journal_bytes']} B "
+          f"({s['journal_compactions']} compactions, "
+          f"{s['journal_floor_fallbacks']} floor fallbacks), "
+          f"{s['ring_evictions']} ring evictions, ledger overhead "
+          f"{s['mem_overhead_fraction']:.4f}")
     ok = all(x.ok for x in results)
     for x in results:
         for v in x.violations:
@@ -1659,6 +1701,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="SLO verdicts (observed vs threshold)")
     hl.set_defaults(fn=cmd_health)
 
+    mm = sub.add_parser("mem",
+                        help="memory ledger (per-plane bytes, RSS, "
+                             "evictions)")
+    mm.add_argument("-cached", action="store_true",
+                    help="last tick sample; don't force a scrape")
+    mm.add_argument("-json", action="store_true",
+                    help="raw operator document as JSON")
+    mm.set_defaults(fn=cmd_mem)
+
     prof = sub.add_parser("profile",
                           help="on-demand profile capture (folded "
                                "stacks, buckets, device ledger)")
@@ -1716,6 +1767,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run twice, require byte-identical traces")
     sk.add_argument("-json", default="",
                     help="write the summary JSON to this path")
+    sk.add_argument("-rss-ceiling-mb", dest="rss_ceiling_mb",
+                    type=float, default=-1.0,
+                    help="fail if process RSS high-water exceeds this "
+                         "many MiB (default: disabled)")
     sk.set_defaults(fn=cmd_soak)
 
     tl = sub.add_parser("timeline",

@@ -44,6 +44,7 @@ forever.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -62,7 +63,8 @@ class _Shape:
     everyone's behalf; `result`/`evaluated_at` memoize the shape's
     result index per commit batch so K waiters cost one evaluation."""
 
-    __slots__ = ("cond", "result", "evaluated_at", "waiters", "leader")
+    __slots__ = ("cond", "result", "evaluated_at", "waiters", "leader",
+                 "touched")
 
     def __init__(self, lock: threading.Lock) -> None:
         self.cond = threading.Condition(lock)
@@ -70,6 +72,7 @@ class _Shape:
         self.evaluated_at = -1    # store index at evaluation time
         self.waiters = 0
         self.leader = False
+        self.touched = 0.0        # clock.monotonic() of last activity
 
 
 class WatchHub:
@@ -87,6 +90,7 @@ class WatchHub:
         self._wakes = 0           # clients returned "changed"
         self._timeouts = 0        # clients returned "unchanged"
         self._coalesced = 0       # follower wakes served by a leader eval
+        self.shapes_reaped = 0    # idle-shape GC victims (reap_idle)
 
     # ----------------------------------------------------------- client
 
@@ -115,6 +119,7 @@ class WatchHub:
                 clock.register(shape.cond)
                 telemetry.REGISTRY.set_gauge("nomad.fanout.shapes",
                                              len(self._shapes))
+            shape.touched = clock.monotonic()
             shape.waiters += 1
         am_leader = False
         try:
@@ -188,12 +193,39 @@ class WatchHub:
                     # deadline slice fires
                     shape.leader = False
                     shape.cond.notify_all()
+                shape.touched = clock.monotonic()
                 shape.waiters -= 1
                 if shape.waiters <= 0:
                     self._shapes.pop(key, None)
                     clock.unregister(shape.cond)
                     telemetry.REGISTRY.set_gauge("nomad.fanout.shapes",
                                                  len(self._shapes))
+
+    # --------------------------------------------------------------- gc
+
+    def reap_idle(self, now: float, idle_s: float) -> int:
+        """Defensive idle-shape GC (ISSUE 19 satellite): drop any shape
+        that has sat with ZERO parked waiters for longer than `idle_s`
+        (one max_query_time).  The finally-block in block() already
+        pops shapes as their last waiter exits, so a reaped shape means
+        a client path died without unwinding — reaping it unpins the
+        condition from the clock's registry and keeps the table from
+        growing forever.  Driven from Server.tick; counted as
+        nomad.fanout.shapes_reaped."""
+        reaped = 0
+        with self._lock:
+            for key, shape in list(self._shapes.items()):
+                if shape.waiters <= 0 and now - shape.touched > idle_s:
+                    self._shapes.pop(key)
+                    self._clock.unregister(shape.cond)
+                    reaped += 1
+            if reaped:
+                self.shapes_reaped += reaped
+                telemetry.REGISTRY.set_gauge("nomad.fanout.shapes",
+                                             len(self._shapes))
+        if reaped:
+            telemetry.REGISTRY.inc("nomad.fanout.shapes_reaped", reaped)
+        return reaped
 
     # ------------------------------------------------------------ intro
 
@@ -206,7 +238,20 @@ class WatchHub:
                 "wakes": self._wakes,
                 "timeouts": self._timeouts,
                 "coalesced": self._coalesced,
+                "shapes_reaped": self.shapes_reaped,
             }
+
+    def mem_stats(self) -> Dict[str, int]:
+        """Ledger sizer (core/memledger): live shape table + parked
+        waiters at a fixed per-entry estimate (a _Shape is a condition
+        + four scalars; waiters are parked frames we do not own)."""
+        with self._lock:
+            shapes = len(self._shapes)
+            waiters = sum(s.waiters for s in self._shapes.values())
+            reaped = self.shapes_reaped
+        return {"bytes": 96 + shapes * 512 + waiters * 64,
+                "entries": shapes, "cap": 0, "evictions": reaped,
+                "waiters": waiters}
 
 
 # ---------------------------------------------------------------------------
@@ -251,6 +296,7 @@ class EventRing:
         self._next_seq = 0
         self._cum_base = 0           # events trimmed off the tail, total
         self._capacity = capacity
+        self._approx_bytes = 0       # shallow payload estimate, O(1)/append
         self.dropped_total = 0       # events skipped by lagging cursors
         self.closed = False
 
@@ -263,10 +309,13 @@ class EventRing:
                    else self._cum_base)
             self._entries.append(_RingEntry(self._next_seq, topic, index,
                                             payload, count, cum + count))
+            self._approx_bytes += 128 + sys.getsizeof(payload)
             self._next_seq += 1
             excess = len(self._entries) - self._capacity
             if excess > 0:
                 self._cum_base = self._entries[excess - 1].cum_end
+                for e in self._entries[:excess]:
+                    self._approx_bytes -= 128 + sys.getsizeof(e.payload)
                 del self._entries[:excess]
                 self._base_seq += excess
             self._cond.notify_all()
@@ -345,6 +394,8 @@ class EventRing:
                 "base_seq": self._base_seq,
                 "next_seq": self._next_seq,
                 "dropped_total": self.dropped_total,
+                "bytes": self._approx_bytes,
+                "capacity": self._capacity,
             }
 
 

@@ -178,6 +178,24 @@ class FlightRecorder:
             for k in self.stats:
                 self.stats[k] = 0
 
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): ring occupancy + a sampled
+        byte estimate (one newest record per ring sized per call; the
+        records are flat dicts so this stays microseconds)."""
+        from nomad_tpu.core.memledger import approx_sizeof
+        with self._lock:
+            entries = (len(self._waves) + len(self._evals)
+                       + len(self._events))
+            cap = (self._waves.maxlen + self._evals.maxlen
+                   + self._events.maxlen)
+            evictions = sum(self.stats.values())
+            sample = [ring[-1] for ring in (self._waves, self._evals,
+                                            self._events) if ring]
+        per = (sum(approx_sizeof(r, depth=2) for r in sample)
+               / len(sample)) if sample else 0.0
+        return {"bytes": int(per * entries), "entries": entries,
+                "cap": cap, "evictions": evictions}
+
 
 # --------------------------------------------------------------- watchdog
 
@@ -201,10 +219,22 @@ DEFAULT_SLO = {
     "networked_ratio": 0.25,
     # missed heartbeat TTLs per check interval (a flap storm)
     "heartbeat_misses": 64.0,
+    # process RSS ceiling in MiB (core/memledger's tick-sampled
+    # VmRSS).  Disabled by default — a sane ceiling is deployment-
+    # sized; the RSS-gated soak (chaos/soak.py rss_ceiling_mb) and
+    # agent_config server.slo.rss_mb turn it on
+    "rss_mb": -1.0,
     # rolling-window span + check throttle (not rules)
     "window_s": 60.0,
     "interval_s": 5.0,
 }
+
+
+def _memory_doc() -> Dict:
+    """Memory-ledger operator document for breach dumps (late import:
+    memledger imports telemetry only, but keep the edge one-way)."""
+    from nomad_tpu.core.memledger import MEMLEDGER
+    return MEMLEDGER.doc()
 
 # "log ring not specified" sentinel: None is meaningful (no logs in
 # dumps — the deterministic-bundle tests use it)
@@ -287,6 +317,10 @@ class HealthWatchdog:
         net = (round(delta("ports_batched") / d_ports, 6)
                if d_ports else None)
         hb = delta("heartbeat_misses")
+        # memory plane (core/memledger): last tick-sampled RSS; None
+        # before the first scrape so the rule cannot breach during boot
+        from nomad_tpu.core.memledger import MEMLEDGER
+        rss = round(MEMLEDGER.rss_mb(), 3) or None
         rows = (
             ("p99_plan_queue_ms", "ceiling", p99_ms, "ms",
              "rolling-window p99 of nomad.plan.queue_wait_s"),
@@ -298,6 +332,8 @@ class HealthWatchdog:
              "columnar-carved port rows / all port rows"),
             ("heartbeat_misses", "ceiling", hb, "count",
              "missed heartbeat TTLs since last check"),
+            ("rss_mb", "ceiling", rss, "MiB",
+             "tick-sampled process VmRSS (core/memledger)"),
         )
         verdicts = []
         for name, kind, observed, unit, source in rows:
@@ -421,6 +457,9 @@ class HealthWatchdog:
             "Spans": self.tracer.spans()[-200:],
             "Logs": (self.log_ring.tail(200)
                      if self.log_ring is not None else []),
+            # per-plane footprint at breach time (core/memledger): an
+            # OOM-adjacent breach diagnoses itself from the dump
+            "Memory": _memory_doc(),
         }
 
     def dumps(self) -> List[Dict]:

@@ -108,6 +108,21 @@ class LogRing:
             if q in self._subs:
                 self._subs.remove(q)
 
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): buffer occupancy with the
+        newest record as the per-record byte estimate; evictions read
+        the registry's trim counter (the ring itself keeps none)."""
+        from nomad_tpu.core.memledger import approx_sizeof
+        with self._lock:
+            entries = len(self._buf)
+            newest = self._buf[-1] if self._buf else None
+            subs = len(self._subs)
+        per = approx_sizeof(newest, depth=2) if newest is not None else 0
+        dropped = int(REGISTRY.counter_sum("nomad.logring.dropped"))
+        return {"bytes": per * entries + subs * 256, "entries": entries,
+                "cap": self._size, "evictions": dropped,
+                "subscribers": subs}
+
 
 # process-wide default ring (one agent per process in practice)
 RING = LogRing()

@@ -58,6 +58,7 @@ demand from its own private tables).
 
 from __future__ import annotations
 
+import itertools
 import sys
 import threading
 import time
@@ -586,6 +587,27 @@ class SamplingProfiler:
             if role is None or r == role:
                 lines.append(f"{r};<fold-table-overflow> {count}")
         return "\n".join(lines)
+
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): fold-table + capture
+        occupancy.  Folds are (role, stack-tuple) keys — flat per-key
+        estimate scaled by a sampled key length; fold-table overflow
+        counts as the eviction stream."""
+        with self._lock:
+            folds = len(self._folds)
+            frames = sum(len(k[1]) for k in
+                         itertools.islice(self._folds, 8))
+            sampled = min(folds, 8)
+            captures = len(self._captures)
+            overflow = sum(self._overflow.values())
+            buckets = len(self._buckets)
+            remote = len(self._remote)
+        per_fold = 96 + (frames / sampled if sampled else 0) * 80
+        return {"bytes": int(folds * per_fold + captures * 16384
+                             + buckets * 96 + remote * 2048),
+                "entries": folds + captures,
+                "cap": 0, "evictions": overflow,
+                "folds": folds, "captures": captures}
 
     def brief(self) -> Dict:
         """Compact summary for /v1/operator/debug and HealthBreach

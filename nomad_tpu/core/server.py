@@ -39,7 +39,7 @@ from nomad_tpu.structs import (
     new_id,
 )
 
-from . import flightrec, identity, profiling, telemetry, timeline
+from . import flightrec, identity, memledger, profiling, telemetry, timeline
 from . import logging as logging_mod
 from .logging import log
 from .blocked_evals import BlockedEvals
@@ -89,6 +89,10 @@ class Server:
         # virtual-time soak: no raw time.time() left in core/)
         logging_mod.configure(self.clock)
         identity.configure(self.clock)
+        # the memory ledger's scrape CADENCE rides the same injected
+        # clock (core/memledger.py); its VALUES (RSS, byte estimates)
+        # are wall facts and stay out of every canonical dump
+        memledger.configure(self.clock)
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -226,6 +230,33 @@ class Server:
         profiling.configure(hz=profile_hz)
         profiling.PROFILER.device_ledger_provider = self._device_ledger
         profiling.PROFILER.flight_provider = flightrec.FLIGHT.snapshot
+        # memory ledger plane registrations (core/memledger.py): every
+        # bounded plane this server owns gets a sizer; last-write-wins
+        # by name, so a new Server re-binds its planes the way the
+        # configure() calls above re-bind the clock.  `state` may be a
+        # ReplicatedState proxy without the sizer hooks — register what
+        # exists and skip the rest.
+        ml = memledger.MEMLEDGER
+        if hasattr(self.state, "mem_stats"):
+            ml.register("state", self.state.mem_stats)
+        if hasattr(self.state, "journal_stats"):
+            ml.register("journal", self.state.journal_stats)
+        ml.register("watch_hub", self.watch_hub.mem_stats)
+        ml.register("events", self.events.mem_stats)
+        ml.register("flight", flightrec.FLIGHT.mem_stats)
+        ml.register("timeline", timeline.TIMELINE.mem_stats)
+        ml.register("tracer", telemetry.TRACER.mem_stats)
+        ml.register("metrics", telemetry.REGISTRY.mem_stats)
+        ml.register("logring", logging_mod.RING.mem_stats)
+        ml.register("profiler", profiling.PROFILER.mem_stats)
+        if self.worker_pool is not None:
+            ml.register("worker_pool", self.worker_pool.mem_stats)
+        else:
+            ml.unregister("worker_pool")
+        # blocking watchers re-touch their shape each park; a shape
+        # nobody has parked on for this long is garbage (defensive GC —
+        # the pop-at-zero path already frees the common case)
+        self.watch_idle_s = 300.0
 
     def _device_ledger(self) -> Dict:
         """Capture-bundle provider: this server's executor ledger
@@ -855,6 +886,12 @@ class Server:
         # aligned timeline row per tick, followers included (their
         # gauges and windows are node-local too)
         timeline.TIMELINE.sample(self.clock.monotonic())
+        # footprint sampling shares the tick too (throttled inside the
+        # ledger); idle-shape GC rides the same cadence so a scrape
+        # never reports shapes the fanout plane has already abandoned
+        if memledger.MEMLEDGER.sample(self.clock.monotonic()):
+            self.watch_hub.reap_idle(self.clock.monotonic(),
+                                     self.watch_idle_s)
         if not self._leader:
             # followers carry no timers/queues; their copies of these
             # duties belong to the leader (reference: leaderLoop)

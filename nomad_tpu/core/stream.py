@@ -284,3 +284,15 @@ class EventBroker:
             "DroppedTotal": ring["dropped_total"],
             "Cursors": [s.stats() for s in subs],
         }
+
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): the shared ring's incremental
+        byte estimate + entry occupancy; drops count as evictions."""
+        ring = self._ring.stats()
+        with self._lock:
+            n_subs = len(self._subs)
+        return {"bytes": ring["bytes"] + 256 * n_subs,
+                "entries": ring["entries"],
+                "cap": ring["capacity"],
+                "evictions": ring["dropped_total"],
+                "subscribers": n_subs}

@@ -402,6 +402,23 @@ class MetricsRegistry:
             self._hists.clear()
             self._windows.clear()
 
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): series counts across the four
+        stores.  Flat estimate per series kind — scalar series are a
+        keyed float, hist/window series carry bucket arrays / sample
+        deques — so the scrape never walks the stores."""
+        with self._lock:
+            scalars = len(self._counters) + len(self._gauges)
+            hists = len(self._hists)
+            windows = len(self._windows)
+            win_subs = sum(len(w._subs) for w in self._windows.values())
+        return {"bytes": (scalars * 160 + hists * 640
+                          + windows * 256 + win_subs * 640),
+                "entries": scalars + hists + windows,
+                "cap": 0, "evictions": 0,
+                "series": {"scalar": scalars, "hist": hists,
+                           "window": windows}}
+
 
 class StatCounters:
     """Dict-shaped stat block whose increments are ATOMIC and mirrored
@@ -577,6 +594,19 @@ class Tracer:
             self._spans.clear()
             self._seq = 0
             self.dropped = 0
+
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): span-ring occupancy, newest
+        span sized as the per-record estimate."""
+        from nomad_tpu.core.memledger import approx_sizeof
+        with self._lock:
+            entries = len(self._spans)
+            cap = self._spans.maxlen
+            dropped = self.dropped
+            newest = self._spans[-1] if self._spans else None
+        per = approx_sizeof(newest, depth=2) if newest is not None else 0
+        return {"bytes": per * entries, "entries": entries,
+                "cap": cap, "evictions": dropped}
 
 
 # -------------------------------------------------------------- globals

@@ -419,6 +419,29 @@ class Timeline:
         st["points"] = len(self._rows)
         return st
 
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): row/annotation occupancy with
+        a sampled byte estimate — one recent row + one annotation per
+        ring are deep-sized per call, never the whole history."""
+        from nomad_tpu.core.memledger import approx_sizeof
+        with self._lock:
+            points = len(self._rows)
+            ann = len(self._ann_canon) + len(self._ann_vol)
+            evictions = (self.stats["point_evictions"]
+                         + self.stats["annotation_evictions"]
+                         + self.stats["volatile_evictions"])
+            row = self._rows[max(self._rows)] if self._rows else None
+            anns = [ring[-1] for ring in (self._ann_canon, self._ann_vol)
+                    if ring]
+        per_row = approx_sizeof(row, depth=2) if row is not None else 0
+        per_ann = (sum(approx_sizeof(a, depth=2) for a in anns)
+                   / len(anns)) if anns else 128.0
+        return {"bytes": int(per_row * points + per_ann * ann),
+                "entries": points + ann,
+                "cap": self.max_points + 2 * self.max_annotations,
+                "evictions": evictions,
+                "points": points, "annotations": ann}
+
     # -------------------------------------------------- canonical dump
 
     def canonical_dump(self) -> Dict:

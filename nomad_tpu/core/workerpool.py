@@ -611,7 +611,13 @@ class WorkerPool:
         self._started = False
         self._closing = False
         self.stats = {"respawns": 0, "plans": 0, "dispatches": 0,
-                      "dequeues": 0}
+                      "dequeues": 0,
+                      # replica-journal accounting (parent side): the
+                      # children's journals live across the process
+                      # boundary, so the ledger charges the wire — every
+                      # reply that carried an export_since doc counts
+                      # its packed bytes here
+                      "export_replies": 0, "export_bytes": 0}
 
     # ----------------------------------------------------- lifecycle
 
@@ -713,6 +719,22 @@ class WorkerPool:
                     for k, v in self.front.stats.items()})
         return out
 
+    def mem_stats(self) -> Dict:
+        """Ledger sizer (core/memledger): parent-side bookkeeping for
+        the children (outstanding eval tokens, queued plan handles,
+        chain refs) plus the cumulative replica-sync wire bytes — the
+        children's actual journals are across the process boundary, so
+        shipped bytes are the honest proxy the ledger can audit."""
+        entries = sum(len(c.outstanding) + len(c.plans)
+                      + len(c.chains) + len(c.pendings)
+                      for c in self._children)
+        return {"bytes": 4096 * len(self._children) + 192 * entries,
+                "entries": entries, "cap": 0, "evictions": 0,
+                "export_replies": self.stats["export_replies"],
+                "export_bytes_shipped": self.stats["export_bytes"],
+                "gauges": {"nomad.pool.export_bytes":
+                           self.stats["export_bytes"]}}
+
     # ------------------------------------------------------- serving
 
     def _serve(self, child: _Child) -> None:
@@ -730,7 +752,13 @@ class WorkerPool:
                 result, ok = f"{type(e).__name__}: {e}", False
             if rid is not None:
                 try:
-                    conn.send_bytes(wire.packb([rid, ok, result]))
+                    blob = wire.packb([rid, ok, result])
+                    if (ok and isinstance(result, dict)
+                            and ("export" in result
+                                 or "kind" in result)):
+                        self.stats["export_replies"] += 1
+                        self.stats["export_bytes"] += len(blob)
+                    conn.send_bytes(blob)
                 except (OSError, ValueError, BrokenPipeError):
                     return
 

@@ -16,6 +16,8 @@ are rebuildable from here at any time (checkpoint/resume, SURVEY.md §6.4).
 
 from __future__ import annotations
 
+import itertools
+import sys
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -42,6 +44,13 @@ from nomad_tpu.structs import (
     ServiceRegistration,
     compute_class,
 )
+
+
+def _entry_cost(entry: tuple) -> int:
+    """Approximate retained cost of one journal entry: the 3-tuple,
+    its key payload, and deque-slot overhead.  getsizeof is C-level
+    (~100ns), cheap enough for the append hot path."""
+    return 64 + sys.getsizeof(entry) + sys.getsizeof(entry[2])
 
 
 class StateStore:
@@ -176,6 +185,22 @@ class StateStore:
         self._journal: "deque" = deque()
         self._journal_cap = 8192
         self._journal_floor = 0
+        # journal footprint + coalescing ledger (ISSUE 19): byte
+        # estimate maintained incrementally at append/evict, merge-by-
+        # key compactions metered so the MEMLEDGER scrape can publish
+        # nomad.journal.* without touching telemetry under this lock
+        self._journal_bytes = 0
+        self._journal_appends = 0
+        self._journal_compacted_at = 0     # append count at last compact
+        self._journal_compact_backoff = 0  # appends to wait before retry
+        self._journal_evictions = 0        # entries lost to the floor
+        self._journal_compactions = 0
+        self._journal_reclaimed_bytes = 0
+        self._journal_floor_fallbacks = 0  # full-snapshot exports served
+        # sampled per-table row-cost cache for mem_stats (one table
+        # re-sampled per call, round-robin)
+        self._mem_rr = 0
+        self._mem_row_cost: Dict[str, float] = {}
 
     # ------------------------------------------------------------- indexes
 
@@ -376,8 +401,8 @@ class StateStore:
         with self._lock:
             self._listeners.append(fn)
 
-    def _emit(self, topic: str, index: int, payload: object) -> None:
-        self._journal_note(topic, index, payload)
+    def _emit_locked(self, topic: str, index: int, payload: object) -> None:
+        self._journal_note_locked(topic, index, payload)
         for fn in list(self._listeners):
             try:
                 fn(topic, index, payload)
@@ -386,7 +411,7 @@ class StateStore:
 
     # ------------------------------------------- replica export (deltas)
 
-    def _journal_note(self, topic: str, index: int, payload) -> None:
+    def _journal_note_locked(self, topic: str, index: int, payload) -> None:
         """Record dirty keys for export_since (lock held — _emit fires
         from write paths).  Payload fidelity varies by topic (object on
         upsert, bare key on delete); the journal stores only (section,
@@ -416,15 +441,137 @@ class StateStore:
         elif topic == "Restore":
             self._journal.clear()
             self._journal_floor = index
+            self._journal_bytes = 0
             return
         else:
             return                      # PlanResult etc: no replica table
         j = self._journal
         for e in entries:
-            if len(j) >= self._journal_cap:
+            entry = (index,) + e
+            j.append(entry)
+            self._journal_bytes += _entry_cost(entry)
+        self._journal_appends += len(entries)
+        if len(j) > self._journal_cap:
+            # coalesce superseded (section, key) deltas before paying
+            # retention: newest-wins dedupe preserves export_since for
+            # EVERY since value (export resolves keys against the LIVE
+            # tables, so intermediate versions were never shipped) and
+            # never raises the floor.  Adaptive backoff: while
+            # compaction pays (churny duplicate-heavy journals) it runs
+            # on every overflow and the floor never moves; once a
+            # compaction reclaims almost nothing (unique-key growth) it
+            # backs off cap/8 appends so the degenerate case costs O(1)
+            # eviction per append, not O(n) re-compaction.
+            if (self._journal_appends - self._journal_compacted_at
+                    >= self._journal_compact_backoff):
+                reclaimed = self._compact_journal_locked()
+                self._journal_compacted_at = self._journal_appends
+                self._journal_compact_backoff = (
+                    0 if reclaimed >= max(self._journal_cap // 8, 1)
+                    else max(self._journal_cap // 8, 64))
+            while len(j) > self._journal_cap:
                 self._journal_floor = j[0][0]
-                j.popleft()
-            j.append((index,) + e)
+                old = j.popleft()
+                self._journal_bytes -= _entry_cost(old)
+                self._journal_evictions += 1
+
+    def _compact_journal_locked(self) -> int:
+        """Merge-by-key journal coalescing: keep only the NEWEST entry
+        per (section, key).  Exactly equivalence-preserving — for any
+        `since`, every key the dropped duplicates would have dirtied is
+        still dirtied by its surviving (newer) entry, and export
+        resolves the same live object either way (tombstones and
+        block_gone carries included; the property test in
+        tests/test_memledger.py proves replica bit-identity).  The
+        floor never moves, so compaction cannot cause a full-snapshot
+        fallback.  Returns entries reclaimed."""
+        j = self._journal
+        if len(j) < 2:
+            return 0
+        seen: set = set()
+        kept: List[tuple] = []
+        for entry in reversed(j):
+            k = (entry[1], entry[2])
+            if k in seen:
+                continue
+            seen.add(k)
+            kept.append(entry)
+        reclaimed = len(j) - len(kept)
+        if reclaimed == 0:
+            return 0
+        kept.reverse()
+        before_bytes = self._journal_bytes
+        j.clear()
+        j.extend(kept)
+        self._journal_bytes = sum(_entry_cost(e) for e in kept)
+        self._journal_compactions += 1
+        self._journal_reclaimed_bytes += max(
+            before_bytes - self._journal_bytes, 0)
+        return reclaimed
+
+    def compact_journal(self) -> int:
+        """On-demand compaction (tests, operator tooling)."""
+        with self._lock:
+            return self._compact_journal_locked()
+
+    def journal_stats(self) -> Dict:
+        """Ledger sizer for the export journal (core/memledger): the
+        retained window, its byte estimate, the floor, and the
+        coalescing/fallback meters.  The `gauges` sub-dict is published
+        verbatim by the MEMLEDGER scrape — no telemetry work happens
+        under the store lock."""
+        with self._lock:
+            return {
+                "entries": len(self._journal),
+                "bytes": self._journal_bytes,
+                "cap": self._journal_cap,
+                "floor": self._journal_floor,
+                "evictions": self._journal_evictions,
+                "compactions": self._journal_compactions,
+                "bytes_reclaimed": self._journal_reclaimed_bytes,
+                "floor_fallbacks": self._journal_floor_fallbacks,
+                "gauges": {
+                    "nomad.journal.entries": len(self._journal),
+                    "nomad.journal.bytes": self._journal_bytes,
+                    "nomad.journal.compactions":
+                        self._journal_compactions,
+                    "nomad.journal.bytes_reclaimed":
+                        self._journal_reclaimed_bytes,
+                    "nomad.journal.floor_fallbacks":
+                        self._journal_floor_fallbacks,
+                },
+            }
+
+    def mem_stats(self) -> Dict:
+        """Ledger sizer for the live tables: row counts plus a SAMPLED
+        byte estimate.  Cost discipline (PERF.md §21): each call
+        deep-sizes a few rows of ONE table (round-robin) and caches the
+        per-table mean row cost; the other tables reuse their cached
+        means, so a scrape is O(sample) — never a table walk."""
+        from nomad_tpu.core.memledger import approx_sizeof
+        with self._lock:
+            tables = {"nodes": self._nodes, "jobs": self._jobs,
+                      "evals": self._evals, "allocs": self._allocs,
+                      "deployments": self._deployments,
+                      "alloc_blocks": self._alloc_blocks,
+                      "csi_volumes": self._csi_volumes}
+            table_rows = {k: len(t) for k, t in tables.items()}
+            names = sorted(tables)
+            pick = names[self._mem_rr % len(names)]
+            self._mem_rr += 1
+            rows = list(itertools.islice(tables[pick].values(), 3))
+        # deep-size OUTSIDE the store lock: rows are immutable by COW
+        # discipline, and the estimator must never stall writers
+        if rows:
+            per = sum(approx_sizeof(r) for r in rows) / len(rows)
+            self._mem_row_cost[pick] = per
+        total = 0
+        for k, n in table_rows.items():
+            total += int(n * self._mem_row_cost.get(k, 512.0))
+        return {"bytes": total,
+                "entries": sum(table_rows.values()),
+                "cap": 0, "evictions": 0,
+                "tables": table_rows}
 
     def export_since(self, since_index: int) -> Dict:
         """Wire-shippable state export for scheduler-worker replicas
@@ -441,6 +588,10 @@ class StateStore:
             if since_index >= latest:
                 return {"kind": "empty", "index": latest, "fence": fence}
             if since_index < self._journal_floor:
+                # the thrash the journal compaction exists to prevent:
+                # counted here, published as nomad.journal.floor_fallbacks
+                # by the MEMLEDGER scrape, gated == 0 by perfcheck
+                self._journal_floor_fallbacks += 1
                 return {"kind": "full", "doc": self.snapshot_save(),
                         "index": self._index, "fence": self._placement_seq}
             ups: Dict[str, list] = {}
@@ -680,7 +831,7 @@ class StateStore:
             node.computed_class = compute_class(node)
             self._nodes = {**self._nodes, node.id: node}
             self._touch_node(node.id)
-            self._emit("Node", idx, node)
+            self._emit_locked("Node", idx, node)
             return idx
 
     def upsert_nodes(self, nodes: Iterable[Node]) -> int:
@@ -702,7 +853,7 @@ class StateStore:
                 inserted.append(node)
             self._nodes = table          # publish before events fire
             for node in inserted:
-                self._emit("Node", idx, node)
+                self._emit_locked("Node", idx, node)
             return idx
 
     def delete_node(self, node_id: str) -> int:
@@ -712,7 +863,7 @@ class StateStore:
             nodes.pop(node_id, None)
             self._nodes = nodes
             self._touch_node(node_id)
-            self._emit("Node", idx, node_id)
+            self._emit_locked("Node", idx, node_id)
             return idx
 
     def update_node_status(self, node_id: str, status: str) -> int:
@@ -774,7 +925,7 @@ class StateStore:
             versions = dict(self._job_versions.get(key, {}))
             versions[job.version] = job
             self._job_versions = {**self._job_versions, key: versions}
-            self._emit("Job", idx, job)
+            self._emit_locked("Job", idx, job)
             return idx
 
     def delete_job(self, namespace: str, job_id: str) -> int:
@@ -783,7 +934,7 @@ class StateStore:
             jobs = dict(self._jobs)
             jobs.pop((namespace, job_id), None)
             self._jobs = jobs
-            self._emit("Job", idx, (namespace, job_id))
+            self._emit_locked("Job", idx, (namespace, job_id))
             return idx
 
     # --------------------------------------------------------------- evals
@@ -824,7 +975,7 @@ class StateStore:
                 by_job[key][e.id] = e
                 inserted.append(e)
             for e in inserted:
-                self._emit("Evaluation", idx, e)
+                self._emit_locked("Evaluation", idx, e)
             return idx
 
     def delete_evals(self, eval_ids: Iterable[str]) -> int:
@@ -937,7 +1088,7 @@ class StateStore:
                 vol.read_allocs.update(
                     {a.id: a.node_id for a in rows})
                 vol_changed[key] = vol
-        self._emit("BlockMaterialized", self._index, block)
+        self._emit_locked("BlockMaterialized", self._index, block)
 
     def _resolve_block_member_locked(self, alloc_id: str,
                                      namespace: str = None,
@@ -1038,7 +1189,7 @@ class StateStore:
         # one event per transaction, not per alloc: a 100k-alloc plan fires
         # one list-payload event (subscribers loop internally, vectorized)
         if inserted:
-            self._emit("Allocations", idx, inserted)
+            self._emit_locked("Allocations", idx, inserted)
 
     def update_allocs_from_client(self, updates: Iterable[Allocation]) -> int:
         """Client-side status updates (reference: FSM AllocClientUpdate):
@@ -1100,7 +1251,7 @@ class StateStore:
             dep.create_index = prev.create_index if prev else idx
             dep.modify_index = idx
             self._deployments = {**self._deployments, dep.id: dep}
-            self._emit("Deployment", idx, dep)
+            self._emit_locked("Deployment", idx, dep)
             return idx
 
     # ------------------------------------------------------- plan results
@@ -1281,7 +1432,7 @@ class StateStore:
                     d.status_description = du.status_description
                     d.modify_index = idx
                     self._deployments = {**self._deployments, d.id: d}
-            self._emit("PlanResult", idx, result)
+            self._emit_locked("PlanResult", idx, result)
             return idx
 
     def _commit_block_locked(self, block, idx: int, changed_vols,
@@ -1332,7 +1483,7 @@ class StateStore:
                     # writer accounting
                     vol.write_allocs.update(dict.fromkeys(block.ids, ""))
                 changed_vols[key] = vol
-        self._emit("AllocBlock", idx, block)
+        self._emit_locked("AllocBlock", idx, block)
 
     # ----------------------------------------------------------- csi / cfg
 
@@ -1497,7 +1648,7 @@ class StateStore:
                          if k != block_id})
         self._csi_volumes = {**self._csi_volumes, (namespace, vol_id): v}
         self._fresh_claim_vols.discard((namespace, vol_id))
-        self._emit("CSIVolume", idx, v)
+        self._emit_locked("CSIVolume", idx, v)
         return idx
 
     def release_csi_claim(self, namespace: str, vol_id: str,
@@ -1522,7 +1673,7 @@ class StateStore:
                               if k != alloc_id})
             self._csi_volumes = {**self._csi_volumes,
                                  (namespace, vol_id): v}
-            self._emit("CSIVolume", idx, v)
+            self._emit_locked("CSIVolume", idx, v)
             return idx
 
     def set_scheduler_config(self, cfg: SchedulerConfiguration) -> int:
@@ -1969,7 +2120,7 @@ class StateStore:
             self._node_seq_floor = self._placement_seq
             self._index = max(int(doc.get("Index", 0)), self._index) + 1
             self._index_cv.notify_all()
-            self._emit("Restore", self._index, None)
+            self._emit_locked("Restore", self._index, None)
 
     # ------------------------------------------------------------ snapshot
 

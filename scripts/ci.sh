@@ -128,6 +128,22 @@ try:
         assert fam in text, f"missing quality family {fam}"
     print(f"explain smoke ok: eval {huge_eval[:8]} blocked on "
           f"{sorted(tg['Metric']['DimensionExhausted'])}")
+
+    # memory ledger rides the same observability contract (ISSUE 19):
+    # the operator doc, the debug bundle's Memory + unified Evictions
+    # keys, and the nomad.mem.* families in the exposition
+    mem = api.operator.memory()
+    assert mem["Schema"] == "nomad-tpu.memory.v1", mem
+    assert mem["RSSBytes"] > 0 and mem["TrackedBytes"] > 0, mem
+    assert {"state", "journal", "flight"} <= set(mem["Planes"]), mem
+    dbg = api.operator.debug()
+    assert dbg["Memory"]["RSSBytes"] > 0, sorted(dbg)
+    assert "journal" in dbg["Evictions"], sorted(dbg["Evictions"])
+    text = api.agent.metrics(format="prometheus")
+    for fam in ("nomad_mem_rss_bytes", "nomad_mem_plane_bytes"):
+        assert fam in text, f"missing memory family {fam}"
+    print(f"memory smoke ok: rss={mem['RSSBytes']} "
+          f"tracked={mem['TrackedBytes']} planes={len(mem['Planes'])}")
     print(f"telemetry smoke ok: {n} exposition lines, trace {eval_id[:8]}"
           f" spans={sorted(names)}")
 finally:
@@ -514,6 +530,40 @@ echo "== fanout (read-path plane: hub/ring/follower suite + watcher smoke) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fanout.py -q
 JAX_PLATFORMS=cpu python bench.py --watchers --quick > BENCH_watchers.json
 python scripts/perfcheck.py --kind watchers --fresh BENCH_watchers.json
+
+echo "== memory (footprint plane: ledger suite + RSS-gated soak, both directions) =="
+# the memory & footprint observability plane (ISSUE 19): the ledger /
+# compaction-equivalence / floor-fallback / idle-reap suite, then a
+# quick churn soak under a generous RSS ceiling judged by the
+# memory-kind perfcheck gates (RSS high-water, floor-fallbacks == 0,
+# eviction budget, ledger overhead <= 0.1% of soak wall), and finally
+# the fail direction: an absurdly small ceiling must trip the gate
+# and exit non-zero (a gate that cannot fail is not a gate)
+JAX_PLATFORMS=cpu python -m pytest tests/test_memledger.py -q
+JAX_PLATFORMS=cpu python -m nomad_tpu soak -quick -rss-ceiling-mb 8192 \
+    -json SOAK_mem.json
+python - <<'EOF'
+import json
+out = json.load(open("SOAK_mem.json"))
+for k in ("rss_peak_bytes", "journal_bytes", "journal_compactions",
+          "journal_floor_fallbacks", "ring_evictions",
+          "mem_scrape_us", "mem_overhead_fraction"):
+    assert k in out, f"missing summary field {k}"
+assert out["ok"], out
+assert out["rss_peak_bytes"] > 0, out
+assert out["journal_floor_fallbacks"] == 0, out
+print("memory summary ok: rss_peak",
+      round(out["rss_peak_bytes"] / 1048576.0, 1), "MiB, journal",
+      out["journal_bytes"], "B, overhead",
+      out["mem_overhead_fraction"])
+EOF
+python scripts/perfcheck.py --kind memory --fresh SOAK_mem.json
+if JAX_PLATFORMS=cpu python -m nomad_tpu soak -quick \
+    -rss-ceiling-mb 1 >/dev/null 2>&1; then
+    echo "memory gate FAILED OPEN: 1 MiB RSS ceiling did not trip" >&2
+    exit 1
+fi
+echo "memory gate fail-direction ok: 1 MiB ceiling tripped as expected"
 
 echo "== bench smoke (CPU backend, reduced scale) =="
 JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \

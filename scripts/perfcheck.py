@@ -276,6 +276,81 @@ def compare_watchers(fresh: Dict) -> Dict:
             "legacy_http_wake": fresh.get("legacy_http_wake")}
 
 
+# ---- memory kind (ISSUE 19): judge a soak summary's footprint alone -------
+# RSS high-water ceiling when the caller doesn't pass --rss-ceiling-mb
+MEMORY_RSS_CEILING_MB_DEFAULT = 4096.0
+# ring evictions are counted backpressure by design; the budget scales
+# with the soak's virtual horizon (a 4h churn soak legitimately trims)
+MEMORY_EVICTIONS_PER_VH = 250_000.0
+MEMORY_EVICTIONS_FLOOR = 1_000.0
+MEMORY_ABS_GATES: Dict[str, Tuple[str, float]] = {
+    # a floor fallback = a replica forced to full resync because the
+    # journal evicted past its cursor — compaction must keep this at 0
+    "journal_floor_fallbacks": ("==", 0),
+    # ledger cost over soak wall time: the 0.1% budget (PERF.md §21)
+    "mem_overhead_fraction": ("<=", 0.001),
+    # mean scrape cost sanity ceiling (µs)
+    "mem_scrape_us": ("<=", 5000.0),
+}
+
+
+def check_memory_rss(fresh: Dict, ceiling_mb: float) -> Dict:
+    row: Dict = {"metric": "rss_peak_bytes",
+                 "gate": f"<= {ceiling_mb:g} MiB"}
+    peak = _num(fresh.get("rss_peak_bytes"))
+    if peak is None:
+        row["status"] = "skip"
+        row["reason"] = "doc lacks rss_peak_bytes"
+        return row
+    limit = ceiling_mb * 1024.0 * 1024.0
+    row.update(fresh=peak, limit=limit,
+               fresh_mb=round(peak / (1024.0 * 1024.0), 1))
+    row["status"] = "ok" if peak <= limit else "fail"
+    return row
+
+
+def check_memory_evictions(fresh: Dict) -> Dict:
+    row: Dict = {"metric": "ring_evictions",
+                 "gate": f"<= max({MEMORY_EVICTIONS_FLOOR:g}, "
+                         f"{MEMORY_EVICTIONS_PER_VH:g} * "
+                         f"virtual_hours)"}
+    ev = _num(fresh.get("ring_evictions"))
+    vh = _num(fresh.get("soak_virtual_hours"))
+    if ev is None or vh is None:
+        row["status"] = "skip"
+        row["reason"] = "doc lacks ring_evictions/soak_virtual_hours"
+        return row
+    limit = max(MEMORY_EVICTIONS_FLOOR, MEMORY_EVICTIONS_PER_VH * vh)
+    row.update(fresh=ev, limit=limit)
+    row["status"] = "ok" if ev <= limit else "fail"
+    return row
+
+
+def compare_memory(fresh: Dict,
+                   ceiling_mb: float =
+                   MEMORY_RSS_CEILING_MB_DEFAULT) -> Dict:
+    """--kind memory: judge a soak summary's footprint fields ALONE
+    (baseline-free like workers/watchers — RSS is a host fact, so a
+    cross-run band would gate the machine, not the code)."""
+    checks: List[Dict] = [check_memory_rss(fresh, ceiling_mb),
+                          check_memory_evictions(fresh)]
+    for metric, gate in sorted(MEMORY_ABS_GATES.items()):
+        checks.append(_check_abs(metric, fresh.get(metric), gate))
+    failed = sorted({c["metric"] for c in checks
+                     if c["status"] == "fail"})
+    return {"kind": "memory",
+            "verdict": "pass" if not failed else "fail",
+            "failed": failed,
+            "skipped": [c["metric"] for c in checks
+                        if c["status"] == "skip"],
+            "checks": checks,
+            "rss_peak_bytes": fresh.get("rss_peak_bytes"),
+            "journal_bytes": fresh.get("journal_bytes"),
+            "journal_compactions": fresh.get("journal_compactions"),
+            "mem_overhead_fraction":
+                fresh.get("mem_overhead_fraction")}
+
+
 # deterministic-by-contract soak fields: exact equality
 SOAK_EXACT = ("converged_fingerprint", "trace_digest", "soak_evals",
               "schedule_events", "soak_breaches", "soak_virtual_hours",
@@ -562,6 +637,38 @@ def self_check() -> int:
            and w_collapse["verdict"] == "fail"
            and "write_throughput_ratio" in w_collapse["failed"]
            and len(w_absent["skipped"]) == len(w_absent["checks"]))
+    # memory-kind wiring (ISSUE 19): a healthy footprint doc must
+    # pass; an RSS blowout, a journal floor fallback, an eviction
+    # storm, and a ledger-overhead regression must each fail; a doc
+    # predating the plane must come out all-skip, not all-pass
+    mdoc = {"rss_peak_bytes": 300 * 1024 * 1024,
+            "soak_virtual_hours": 2.0, "ring_evictions": 120,
+            "journal_floor_fallbacks": 0, "journal_bytes": 50_000,
+            "mem_overhead_fraction": 0.0004, "mem_scrape_us": 180.0}
+    m_ok = compare_memory(mdoc, 512.0)
+    m_rss = compare_memory(
+        {**mdoc, "rss_peak_bytes": 900 * 1024 * 1024}, 512.0)
+    m_floor = compare_memory(
+        {**mdoc, "journal_floor_fallbacks": 3}, 512.0)
+    m_evict = compare_memory(
+        {**mdoc, "ring_evictions": 5_000_000}, 512.0)
+    m_over = compare_memory(
+        {**mdoc, "mem_overhead_fraction": 0.02}, 512.0)
+    m_absent = compare_memory({"bench": "other"}, 512.0)
+    print(f"memory gates: healthy={m_ok['verdict']} "
+          f"rss={m_rss['verdict']} floor={m_floor['verdict']} "
+          f"evict={m_evict['verdict']} overhead={m_over['verdict']} "
+          f"absent-skips={len(m_absent['skipped'])}")
+    ok &= (m_ok["verdict"] == "pass"
+           and m_rss["verdict"] == "fail"
+           and "rss_peak_bytes" in m_rss["failed"]
+           and m_floor["verdict"] == "fail"
+           and "journal_floor_fallbacks" in m_floor["failed"]
+           and m_evict["verdict"] == "fail"
+           and "ring_evictions" in m_evict["failed"]
+           and m_over["verdict"] == "fail"
+           and "mem_overhead_fraction" in m_over["failed"]
+           and len(m_absent["skipped"]) == len(m_absent["checks"]))
     print(f"perfcheck self-check: {'PASS' if ok else 'FAIL'}")
     return 0 if ok else 1
 
@@ -571,14 +678,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="compare fresh bench/soak JSON against the "
                     "checked-in trajectory with tolerance bands")
     ap.add_argument("--kind",
-                    choices=("bench", "soak", "workers", "watchers"),
+                    choices=("bench", "soak", "workers", "watchers",
+                             "memory"),
                     default="bench",
                     help="workers: judge a --workers N A/B doc alone "
                          "(process-scaling band + absolute gates; no "
                          "baseline needed).  watchers: judge a "
                          "`bench --watchers` fanout doc alone "
                          "(scale-aware wake band, coalescing gate, "
-                         "zero-stale-reads + throughput-ratio gates)")
+                         "zero-stale-reads + throughput-ratio gates). "
+                         "memory: judge a soak summary's footprint "
+                         "alone (RSS high-water ceiling, zero journal "
+                         "floor fallbacks, eviction budget, ledger "
+                         "overhead <= 0.1%)")
     ap.add_argument("--fresh", help="fresh summary JSON to judge")
     ap.add_argument("--baseline",
                     help="baseline JSON (default: newest BENCH_r*.json"
@@ -591,6 +703,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "anyway (exploration, not gating)")
     ap.add_argument("--json", default="",
                     help="also write the verdict doc to this path")
+    ap.add_argument("--rss-ceiling-mb", type=float,
+                    default=MEMORY_RSS_CEILING_MB_DEFAULT,
+                    help="--kind memory: RSS high-water ceiling in "
+                         "MiB (default %(default)s)")
     ap.add_argument("--self-check", action="store_true",
                     help="validate the comparator against the "
                          "checked-in baselines (CI wiring test)")
@@ -600,14 +716,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return self_check()
     if not args.fresh:
         ap.error("--fresh is required (or use --self-check)")
-    if args.kind in ("workers", "watchers"):
+    if args.kind in ("workers", "watchers", "memory"):
         try:
             fresh = _load(args.fresh)
         except (OSError, ValueError) as e:
             print(f"cannot load inputs: {e}", file=sys.stderr)
             return 2
-        verdict = (compare_workers(fresh) if args.kind == "workers"
-                   else compare_watchers(fresh))
+        if args.kind == "workers":
+            verdict = compare_workers(fresh)
+        elif args.kind == "watchers":
+            verdict = compare_watchers(fresh)
+        else:
+            verdict = compare_memory(fresh, args.rss_ceiling_mb)
         verdict["fresh_path"] = args.fresh
         out = json.dumps(verdict, indent=2, sort_keys=True)
         print(out)

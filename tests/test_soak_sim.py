@@ -27,7 +27,11 @@ SUMMARY_KEYS = {"seed", "soak_virtual_hours", "soak_evals",
                 "compression_x", "p99_plan_queue_ms", "quality", "ok",
                 "timeline_points", "timeline_annotations",
                 "timeline_overhead_fraction", "timeline_evictions",
-                "timeline_digest"}
+                "timeline_digest", "rss_bytes", "rss_peak_bytes",
+                "journal_bytes", "journal_entries",
+                "journal_compactions", "journal_bytes_reclaimed",
+                "journal_floor_fallbacks", "ring_evictions",
+                "mem_scrape_us", "mem_overhead_fraction"}
 
 
 def test_tiny_soak_green_and_summarized():
