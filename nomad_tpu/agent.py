@@ -166,6 +166,26 @@ class Agent:
             self.follower = ReadFollower(self.server.state, self.clock,
                                          self.follow)
         self.http = HTTPAPIServer(self, host=http_host, port=http_port)
+        if cluster_mode:
+            # cluster-scope metric federation (core/federation.py): the
+            # gossip meta carries each server's HTTP address (the meta
+            # dict is shared by reference with the local Member, so the
+            # mutation rides every subsequent ping/sync), and the leader
+            # side of Server.tick drives the puller.  Distinct from the
+            # multi-REGION federation below: this one is intra-cluster.
+            from nomad_tpu.core.federation import FederationPuller
+            self.server.gossip.meta["http"] = self.address
+            self.server.federation = FederationPuller(
+                self.server.name,
+                targets=self._federation_targets,
+                clock=self.clock,
+                state=self.server.state)
+        if self.follower is not None:
+            # announce this read follower to whichever upstream it pulls
+            # from, so the leader's puller scrapes it too (follower lag
+            # rides the cluster SLO rules)
+            port = self.address.rsplit(":", 1)[-1]
+            self.follower.announce = (f"follower-{port}", self.address)
         # multi-region federation (reference: nomad/regions.go + WAN serf):
         # this agent's region + the push-pull address table; ?region=X
         # requests proxy through it (api/http_server.Router.route)
@@ -211,6 +231,17 @@ class Agent:
     @property
     def address(self) -> str:
         return self.http.addr
+
+    def _federation_targets(self) -> List:
+        """Gossip-derived (origin, http-url) scrape targets for the
+        metric-federation puller (peers whose agents published an HTTP
+        address into their gossip meta)."""
+        out = []
+        for name, m in sorted(self.server.gossip.alive_members().items()):
+            url = (m.meta or {}).get("http")
+            if url:
+                out.append((name, url))
+        return out
 
     # -------------------------------------------------------------- intro
 

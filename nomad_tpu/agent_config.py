@@ -228,7 +228,10 @@ def parse_agent_config(src: str):
                     known_slo = {"p99_plan_queue_ms", "refute_rate",
                                  "invalidations_per_s",
                                  "networked_ratio", "heartbeat_misses",
-                                 "rss_mb", "window_s", "interval_s"}
+                                 "rss_mb", "window_s", "interval_s",
+                                 "cluster_scrape_failures",
+                                 "cluster_follower_lag",
+                                 "cluster_heartbeat_misses"}
                     slo = {}
                     for a in b.body:
                         if not isinstance(a, Attr):

@@ -386,6 +386,12 @@ class Operator(_Endpoint):
         return self.c.request("GET", "/v1/operator/timeline",
                               params={"dump": "true"})
 
+    def cluster_health(self) -> Dict:
+        """Cluster-scope rollup (core/federation.py): the leader's
+        per-origin federation scrape ledger plus the cluster_* subset
+        of the SLO verdicts — what `nomad cluster status` renders."""
+        return self.c.get("/v1/operator/cluster-health")
+
 
 class System(_Endpoint):
     def gc(self) -> Dict:
@@ -411,9 +417,13 @@ class Agent(_Endpoint):
         """Recent eval-lifecycle trace summaries."""
         return self.c.get("/v1/traces")
 
-    def trace(self, trace_id: str) -> Dict:
-        """One trace's full span tree."""
-        return self.c.get(f"/v1/trace/{trace_id}")
+    def trace(self, trace_id: str, cluster: bool = False) -> Dict:
+        """One trace's full span tree.  `cluster=True` asks the agent
+        to scatter-gather the id from every gossip peer and stitch one
+        joined cross-origin tree (core/federation.stitch_trace)."""
+        params = {"cluster": "true"} if cluster else {}
+        return self.c.request("GET", f"/v1/trace/{trace_id}",
+                              params=params)
 
 
 class Volumes(_Endpoint):

@@ -569,6 +569,44 @@ class Router:
                 if (qs.get("dumps") or ["false"])[0] == "true":
                     doc["DumpBundles"] = s.health.dumps()
                 return doc
+            if p[1:2] == ["cluster-health"] and method == "GET":
+                # cluster-scope rollup: the federation puller's
+                # per-origin scrape ledger (scraping is a leader duty —
+                # off-leader the ledger sits at zero scrapes; None in
+                # standalone/dev mode) + the cluster_* subset of the SLO
+                # verdicts from the local health watchdog
+                # (core/flightrec.py)
+                fed = getattr(s, "federation", None)
+                doc = s.health.check()
+                rules = [v for v in doc["Rules"]
+                         if v["Rule"].startswith("cluster_")]
+                return {"Schema": "nomad-tpu.cluster-health.v1",
+                        "Healthy": all(v["Ok"] for v in rules),
+                        "At": doc["At"],
+                        "Rules": rules,
+                        "Federation": (fed.doc()
+                                       if fed is not None else None)}
+            if p[1:2] == ["federation"] and p[2:3] == ["register"]:
+                # read followers announce themselves here
+                # (fanout.ReadFollower._announce_once) so the leader's
+                # federation puller scrapes them alongside gossip peers.
+                # Idempotent; dormant on non-leaders until they lead.
+                if method in ("PUT", "POST"):
+                    b = body or {}
+                    origin, url = b.get("Origin"), b.get("Url")
+                    if not origin or not url:
+                        raise APIError(400, "Origin and Url required")
+                    fed = getattr(s, "federation", None)
+                    if fed is None:
+                        return {"Registered": False}
+                    fed.register_target(str(origin), str(url))
+                    return {"Registered": True}
+                if method == "DELETE":
+                    b = body or {}
+                    fed = getattr(s, "federation", None)
+                    if fed is not None and b.get("Origin"):
+                        fed.unregister_target(str(b["Origin"]))
+                    return {}
             if p[1:2] == ["flight-recorder"] and method == "GET":
                 # the bounded recent-history view of the wave hot path
                 # (core/flightrec.py); ?n= caps each ring's tail
@@ -698,6 +736,14 @@ class Router:
                     "Follower": (self.agent.follower.stats()
                                  if getattr(self.agent, "follower", None)
                                  is not None else None),
+                    # cluster-scope federation plane (core/federation.py):
+                    # the leader's per-origin scrape ledger — who answered
+                    # the last pull, how far behind each origin's applied
+                    # index sits.  None off-leader (the puller is a leader
+                    # duty) and in standalone/dev mode
+                    "Cluster": (s.federation.doc()
+                                if getattr(s, "federation", None)
+                                is not None else None),
                     # memory & footprint plane (core/memledger.py):
                     # per-plane byte ledger + RSS, and the unified
                     # eviction/drop counters — one key per plane, the
@@ -826,6 +872,25 @@ class Router:
                 return ["local"]
         elif head == "agent":
             if p[1:2] == ["self"]:
+                if (qs.get("compact") or ["0"])[0] in ("1", "true"):
+                    # the metric-federation scrape body
+                    # (core/federation.py): registry summaries + flight
+                    # occupancy + mem doc + follower tail + a timeline
+                    # delta since ?since_seq=.  msgpack over core/wire —
+                    # the leader's puller decodes it, not a human
+                    from nomad_tpu.core import wire
+                    from nomad_tpu.core.federation import agent_snapshot
+                    try:
+                        since = int((qs.get("since_seq") or ["0"])[0])
+                    except ValueError:
+                        raise APIError(400, "bad since_seq")
+                    fol = getattr(self.agent, "follower", None)
+                    origin = getattr(s, "name", None) or "local"
+                    if fol is not None and fol.announce is not None:
+                        origin = fol.announce[0]
+                    return BytesResponse(wire.packb(agent_snapshot(
+                        origin, state=s.state, follower=fol,
+                        since_seq=since)))
                 return {"config": {"Server": {"Enabled": True},
                                    "Client": {
                                        "Enabled": bool(self.agent.clients)}},
@@ -848,6 +913,8 @@ class Router:
             from nomad_tpu.core.telemetry import TRACER
             if len(p) < 2 or not p[1]:
                 raise APIError(404, "trace id required")
+            if (qs.get("cluster") or ["false"])[0] == "true":
+                return self._cluster_trace(p[1], token)
             spans = TRACER.trace(p[1])
             if not spans:
                 raise APIError(404, "trace not found")
@@ -860,6 +927,41 @@ class Router:
             # handler did not intercept it
             raise APIError(400, "use GET /v1/event/stream")
         raise APIError(404, f"no handler for {method} /v1/{'/'.join(p)}")
+
+    def _cluster_trace(self, trace_id: str, token: str = "") -> Dict:
+        """`GET /v1/trace/<id>?cluster=true` — scatter-gather the trace
+        from every gossip peer and stitch one joined tree
+        (core/federation.stitch_trace): the forwarded-RPC span on the
+        follower parents the leader's commit spans parents the serving
+        follower's read spans.  A dark peer only narrows the view; the
+        stitch is best-effort over whoever answered."""
+        import urllib.request
+        from nomad_tpu.core.federation import local_trace, stitch_trace
+        s = self.server
+        origin = getattr(s, "name", None) or "local"
+        by_origin: Dict[str, List[Dict]] = {origin: local_trace(trace_id)}
+        members = (sorted(s.gossip.alive_members().items())
+                   if hasattr(s, "gossip") else [])
+        for name, member in members:
+            url = (member.meta or {}).get("http")
+            if not url or name == origin:
+                continue
+            req = urllib.request.Request(
+                f"{url}/v1/trace/{urllib.parse.quote(trace_id)}")
+            if token:
+                req.add_header("X-Nomad-Token", token)
+            try:
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    doc = json.loads(resp.read().decode("utf-8"))
+                by_origin[name] = list(doc.get("Spans") or [])
+            except Exception:
+                # includes the peer's own 404 (no spans there): either
+                # way that origin contributes nothing to the stitch
+                by_origin[name] = []
+        stitched = stitch_trace(trace_id, by_origin)
+        if stitched["SpanCount"] == 0:
+            raise APIError(404, "trace not found")
+        return stitched
 
     # ----------------------------------------------------------- sub-trees
 

@@ -308,15 +308,11 @@ class SoakRunner:
                 for v in (res.violations or ["did not converge"]))
 
     def _rebind_clock(self) -> None:
-        from nomad_tpu.core import flightrec, identity, memledger, telemetry
-        from nomad_tpu.core import logging as logging_mod
-        from nomad_tpu.core import timeline as timeline_mod
-        telemetry.configure(self.clock)
-        flightrec.configure(self.clock)
-        logging_mod.configure(self.clock)
-        identity.configure(self.clock)
-        timeline_mod.configure(self.clock)
-        memledger.configure(self.clock)
+        # every plane at once through the ObsBus seam (core/obsbus.py):
+        # a scenario that swapped in its own clock hands the soak clock
+        # back to all eight planes in one call
+        from nomad_tpu.core.obsbus import OBSBUS
+        OBSBUS.configure(self.clock)
 
     # -------------------------------------------------- synthetic fleet
 

@@ -16,7 +16,7 @@ import json
 import signal
 import sys
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from nomad_tpu.api.client import APIClient, APIException
 
@@ -891,6 +891,9 @@ def cmd_health(args) -> int:
     one row per rule, observed vs threshold.  Exit 0 healthy, 1 when
     any rule is breached (scriptable, like a health check)."""
     doc = _client(args).operator.health()
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if doc.get("Healthy") else 1
     print(f"Healthy      = {doc.get('Healthy')}")
     print(f"Breaches     = {doc.get('Breaches', 0)} "
           f"(checks {doc.get('Checks', 0)}, "
@@ -1253,7 +1256,28 @@ def cmd_trace_list(args) -> int:
 
 
 def cmd_trace_status(args) -> int:
-    _out(_client(args).agent.trace(args.trace_id))
+    if not args.cluster:
+        _out(_client(args).agent.trace(args.trace_id))
+        return 0
+    # -cluster: the stitched cross-origin tree (core/federation.py) —
+    # render it as an indented span tree, one line per span, with the
+    # serving origin on every line so the forwarded-RPC → leader-commit
+    # → follower-serve hops read top-to-bottom
+    doc = _client(args).agent.trace(args.trace_id, cluster=True)
+    print(f"Trace    = {doc.get('TraceID', '')}")
+    print(f"Origins  = {', '.join(doc.get('Origins', []))}")
+    print(f"Spans    = {doc.get('SpanCount', 0)}")
+
+    def walk(node: Dict, depth: int) -> None:
+        s = node.get("Span", {})
+        dur = (s.get("Duration") or 0.0) * 1000.0
+        print(f"{'  ' * depth}{s.get('Name', ''):<{32 - 2 * depth}} "
+              f"@{s.get('Origin', ''):<12} {dur:8.2f}ms")
+        for kid in node.get("Children", []):
+            walk(kid, depth + 1)
+
+    for root in doc.get("Tree", []):
+        walk(root, 0)
     return 0
 
 
@@ -1282,6 +1306,55 @@ def cmd_system_gc(args) -> int:
 def cmd_server_members(args) -> int:
     _out(_client(args).agent.members())
     return 0
+
+
+def cmd_cluster_status(args) -> int:
+    """Cluster-scope health (`nomad cluster status`): the contacted
+    agent's federation scrape ledger — one row per origin it pulled —
+    plus the cluster_* SLO verdicts.  Exit 0 healthy, 1 breached.
+    Point -address at the leader; off-leader the ledger is empty (the
+    puller is a leader duty)."""
+    doc = _client(args).operator.cluster_health()
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if doc.get("Healthy") else 1
+    fed = doc.get("Federation") or {}
+    origins = fed.get("Origins") or {}
+    print(f"Healthy      = {doc.get('Healthy')}")
+    print(f"Origin       = {fed.get('Origin', '-')} "
+          f"(scrapes {fed.get('Scrapes', 0)}, "
+          f"failures {fed.get('Failures', 0)}, "
+          f"last {fed.get('ScrapeMicros', 0):g}µs)")
+    print(f"FollowerLag  = {fed.get('FollowerLagMax', 0):g} "
+          f"(max applied-index lag behind this node)")
+    if origins:
+        print(f"{'Origin':<16} {'Ok':<4} {'Healthy':<8} "
+              f"{'AppliedIdx':>10} {'HBMiss':>7} {'RSS':>9}")
+        for name, row in sorted(origins.items()):
+            if not row.get("Ok"):
+                print(f"{name:<16} {'no':<4} {'-':<8} {'-':>10} "
+                      f"{'-':>7} {'-':>9}  {row.get('Error', '')}")
+                continue
+            fol = row.get("Follower")
+            idx = (fol.get("AppliedIndex") if fol
+                   else row.get("AppliedIndex", 0))
+            rss = row.get("RSSBytes", 0) / (1024.0 * 1024.0)
+            print(f"{name:<16} {'yes':<4} "
+                  f"{'yes' if row.get('Healthy') else 'NO':<8} "
+                  f"{idx if idx is not None else '-':>10} "
+                  f"{row.get('HeartbeatMisses', 0):>7} "
+                  f"{rss:>8.1f}M")
+    else:
+        print("(no origins scraped yet — not the leader, or the "
+              "first federation interval hasn't elapsed)")
+    print(f"{'Rule':<28} {'Observed':>12} {'Threshold':>12}  Status")
+    for r in doc.get("Rules", []):
+        obs = r.get("Observed")
+        obs_s = "-" if obs is None else f"{obs:g}"
+        print(f"{r.get('Rule', ''):<28} {obs_s:>12} "
+              f"{r.get('Threshold', 0):>12g}  "
+              f"{'OK' if r.get('Ok') else 'BREACH'}")
+    return 0 if doc.get("Healthy") else 1
 
 
 def cmd_status(args) -> int:
@@ -1699,7 +1772,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     hl = sub.add_parser("health",
                         help="SLO verdicts (observed vs threshold)")
+    hl.add_argument("-json", action="store_true",
+                    help="raw operator document as JSON")
     hl.set_defaults(fn=cmd_health)
+
+    cl = sub.add_parser("cluster",
+                        help="cluster-scope observability"
+                        ).add_subparsers(dest="cluster_cmd", required=True)
+    cls_ = cl.add_parser("status",
+                         help="federation ledger (one row per origin) "
+                              "+ cluster SLO verdicts")
+    cls_.add_argument("-json", action="store_true",
+                      help="raw cluster-health document as JSON")
+    cls_.set_defaults(fn=cmd_cluster_status)
 
     mm = sub.add_parser("mem",
                         help="memory ledger (per-plane bytes, RSS, "
@@ -1747,6 +1832,9 @@ def build_parser() -> argparse.ArgumentParser:
     trl.set_defaults(fn=cmd_trace_list)
     trs = trc.add_parser("status")
     trs.add_argument("trace_id")
+    trs.add_argument("-cluster", action="store_true",
+                     help="stitch the trace across every gossip peer "
+                          "into one cross-origin tree")
     trs.set_defaults(fn=cmd_trace_status)
 
     sk = sub.add_parser("soak",

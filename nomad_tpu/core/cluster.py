@@ -518,6 +518,7 @@ class ClusterServer(Server):
         addr = self.leader_rpc_addr()
         if addr is None:
             raise NotLeaderError(None)
+        t0 = self.clock.monotonic()
         r = self.transport.request(
             tuple(addr), {"method": method, "args": args,
                           "kwargs": kwargs, "fwd": True},
@@ -525,7 +526,22 @@ class ClusterServer(Server):
         if r is None:
             raise ConnectionError(f"leader {addr} unreachable")
         if r.get("ok"):
-            return r.get("result")
+            result = r.get("result")
+            # this hop is THIS node's contribution to the trace: the
+            # leader minted the eval (and its trace id) while serving the
+            # forward, so the id only exists in the returned object — the
+            # span is recorded retroactively, keyed off it.  Cross-node
+            # stitching (core/federation.stitch_trace) merges it with the
+            # leader's commit spans.
+            ev = (result[0] if isinstance(result, tuple) and result
+                  else result)
+            tid = getattr(ev, "trace_id", "")
+            if tid:
+                from .telemetry import TRACER
+                TRACER.record("rpc.forward", tid, t0,
+                              self.clock.monotonic(),
+                              method=method, leader=f"{addr[0]}:{addr[1]}")
+            return result
         if r.get("not_leader"):
             raise NotLeaderError(None)
         raise RuntimeError(r.get("error", "forwarded rpc failed"))

@@ -437,6 +437,14 @@ class ReadFollower:
         self.pulls = 0
         self.failures = 0
         self.skipped_regressions = 0
+        # metric-federation registration (core/federation.py): when the
+        # Agent sets `announce = (origin, own-http-url)`, each upstream
+        # this follower successfully pulls from is told where to scrape
+        # it (PUT /v1/operator/federation/register).  Re-announced after
+        # every upstream rotation, so a failover re-registers with the
+        # new leader on the first successful pull.
+        self.announce: Optional[Tuple[str, str]] = None
+        self._announced_to = ""
 
     # ---------------------------------------------------------- control
 
@@ -464,6 +472,19 @@ class ReadFollower:
             return None
         return max(self.clock.monotonic() - self._last_contact, 0.0)
 
+    def publish_gauges(self) -> None:
+        """Registry gauges for applied index + staleness, so federation
+        and the soak verdict gate follower lag without scraping the
+        X-Nomad-* HTTP headers.  Refreshed on every pull outcome (the
+        staleness gauge must keep growing while the upstream is dark)
+        and on demand from stats()/the agent snapshot."""
+        telemetry.REGISTRY.set_gauge("nomad.follower.applied_index",
+                                     float(self.state.latest_index()))
+        last = self.last_contact_s()
+        if last is not None:
+            telemetry.REGISTRY.set_gauge("nomad.follower.last_contact_s",
+                                         round(last, 6))
+
     def _fetch(self, url: str) -> bytes:
         import urllib.request
         req = urllib.request.Request(url)
@@ -488,6 +509,7 @@ class ReadFollower:
             self.known_leader = False
             self._active = (self._active + 1) % len(self.upstreams)
             telemetry.REGISTRY.inc("nomad.follower.pull_failures")
+            self.publish_gauges()
             return False
         head = int(export.get("index", 0))
         if head < since:
@@ -504,9 +526,32 @@ class ReadFollower:
         self.pulls += 1
         self.known_leader = True
         self._last_contact = self.clock.monotonic()
-        telemetry.REGISTRY.set_gauge("nomad.follower.applied_index",
-                                     self.state.latest_index())
+        self.publish_gauges()
+        if self.announce is not None and self._announced_to != self.upstream:
+            self._announce_once()
         return True
+
+    def _announce_once(self) -> None:
+        """Register this follower as a federation scrape target with the
+        active upstream.  Best-effort: a failed announce retries on the
+        next successful pull (the flag only latches on success)."""
+        import json
+        import urllib.request
+        origin, url = self.announce
+        req = urllib.request.Request(
+            f"{self.upstream}/v1/operator/federation/register",
+            data=json.dumps({"Origin": origin, "Url": url}).encode(),
+            method="PUT")
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("X-Nomad-Token", self.token)
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                resp.read()
+            self._announced_to = self.upstream
+        except Exception as exc:  # noqa: BLE001 - best-effort registration
+            log("follower", "debug", "federation announce failed",
+                upstream=self.upstream, error=repr(exc))
 
     def _run(self) -> None:
         from nomad_tpu.core.flightrec import FLIGHT
@@ -550,6 +595,7 @@ class ReadFollower:
             return e.code, e.read()
 
     def stats(self) -> Dict:
+        self.publish_gauges()
         return {
             "upstream": self.upstream,
             "known_leader": self.known_leader,

@@ -224,6 +224,17 @@ DEFAULT_SLO = {
     # sized; the RSS-gated soak (chaos/soak.py rss_ceiling_mb) and
     # agent_config server.slo.rss_mb turn it on
     "rss_mb": -1.0,
+    # cluster-federation rules (core/federation.py; Observed=None until
+    # the leader's puller has scraped at least once, so followers and
+    # standalone servers can never breach them):
+    #   failed peer/follower scrapes per check interval (any failure is
+    #   a breach — a clean cluster scrapes clean)
+    "cluster_scrape_failures": 0.0,
+    #   max follower applied-index lag behind the leader's last index
+    "cluster_follower_lag": 1024.0,
+    #   cross-peer missed-heartbeat sum per check interval (the local
+    #   heartbeat_misses rule, widened to the whole cluster)
+    "cluster_heartbeat_misses": 64.0,
     # rolling-window span + check throttle (not rules)
     "window_s": 60.0,
     "interval_s": 5.0,
@@ -295,6 +306,15 @@ class HealthWatchdog:
             "heartbeat_misses": r.counter("nomad.heartbeat.missed"),
             "ports_batched": r.counter("nomad.ports.batched_rows"),
             "ports_sequential": r.counter("nomad.ports.sequential_rows"),
+            # federation plane (core/federation.py): scrapes gates the
+            # cluster rules on "has the puller ever run here", failures
+            # and the cross-peer heartbeat sum are counter-shaped deltas
+            "cluster_scrapes": r.counter("nomad.cluster.scrapes"),
+            # failures are origin-labeled; the rule sums across origins
+            "cluster_scrape_failures":
+                r.counter_sum("nomad.cluster.scrape_failures"),
+            "cluster_heartbeat_misses":
+                r.gauge("nomad.cluster.heartbeat_misses_total"),
         }
 
     def _verdicts(self, cur: Dict[str, float],
@@ -321,6 +341,13 @@ class HealthWatchdog:
         # before the first scrape so the rule cannot breach during boot
         from nomad_tpu.core.memledger import MEMLEDGER
         rss = round(MEMLEDGER.rss_mb(), 3) or None
+        # cluster rules observe None until this node's federation puller
+        # has scraped (leaders only): followers/standalone never breach
+        fed = cur["cluster_scrapes"] > 0
+        c_fail = delta("cluster_scrape_failures") if fed else None
+        c_hb = delta("cluster_heartbeat_misses") if fed else None
+        c_lag = (self.registry.gauge("nomad.cluster.follower_lag_max")
+                 if fed else None)
         rows = (
             ("p99_plan_queue_ms", "ceiling", p99_ms, "ms",
              "rolling-window p99 of nomad.plan.queue_wait_s"),
@@ -334,6 +361,12 @@ class HealthWatchdog:
              "missed heartbeat TTLs since last check"),
             ("rss_mb", "ceiling", rss, "MiB",
              "tick-sampled process VmRSS (core/memledger)"),
+            ("cluster_scrape_failures", "ceiling", c_fail, "count",
+             "failed federation scrapes since last check"),
+            ("cluster_follower_lag", "ceiling", c_lag, "index",
+             "max follower applied-index lag at last federation scrape"),
+            ("cluster_heartbeat_misses", "ceiling", c_hb, "count",
+             "cross-peer missed heartbeat TTLs since last check"),
         )
         verdicts = []
         for name, kind, observed, unit, source in rows:
@@ -476,3 +509,9 @@ def configure(clock: Clock) -> None:
     """Bind the process flight recorder to an injected clock (every
     Server calls this with its own, next to telemetry.configure)."""
     FLIGHT.set_clock(clock)
+
+
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("flightrec", configure=FLIGHT.set_clock,
+                snapshot=FLIGHT.snapshot, reset=FLIGHT.reset)

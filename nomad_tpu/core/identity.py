@@ -103,3 +103,8 @@ def variable_prefix(job_id: str) -> str:
     """The variable subtree this workload may read (reference: the
     implicit workload policy paths nomad/jobs/<job_id>...)."""
     return f"nomad/jobs/{job_id}"
+
+
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("identity", configure=configure)

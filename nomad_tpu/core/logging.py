@@ -134,5 +134,11 @@ def configure(clock: Clock) -> None:
     RING.clock = clock
 
 
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("logging", configure=configure,
+                snapshot=lambda: {"tail": RING.tail(200)})
+
+
 def log(component: str, level: str, msg: str, **fields) -> None:
     RING.log(component, level, msg, **fields)

@@ -275,3 +275,9 @@ MEMLEDGER = MemLedger()
 
 def configure(clock) -> None:
     MEMLEDGER.configure(clock)
+
+
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("memledger", configure=MEMLEDGER.configure,
+                snapshot=MEMLEDGER.doc)

@@ -790,3 +790,12 @@ def configure(hz: Optional[float] = None,
     else:
         PROFILER.start()
     return PROFILER
+
+
+# The profiler is wall-clock-only by doctrine (sampling a virtual clock
+# would alias the sampler against compressed time), so its bus hook
+# registers configure=None: the bus-wide clock rebind skips it, while
+# snapshot capture still includes it.
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("profiler", snapshot=PROFILER.brief)

@@ -39,7 +39,11 @@ from nomad_tpu.structs import (
     new_id,
 )
 
-from . import flightrec, identity, memledger, profiling, telemetry, timeline
+# importing the plane modules is what registers them on the ObsBus
+# (each registers at module bottom); `identity` is imported for exactly
+# that side effect — the server itself only touches it via the bus
+from . import (flightrec, identity, memledger,  # noqa: F401 - bus reg
+               obsbus, profiling, telemetry, timeline)
 from . import logging as logging_mod
 from .logging import log
 from .blocked_evals import BlockedEvals
@@ -74,25 +78,21 @@ class Server:
         # so a chaos scenario's VirtualClock owns the whole server's
         # timeline; production default is the wall clock
         self.clock = clock if clock is not None else SystemClock()
-        # process telemetry rides the same injected clock (telemetry is
-        # process-global like logging.RING; all in-process agents of one
-        # simulated cluster share a clock already, so last-write wins is
-        # benign)
-        telemetry.configure(self.clock)
-        flightrec.configure(self.clock)
-        # the retrospective timeline samples off the same injected
-        # clock on every tick (core/timeline.py) — VirtualClock soaks
-        # replay its canonical dump byte-identical
-        timeline.configure(self.clock)
-        # the process log ring's record stamps and the identity
-        # iat/exp defaults ride the same timeline (satellite of the
-        # virtual-time soak: no raw time.time() left in core/)
-        logging_mod.configure(self.clock)
-        identity.configure(self.clock)
-        # the memory ledger's scrape CADENCE rides the same injected
-        # clock (core/memledger.py); its VALUES (RSS, byte estimates)
-        # are wall facts and stay out of every canonical dump
-        memledger.configure(self.clock)
+        # every observability plane (telemetry registry, tracer, flight
+        # recorder, timeline, log ring, identity signer, memory ledger;
+        # the profiler opts out — wall-clock by doctrine) rides the same
+        # injected clock through the ObsBus seam (core/obsbus.py): one
+        # call replaces the former per-plane configure() litany, and the
+        # analyzer's `obsbus` pass enforces that new planes register.
+        # Planes are process-global like logging.RING; all in-process
+        # agents of one simulated cluster share a clock already, so
+        # last-write-wins is benign.
+        obsbus.OBSBUS.configure(self.clock)
+        # cluster-scope metric federation (core/federation.py): the
+        # Agent wires a FederationPuller here in cluster mode; the tick
+        # loop drives it as a leader duty (None on standalone servers
+        # and followers-only deployments)
+        self.federation = None
         # max ready evals one worker pass batches into a single device
         # launch (DP over evals, SURVEY §3.6 row 1); <=1 disables batching
         self.eval_batch = eval_batch
@@ -876,6 +876,17 @@ class Server:
         t = now if now is not None else self.clock.time()
         with self._tick_lock:
             self._tick_locked(t)
+        # metric federation is a leader duty like the timers above, but
+        # its scrapes are real HTTP to peers — that I/O stays OUTSIDE
+        # the tick lock so a slow or dead peer (connect timeout) can
+        # never stall health/timeline sampling for the next tick.
+        # Throttled inside the puller (injected-clock cadence + wall
+        # floor, the MEMLEDGER discipline), and it never raises — a
+        # dead peer is a counted scrape failure, not a broken tick.
+        # The unlocked _leader read is the same benign race the tick
+        # loop already tolerates (leadership can move mid-tick).
+        if self.federation is not None and self._leader:
+            self.federation.sample(self.clock.monotonic())
 
     def _tick_locked(self, t: float) -> None:
         # the health watchdog is node-local observability, not a leader

@@ -621,3 +621,16 @@ def configure(clock: Clock) -> None:
     all agents of one simulated cluster share one clock already)."""
     REGISTRY.set_clock(clock)
     TRACER.set_clock(clock)
+
+
+# Two bus planes out of one module: the registry and the tracer rebind
+# and snapshot independently (the tracer's ring is the trace-stitching
+# source, the registry feeds exposition/federation).
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("telemetry", configure=REGISTRY.set_clock,
+                snapshot=REGISTRY.snapshot, reset=REGISTRY.reset)
+OBSBUS.register("tracer", configure=TRACER.set_clock,
+                snapshot=lambda: {"traces": TRACER.traces(),
+                                  "dropped": TRACER.dropped},
+                reset=TRACER.reset)

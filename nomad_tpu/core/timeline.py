@@ -693,3 +693,9 @@ def configure(clock: Clock) -> None:
     """Bind the process timeline to an injected clock (every Server
     calls this with its own, next to telemetry/flightrec.configure)."""
     TIMELINE.set_clock(clock)
+
+
+from nomad_tpu.core.obsbus import OBSBUS  # noqa: E402 - after globals
+
+OBSBUS.register("timeline", configure=TIMELINE.set_clock,
+                snapshot=TIMELINE.snapshot_stats, reset=TIMELINE.reset)

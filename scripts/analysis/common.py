@@ -17,7 +17,7 @@ ROOT = Path(__file__).resolve().parent.parent.parent
 Finding = Tuple[str, int, str, str]        # (path, lineno, pass, message)
 
 PASS_NAMES = ("lock", "cow", "purity", "thread", "rawtime",
-              "lockorder", "determinism", "wireproto")
+              "lockorder", "determinism", "wireproto", "obsbus")
 
 
 def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:

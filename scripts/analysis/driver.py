@@ -1,7 +1,7 @@
 """Analyzer driver: pass scoping, the repo walk, suppression
 accounting, JSON findings output, and the CLI.
 
-Eight passes (suppress a finding with `# analyze: ok <pass>` on its
+Nine passes (suppress a finding with `# analyze: ok <pass>` on its
 line, or `# analyze: ok *`):
 
   lock         lock discipline (*_locked helpers under the lock)
@@ -17,6 +17,8 @@ line, or `# analyze: ok *`):
                timeline/wire/codec
   wireproto    RPC op-table parity + payload-key drift (workerpool) +
                the wire-struct manifest/version gate
+  obsbus       observability planes must register on the ObsBus
+               (core/ modules with a module-level `configure()`)
 
 Stale-suppression accounting: every `# analyze: ok <pass>` comment in
 the scoped files must still suppress at least one raw finding of that
@@ -39,6 +41,7 @@ from cowpass import check_cow
 from determinism import check_determinism
 from lockorder import check_lockorder
 from lockpass import check_lock
+from obsbuspass import check_obsbus
 from puritypass import check_purity
 from rawtimepass import check_rawtime
 from threadpass import check_thread
@@ -80,6 +83,7 @@ def _scoped_files() -> Dict[str, List[Path]]:
         "lockorder": all_py,
         "determinism": determinism,
         "wireproto": wireproto,
+        "obsbus": sorted((pkg / "core").glob("*.py")),
     }
 
 
@@ -129,6 +133,8 @@ def analyze_source(text: str, path: str = "<memory>",
             findings.extend(check_determinism(tree, path))
         elif name == "wireproto":
             findings.extend(_wp.check_wireproto({path: tree}))
+        elif name == "obsbus":
+            findings.extend(check_obsbus(tree, path))
     lines = text.splitlines()
     return sorted({f for f in findings
                    if not _suppressed(lines, f[1], f[2])})
@@ -182,7 +188,7 @@ def analyze_repo_full(root: Path = ROOT
 
     single = {"lock": check_lock, "cow": check_cow,
               "thread": check_thread, "rawtime": check_rawtime,
-              "determinism": check_determinism}
+              "determinism": check_determinism, "obsbus": check_obsbus}
     for name, checker in single.items():
         for p in scopes[name]:
             key = str(p)

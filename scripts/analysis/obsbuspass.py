@@ -1,0 +1,61 @@
+"""Pass `obsbus` — observability planes must register on the ObsBus
+(nomad_tpu/core/).
+
+A core module that defines a module-level `configure(...)` seam is an
+observability plane by convention (telemetry, flightrec, timeline,
+logging, identity, memledger, profiling all follow it).  Before the bus
+(core/obsbus.py), every such plane needed a hand-written call in
+`Server.__init__` AND the soak's `_rebind_clock` — and a forgotten call
+meant a plane silently stuck on the wall clock while the rest of the
+process ran virtual time.  The bus replaces the call litany with
+import-time registration; this pass closes the loop by flagging any
+core module that defines `configure()` without a matching
+`OBSBUS.register(...)` call, so a NEW plane cannot ship half-wired.
+
+Matching is name-based on the call chain: any call whose dotted path
+ends in `.register` rooted at a name containing `OBSBUS`/`obsbus`
+counts (covers `OBSBUS.register(...)`, `obsbus.OBSBUS.register(...)`,
+and a locally aliased bus).  `core/obsbus.py` itself is exempt — the
+bus is the seam, not a plane.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from common import Finding, _dotted
+
+
+def _registers_on_bus(tree: ast.Module) -> bool:
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        dotted = _dotted(n.func)
+        if not dotted or not dotted.endswith(".register"):
+            continue
+        root = dotted.split(".", 1)[0]
+        if "obsbus" in root.lower():
+            return True
+    return False
+
+
+def check_obsbus(tree: ast.Module, path: str) -> List[Finding]:
+    if path.replace("\\", "/").endswith("core/obsbus.py"):
+        return []
+    configure_def = None
+    for n in tree.body:                    # module level only
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == "configure":
+            configure_def = n
+            break
+    if configure_def is None:
+        return []
+    if _registers_on_bus(tree):
+        return []
+    return [(path, configure_def.lineno, "obsbus",
+             "module-level `configure()` marks an observability plane, "
+             "but the module never calls `OBSBUS.register(...)` — the "
+             "ObsBus clock rebind and debug capture will skip it; "
+             "register (name, configure, snapshot, reset) hooks at "
+             "module bottom (see core/obsbus.py)")]

@@ -343,6 +343,37 @@ class Proxy:
 '''
 
 
+SELFTEST_OBSBUS = '''
+from nomad_tpu.chaos.clock import Clock, SystemClock
+
+_CLOCK = SystemClock()
+
+
+def configure(clock):                         # VIOLATION: unregistered
+    global _CLOCK
+    _CLOCK = clock
+
+
+def snapshot():
+    return {"clock": type(_CLOCK).__name__}
+'''
+
+SELFTEST_OBSBUS_CLEAN = '''
+from nomad_tpu.chaos.clock import Clock, SystemClock
+from nomad_tpu.core.obsbus import OBSBUS
+
+_CLOCK = SystemClock()
+
+
+def configure(clock):
+    global _CLOCK
+    _CLOCK = clock
+
+
+OBSBUS.register("fixture", configure=configure)
+'''
+
+
 def selftest() -> int:
     from driver import analyze_source
     ok = True
@@ -377,6 +408,8 @@ def selftest() -> int:
     expect("wireproto", SELFTEST_WIREPROTO, 3, "no dispatch")
     expect("wireproto", SELFTEST_WIREPROTO, 3, "no send")
     expect("wireproto", SELFTEST_WIREPROTO_CLEAN, 0)
+    expect("obsbus", SELFTEST_OBSBUS, 1, "OBSBUS.register")
+    expect("obsbus", SELFTEST_OBSBUS_CLEAN, 0)
     # suppression: the same violations annotated away must go quiet
     suppressed = SELFTEST_THREAD.replace(
         "def _on_raft_leader(self):",
@@ -386,10 +419,15 @@ def selftest() -> int:
         "self._conn.send_bytes(buf)        # VIOLATION: blocks held",
         "self._conn.send_bytes(buf)  # analyze: ok lockorder")
     expect("lockorder", suppressed_lo, 2)
+    suppressed_ob = SELFTEST_OBSBUS.replace(
+        "def configure(clock):                         "
+        "# VIOLATION: unregistered",
+        "def configure(clock):  # analyze: ok obsbus")
+    expect("obsbus", suppressed_ob, 0)
     if ok:
         print("analyze selftest ok: every pass caught its injected "
               "violations (lock=3 cow=4 purity=5 thread=1+2 rawtime=5 "
-              "lockorder=3 determinism=5 wireproto=3, suppression "
-              "honored)")
+              "lockorder=3 determinism=5 wireproto=3 obsbus=1, "
+              "suppression honored)")
         return 0
     return 1

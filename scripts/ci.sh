@@ -565,6 +565,25 @@ if JAX_PLATFORMS=cpu python -m nomad_tpu soak -quick \
 fi
 echo "memory gate fail-direction ok: 1 MiB ceiling tripped as expected"
 
+echo "== federation (cluster observability: 3-process cluster, stitching, failover) =="
+# the cluster-scope observability plane (ISSUE 20): the obsbus /
+# snapshot / stitching / puller suite first, then scripts/fedsmoke.py
+# boots three REAL agent processes (separate interpreters = separate
+# tracers, so the stitched trace crossing origins is genuine) into one
+# raft cluster and asserts: a job registered through a NON-leader
+# yields a stitched trace spanning >= 2 origins (the rpc.forward hop +
+# the leader's commit spans), nomad.cluster.* families ride the
+# leader's exposition, /v1/operator/cluster-health and the
+# `nomad cluster status` / `trace status -cluster` verdicts are green
+# — then the leader is SIGKILLed and the new leader's verdict must
+# re-converge.  The measured scrape CPU duty / peer p99 / stitch
+# latency land in FED_ci.json, judged by the federation-kind perfcheck
+# gates (overhead <= 0.1%, peer scrape p99 <= 50ms, zero failures on
+# the healthy cluster)
+JAX_PLATFORMS=cpu python -m pytest tests/test_federation.py -q -m 'not slow'
+JAX_PLATFORMS=cpu python scripts/fedsmoke.py --json FED_ci.json
+python scripts/perfcheck.py --kind federation --fresh FED_ci.json
+
 echo "== bench smoke (CPU backend, reduced scale) =="
 JAX_PLATFORMS=cpu python bench.py --nodes 1000 --evals 16 \
     --placements 2000 --iters 1 | python -c '

@@ -351,6 +351,42 @@ def compare_memory(fresh: Dict,
                 fresh.get("mem_overhead_fraction")}
 
 
+# ---- federation kind (ISSUE 20): judge a federation doc alone -------------
+FEDERATION_ABS_GATES: Dict[str, Tuple[str, float]] = {
+    # leader-side scrape cost over the measurement wall time: the same
+    # 0.1% observability budget every other plane answers to
+    "federation_overhead_fraction": ("<=", 0.001),
+    # one peer snapshot fetch+fold, p99 over the run (ms) — loopback /
+    # LAN scale; a slow peer shows up here before it breaches an SLO
+    "peer_scrape_p99_ms": ("<=", 50.0),
+    # a clean run scrapes every peer every interval; any failure means
+    # the harness (or the cluster) is broken, not slow
+    "scrape_failures": ("==", 0),
+}
+
+
+def compare_federation(fresh: Dict) -> Dict:
+    """--kind federation: judge a federation measurement doc ALONE
+    (baseline-free like workers/watchers/memory — scrape cost is a
+    host fact; the gates are budgets, not trajectories)."""
+    checks: List[Dict] = []
+    for metric, gate in sorted(FEDERATION_ABS_GATES.items()):
+        checks.append(_check_abs(metric, fresh.get(metric), gate))
+    failed = sorted({c["metric"] for c in checks
+                     if c["status"] == "fail"})
+    return {"kind": "federation",
+            "verdict": "pass" if not failed else "fail",
+            "failed": failed,
+            "skipped": [c["metric"] for c in checks
+                        if c["status"] == "skip"],
+            "checks": checks,
+            "scrapes": fresh.get("scrapes"),
+            "peers": fresh.get("peers"),
+            "federation_overhead_fraction":
+                fresh.get("federation_overhead_fraction"),
+            "stitch_ms": fresh.get("stitch_ms")}
+
+
 # deterministic-by-contract soak fields: exact equality
 SOAK_EXACT = ("converged_fingerprint", "trace_digest", "soak_evals",
               "schedule_events", "soak_breaches", "soak_virtual_hours",
@@ -669,6 +705,30 @@ def self_check() -> int:
            and m_over["verdict"] == "fail"
            and "mem_overhead_fraction" in m_over["failed"]
            and len(m_absent["skipped"]) == len(m_absent["checks"]))
+    # federation-kind wiring (ISSUE 20): a healthy scrape doc must
+    # pass; an overhead blowout, a slow peer, and a failed scrape must
+    # each fail; a doc predating the plane must come out all-skip
+    fdoc = {"scrapes": 12, "peers": 3, "scrape_failures": 0,
+            "peer_scrape_p99_ms": 4.0,
+            "federation_overhead_fraction": 0.0002, "stitch_ms": 6.0}
+    f_ok = compare_federation(fdoc)
+    f_over = compare_federation(
+        {**fdoc, "federation_overhead_fraction": 0.02})
+    f_slow = compare_federation({**fdoc, "peer_scrape_p99_ms": 400.0})
+    f_fail = compare_federation({**fdoc, "scrape_failures": 2})
+    f_absent = compare_federation({"bench": "other"})
+    print(f"federation gates: healthy={f_ok['verdict']} "
+          f"overhead={f_over['verdict']} slow={f_slow['verdict']} "
+          f"failures={f_fail['verdict']} "
+          f"absent-skips={len(f_absent['skipped'])}")
+    ok &= (f_ok["verdict"] == "pass"
+           and f_over["verdict"] == "fail"
+           and "federation_overhead_fraction" in f_over["failed"]
+           and f_slow["verdict"] == "fail"
+           and "peer_scrape_p99_ms" in f_slow["failed"]
+           and f_fail["verdict"] == "fail"
+           and "scrape_failures" in f_fail["failed"]
+           and len(f_absent["skipped"]) == len(f_absent["checks"]))
     print(f"perfcheck self-check: {'PASS' if ok else 'FAIL'}")
     return 0 if ok else 1
 
@@ -679,7 +739,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "checked-in trajectory with tolerance bands")
     ap.add_argument("--kind",
                     choices=("bench", "soak", "workers", "watchers",
-                             "memory"),
+                             "memory", "federation"),
                     default="bench",
                     help="workers: judge a --workers N A/B doc alone "
                          "(process-scaling band + absolute gates; no "
@@ -690,7 +750,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "memory: judge a soak summary's footprint "
                          "alone (RSS high-water ceiling, zero journal "
                          "floor fallbacks, eviction budget, ledger "
-                         "overhead <= 0.1%)")
+                         "overhead <= 0.1%).  federation: judge a "
+                         "federation scrape doc alone (overhead <= "
+                         "0.1%, peer scrape p99 <= 50ms, zero scrape "
+                         "failures on a clean run)")
     ap.add_argument("--fresh", help="fresh summary JSON to judge")
     ap.add_argument("--baseline",
                     help="baseline JSON (default: newest BENCH_r*.json"
@@ -716,7 +779,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return self_check()
     if not args.fresh:
         ap.error("--fresh is required (or use --self-check)")
-    if args.kind in ("workers", "watchers", "memory"):
+    if args.kind in ("workers", "watchers", "memory", "federation"):
         try:
             fresh = _load(args.fresh)
         except (OSError, ValueError) as e:
@@ -726,6 +789,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             verdict = compare_workers(fresh)
         elif args.kind == "watchers":
             verdict = compare_watchers(fresh)
+        elif args.kind == "federation":
+            verdict = compare_federation(fresh)
         else:
             verdict = compare_memory(fresh, args.rss_ceiling_mb)
         verdict["fresh_path"] = args.fresh

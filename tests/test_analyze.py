@@ -8,7 +8,7 @@ blessed shapes the codebase actually uses — `with self._lock:` scopes,
 `_writable_*` copies, rebound donated buffers, cond-wait under its own
 lock).  Plus: suppression comments silence exactly their pass, stale
 suppressions are reported, the selftest is green, and the WHOLE repo is
-violation-free across all eight passes (the same gate CI runs).
+violation-free across all nine passes (the same gate CI runs).
 """
 
 import importlib.util
@@ -612,6 +612,46 @@ def test_rawtime_catches_nested_aliased_reimports():
     assert len(got) == 2, got
 
 
+# --------------------------------------------- obsbus plane registry
+
+OBSBUS_BAD = '''
+REGISTRY = object()
+
+
+def configure(clock):
+    REGISTRY.clock = clock
+'''
+
+OBSBUS_GOOD = '''
+from nomad_tpu.core.obsbus import OBSBUS
+
+REGISTRY = object()
+
+
+def configure(clock):
+    REGISTRY.clock = clock
+
+
+OBSBUS.register("fixture", configure=configure)
+'''
+
+
+def test_obsbus_flags_unregistered_plane():
+    got = findings(OBSBUS_BAD, ("obsbus",))
+    assert len(got) == 1 and "OBSBUS.register" in got[0][3], got
+
+
+def test_obsbus_accepts_registered_plane():
+    assert findings(OBSBUS_GOOD, ("obsbus",)) == []
+
+
+def test_obsbus_suppression():
+    silenced = OBSBUS_BAD.replace(
+        "def configure(clock):",
+        "def configure(clock):  # analyze: ok obsbus")
+    assert findings(silenced, ("obsbus",)) == []
+
+
 # ------------------------------------------ stale-suppression account
 
 def test_stale_suppressions_reported_repo_wide():
@@ -628,7 +668,7 @@ def test_selftest_green():
 
 
 def test_repo_is_violation_free():
-    """The same gate scripts/ci.sh runs: all eight passes over their
+    """The same gate scripts/ci.sh runs: all nine passes over their
     scoped files, zero findings.  A true positive introduced by a
     future PR fails HERE with the file:line in the assertion message."""
     got = analyze.analyze_repo()

@@ -1,0 +1,496 @@
+"""Cluster-scope observability (core/obsbus.py + core/federation.py):
+the ObsBus plane-registration seam, metric federation (leader pulls
+compact peer snapshots, publishes `nomad.cluster.*`), cross-node trace
+stitching, and the HTTP/SDK/CLI surfaces on top of them.
+
+Determinism doctrine: federation cadence rides the injected clock, the
+fake peer transport is a pure function of (origin, scrape count), and
+two identical runs must publish byte-identical cluster gauge/counter
+sequences (wall-derived self-metering — scrape_us — is excluded, like
+every other volatile wall fact)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent
+from nomad_tpu.api.client import APIClient
+from nomad_tpu.chaos.clock import VirtualClock
+from nomad_tpu.core import wire
+from nomad_tpu.core.federation import (
+    FederationPuller,
+    agent_snapshot,
+    stitch_trace,
+)
+from nomad_tpu.core.flightrec import HealthWatchdog
+from nomad_tpu.core.obsbus import OBSBUS, ObsBus
+from nomad_tpu.core.telemetry import REGISTRY
+from nomad_tpu.structs import codec
+
+
+def _wait(fn, timeout=30, period=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(period)
+    return fn()
+
+
+def _wire_batch_job(count=1, run_for=300):
+    job = mock.batch_job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].config = {"run_for_s": run_for}
+    return codec.encode(job), job
+
+
+# ---------------------------------------------------------------------------
+# ObsBus
+# ---------------------------------------------------------------------------
+
+
+class TestObsBus:
+    def test_every_plane_registers_on_server_import(self):
+        """Importing core.server pulls in every plane module, and each
+        registers itself at module bottom — the acceptance list: all
+        eight planes visible on the process-global bus."""
+        import nomad_tpu.core.server  # noqa: F401 - registration side effect
+        assert {"telemetry", "tracer", "flightrec", "logging",
+                "identity", "timeline", "memledger",
+                "profiler"} <= set(OBSBUS.planes())
+
+    def test_configure_fans_out_and_isolates_errors(self):
+        bus = ObsBus()
+        seen = []
+        bus.register("good", configure=seen.append)
+        bus.register("bad", configure=lambda c: 1 / 0)
+        clock = VirtualClock()
+        bus.configure(clock)
+        assert seen == [clock]
+        assert bus.stats()["hook_errors"] == 1
+
+    def test_snapshot_routes_and_isolates(self):
+        bus = ObsBus()
+        bus.register("a", snapshot=lambda: {"x": 1})
+        bus.register("b", snapshot=lambda: 1 / 0)
+        bus.register("c")                    # no snapshot hook: absent
+        snap = bus.snapshot()
+        assert snap["a"] == {"x": 1}
+        assert "error" in snap["b"]
+        assert "c" not in snap
+
+    def test_reset_returns_reset_plane_names(self):
+        bus = ObsBus()
+        hit = []
+        bus.register("a", reset=lambda: hit.append("a"))
+        bus.register("b")
+        assert bus.reset() == ["a"]
+        assert hit == ["a"]
+
+    def test_registration_is_last_write_wins(self):
+        bus = ObsBus()
+        bus.register("p", snapshot=lambda: {"v": 1})
+        bus.register("p", snapshot=lambda: {"v": 2})
+        assert bus.planes() == ["p"]
+        assert bus.snapshot()["p"] == {"v": 2}
+
+
+# ---------------------------------------------------------------------------
+# agent_snapshot (the federation scrape body)
+# ---------------------------------------------------------------------------
+
+
+class TestAgentSnapshot:
+    def test_shape_and_wire_round_trip(self):
+        doc = agent_snapshot("s1")
+        assert doc["Schema"] == "nomad-tpu.federation.v1"
+        assert doc["Origin"] == "s1"
+        assert set(doc["Counters"]) >= {"nomad.heartbeat.missed",
+                                        "nomad.health.breaches"}
+        assert "Timeline" in doc and "Memory" in doc
+        again = wire.unpackb(wire.packb(doc))
+        assert again["Origin"] == "s1"
+        assert again["Counters"] == doc["Counters"]
+
+    def test_since_seq_bounds_the_timeline_delta(self):
+        full = agent_snapshot("s1", since_seq=0)["Timeline"]
+        tail = agent_snapshot("s1",
+                              since_seq=full["Seq"])["Timeline"]
+        assert tail["Seq"] >= full["Seq"]
+        assert len(tail["Samples"]) <= len(full["Samples"])
+
+
+# ---------------------------------------------------------------------------
+# stitch_trace
+# ---------------------------------------------------------------------------
+
+
+def _span(name, trace="t1", parent="", start=0.0, seq=0, dur=0.001):
+    sid = f"{trace[:8]}-{name}"
+    return {"TraceID": trace, "SpanID": sid, "ParentID": parent,
+            "Name": name, "Start": start, "End": start + dur,
+            "Duration": dur, "Seq": seq}
+
+
+class TestStitchTrace:
+    def test_cross_origin_parent_edge(self):
+        """The whole point: a follower's forwarded-RPC span parents
+        the leader's commit span even though they were recorded on
+        different nodes (ParentID resolves cross-origin when no
+        same-origin parent exists)."""
+        fwd = _span("rpc.forward", start=0.0, seq=0)
+        commit = _span("plan.apply", parent=fwd["SpanID"],
+                       start=0.001, seq=1)
+        doc = stitch_trace("t1", {"follower": [fwd],
+                                  "leader": [commit]})
+        assert doc["Origins"] == ["follower", "leader"]
+        assert doc["SpanCount"] == 2
+        assert len(doc["Tree"]) == 1
+        root = doc["Tree"][0]
+        assert root["Span"]["Name"] == "rpc.forward"
+        assert root["Span"]["Origin"] == "follower"
+        kids = [k["Span"] for k in root["Children"]]
+        assert [(k["Name"], k["Origin"]) for k in kids] == [
+            ("plan.apply", "leader")]
+
+    def test_same_origin_parent_preferred(self):
+        """Replicated span names collide by SpanID (deterministic ids);
+        each copy must attach to ITS OWN origin's parent, not the first
+        origin's."""
+        docs = {}
+        for o in ("a", "b"):
+            root = _span("eval", start=0.0, seq=0)
+            kid = _span("worker.schedule", parent=root["SpanID"],
+                        start=0.001, seq=1)
+            docs[o] = [root, kid]
+        doc = stitch_trace("t1", docs)
+        assert doc["SpanCount"] == 4
+        for tree in doc["Tree"]:
+            origin = tree["Span"]["Origin"]
+            for kid in tree["Children"]:
+                assert kid["Span"]["Origin"] == origin
+
+    def test_dedupe_and_empty_origins_excluded(self):
+        s = _span("eval")
+        doc = stitch_trace("t1", {"a": [s, dict(s)],   # same (origin, id)
+                                  "b": [],             # polled, empty
+                                  "c": [dict(s)]})     # same id, new origin
+        assert doc["SpanCount"] == 2
+        assert doc["Origins"] == ["a", "c"]            # b contributed 0
+
+
+# ---------------------------------------------------------------------------
+# FederationPuller: determinism, peer isolation, throttle, SLO edge
+# ---------------------------------------------------------------------------
+
+
+def _fake_transport(fail=()):
+    """Pure function of (origin, call count) — deterministic scrape
+    bodies; origins in `fail` raise like a dark peer."""
+    calls = {}
+
+    def fetch(origin, url, since_seq):
+        n = calls[origin] = calls.get(origin, 0) + 1
+        if origin in fail:
+            raise ConnectionError(f"{origin} down")
+        return {"Schema": "nomad-tpu.federation.v1", "Origin": origin,
+                "At": float(n), "AppliedIndex": 100 * n,
+                "Counters": {"nomad.heartbeat.missed": float(n)},
+                "Gauges": {"nomad.health.healthy": 1.0,
+                           "nomad.health.breached_rules": 0.0,
+                           "nomad.mem.rss_bytes": 1024.0 * n},
+                "Flight": {"entries": 10 * n},
+                "Memory": {"rss_bytes": 1024 * n},
+                "Follower": None,
+                "Timeline": {"Seq": since_seq, "StepS": 1.0,
+                             "Samples": {}, "Annotations": []}}
+    return fetch
+
+
+class _FakeState:
+    def __init__(self, index=500):
+        self.index = index
+
+    def latest_index(self):
+        return self.index
+
+
+def _cluster_metrics():
+    """The deterministic `nomad.cluster.*` slice of the registry —
+    wall-derived self-metering (scrape_us, scrape_s windows) excluded,
+    like every volatile wall fact."""
+    snap = REGISTRY.snapshot()
+    out = {}
+    for kind in ("counters", "gauges"):
+        for k, v in snap[kind].items():
+            if k.startswith("nomad.cluster.") and "scrape_us" not in k:
+                out[f"{kind}:{k}"] = v
+    return out
+
+
+def _run_scrapes(n=4):
+    REGISTRY.clear_series("nomad.cluster.")
+    clock = VirtualClock()
+    puller = FederationPuller(
+        "s1", targets=lambda: [("s2", "http://s2"), ("s3", "http://s3")],
+        transport=_fake_transport(), clock=clock, state=_FakeState(),
+        interval_s=5.0, min_wall_s=0.0)
+    seq = []
+    for i in range(n):
+        assert puller.sample(5.0 * i)
+        seq.append(json.dumps(_cluster_metrics(), sort_keys=True))
+    return "\n".join(seq).encode()
+
+
+class TestFederationPuller:
+    def test_double_run_is_byte_identical(self):
+        assert _run_scrapes() == _run_scrapes()
+
+    def test_gauges_are_origin_labeled(self):
+        _run_scrapes(n=1)
+        g = REGISTRY.snapshot()["gauges"]
+        assert g["nomad.cluster.applied_index{origin=s2}"] == 100.0
+        assert g["nomad.cluster.applied_index{origin=s3}"] == 100.0
+        assert g["nomad.cluster.peers"] == 2.0
+        assert g["nomad.cluster.peers_ok"] == 2.0
+
+    def test_throttle_follows_the_memledger_discipline(self):
+        puller = FederationPuller(
+            "s1", targets=lambda: [], transport=_fake_transport(),
+            clock=VirtualClock(), interval_s=5.0, min_wall_s=0.0)
+        assert puller.sample(0.0)
+        assert not puller.sample(2.0)      # within interval: suppressed
+        assert puller.sample(5.0)          # due
+        assert puller.sample(-10.0)        # rebound timebase: due
+
+    def test_peer_down_is_counted_never_raised(self):
+        REGISTRY.clear_series("nomad.cluster.")
+        puller = FederationPuller(
+            "s1", targets=lambda: [("s2", "http://s2"),
+                                   ("s3", "http://s3")],
+            transport=_fake_transport(fail=("s3",)),
+            clock=VirtualClock(), state=_FakeState(),
+            interval_s=5.0, min_wall_s=0.0)
+        assert puller.sample(0.0)          # the dark peer must not raise
+        assert REGISTRY.counter("nomad.cluster.scrape_failures",
+                                origin="s3") == 1.0
+        g = REGISTRY.snapshot()["gauges"]
+        assert g["nomad.cluster.peers"] == 2.0
+        assert g["nomad.cluster.peers_ok"] == 1.0
+        row = puller.doc()["Origins"]["s3"]
+        assert not row["Ok"] and "down" in row["Error"]
+
+    def test_follower_registration_merges_and_unregisters(self):
+        puller = FederationPuller(
+            "s1", targets=lambda: [("s2", "http://s2")],
+            transport=_fake_transport(), clock=VirtualClock())
+        puller.register_target("follower-1", "http://f1")
+        assert puller.targets() == [("follower-1", "http://f1"),
+                                    ("s2", "http://s2")]
+        puller.unregister_target("follower-1")
+        assert puller.targets() == [("s2", "http://s2")]
+
+    def test_scrape_failure_trips_the_cluster_slo_once(self):
+        """The cluster_scrape_failures rule is edge-triggered: a peer
+        that STAYS dark breaches on the first check after the failures
+        appear and is not re-counted while the breach persists."""
+        REGISTRY.clear_series("nomad.cluster.")
+        clock = VirtualClock()
+        wd = HealthWatchdog(clock=clock)
+        wd.check(now=0.0)                  # baseline
+        puller = FederationPuller(
+            "s1", targets=lambda: [("s2", "http://s2")],
+            transport=_fake_transport(fail=("s2",)),
+            clock=clock, interval_s=5.0, min_wall_s=0.0)
+        puller.sample(0.0)
+        doc = wd.check(now=60.0)
+        rule = next(r for r in doc["Rules"]
+                    if r["Rule"] == "cluster_scrape_failures")
+        assert not rule["Ok"]
+        breaches = wd.stats["breaches"]
+        puller.sample(65.0)                # still dark: more failures
+        wd.check(now=120.0)
+        assert wd.stats["breaches"] == breaches   # edge-triggered once
+
+    def test_cluster_rules_observe_none_without_federation(self):
+        """Followers and standalone servers never run the puller, so
+        every cluster_* rule observes None (can't breach) until the
+        `nomad.cluster.scrapes` counter moves."""
+        REGISTRY.clear_series("nomad.cluster.")
+        wd = HealthWatchdog(clock=VirtualClock())
+        wd.check(now=0.0)
+        doc = wd.check(now=60.0)
+        for r in doc["Rules"]:
+            if r["Rule"].startswith("cluster_"):
+                assert r["Observed"] is None and r["Ok"], r
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces on a standalone agent (fast)
+# ---------------------------------------------------------------------------
+
+
+class TestStandaloneSurfaces:
+    def test_compact_self_cluster_health_and_bundle(self):
+        ag = Agent(num_clients=1, num_workers=1,
+                   heartbeat_ttl=3600).start()
+        try:
+            api = APIClient(address=ag.address)
+            w, job = _wire_batch_job()
+            api.jobs.register(w)
+            _wait(lambda: api.jobs.allocations(job.id))
+
+            # compact scrape body: msgpack, not JSON
+            with urllib.request.urlopen(
+                    ag.address + "/v1/agent/self?compact=1",
+                    timeout=5) as r:
+                assert r.headers["Content-Type"] == "application/msgpack"
+                doc = wire.unpackb(r.read())
+            assert doc["Schema"] == "nomad-tpu.federation.v1"
+            assert doc["AppliedIndex"] >= 1
+
+            # cluster-health: no federation plane in standalone mode,
+            # cluster rules observe None -> healthy
+            ch = api.operator.cluster_health()
+            assert ch["Healthy"] and ch["Federation"] is None
+            assert {r["Rule"] for r in ch["Rules"]} == {
+                "cluster_scrape_failures", "cluster_follower_lag",
+                "cluster_heartbeat_misses"}
+
+            # the debug bundle carries the (absent) cluster section
+            assert ag.http and "Cluster" in api.operator.debug()
+            assert api.operator.debug()["Cluster"] is None
+
+            # ?cluster=true works standalone: one origin, local spans
+            ev = api.jobs.evaluations(job.id)[0]["ID"]
+            stitched = api.agent.trace(ev, cluster=True)
+            assert stitched["Origins"] == ["local"]
+            assert stitched["SpanCount"] >= 1
+        finally:
+            ag.shutdown()
+
+    def test_follower_gauges_and_announce_latch(self):
+        """Satellite: the read follower publishes `nomad.follower.*`
+        registry gauges (not just HTTP headers), and announces itself
+        to its upstream exactly once per upstream."""
+        leader = Agent(num_clients=1, num_workers=1,
+                       heartbeat_ttl=3600).start()
+        fol = Agent(num_clients=0, num_workers=1, heartbeat_ttl=3600,
+                    follow=leader.address).start()
+        try:
+            api = APIClient(address=leader.address)
+            w, job = _wire_batch_job()
+            api.jobs.register(w)
+            fapi = APIClient(address=fol.address)
+            assert _wait(lambda: any(s["ID"] == job.id
+                                     for s in fapi.jobs.list()),
+                         timeout=15)
+            g = REGISTRY.snapshot()["gauges"]
+            assert g["nomad.follower.applied_index"] >= 1
+            assert g["nomad.follower.last_contact_s"] >= 0.0
+            # announce latched to the current upstream (the standalone
+            # leader has no puller, but the PUT round-trip succeeded).
+            # The latch happens on the same pull that applied the job,
+            # just after the state apply — poll, don't race it.
+            assert _wait(lambda:
+                         fol.follower._announced_to == leader.address,
+                         timeout=15)
+        finally:
+            fol.shutdown()
+            leader.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3-server cluster: stitched traces + cluster health across failover
+# ---------------------------------------------------------------------------
+
+
+def _cluster_trio():
+    a1 = Agent(server_name="fed-s1", bootstrap_expect=3, num_clients=1,
+               num_workers=1, heartbeat_ttl=3600).start()
+    seed = "{}:{}".format(*a1.server.gossip.addr)
+    a2 = Agent(server_name="fed-s2", bootstrap_expect=3, num_clients=0,
+               num_workers=1, heartbeat_ttl=3600, join=[seed]).start()
+    a3 = Agent(server_name="fed-s3", bootstrap_expect=3, num_clients=0,
+               num_workers=1, heartbeat_ttl=3600, join=[seed]).start()
+    agents = [a1, a2, a3]
+    for ag in agents:
+        # shrink the federation cadence so the test doesn't idle
+        # through the production 5 s interval / 0.5 s wall floor
+        ag.server.federation.interval_s = 0.2
+        ag.server.federation.min_wall_s = 0.0
+    return agents
+
+
+@pytest.mark.slow
+class TestClusterFederation:
+    def test_stitch_health_and_failover_reconvergence(self):
+        agents = _cluster_trio()
+        try:
+            leader = _wait(lambda: next(
+                (a for a in agents if a.server.is_leader()), None),
+                timeout=30)
+            assert leader is not None
+            others = [a for a in agents if a is not leader]
+            lapi = APIClient(address=leader.address)
+
+            # register through a NON-leader: the forwarded write is the
+            # cross-origin hop the stitched trace exists to show
+            fapi = APIClient(address=others[0].address)
+            w, job = _wire_batch_job()
+            fapi.jobs.register(w)
+            assert _wait(lambda: lapi.jobs.allocations(job.id),
+                         timeout=30)
+
+            # federation converges: the leader scraped both peers
+            def scraped():
+                doc = lapi.operator.cluster_health()
+                fed = doc.get("Federation") or {}
+                return (len(fed.get("Origins") or {}) >= 2
+                        and fed.get("Scrapes", 0) > 0 and doc)
+            doc = _wait(scraped, timeout=30)
+            assert doc and doc["Healthy"], doc
+            assert all(r["Ok"] for r in doc["Rules"])
+            rows = doc["Federation"]["Origins"]
+            assert all(rows[o]["Ok"] for o in rows), rows
+
+            # the exposition carries the cluster families
+            prom = lapi.agent.metrics(format="prometheus")
+            assert "nomad_cluster_peers" in prom
+            assert "nomad_cluster_applied_index" in prom
+
+            # stitched trace: one joined tree, >= 2 origins
+            ev = fapi.jobs.evaluations(job.id)[0]["ID"]
+            stitched = lapi.agent.trace(ev, cluster=True)
+            assert len(stitched["Origins"]) >= 2, stitched["Origins"]
+            assert stitched["Tree"], "stitched trace has no roots"
+
+            # kill the leader: a new leader's puller takes over and
+            # cluster health re-converges green
+            leader.shutdown()
+            new_leader = _wait(lambda: next(
+                (a for a in others if a.server.is_leader()), None),
+                timeout=30)
+            assert new_leader is not None
+            napi = APIClient(address=new_leader.address)
+
+            def reconverged():
+                doc = napi.operator.cluster_health()
+                fed = doc.get("Federation") or {}
+                rows = fed.get("Origins") or {}
+                live = [o for o, r in rows.items() if r.get("Ok")]
+                return (fed.get("Scrapes", 0) > 0 and live and doc)
+            doc = _wait(reconverged, timeout=30)
+            assert doc, "new leader never scraped"
+            assert doc["Healthy"] or any(
+                not r["Ok"] for r in doc["Rules"]) is False
+        finally:
+            for ag in agents:
+                try:
+                    ag.shutdown()
+                except Exception:
+                    pass

@@ -176,7 +176,9 @@ class TestHealthWatchdog:
         assert doc["Healthy"] and doc["Dumps"] == 0
         assert {r["Rule"] for r in doc["Rules"]} == {
             "p99_plan_queue_ms", "refute_rate", "invalidations_per_s",
-            "networked_ratio", "heartbeat_misses", "rss_mb"}
+            "networked_ratio", "heartbeat_misses", "rss_mb",
+            "cluster_scrape_failures", "cluster_follower_lag",
+            "cluster_heartbeat_misses"}
 
     def test_negative_threshold_disables_rule(self):
         wd, clk, _ = _loaded_watchdog(
@@ -463,7 +465,7 @@ class TestHTTPRoundTrip:
     def test_operator_health(self, api):
         doc = api.operator.health()
         assert doc["Healthy"] is True
-        assert len(doc["Rules"]) == 6
+        assert len(doc["Rules"]) == 9
         for r in doc["Rules"]:
             assert {"Rule", "Kind", "Threshold", "Observed", "Ok",
                     "Unit", "Source"} <= set(r)
